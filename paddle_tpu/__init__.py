@@ -1,0 +1,76 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference: skera666/Paddle), built on JAX/XLA/Pallas.
+
+Public namespace mirrors ``paddle.*`` (reference: python/paddle/__init__.py
+— verify): tensor creation + ~200 tensor ops at top level, plus subpackages
+``nn``, ``optimizer``, ``io``, ``amp``, ``jit``, ``static``, ``distributed``,
+``vision``, ``profiler``, ``metric``, ``incubate``, ``device``, ``autograd``.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import framework
+from .framework import (set_default_dtype, get_default_dtype, seed,
+                        set_device, get_device, CPUPlace, TPUPlace, Place)
+from .tensor import Tensor, Parameter, to_tensor
+from .ops import *                      # noqa: F401,F403 — op table
+from . import ops
+from .autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+from . import autograd
+
+# subpackages (imported lazily-ish but eagerly fine; keep import light)
+from . import nn
+from . import optimizer
+from . import io
+from . import amp
+from . import jit
+from . import distributed
+from . import device
+from . import vision
+from . import metric
+from . import profiler
+from . import incubate
+from . import static
+from . import models
+from . import utils
+from . import hapi
+from .hapi import Model, summary
+
+# paddle API aliases
+from .serialization import save, load
+from .utils.run_check import run_check
+
+disable_static = lambda *a, **k: None   # parity no-op: we are dygraph-first
+enable_static = lambda *a, **k: None
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    import jax
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def in_dynamic_mode() -> bool:
+    return not framework.in_functional_mode()
+
+
+def get_flags(flags=None):
+    from .utils import flags as _f
+    return _f.get_flags(flags)
+
+
+def set_flags(flags):
+    from .utils import flags as _f
+    return _f.set_flags(flags)

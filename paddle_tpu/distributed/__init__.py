@@ -1,0 +1,28 @@
+"""paddle_tpu.distributed — the distributed stack.
+
+Reference parity: python/paddle/distributed/ (fleet, collective
+communication, auto_parallel, launch — verify). TPU-native design
+(SURVEY §2.4/§7): "process group" ≡ (Mesh, axis subset); collectives ≡ XLA
+collectives emitted by GSPMD or explicit shard_map; rendezvous ≡
+jax.distributed.initialize.
+"""
+from .parallel import (init_parallel_env, get_rank, get_world_size,
+                       ParallelEnv, DataParallel)                 # noqa
+from .communication import (all_reduce, all_gather, all_gather_object,
+                            reduce_scatter, broadcast, scatter, reduce,
+                            alltoall, alltoall_single, send, recv, barrier,
+                            new_group, get_group, wait, stream,
+                            ReduceOp, P2POp, batch_isend_irecv, irecv, isend)  # noqa
+from .mesh import (HybridCommunicateGroup, get_hybrid_communicate_group,
+                   build_device_mesh)                             # noqa
+from .auto_parallel_api import (ProcessMesh, shard_tensor, dtensor_from_fn,
+                                reshard, Shard, Replicate, Partial,
+                                Placement, shard_layer, shard_optimizer,
+                                to_static as dist_to_static, DistAttr)  # noqa
+from . import fleet                                               # noqa
+from . import checkpoint                                          # noqa
+from .launch_utils import spawn                                   # noqa
+
+# short aliases matching paddle.distributed.*
+is_initialized = parallel_initialized = \
+    lambda: ParallelEnv().world_size >= 1

@@ -1,0 +1,275 @@
+"""Eager collective communication API.
+
+Reference parity: python/paddle/distributed/communication/ +
+paddle/phi/core/distributed/ProcessGroup* (NCCL) — verify.
+
+TPU-native design: the *perf path* never calls these eagerly — GSPMD emits
+collectives inside jitted programs over the mesh (SURVEY §2.4). This module
+provides the paddle-compatible eager API for host-level coordination and
+tests: across processes it lowers to jax multihost utilities (which run tiny
+XLA collective programs over DCN/ICI); with one process and a sharded
+array, the "group" is a mesh axis and the op runs as a tiny jitted
+shard_map collective."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["ReduceOp", "Group", "all_reduce", "all_gather",
+           "all_gather_object", "reduce_scatter", "broadcast", "scatter",
+           "reduce", "alltoall", "alltoall_single", "send", "recv",
+           "barrier", "new_group", "get_group", "wait", "stream", "P2POp",
+           "batch_isend_irecv", "isend", "irecv"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    def __init__(self, ranks, gid=0, name=None):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.name = name or f"group_{gid}"
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @property
+    def rank(self):
+        pid = jax.process_index()
+        return self.ranks.index(pid) if pid in self.ranks else -1
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, ranks={self.ranks})"
+
+
+_GROUPS: dict[int, Group] = {}
+_NEXT_GID = [1]
+
+
+def _world():
+    if 0 not in _GROUPS:
+        _GROUPS[0] = Group(list(range(jax.process_count())), 0, "world")
+    return _GROUPS[0]
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    gid = _NEXT_GID[0]
+    _NEXT_GID[0] += 1
+    g = Group(ranks if ranks is not None
+              else list(range(jax.process_count())), gid)
+    _GROUPS[gid] = g
+    return g
+
+
+def get_group(gid=0):
+    return _GROUPS.get(gid, _world())
+
+
+def _val(t):
+    return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _single_process() -> bool:
+    return jax.process_count() == 1
+
+
+def _reduce_terms(op, parts):
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        out = sum(parts[1:], parts[0])
+        return out / len(parts) if op == ReduceOp.AVG else out
+    if op == ReduceOp.MAX:
+        return jax.tree.reduce(jnp.maximum, parts)
+    if op == ReduceOp.MIN:
+        return jax.tree.reduce(jnp.minimum, parts)
+    out = parts[0]
+    for p in parts[1:]:
+        out = out * p
+    return out
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    if _single_process():
+        return tensor  # single process: tensor is already global
+    from jax.experimental import multihost_utils
+    v = _val(tensor)
+    gathered = multihost_utils.process_allgather(v)
+    out = _reduce_terms(op, list(gathered))
+    tensor._update_value(out.astype(v.dtype))
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    if _single_process():
+        tensor_list.append(Tensor(_val(tensor)))
+        return tensor_list
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(_val(tensor))
+    for row in gathered:
+        tensor_list.append(Tensor(jnp.asarray(row)))
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    if _single_process():
+        object_list.append(obj)
+        return object_list
+    import pickle
+    from jax.experimental import multihost_utils
+    data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # pad to max length across processes
+    n = np.array([data.size], np.int32)
+    sizes = multihost_utils.process_allgather(jnp.asarray(n))
+    maxn = int(np.max(sizes))
+    padded = np.zeros(maxn, np.uint8)
+    padded[:data.size] = data
+    rows = multihost_utils.process_allgather(jnp.asarray(padded))
+    for row, size in zip(rows, np.asarray(sizes).reshape(-1)):
+        object_list.append(pickle.loads(bytes(np.asarray(row)[:int(size)])))
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    if _single_process():
+        tensor._update_value(_val(tensor_list[0]))
+        return tensor
+    from jax.experimental import multihost_utils
+    stacked = jnp.stack([_val(t) for t in tensor_list])
+    summed = multihost_utils.process_allgather(stacked)
+    total = _reduce_terms(op, list(summed))
+    tensor._update_value(total[jax.process_index()])
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    if _single_process():
+        return tensor
+    from jax.experimental import multihost_utils
+    v = multihost_utils.broadcast_one_to_all(
+        _val(tensor), is_source=jax.process_index() == src)
+    tensor._update_value(jnp.asarray(v))
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    all_reduce(tensor, op, group, sync_op)  # reduce-to-all ⊇ reduce
+    return tensor
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if _single_process():
+        if tensor_list:
+            tensor._update_value(_val(tensor_list[0]))
+        return tensor
+    from jax.experimental import multihost_utils
+    stacked = jnp.stack([_val(t) for t in tensor_list]) if tensor_list \
+        else jnp.zeros((jax.process_count(),) + tuple(tensor.shape),
+                       tensor.dtype)
+    v = multihost_utils.broadcast_one_to_all(
+        stacked, is_source=jax.process_index() == src)
+    tensor._update_value(jnp.asarray(v)[jax.process_index()])
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    if out_tensor_list is None:
+        out_tensor_list = []
+    if _single_process():
+        out_tensor_list.extend(Tensor(_val(t)) for t in in_tensor_list)
+        return out_tensor_list
+    from jax.experimental import multihost_utils
+    stacked = jnp.stack([_val(t) for t in in_tensor_list])
+    rows = multihost_utils.process_allgather(stacked)  # (P, P, ...)
+    me = jax.process_index()
+    for p in range(jax.process_count()):
+        out_tensor_list.append(Tensor(jnp.asarray(rows[p][me])))
+    return out_tensor_list
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    parts = jnp.split(_val(in_tensor),
+                      jax.process_count() if _single_process() is False
+                      else 1)
+    outs = alltoall([Tensor(p) for p in parts])
+    res = jnp.concatenate([_val(t) for t in outs])
+    if out_tensor is not None:
+        out_tensor._update_value(res)
+        return out_tensor
+    return Tensor(res)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv across processes uses the launch-level "
+        "store; inside compiled programs use shard_map ppermute "
+        "(paddle_tpu.distributed.fleet.meta_parallel pipeline)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "see send(): use ppermute inside compiled programs")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+@dataclasses.dataclass
+class P2POp:
+    op: object
+    tensor: object
+    peer: int
+    group: object = None
+
+
+def batch_isend_irecv(p2p_op_list):
+    raise NotImplementedError(
+        "host-level batched p2p: planned with the C++ store backend; "
+        "compiled pipelines use ppermute schedules instead")
+
+
+def barrier(group=None):
+    if _single_process():
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    v = _val(tensor)
+    if hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+
+
+class stream:
+    """paddle.distributed.stream.* namespace: same ops, async handles."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    broadcast = staticmethod(broadcast)
+    alltoall = staticmethod(alltoall)

@@ -1,0 +1,259 @@
+"""Megatron-style TP/SP layers + pipeline scaffolding.
+
+Reference parity: python/paddle/distributed/fleet/layers/mpu/mp_layers.py
+(ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+ParallelCrossEntropy), fleet/meta_parallel/ (PipelineLayer, LayerDesc)
+— verify.
+
+TPU-native design: TP layers are the SAME math as their serial versions
+plus parameter partition specs over the "mp" axis and sharding constraints
+at the boundaries — GSPMD inserts the identity-fwd/allreduce-bwd pair the
+reference implements as custom ops (mp_ops.py c_identity/c_allreduce).
+Sequence parallelism is a constraint over "sep" on the sequence dim."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ... import framework
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from ...param_attr import ParamAttr
+from ...tensor import Tensor, apply_op
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy", "LayerDesc",
+           "SharedLayerDesc", "PipelineLayer", "ScatterOp", "GatherOp",
+           "mark_as_sequence_parallel_parameter", "get_rng_state_tracker"]
+
+
+def _constrain(x, spec: P):
+    """with_sharding_constraint under trace; no-op when not in a mesh ctx."""
+    def f(v):
+        try:
+            return jax.lax.with_sharding_constraint(v, spec)
+        except Exception:
+            return v
+    if framework.in_functional_mode():
+        return apply_op(f, x)
+    return x
+
+
+class ColumnParallelLinear(Layer):
+    """W: (in, out) sharded over "mp" on the OUT dim (reference:
+    mp_layers.py ColumnParallelLinear — verify)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features),
+            attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.XavierNormal())
+        self.weight._sharding_spec = P(None, "mp")
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter((out_features,), is_bias=True)
+            self.bias._sharding_spec = P("mp")
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            out = _constrain(out, P(*([None] * out.ndim)))
+        else:
+            out = _constrain(out, P(*([None] * (out.ndim - 1) + ["mp"])))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """W: (in, out) sharded over "mp" on the IN dim; partial outputs are
+    all-reduced by GSPMD when the constraint demands replication."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features),
+            attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.XavierNormal())
+        self.weight._sharding_spec = P("mp", None)
+        self.weight.is_distributed = True
+        self.bias = self.create_parameter(
+            (out_features,), is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _constrain(x, P(*([None] * (x.ndim - 1) + ["mp"])))
+        out = F.linear(x, self.weight, None)
+        out = _constrain(out, P(*([None] * out.ndim)))  # forces all-reduce
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim),
+            attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Normal(0.0, 1.0))
+        self.weight._sharding_spec = P("mp", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return _constrain(out, P(*([None] * out.ndim)))
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel CE: with logits sharded over "mp" on the class dim,
+    GSPMD turns log_softmax's reductions into mp all-reduces — the manual
+    max/sum allreduce pair of the reference comes for free."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+# ---------------------------------------------------------------------------
+# sequence parallel utils (reference: fleet/utils/sequence_parallel_utils.py)
+# ---------------------------------------------------------------------------
+
+class ScatterOp:
+    """Split activations along seq dim over the mp axis (Megatron-SP)."""
+
+    @staticmethod
+    def apply(x, axis=1):
+        spec = [None] * x.ndim
+        spec[axis] = "mp"
+        return _constrain(x, P(*spec))
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, axis=1):
+        return _constrain(x, P(*([None] * x.ndim)))
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+
+
+class _RNGStateTracker:
+    """TP-aware rng tracker (reference: fleet/layers/mpu/random.py
+    get_rng_state_tracker — verify). With threaded JAX keys, per-region
+    determinism is already per-mesh-position; we keep named seeds."""
+
+    def __init__(self):
+        self._states = {}
+
+    def add(self, name, seed):
+        self._states[name] = jax.random.PRNGKey(seed)
+
+    def rng_state(self, name="model-parallel-rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            if name in self._states:
+                with framework.rng_context(self._states[name]):
+                    yield
+            else:
+                yield
+        return ctx()
+
+
+_RNG_TRACKER = _RNGStateTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_TRACKER
+
+
+# ---------------------------------------------------------------------------
+# pipeline scaffolding
+# ---------------------------------------------------------------------------
+
+class LayerDesc:
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Stage-partitioned sequential model (reference:
+    meta_parallel/parallel_layers/pp_layers.py — verify).
+
+    TPU-native execution: all stages live in ONE program; each segment's
+    parameters carry a stage tag, and the pipelined schedule (1F1B as a
+    lax.scan over microbatches with ppermute between stage-sharded
+    segments) is applied by paddle_tpu.distributed.pipeline.
+    First-cut forward (no pp axis / pp=1) runs segments sequentially."""
+
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 **kwargs):
+        super().__init__()
+        from ...nn.common import LayerList
+        self._descs = list(layers)
+        self.loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        built = []
+        for d in self._descs:
+            if isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            else:
+                built.append(d)
+        self.run_function = LayerList(built)
+        # stage assignment: uniform split
+        n = len(built)
+        per = max(1, n // self._num_stages)
+        self._stage_of = [min(i // per, self._num_stages - 1)
+                          for i in range(n)]
+        for i, l in enumerate(built):
+            if isinstance(l, Layer):
+                for p in l.parameters():
+                    p.pp_stage = self._stage_of[i]
+
+    def get_stage_from_index(self, idx):
+        return self._stage_of[idx]
+
+    def forward(self, x):
+        for fn in self.run_function:
+            x = fn(x)
+        return x

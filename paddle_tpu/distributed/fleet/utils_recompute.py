@@ -1,0 +1,63 @@
+"""Activation recompute (reference:
+python/paddle/distributed/fleet/recompute/recompute.py — PyLayer that
+re-runs forward in backward — verify).
+
+TPU-native design: ``jax.checkpoint`` — the compiler reruns the forward in
+the backward pass, with a policy hook for selective recompute (dots
+saveable). Eager mode just calls through (the tape holds residuals)."""
+from __future__ import annotations
+
+import jax
+
+from ... import framework
+from ...tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              policy=None, **kwargs):
+    if not framework.in_functional_mode():
+        return function(*args, **kwargs)
+
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    vals = tuple(args[i]._value for i in tensor_pos)
+    holder = {}
+
+    def pure(*tvals):
+        full = list(args)
+        for p, v in zip(tensor_pos, tvals):
+            full[p] = Tensor(v)
+        out = function(*full, **kwargs)
+        leaves, tree = jax.tree.flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        holder["tree"] = tree
+        return tuple(l._value if isinstance(l, Tensor) else l
+                     for l in leaves)
+
+    ckpt_kwargs = {}
+    if policy is not None:
+        ckpt_kwargs["policy"] = policy
+    out_vals = jax.checkpoint(pure, **ckpt_kwargs)(*vals)
+    return jax.tree.unflatten(holder["tree"],
+                              [Tensor(v) for v in out_vals])
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Recompute a Sequential in segments (reference:
+    recompute_sequential — verify). ctx: {"segments": n}."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else ctx
+    funcs = list(functions)
+    seg_size = max(1, len(funcs) // segments)
+
+    def make_seg(fs):
+        def seg_forward(x):
+            for f in fs:
+                x = f(x)
+            return x
+        return seg_forward
+
+    x = args[0]
+    for s in range(0, len(funcs), seg_size):
+        x = recompute(make_seg(funcs[s:s + seg_size]), x)
+    return x

@@ -1,0 +1,50 @@
+"""Process spawn for multi-process tests/training (reference:
+python/paddle/distributed/spawn.py — verify). On TPU a host usually runs
+ONE process owning all local chips, so spawn is mainly for CPU-backend
+multi-process tests (the reference's Gloo-on-CPU pattern, SURVEY §4)."""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Callable
+
+__all__ = ["spawn", "find_free_port"]
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(fn, rank, nprocs, port, args, backend):
+    os.environ["JAX_PLATFORMS"] = backend
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    os.environ["JAX_COORDINATOR_ADDRESS"] = f"127.0.0.1:{port}"
+    os.environ["JAX_PROCESS_ID"] = str(rank)
+    os.environ["JAX_NUM_PROCESSES"] = str(nprocs)
+    fn(*args)
+
+
+def spawn(func: Callable, args=(), nprocs=1, join=True, daemon=False,
+          backend="cpu", **options):
+    ctx = mp.get_context("spawn")
+    port = find_free_port()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, port, args, backend),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode != 0:
+                raise RuntimeError(
+                    f"spawned process exited with code {p.exitcode}")
+    return procs

@@ -1,0 +1,238 @@
+"""Global framework state: grad mode, default dtype, places, RNG.
+
+Reference parity: paddle's dygraph tracer state + ``paddle.seed`` +
+``paddle.set_default_dtype`` (reference: python/paddle/base/framework.py,
+python/paddle/base/core.py — verify). TPU-native design: instead of a C++
+Tracer we keep a tiny amount of host state; randomness is a JAX PRNG key that
+is *threaded* through jitted step functions (see ``rng_context``) so that
+compiled training steps stay pure while eager code keeps Paddle's stateful
+``paddle.seed`` UX.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "set_default_dtype", "get_default_dtype", "seed", "get_rng_key",
+    "split_key", "rng_context", "no_grad_guard", "is_grad_enabled",
+    "set_grad_enabled", "in_functional_mode", "functional_mode",
+    "Place", "CPUPlace", "TPUPlace", "set_device", "get_device",
+    "convert_dtype", "DTYPE_MAP",
+]
+
+# ---------------------------------------------------------------------------
+# dtype handling
+# ---------------------------------------------------------------------------
+
+DTYPE_MAP = {
+    "float32": jnp.float32, "float16": jnp.float16, "bfloat16": jnp.bfloat16,
+    "float64": jnp.float32,  # x64 is disabled JAX-side; degrade to f32
+    "int64": jnp.int32,      # ditto: degrade to i32 (documented divergence)
+    "int32": jnp.int32, "int16": jnp.int16, "int8": jnp.int8,
+    "uint8": jnp.uint8, "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "fp32": jnp.float32, "fp16": jnp.float16, "bf16": jnp.bfloat16,
+}
+
+
+def convert_dtype(dtype: Any):
+    """Normalize a paddle-style dtype spec to a jnp dtype (or None)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in DTYPE_MAP:
+            raise ValueError(f"unsupported dtype string: {dtype!r}")
+        return DTYPE_MAP[dtype]
+    if dtype in (float,):
+        return _state.default_dtype
+    if dtype in (int,):
+        return jnp.int32
+    if dtype in (bool,):
+        return jnp.bool_
+    d = jnp.dtype(dtype)
+    # degrade 64-bit requests (jax x64 disabled; TPU-first)
+    if d == jnp.dtype("float64"):
+        return jnp.float32
+    if d == jnp.dtype("int64"):
+        return jnp.int32
+    return d
+
+
+# ---------------------------------------------------------------------------
+# thread-local framework state
+# ---------------------------------------------------------------------------
+
+class _State(threading.local):
+    def __init__(self):
+        self.grad_enabled: bool = True
+        self.default_dtype = jnp.float32
+        self._rng_key = None           # lazy: creating a key inits a backend
+        self.rng_seed: int = 0
+        self.rng_stack: list = []      # functional-mode threaded keys
+        self.functional: bool = False  # True while compiling a pure step
+        self._device: Optional[str] = None  # lazy: don't touch devices at
+        self.amp_stack: list = []      # import (TPU tunnel is exclusive)
+
+    @property
+    def rng_key(self):
+        if self._rng_key is None:
+            self._rng_key = jax.random.PRNGKey(self.rng_seed)
+        return self._rng_key
+
+    @rng_key.setter
+    def rng_key(self, v):
+        self._rng_key = v
+
+    @property
+    def device(self) -> str:
+        if self._device is None:
+            self._device = "tpu" if any(
+                d.platform != "cpu" for d in jax.devices()) else "cpu"
+        return self._device
+
+    @device.setter
+    def device(self, v: str):
+        self._device = v
+
+
+_state = _State()
+
+
+def state() -> _State:
+    return _state
+
+
+def set_default_dtype(d) -> None:
+    _state.default_dtype = convert_dtype(d)
+
+
+def get_default_dtype() -> str:
+    return jnp.dtype(_state.default_dtype).name
+
+
+# ---------------------------------------------------------------------------
+# grad mode
+# ---------------------------------------------------------------------------
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled and not _state.functional
+
+
+def set_grad_enabled(v: bool) -> None:
+    _state.grad_enabled = bool(v)
+
+
+@contextlib.contextmanager
+def no_grad_guard():
+    prev = _state.grad_enabled
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+def in_functional_mode() -> bool:
+    return _state.functional
+
+
+@contextlib.contextmanager
+def functional_mode():
+    """While active, ops never record onto the eager tape (the surrounding
+    ``jax.grad``/``jax.vjp`` of the step compiler owns differentiation)."""
+    prev = _state.functional
+    _state.functional = True
+    try:
+        yield
+    finally:
+        _state.functional = prev
+
+
+# ---------------------------------------------------------------------------
+# RNG: stateful eager seed + pure threaded keys under jit
+# ---------------------------------------------------------------------------
+
+def seed(n: int) -> None:
+    _state.rng_key = jax.random.PRNGKey(int(n))
+
+
+def get_rng_key():
+    return _state.rng_key
+
+
+def split_key():
+    """One fresh PRNG subkey.
+
+    Eager: split the global key (stateful, matches ``paddle.seed`` UX).
+    Functional mode (inside a compiled step): split the *threaded* key, so
+    the trace derives all randomness from the per-step input key.
+    """
+    if _state.rng_stack:
+        key = _state.rng_stack[-1]
+        key, sub = jax.random.split(key)
+        _state.rng_stack[-1] = key
+        return sub
+    key, sub = jax.random.split(_state.rng_key)
+    _state.rng_key = key
+    return sub
+
+
+@contextlib.contextmanager
+def rng_context(key):
+    """Thread `key` as the RNG source (used by the step compiler)."""
+    _state.rng_stack.append(key)
+    try:
+        yield
+    finally:
+        _state.rng_stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# places / devices
+# ---------------------------------------------------------------------------
+
+class Place:
+    """Device place façade (reference: phi::Place — verify). On TPU the
+    runtime places data via jax default device / shardings; Place is kept for
+    API parity and host/device distinction."""
+
+    def __init__(self, kind: str, index: int = 0):
+        self.kind = kind
+        self.index = index
+
+    def __repr__(self):
+        return f"Place({self.kind}:{self.index})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place) and self.kind == other.kind
+                and self.index == other.index)
+
+
+def CPUPlace() -> Place:
+    return Place("cpu")
+
+
+def TPUPlace(index: int = 0) -> Place:
+    return Place("tpu", index)
+
+
+def set_device(dev: str) -> Place:
+    kind, _, idx = dev.partition(":")
+    if kind in ("gpu", "cuda", "xpu"):  # parity alias: paddle scripts say gpu
+        kind = "tpu"
+    _state.device = kind
+    return Place(kind, int(idx) if idx else 0)
+
+
+def get_device() -> str:
+    return _state.device
+
+
+def default_backend_devices():
+    return jax.devices()

@@ -1,0 +1,162 @@
+"""High-level paddle.Model (reference: python/paddle/hapi/model.py —
+verify): prepare/fit/evaluate/predict/save/load + summary. Training runs
+through the fused TrainStep (one XLA program per step)."""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from ..io import DataLoader
+from ..nn.layer import Layer
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["Model", "summary"]
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+
+    def _make_step(self):
+        from ..jit import TrainStep
+        loss_layer = self._loss
+
+        def loss_fn(model, batch):
+            x, y = batch
+            out = model(x)
+            return loss_layer(out, y)
+        self._train_step = TrainStep(self.network, loss_fn, self._optimizer)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        if self._train_step is None:
+            self._make_step()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        loss = self._train_step((x, y))
+        return [float(loss.item())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        y = labels[0] if isinstance(labels, (list, tuple)) else labels
+        out = self.network(x)
+        loss = self._loss(out, y)
+        self.network.train()
+        return [float(loss.item())], out
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+        out = self.network(x)
+        self.network.train()
+        return out
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        history = []
+        it = 0
+        for epoch in range(epochs):
+            t0 = time.time()
+            losses = []
+            for batch in loader:
+                x, y = batch[0], batch[1]
+                loss = self.train_batch(x, y)
+                losses.append(loss[0])
+                it += 1
+                if verbose and it % log_freq == 0:
+                    print(f"epoch {epoch} step {it}: "
+                          f"loss={np.mean(losses[-log_freq:]):.4f}")
+                if num_iters is not None and it >= num_iters:
+                    break
+            history.append(float(np.mean(losses)))
+            if verbose:
+                print(f"epoch {epoch}: loss={history[-1]:.4f} "
+                      f"({time.time() - t0:.1f}s)")
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, f"epoch_{epoch}"))
+            if num_iters is not None and it >= num_iters:
+                break
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size)
+        losses = []
+        for batch in loader:
+            loss, _ = self.eval_batch(batch[0], batch[1])
+            losses.append(loss[0])
+        res = {"loss": [float(np.mean(losses))]}
+        if verbose:
+            print(f"eval loss: {res['loss'][0]:.4f}")
+        return res
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size)
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x))
+        return outs
+
+    def save(self, path, training=True):
+        from ..serialization import save
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..serialization import load
+        state = load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network, input_size)
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Parameter-count table (reference: paddle.summary — verify)."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = p.size
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<24}{'Param #':>12}"]
+    lines += [f"{r[0]:<{width}}{str(r[1]):<24}{r[2]:>12,}" for r in rows]
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    out = "\n".join(lines)
+    print(out)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,4 @@
+"""paddle_tpu.incubate (reference: python/paddle/incubate/ — verify):
+fused transformer ops, MoE, flash attention wrappers."""
+from . import nn          # noqa: F401
+from . import distributed  # noqa: F401

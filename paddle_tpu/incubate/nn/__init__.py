@@ -1,0 +1,5 @@
+"""Fused layers (reference: python/paddle/incubate/nn/ — verify). On TPU
+"fused" means one jit region + Pallas attention; the layer API is kept."""
+from .functional import fused_multi_head_attention, fused_feedforward  # noqa
+from .layers import FusedMultiHeadAttention, FusedFeedForward          # noqa
+from . import functional                                               # noqa

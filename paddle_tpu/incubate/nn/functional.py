@@ -1,0 +1,130 @@
+"""Fused transformer functional ops (reference:
+python/paddle/incubate/nn/functional/fused_transformer.py — verify).
+XLA fuses these chains; flash attention uses the Pallas kernel."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn import functional as F
+from ...tensor import Tensor
+
+__all__ = ["fused_multi_head_attention", "fused_feedforward",
+           "fused_linear", "fused_rms_norm", "fused_rotary_position_embedding",
+           "flash_attention"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    from ...ops.math import matmul
+    out = matmul(x, weight, transpose_y=transpose_weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True):
+    """RoPE applied to q/k (reference: fused_rope — verify). q/k:
+    (b, s, h, d). sin/cos: (1, s, 1, d) or (s, d)."""
+    from ...tensor import apply_op
+
+    def rope(t, sin_v, cos_v):
+        if sin_v.ndim == 2:
+            sin_v = sin_v[None, :, None, :]
+            cos_v = cos_v[None, :, None, :]
+        if use_neox_rotary_style:
+            half = t.shape[-1] // 2
+            t1, t2 = t[..., :half], t[..., half:]
+            rotated = jnp.concatenate([-t2, t1], axis=-1)
+        else:
+            t1 = t[..., 0::2]
+            t2 = t[..., 1::2]
+            rotated = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+        return t * cos_v + rotated * sin_v
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+        else:
+            outs.append(apply_op(
+                lambda tv, sv, cv: rope(tv, sv, cv), t, sin, cos))
+    return tuple(outs)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-05, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.5,
+                               attn_dropout_rate=0.5, ln_epsilon=1e-05,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, num_heads=None,
+                               name=None):
+    from ...ops.math import matmul
+    from ...ops.manipulation import reshape, transpose
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    b, s, d = x.shape
+    # qkv_weight: (3, num_heads, head_dim, d) — paddle layout
+    nh = qkv_weight.shape[1]
+    hd = qkv_weight.shape[2]
+    w = reshape(qkv_weight, (3 * nh * hd, d))
+    qkv = matmul(x, w, transpose_y=True)
+    if qkv_bias is not None:
+        qkv = qkv + reshape(qkv_bias, (3 * nh * hd,))
+    qkv = reshape(qkv, (b, s, 3, nh, hd))
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = F.scaled_dot_product_attention(q, k, v, attn_mask,
+                                         attn_dropout_rate, False, training)
+    out = reshape(out, (b, s, nh * hd))
+    out = matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], ln_scale, ln_bias,
+                           ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, ring_id=-1,
+                      name=None):
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], ln1_scale, ln1_bias, ln1_epsilon)
+    out = F.linear(x, linear1_weight, linear1_bias)
+    out = getattr(F, activation)(out)
+    out = F.dropout(out, dropout1_rate, training=training)
+    out = F.linear(out, linear2_weight, linear2_bias)
+    out = F.dropout(out, dropout2_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, **kwargs):
+    return F.flash_attention(query, key, value, dropout, causal,
+                             return_softmax)

@@ -1,0 +1,162 @@
+"""Metrics (reference: python/paddle/metric/metrics.py — verify)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    import jax.numpy as jnp
+    from ..tensor import apply_op
+
+    def f(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        lab_ = lab.reshape(lab.shape[0], -1)[:, :1]
+        hit = jnp.any(topk == lab_, axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return apply_op(f, input, label)
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self.__class__.__name__.lower()
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def compute(self, pred, label):
+        p = np.asarray(pred._value if isinstance(pred, Tensor) else pred)
+        l = np.asarray(label._value if isinstance(label, Tensor) else label)
+        l = l.reshape(l.shape[0], -1)[:, :1]
+        maxk = max(self.topk)
+        topk = np.argsort(-p, axis=-1)[..., :maxk]
+        corrects = (topk == l)
+        return Tensor(jnp.asarray(corrects.astype(np.float32)))
+
+    def update(self, correct):
+        c = np.asarray(correct._value if isinstance(correct, Tensor)
+                       else correct)
+        for i, k in enumerate(self.topk):
+            self.total[i] += float(c[..., :k].sum())
+            self.count[i] += c.shape[0]
+        return self.accumulate()
+
+    def accumulate(self):
+        res = [t / c if c else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        return self._name
+
+
+class Precision(Metric):
+    def __init__(self, name=None):
+        self._name = name or "precision"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (np.asarray(preds._value if isinstance(preds, Tensor)
+                        else preds) > 0.5).astype(int).reshape(-1)
+        l = np.asarray(labels._value if isinstance(labels, Tensor)
+                       else labels).astype(int).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        self._name = name or "recall"
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (np.asarray(preds._value if isinstance(preds, Tensor)
+                        else preds) > 0.5).astype(int).reshape(-1)
+        l = np.asarray(labels._value if isinstance(labels, Tensor)
+                       else labels).astype(int).reshape(-1)
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        self._name = name or "auc"
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        p = np.asarray(preds._value if isinstance(preds, Tensor) else preds)
+        l = np.asarray(labels._value if isinstance(labels, Tensor)
+                       else labels).reshape(-1)
+        pos_prob = p[:, 1] if p.ndim == 2 and p.shape[1] == 2 else \
+            p.reshape(-1)
+        bins = np.minimum((pos_prob * self.num_thresholds).astype(int),
+                          self.num_thresholds)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        area = 0.0
+        pos = neg = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            p_, n_ = self._stat_pos[i], self._stat_neg[i]
+            area += n_ * (pos + p_ / 2.0)
+            pos += p_
+            neg += n_
+        return area / (tot_pos * tot_neg)
+
+    def name(self):
+        return self._name

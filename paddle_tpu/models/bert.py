@@ -1,0 +1,123 @@
+"""BERT-base (reference config: BASELINE "BERT-base pretraining, data
+parallel"; model lives in PaddleNLP upstream — in-repo equivalent here).
+Uses the framework's TransformerEncoder; MLM+NSP pretraining heads."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.creation import arange, zeros
+from ..ops.manipulation import reshape, unsqueeze
+from ..tensor import Tensor, apply_op
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "bert_base_config", "bert_tiny_config"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+
+def bert_base_config(**kw):
+    return BertConfig(**kw)
+
+
+def bert_tiny_config(**kw):
+    return BertConfig(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=256,
+                      max_position_embeddings=128, **kw)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        b, s = input_ids.shape
+        pos = arange(s, dtype="int32")
+        x = self.word_embeddings(input_ids)
+        x = x + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        enc_layer = nn.TransformerEncoderLayer(
+            config.hidden_size, config.num_attention_heads,
+            config.intermediate_size, config.hidden_dropout_prob,
+            config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            layer_norm_eps=config.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(enc_layer,
+                                             config.num_hidden_layers)
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # (b, s) 1/0 mask → additive (b, 1, 1, s)
+            def to_additive(m):
+                return (1.0 - m.astype(jnp.float32))[:, None, None, :] * \
+                    jnp.finfo(jnp.float32).min
+            attention_mask = apply_op(to_additive, attention_mask)
+        seq = self.encoder(x, attention_mask)
+        pooled = F.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertForPretraining(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.mlm_transform = nn.Linear(config.hidden_size,
+                                       config.hidden_size)
+        self.mlm_norm = nn.LayerNorm(config.hidden_size,
+                                     config.layer_norm_eps)
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+        self.config = config
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = F.gelu(self.mlm_transform(seq))
+        h = self.mlm_norm(h)
+        from ..ops.math import matmul
+        logits = matmul(h, self.bert.embeddings.word_embeddings.weight,
+                        transpose_y=True)
+        nsp_logits = self.nsp_head(pooled)
+        if masked_lm_labels is None:
+            return logits, nsp_logits
+        mlm_loss = F.cross_entropy(logits, masked_lm_labels,
+                                   ignore_index=-100)
+        loss = mlm_loss
+        if next_sentence_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits, next_sentence_labels)
+        return loss, logits

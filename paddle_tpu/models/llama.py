@@ -1,0 +1,214 @@
+"""Llama-2 family — the flagship pretrain model.
+
+Reference parity: PaddleNLP's LlamaForCausalLM trained via Fleet TP×PP
+(the BASELINE "Llama-2 7B/13B" config; model lives in the ecosystem repo
+— SURVEY §1 requires an in-repo equivalent).
+
+TPU-native design: attention in bshd layout through
+scaled_dot_product_attention (Pallas flash kernel on TPU), RoPE precomputed
+as buffers, RMSNorm in fp32, SwiGLU MLP. Tensor parallelism = partition
+specs on weights (Column/Row pattern over "mp"), sequence parallelism =
+constraints over "sep" on the seq dim; the pipeline axis is applied by the
+trainer splitting `layers` into stages."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.creation import arange, zeros
+from ..ops.manipulation import concat, reshape, transpose
+from ..tensor import Tensor, apply_op
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "llama_tiny_config", "llama_7b_config", "llama_13b_config"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    tensor_parallel: bool = True        # attach "mp" partition specs
+    sequence_parallel: bool = False     # constrain activations over "sep"
+    dtype: str = "float32"
+
+
+def llama_tiny_config(**kw):
+    return LlamaConfig(vocab_size=512, hidden_size=128,
+                       intermediate_size=384, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=4,
+                       max_position_embeddings=256, **kw)
+
+
+def llama_7b_config(**kw):
+    return LlamaConfig(**kw)
+
+
+def llama_13b_config(**kw):
+    return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                       num_hidden_layers=40, num_attention_heads=40,
+                       num_key_value_heads=40, **kw)
+
+
+def _rope_cache(config: LlamaConfig):
+    head_dim = config.hidden_size // config.num_attention_heads
+    inv = 1.0 / (config.rope_theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(config.max_position_embeddings, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)                       # (S, D/2)
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # (S, D)
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _apply_rope(q, k, cos, sin, offset=0):
+    """q/k: (b, s, h, d); neox-style rotate-half."""
+    def rope(t):
+        s = t.shape[1]
+        c = cos[offset:offset + s][None, :, None, :].astype(t.dtype)
+        sn = sin[offset:offset + s][None, :, None, :].astype(t.dtype)
+        half = t.shape[-1] // 2
+        t1, t2 = t[..., :half], t[..., half:]
+        rot = jnp.concatenate([-t2, t1], axis=-1)
+        return t * c + rot * sn
+    return rope(q), rope(k)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        h = config.hidden_size
+        kv = self.num_kv_heads * self.head_dim
+        self.q_proj = nn.Linear(h, h, bias_attr=False)
+        self.k_proj = nn.Linear(h, kv, bias_attr=False)
+        self.v_proj = nn.Linear(h, kv, bias_attr=False)
+        self.o_proj = nn.Linear(h, h, bias_attr=False)
+        if config.tensor_parallel:
+            for l in (self.q_proj, self.k_proj, self.v_proj):
+                l.weight._sharding_spec = P(None, "mp")
+            self.o_proj.weight._sharding_spec = P("mp", None)
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        b, s, _ = x.shape
+        q = reshape(self.q_proj(x), (b, s, self.num_heads, self.head_dim))
+        k = reshape(self.k_proj(x), (b, s, self.num_kv_heads, self.head_dim))
+        v = reshape(self.v_proj(x), (b, s, self.num_kv_heads, self.head_dim))
+        q, k = apply_op(lambda qv, kv_: _apply_rope(qv, kv_, cos, sin), q, k)
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask,
+                                             is_causal=attn_mask is None)
+        out = reshape(out, (b, s, self.num_heads * self.head_dim))
+        return self.o_proj(out)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, ff = config.hidden_size, config.intermediate_size
+        self.gate_proj = nn.Linear(h, ff, bias_attr=False)
+        self.up_proj = nn.Linear(h, ff, bias_attr=False)
+        self.down_proj = nn.Linear(ff, h, bias_attr=False)
+        if config.tensor_parallel:
+            self.gate_proj.weight._sharding_spec = P(None, "mp")
+            self.up_proj.weight._sharding_spec = P(None, "mp")
+            self.down_proj.weight._sharding_spec = P("mp", None)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+        self._seq_parallel = config.sequence_parallel
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        h = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
+        out = h + self.mlp(self.post_attention_layernorm(h))
+        if self._seq_parallel:
+            from ..distributed.fleet.meta_parallel import _constrain
+            out = _constrain(out, P(None, "sep", None))
+        return out
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size)
+        if config.tensor_parallel:
+            self.embed_tokens.weight._sharding_spec = P("mp", None)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        cos, sin = _rope_cache(config)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        cos, sin = self.rope_cos._value, self.rope_sin._value
+        for layer in self.layers:
+            x = layer(x, cos, sin, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                     bias_attr=False)
+            if config.tensor_parallel:
+                self.lm_head.weight._sharding_spec = P(None, "mp")
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.llama(input_ids, attn_mask)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            from ..ops.math import matmul
+            logits = matmul(h, self.llama.embed_tokens.weight,
+                            transpose_y=True)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits, labels, reduction="mean")
+        return loss, logits
+
+    def num_params(self):
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self, seq_len):
+        """~6N + attention flops per token (for MFU accounting)."""
+        n = self.num_params()
+        c = self.config
+        attn = 12 * c.num_hidden_layers * c.hidden_size * seq_len
+        return 6 * n + attn

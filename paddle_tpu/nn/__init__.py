@@ -1,0 +1,16 @@
+"""paddle_tpu.nn — layers namespace (reference: python/paddle/nn/__init__.py
+— verify)."""
+from .layer import Layer                      # noqa: F401
+from . import functional                      # noqa: F401
+from . import initializer                     # noqa: F401
+from .common import *                         # noqa: F401,F403
+from .conv import *                           # noqa: F401,F403
+from .norm import *                           # noqa: F401,F403
+from .pooling import *                        # noqa: F401,F403
+from .loss import *                           # noqa: F401,F403
+from .transformer import *                    # noqa: F401,F403
+from .rnn import *                            # noqa: F401,F403
+
+from ..param_attr import ParamAttr            # noqa: F401
+
+from . import common, conv, norm, pooling, loss, transformer, rnn  # noqa

@@ -1,0 +1,946 @@
+"""nn.functional: activations, linear/conv/pool, norms, losses, attention.
+
+Reference parity: python/paddle/nn/functional/ — verify. All ops lower to
+jnp/lax (conv → lax.conv_general_dilated on the MXU; pooling →
+lax.reduce_window; resize → jax.image). Attention delegates to
+paddle_tpu.ops.pallas flash-attention when available.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype
+from ..tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    "linear", "embedding", "one_hot",
+    "relu", "relu_", "relu6", "leaky_relu", "elu", "selu", "celu", "gelu",
+    "silu", "swish", "mish", "hardswish", "hardsigmoid", "hardtanh",
+    "hardshrink", "softshrink", "tanhshrink", "softplus", "softsign",
+    "sigmoid", "tanh", "log_sigmoid", "prelu", "glu", "gumbel_softmax",
+    "softmax", "log_softmax", "maxout",
+    "layer_norm", "batch_norm", "instance_norm", "group_norm", "rms_norm",
+    "normalize",
+    "conv1d", "conv2d", "conv3d", "conv2d_transpose", "conv1d_transpose",
+    "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
+    "avg_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_max_pool2d",
+    "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "nll_loss", "kl_div", "margin_ranking_loss",
+    "sigmoid_focal_loss", "square_error_cost", "label_smooth",
+    "scaled_dot_product_attention", "flash_attention",
+    "interpolate", "upsample", "pixel_shuffle", "channel_shuffle",
+    "cosine_similarity", "pairwise_distance", "pad", "unfold", "sequence_mask",
+]
+
+from ..ops.manipulation import pad, unfold  # re-export paddle-style
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+
+def linear(x, weight, bias=None, name=None):
+    def f(a, w, *b):
+        from ..amp import get_amp_dtype
+        d = get_amp_dtype()
+        if d is not None:
+            a, w = a.astype(d), w.astype(d)
+        out = a @ w
+        if b:
+            out = out + (b[0].astype(d) if d is not None else b[0])
+        return out
+    if bias is None:
+        return apply_op(f, x, weight)
+    return apply_op(f, x, weight, bias)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        return out
+    return apply_op(f, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    from ..ops.creation import one_hot as _oh
+    return _oh(x, num_classes)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+def _act(fn):
+    def op(x, name=None):
+        return apply_op(fn, x)
+    return op
+
+
+relu = _act(jax.nn.relu)
+relu6 = _act(jax.nn.relu6)
+sigmoid = _act(jax.nn.sigmoid)
+tanh = _act(jnp.tanh)
+softplus_j = jax.nn.softplus
+log_sigmoid = _act(jax.nn.log_sigmoid)
+silu = _act(jax.nn.silu)
+softsign = _act(jax.nn.soft_sign)
+mish = _act(lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+tanhshrink = _act(lambda v: v - jnp.tanh(v))
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda v: jax.nn.leaky_relu(v, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.elu(v, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(
+        lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.celu(v, alpha), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda v: jax.nn.gelu(v, approximate=approximate), x)
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda v: v * jnp.clip(v + 3, 0, 6) / 6, x)
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return apply_op(lambda v: jnp.clip(v * slope + offset, 0, 1), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda v: jnp.clip(v, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v - threshold,
+                            jnp.where(v < -threshold, v + threshold, 0.0)), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply_op(
+        lambda v: jnp.where(v * beta > threshold, v,
+                            jax.nn.softplus(v * beta) / beta), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+    return apply_op(f, x, weight)
+
+
+def glu(x, axis=-1, name=None):
+    def f(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return apply_op(f, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        c = v.shape[axis]
+        new_shape = list(v.shape)
+        new_shape[axis] = c // groups
+        new_shape.insert(axis + 1, groups)
+        return jnp.max(v.reshape(new_shape), axis=axis + 1)
+    return apply_op(f, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    d = convert_dtype(dtype)
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+    return apply_op(f, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    d = convert_dtype(dtype)
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply_op(f, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = framework.split_key()
+
+    def f(v):
+        g = jax.random.gumbel(key, v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:  # straight-through: hard forward, soft gradient
+            idx = jnp.argmax(y, axis=axis)
+            y_hard = jax.nn.one_hot(idx, v.shape[axis], axis=axis,
+                                    dtype=v.dtype)
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+    return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# norms
+# ---------------------------------------------------------------------------
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    ndim = len(tuple(normalized_shape))
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - ndim, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(f, x, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
+    """TPU-first: fused by XLA; Pallas kernel available in ops.pallas."""
+    def f(v, *w):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=axis,
+                      keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(
+            v.dtype)
+        if w:
+            out = out * w[0]
+        return out
+    args = [weight] if weight is not None else []
+    return apply_op(f, x, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    use_batch_stats = training and not use_global_stats
+
+    def stats_shape(v):
+        s = [1] * v.ndim
+        s[ch_axis] = v.shape[ch_axis]
+        return s
+
+    if use_batch_stats:
+        # compute batch stats; update running stats in-place (buffer update)
+        def f(v, *wb):
+            axes = tuple(i for i in range(v.ndim) if i != ch_axis % v.ndim)
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+            out = (v - mean.reshape(stats_shape(v))) * jax.lax.rsqrt(
+                var.reshape(stats_shape(v)) + epsilon)
+            i = 0
+            if weight is not None:
+                out = out * wb[i].reshape(stats_shape(v))
+                i += 1
+            if bias is not None:
+                out = out + wb[i].reshape(stats_shape(v))
+            return out, mean, var
+        args = [a for a in (weight, bias) if a is not None]
+        out, bmean, bvar = apply_op(f, x, *args)
+        # running-stat update (momentum convention: paddle's)
+        n = int(np.prod([x.shape[i] for i in range(x.ndim)
+                         if i != ch_axis % x.ndim]))
+        unbiased = n / max(n - 1, 1)
+        running_mean._update_value(
+            running_mean._value * momentum + bmean._value * (1 - momentum))
+        running_var._update_value(
+            running_var._value * momentum +
+            bvar._value * unbiased * (1 - momentum))
+        return out
+
+    def g(v, m, va, *wb):
+        out = (v - m.reshape(stats_shape(v))) * jax.lax.rsqrt(
+            va.reshape(stats_shape(v)) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(stats_shape(v))
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(stats_shape(v))
+        return out
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(g, x, running_mean, running_var, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-05, data_format="NCHW", name=None):
+    def f(v, *wb):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        i = 0
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(f, x, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(v, *wb):
+        if data_format != "NCHW":
+            v = jnp.moveaxis(v, -1, 1)
+        n, c = v.shape[0], v.shape[1]
+        g = v.reshape((n, num_groups, c // num_groups) + v.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, c] + [1] * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if data_format != "NCHW":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = [a for a in (weight, bias) if a is not None]
+    return apply_op(f, x, *args)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        n = jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True)
+        return v / jnp.maximum(n, epsilon)
+    return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# conv
+# ---------------------------------------------------------------------------
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(e) for e in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, nd, stride, kernel, dilation):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nd)]
+    return [tuple(p) for p in padding]
+
+
+def _convnd(x, weight, bias, stride, padding, dilation, groups, nd,
+            data_format):
+    strides = _pair(stride, nd)
+    dils = _pair(dilation, nd)
+    chan_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spec = {1: ("NCH", "OIH", "NCH") if not chan_last else
+               ("NHC", "OIH", "NHC"),
+            2: ("NCHW", "OIHW", "NCHW") if not chan_last else
+               ("NHWC", "OIHW", "NHWC"),
+            3: ("NCDHW", "OIDHW", "NCDHW") if not chan_last else
+               ("NDHWC", "OIDHW", "NDHWC")}[nd]
+    kshape = weight.shape[2:]
+    pad_arg = _conv_padding(padding, nd, strides, kshape, dils)
+
+    def f(v, w, *b):
+        out = jax.lax.conv_general_dilated(
+            v, w, window_strides=strides, padding=pad_arg,
+            rhs_dilation=dils, dimension_numbers=spec,
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32
+            if v.dtype == jnp.bfloat16 else None)
+        if v.dtype == jnp.bfloat16:
+            out = out.astype(v.dtype)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[1 if not chan_last else -1] = b[0].size
+            out = out + b[0].reshape(bias_shape)
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op(f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 1,
+                   "NCH" if data_format == "NCL" else "NHC")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 2,
+                   data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _convnd(x, weight, bias, stride, padding, dilation, groups, 3,
+                   data_format)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCHW", output_size=None, name=None):
+    strides = _pair(stride, 2)
+    dils = _pair(dilation, 2)
+    pads = _conv_padding(padding, 2, strides, weight.shape[2:], dils)
+    if isinstance(pads, str):
+        pad_arg = pads
+    else:
+        pad_arg = pads
+
+    def f(v, w, *b):
+        # weight layout (in, out/groups, kh, kw) — paddle conv_transpose
+        out = jax.lax.conv_transpose(
+            v, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
+            strides=strides, padding=pad_arg if isinstance(pad_arg, str)
+            else [(p[0], p[1]) for p in pad_arg],
+            rhs_dilation=dils,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            transpose_kernel=True)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1)
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op(f, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCL", name=None):
+    x4 = apply_op(lambda v: v[:, :, None, :], x)
+    w4 = apply_op(lambda v: v[:, :, None, :], weight)
+    out = conv2d_transpose(x4, w4, bias, (1, _pair(stride, 1)[0]),
+                           (0, _pair(padding, 1)[0]), output_padding, groups,
+                           (1, _pair(dilation, 1)[0]))
+    return apply_op(lambda v: v[:, :, 0, :], out)
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+def _pool(x, kernel, stride, padding, nd, op, include_pad=False,
+          ceil_mode=False):
+    ks = _pair(kernel, nd)
+    st = _pair(stride if stride is not None else kernel, nd)
+    pd = _conv_padding(padding, nd, st, ks, (1,) * nd)
+    if isinstance(pd, str):
+        pads = pd
+    else:
+        pads = [(0, 0), (0, 0)] + list(pd)
+    window = (1, 1) + ks
+    strides = (1, 1) + st
+
+    if op == "max":
+        def f(v):
+            return jax.lax.reduce_window(
+                v, -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+                else jnp.iinfo(v.dtype).min,
+                jax.lax.max, window, strides, pads)
+        return f
+    else:
+        def f(v):
+            s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
+                                      pads)
+            if include_pad or (isinstance(pads, str) and pads == "VALID") or (
+                    not isinstance(pads, str)
+                    and all(p == (0, 0) for p in pads)):
+                denom = float(np.prod(ks))
+                return s / denom
+            ones = jnp.ones_like(v)
+            denom = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                          strides, pads)
+            return s / denom
+        return f
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return apply_op(_pool(x, kernel_size, stride, padding, 2, "max"), x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    x4 = apply_op(lambda v: v[:, :, None, :], x)
+    out = apply_op(_pool(x4, (1, _pair(kernel_size, 1)[0]),
+                         (1, _pair(stride if stride is not None else
+                                   kernel_size, 1)[0]),
+                         (0, _pair(padding, 1)[0]), 2, "max"), x4)
+    return apply_op(lambda v: v[:, :, 0, :], out)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    return apply_op(_pool(x, kernel_size, stride, padding, 3, "max"), x)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return apply_op(_pool(x, kernel_size, stride, padding, 2, "avg",
+                          include_pad=not exclusive), x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    x4 = apply_op(lambda v: v[:, :, None, :], x)
+    out = apply_op(_pool(x4, (1, _pair(kernel_size, 1)[0]),
+                         (1, _pair(stride if stride is not None else
+                                   kernel_size, 1)[0]),
+                         (0, _pair(padding, 1)[0]), 2, "avg",
+                         include_pad=not exclusive), x4)
+    return apply_op(lambda v: v[:, :, 0, :], out)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return apply_op(_pool(x, kernel_size, stride, padding, 3, "avg",
+                          include_pad=not exclusive), x)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    os = _pair(output_size, 2)
+
+    def f(v):
+        n, c, h, w = v.shape
+        oh, ow = os
+        v2 = v.reshape(n, c, oh, h // oh, ow, w // ow) if h % oh == 0 and \
+            w % ow == 0 else None
+        if v2 is not None:
+            return jnp.mean(v2, axis=(3, 5))
+        return jax.image.resize(v, (n, c, oh, ow), method="linear")
+    return apply_op(f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    def f(v):
+        n, c, l = v.shape
+        o = output_size if isinstance(output_size, int) else output_size[0]
+        if l % o == 0:
+            return jnp.mean(v.reshape(n, c, o, l // o), axis=3)
+        return jax.image.resize(v, (n, c, o), method="linear")
+    return apply_op(f, x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    os = _pair(output_size, 2)
+
+    def f(v):
+        n, c, h, w = v.shape
+        oh, ow = os
+        return jnp.max(v.reshape(n, c, oh, h // oh, ow, w // ow),
+                       axis=(3, 5))
+    return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else to_tensor(x)
+    key = framework.split_key()
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+    return apply_op(f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return dropout(x, p, axis=[0, 1] if data_format == "NCHW" else [0, 3],
+                   training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    return dropout(x, p, axis=[0, 1] if data_format == "NCDHW" else [0, 4],
+                   training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = framework.split_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(v):
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2))) \
+            if (1 - p) > 0 else 1.0
+        b = -a * alpha_p * p
+        return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
+    return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    def f(logits, lab, *w):
+        nclass = logits.shape[axis]
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label or (lab.ndim == logits.ndim and
+                          lab.shape == logits.shape):
+            soft = lab.astype(logp.dtype)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+            loss = -jnp.sum(soft * logp, axis=axis)
+            return _reduce(loss, reduction)
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logp.ndim:
+            lab_i = jnp.squeeze(lab_i, axis)
+        onehot = jax.nn.one_hot(lab_i, nclass, axis=axis, dtype=logp.dtype)
+        if label_smoothing > 0:
+            onehot = onehot * (1 - label_smoothing) + label_smoothing / nclass
+        loss = -jnp.sum(onehot * logp, axis=axis)
+        valid = (lab_i != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.clip(lab_i, 0, nclass - 1))
+            loss = loss * wt
+            if reduction == "mean":
+                denom = jnp.sum(jnp.where(valid, wt, 0.0))
+                return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        if reduction == "mean":
+            denom = jnp.sum(valid.astype(loss.dtype))
+            return jnp.sum(loss) / jnp.maximum(denom, 1.0)
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    loss = apply_op(lambda v: v[..., None] if v.ndim == logits.ndim - 1
+                    else v, loss)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]
+            i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)) with pos_weight variant
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, label] + [a for a in (weight, pos_weight) if a is not None]
+    return apply_op(f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce((a - b) ** 2, reduction),
+                    input, label)
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: (a - b) ** 2, input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply_op(f, input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def f(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        nclass = logp.shape[1]
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(jnp.clip(lab_i, 0, nclass - 1), 1),
+            axis=1).squeeze(1)
+        loss = -picked
+        valid = lab_i != ignore_index
+        loss = jnp.where(valid, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.clip(lab_i, 0, nclass - 1))
+            loss = jnp.where(valid, loss * wt, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, wt, 0.0)), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.maximum(t, 1e-30)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op(f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(-y * (a - b) + margin, 0.0),
+                                reduction), input, other, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply_op(f, *args)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(lab, *pd):
+        k = lab.shape[-1]
+        if pd:
+            return (1 - epsilon) * lab + epsilon * pd[0]
+        return (1 - epsilon) * lab + epsilon / k
+    args = [label] + ([prior_dist] if prior_dist is not None else [])
+    return apply_op(f, *args)
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """q/k/v: (batch, seq, heads, head_dim) — paddle convention. Delegates to
+    the Pallas flash-attention kernel on TPU when shapes allow, else the
+    XLA-fused reference path."""
+    from ..ops.pallas import flash_attention as fa
+    args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
+
+    def f(q, k, v, *m):
+        return fa.sdpa(q, k, v, m[0] if m else None, is_causal=is_causal,
+                       dropout_p=dropout_p if training else 0.0)
+    return apply_op(f, *args)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+# ---------------------------------------------------------------------------
+# vision / misc
+# ---------------------------------------------------------------------------
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def f(v):
+        nd = v.ndim - 2
+        if size is not None:
+            out_sp = _pair(size, nd)
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * nd
+            out_sp = tuple(int(s * f_) for s, f_ in zip(v.shape[2:], sf))
+        out_shape = v.shape[:2] + out_sp
+        method = {"nearest": "nearest", "bilinear": "linear",
+                  "linear": "linear", "trilinear": "linear",
+                  "bicubic": "cubic", "area": "linear"}[mode]
+        return jax.image.resize(v, out_shape, method=method)
+    return apply_op(f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c // (r * r), r, r, h, w)
+        v = v.transpose(0, 1, 4, 2, 5, 3)
+        return v.reshape(n, c // (r * r), h * r, w * r)
+    return apply_op(f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, groups, c // groups, h, w)
+        return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    return apply_op(f, x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+    return apply_op(f, x1, x2)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+    return apply_op(f, x, y)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    def f(v):
+        m = maxlen if maxlen is not None else int(jnp.max(v))
+        return (jnp.arange(m)[None, :] < v[..., None]).astype(
+            convert_dtype(dtype))
+    return apply_op(f, x)

@@ -1,0 +1,92 @@
+"""Op table: single flat namespace of tensor ops.
+
+Reference parity: the YAML-driven op registry + generated `_C_ops`
+(reference: paddle/phi/ops/yaml/ops.yaml, paddle/fluid/pybind/ops_api.cc
+— verify). TPU-native design: ops are pure jnp/lax functions dispatched
+through ``tensor.apply_op``; "registration" is plain Python modules, XLA is
+the kernel library. Tensor methods/operators are attached here at import.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .creation import *          # noqa: F401,F403
+from .math import *              # noqa: F401,F403
+from .manipulation import *      # noqa: F401,F403
+from . import creation, math, manipulation
+
+from .math import (add, subtract, multiply, divide, floor_divide, mod, pow,
+                   matmul, neg, abs as abs_op, equal, not_equal, greater_than,
+                   greater_equal, less_than, less_equal, cast, rsub,
+                   logical_and, logical_or, logical_xor, bitwise_and,
+                   bitwise_or, bitwise_xor)
+from .manipulation import getitem
+
+
+# ---------------------------------------------------------------------------
+# attach operators to Tensor
+# ---------------------------------------------------------------------------
+
+def _swap(fn):
+    return lambda self, other: fn(other, self)
+
+
+_OPERATORS = {
+    "__add__": add, "__radd__": _swap(add),
+    "__sub__": subtract, "__rsub__": rsub,
+    "__mul__": multiply, "__rmul__": _swap(multiply),
+    "__truediv__": divide, "__rtruediv__": _swap(divide),
+    "__floordiv__": floor_divide, "__rfloordiv__": _swap(floor_divide),
+    "__mod__": mod, "__rmod__": _swap(mod),
+    "__pow__": pow, "__rpow__": _swap(pow),
+    "__matmul__": matmul, "__rmatmul__": _swap(matmul),
+    "__neg__": lambda self: neg(self),
+    "__abs__": lambda self: abs_op(self),
+    "__eq__": equal, "__ne__": not_equal,
+    "__gt__": greater_than, "__ge__": greater_equal,
+    "__lt__": less_than, "__le__": less_equal,
+    "__and__": logical_and, "__or__": logical_or, "__xor__": logical_xor,
+    "__invert__": lambda self: logical_not(self),
+}
+
+for name_, fn_ in _OPERATORS.items():
+    setattr(Tensor, name_, fn_)
+
+# method-style API on Tensor (paddle: Tensor.<op> mirrors paddle.<op>)
+_METHOD_SOURCES = (math, manipulation, creation)
+_METHODS = [
+    "add", "subtract", "multiply", "divide", "pow", "matmul", "mm", "bmm",
+    "dot", "abs", "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "reciprocal", "sign", "floor", "ceil", "round", "trunc",
+    "sin", "cos", "tan", "tanh", "sigmoid", "erf", "clip", "scale", "lerp",
+    "sum", "mean", "max", "min", "prod", "all", "any", "std", "var",
+    "median", "logsumexp", "cumsum", "cumprod", "argmax", "argmin",
+    "argsort", "sort", "topk", "norm", "dist", "trace", "kron",
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "isnan", "isinf",
+    "isfinite", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "reshape", "reshape_", "transpose", "concat", "split", "chunk",
+    "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "flatten", "flatten_",
+    "gather", "gather_nd", "scatter", "scatter_", "scatter_nd_add",
+    "index_select", "index_add", "expand", "expand_as", "broadcast_to",
+    "tile", "flip", "roll", "where", "masked_select", "masked_fill",
+    "nonzero", "unique", "pad", "take", "take_along_axis", "put_along_axis",
+    "repeat_interleave", "unbind", "tensordot", "maximum", "minimum",
+    "remainder", "mod", "floor_divide", "floor_mod", "multiply_", "add_",
+    "subtract_", "scale_", "clip_", "remainder_", "zero_", "stack",
+    "unstack", "diagonal", "tril", "triu", "moveaxis", "flip",
+    "count_nonzero", "nan_to_num", "neg", "atan2",
+]
+
+for m in _METHODS:
+    for src in _METHOD_SOURCES:
+        if hasattr(src, m):
+            if not hasattr(Tensor, m):
+                setattr(Tensor, m, getattr(src, m))
+            break
+
+# a few methods whose names collide with properties / need wrapping
+Tensor.cast = lambda self, dtype: cast(self, dtype)
+Tensor.astype = lambda self, dtype: cast(self, dtype)

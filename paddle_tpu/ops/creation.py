@@ -1,0 +1,255 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py,
+python/paddle/tensor/random.py — verify). All lower to jnp/jax.random; random
+ops draw keys from framework.split_key() so they are stateful-eager but
+purely threaded under the step compiler."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype
+from ..tensor import Tensor, to_tensor, apply_op
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
+    "tril", "triu", "meshgrid", "assign", "clone", "numel",
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "normal", "standard_normal", "bernoulli", "multinomial", "poisson",
+    "one_hot", "tril_indices", "triu_indices",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype, default=None):
+    d = convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else framework.state().default_dtype
+    return d
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None and isinstance(fill_value, bool):
+        return Tensor(jnp.full(_shape(shape), fill_value, jnp.bool_))
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_op(lambda v: jnp.zeros_like(v, dtype=convert_dtype(dtype)), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_op(lambda v: jnp.ones_like(v, dtype=convert_dtype(dtype)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_op(
+        lambda v: jnp.full_like(v, fill_value, dtype=convert_dtype(dtype)), x)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in ("start", "end", "step"):
+        pass
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(end, Tensor):
+        end = end.item()
+    if isinstance(step, Tensor):
+        step = step.item()
+    if end is None:
+        start, end = 0, start
+    d = convert_dtype(dtype)
+    if d is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            d = jnp.int32
+        else:
+            d = framework.state().default_dtype
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    if isinstance(start, Tensor):
+        start = start.item()
+    if isinstance(stop, Tensor):
+        stop = stop.item()
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(v):
+        d = jnp.diag(v, offset)
+        if v.ndim == 1 and padding_value != 0:
+            mask = jnp.diag(jnp.ones(v.shape[0], bool), offset)
+            d = jnp.where(mask, d, jnp.asarray(padding_value, v.dtype))
+        return d
+    return apply_op(f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda v: jnp.diagflat(v, offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.tril(v, diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.triu(v, diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int32"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int32"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(
+        args[0], (list, tuple)) else args
+    return apply_op(lambda *vs: jnp.meshgrid(*vs, indexing="ij"), *tensors)
+
+
+def assign(x, output=None):
+    if not isinstance(x, Tensor):
+        x = to_tensor(x)
+    out = apply_op(lambda v: v + 0 if jnp.issubdtype(v.dtype, jnp.number)
+                   else jnp.copy(v), x)
+    if output is not None:
+        output.set_value(out._value)
+        return output
+    return out
+
+
+def clone(x):
+    return assign(x)
+
+
+def numel(x):
+    return Tensor(jnp.asarray(x.size, jnp.int32))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        lambda v: jax.nn.one_hot(v, num_classes,
+                                 dtype=framework.state().default_dtype), x)
+
+
+# -- random -----------------------------------------------------------------
+
+def rand(shape, dtype=None, name=None):
+    k = framework.split_key()
+    return Tensor(jax.random.uniform(k, _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    k = framework.split_key()
+    return Tensor(jax.random.normal(k, _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    k = framework.split_key()
+    return Tensor(jax.random.randint(k, _shape(shape), low, high,
+                                     _dt(dtype, jnp.int32)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    k = framework.split_key()
+    return Tensor(jax.random.randint(
+        k, tuple(x.shape), low, high,
+        _dt(dtype, convert_dtype(jnp.dtype(x.dtype).name) or jnp.int32)))
+
+
+def randperm(n, dtype="int32", name=None):
+    k = framework.split_key()
+    return Tensor(jax.random.permutation(k, n).astype(convert_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    k = jax.random.PRNGKey(seed) if seed else framework.split_key()
+    return Tensor(jax.random.uniform(k, _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        k = framework.split_key()
+        return Tensor(jax.random.normal(k, shp,
+                                        framework.state().default_dtype) * s + m)
+    k = framework.split_key()
+    shp = _shape(shape) if shape is not None else ()
+    return Tensor(jax.random.normal(
+        k, shp, framework.state().default_dtype) * std + mean)
+
+
+def bernoulli(x, name=None):
+    k = framework.split_key()
+    return Tensor(jax.random.bernoulli(k, x._value).astype(x.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    k = framework.split_key()
+    v = x._value
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(k, logits, axis=-1,
+                                     shape=(*v.shape[:-1], num_samples))
+    else:
+        # Gumbel top-k without replacement
+        g = jax.random.gumbel(k, v.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int32))
+
+
+def poisson(x, name=None):
+    k = framework.split_key()
+    return Tensor(jax.random.poisson(k, x._value).astype(x.dtype))

@@ -1,0 +1,635 @@
+"""Shape/layout manipulation ops (reference:
+python/paddle/tensor/manipulation.py — verify)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype
+from ..tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "moveaxis", "swapaxes", "concat",
+    "split", "vsplit", "hsplit", "dsplit", "tensor_split", "chunk", "stack",
+    "unstack", "hstack", "vstack", "dstack", "row_stack", "column_stack",
+    "squeeze", "squeeze_", "unsqueeze", "unsqueeze_", "flatten", "flatten_",
+    "gather", "gather_nd", "scatter", "scatter_", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_add", "index_put", "slice",
+    "strided_slice", "expand", "expand_as", "broadcast_to", "broadcast_shape",
+    "broadcast_tensors", "tile", "flip", "rot90", "roll", "where",
+    "masked_select", "masked_fill", "masked_scatter", "nonzero", "unique",
+    "unique_consecutive", "pad", "take", "take_along_axis", "put_along_axis",
+    "repeat_interleave", "unbind", "unfold", "tensordot", "getitem",
+    "as_complex", "as_real", "view", "view_as", "crop", "shard_index",
+    "diagonal", "diag_embed", "fill_diagonal_", "atleast_1d", "atleast_2d",
+    "atleast_3d",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    out = []
+    for s in shape:
+        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    shp = _shape_arg(shape)
+    return apply_op(lambda v: jnp.reshape(v, shp), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+view = reshape
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm, name=None):
+    perm = tuple(int(p) for p in perm)
+    return apply_op(lambda v: jnp.transpose(v, perm), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda v: jnp.swapaxes(v, axis0, axis1), x)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    tensors = list(x)
+    return apply_op(lambda *vs: jnp.concatenate(vs, axis=axis), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_op(lambda *vs: jnp.stack(vs, axis=axis), *tensors)
+
+
+def hstack(x, name=None):
+    return apply_op(lambda *vs: jnp.hstack(vs), *list(x))
+
+
+def vstack(x, name=None):
+    return apply_op(lambda *vs: jnp.vstack(vs), *list(x))
+
+
+def dstack(x, name=None):
+    return apply_op(lambda *vs: jnp.dstack(vs), *list(x))
+
+
+row_stack = vstack
+
+
+def column_stack(x, name=None):
+    return apply_op(lambda *vs: jnp.column_stack(vs), *list(x))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        indices = num_or_sections
+    else:
+        secs = [dim - builtins_sum(s for s in num_or_sections if s != -1)
+                if s == -1 else s for s in num_or_sections]
+        indices = list(np.cumsum(secs)[:-1])
+    return apply_op(lambda v: tuple(jnp.split(v, indices, axis=axis)), x)
+
+
+def builtins_sum(it):
+    tot = 0
+    for v in it:
+        tot += v
+    return tot
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    return apply_op(
+        lambda v: tuple(jnp.array_split(v, num_or_indices, axis=axis)), x)
+
+
+def vsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=0)
+
+
+def hsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=1)
+
+
+def dsplit(x, num_or_sections, name=None):
+    return split(x, num_or_sections, axis=2)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return apply_op(lambda v: tuple(jnp.array_split(v, chunks, axis=axis)), x)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    return apply_op(
+        lambda v: tuple(jnp.squeeze(p, axis) for p in
+                        jnp.split(v, n, axis=axis)), x)
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return apply_op(f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+
+    def f(v):
+        out = v
+        for a in builtins_sorted(axes):
+            out = jnp.expand_dims(out, a)
+        return out
+    return apply_op(f, x)
+
+
+def builtins_sorted(it):
+    return sorted(it)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = v.shape[:s] + (-1,) + v.shape[e + 1:]
+        return jnp.reshape(v, new_shape)
+    return apply_op(f, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    return x
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op(
+        lambda v, i: jnp.take(v, i.astype(jnp.int32).reshape(-1)
+                              if i.ndim else i.astype(jnp.int32),
+                              axis=axis), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def f(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., j] for j in range(k))
+        return v[flat_idx]
+    return apply_op(f, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(v, i, u):
+        i = i.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        zeroed = v.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+    return apply_op(f, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    shp = _shape_arg(shape)
+
+    def f(i, u):
+        i = i.astype(jnp.int32)
+        out = jnp.zeros(shp, u.dtype)
+        k = i.shape[-1]
+        return out.at[tuple(i[..., j] for j in range(k))].add(u)
+    return apply_op(f, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(v, i, u):
+        i = i.astype(jnp.int32)
+        k = i.shape[-1]
+        return v.at[tuple(i[..., j] for j in range(k))].add(u)
+    return apply_op(f, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(
+        lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis), x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(v, i, u):
+        i = i.astype(jnp.int32)
+        vm = jnp.moveaxis(v, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        out = vm.at[i].add(um)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op(f, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(v, u, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(
+            i.dtype, jnp.integer) else i for i in idx)
+        if accumulate:
+            return v.at[idx].add(u)
+        return v.at[idx].set(u)
+    return apply_op(f, x, value, *list(indices))
+
+
+def slice(x, axes, starts, ends, name=None):
+    def f(v):
+        idx = [jnp.s_[:]] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            s = int(s.item()) if isinstance(s, Tensor) else int(s)
+            e = int(e.item()) if isinstance(e, Tensor) else int(e)
+            idx[a] = jnp.s_[s:e]
+        return v[tuple(idx)]
+    return apply_op(f, x)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(v):
+        idx = [jnp.s_[:]] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = jnp.s_[s:e:st]
+        return v[tuple(idx)]
+    return apply_op(f, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _shape_arg(shape)
+    offs = [0] * x.ndim if offsets is None else [
+        int(o.item()) if isinstance(o, Tensor) else int(o) for o in offsets]
+
+    def f(v):
+        idx = tuple(jnp.s_[o:o + s] for o, s in zip(offs, shp))
+        return v[idx]
+    return apply_op(f, x)
+
+
+def expand(x, shape, name=None):
+    shp = _shape_arg(shape)
+
+    def f(v):
+        # paddle expand: -1 keeps dim
+        nd = len(shp)
+        vshape = (1,) * (nd - v.ndim) + v.shape
+        tgt = tuple(vs if s == -1 else s for s, vs in zip(shp, vshape))
+        return jnp.broadcast_to(v.reshape(vshape), tgt)
+    return apply_op(f, x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(inputs, name=None):
+    return apply_op(lambda *vs: tuple(jnp.broadcast_arrays(*vs)),
+                    *list(inputs))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return apply_op(lambda v: jnp.tile(v, reps), x)
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op(lambda v: jnp.flip(v, axis=tuple(axes)), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda v: jnp.rot90(v, k, axes), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda v: jnp.roll(v, shifts, axis=axis), x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    if not isinstance(x, Tensor):
+        x = to_tensor(x)
+    if not isinstance(y, Tensor):
+        y = to_tensor(y)
+    return apply_op(lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                    condition, x, y)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (documented; under jit use where())
+    v = np.asarray(x._value)
+    m = np.asarray(mask._value).astype(bool)
+    return Tensor(jnp.asarray(v[np.broadcast_to(m, v.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    val = _v(value)
+    return apply_op(
+        lambda v, m: jnp.where(m.astype(bool), jnp.asarray(val, v.dtype), v),
+        x, mask)
+
+
+def masked_scatter(x, mask, value, name=None):
+    v = np.asarray(x._value)
+    m = np.broadcast_to(np.asarray(mask._value).astype(bool), v.shape)
+    src = np.asarray(_v(value)).reshape(-1)
+    out = v.copy()
+    out[m] = src[:int(m.sum())]
+    return Tensor(jnp.asarray(out))
+
+
+def nonzero(x, as_tuple=False, name=None):
+    v = np.asarray(x._value)
+    nz = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(a.astype(np.int32))) for a in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int32)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int32", name=None):
+    v = np.asarray(x._value)
+    res = np.unique(v, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(res[0]))]
+    d = convert_dtype(dtype)
+    for extra in res[1:]:
+        out.append(Tensor(jnp.asarray(extra.astype(np.int32), dtype=d)))
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int32", name=None):
+    v = np.asarray(x._value)
+    if axis is None:
+        v = v.reshape(-1)
+        keep = np.concatenate([[True], v[1:] != v[:-1]])
+        vals = v[keep]
+        outs = [Tensor(jnp.asarray(vals))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv.astype(np.int32))))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, v.size))
+            outs.append(Tensor(jnp.asarray(counts.astype(np.int32))))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    pad = _shape_arg(pad) if not isinstance(pad, (list, tuple)) else [
+        int(p.item()) if isinstance(p, Tensor) else int(p) for p in pad]
+
+    def f(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle NCHW convention: pad applies to last len(pad)//2 dims,
+            # given in reverse (last dim first), like torch F.pad
+            k = len(pad) // 2
+            widths = [(0, 0)] * (nd - k)
+            for i in range(k):
+                widths.append((pad[2 * (k - 1 - i)],
+                               pad[2 * (k - 1 - i) + 1]))
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode="constant", constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+    return apply_op(f, x)
+
+
+def take(x, index, mode="raise", name=None):
+    return apply_op(
+        lambda v, i: jnp.take(v.reshape(-1), i.astype(jnp.int32).reshape(-1),
+                              mode="clip" if mode == "clip" else "wrap"
+                              if mode == "wrap" else "clip").reshape(
+                                  i.shape), x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+        arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def f(v, i, u):
+        i = i.astype(jnp.int32)
+        u = jnp.broadcast_to(u, i.shape) if jnp.ndim(u) else \
+            jnp.full(i.shape, u, v.dtype)
+        vm = jnp.moveaxis(v, axis, 0)
+        im = jnp.moveaxis(i, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        dims = jnp.indices(im.shape)
+        idx = (im,) + tuple(dims[1:])
+        if reduce == "assign":
+            out = vm.at[idx].set(um)
+        elif reduce == "add":
+            out = vm.at[idx].add(um)
+        elif reduce in ("multiply", "mul"):
+            out = vm.at[idx].multiply(um)
+        elif reduce == "amax":
+            out = vm.at[idx].max(um)
+        elif reduce == "amin":
+            out = vm.at[idx].min(um)
+        else:
+            raise ValueError(reduce)
+        return jnp.moveaxis(out, 0, axis)
+    if not isinstance(values, Tensor):
+        values = to_tensor(values)
+    return apply_op(f, arr, indices, values)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = jnp.asarray(repeats._value)
+        total = int(np.asarray(reps).sum())
+        return apply_op(
+            lambda v: jnp.repeat(v if axis is not None else v.reshape(-1),
+                                 reps, axis=axis if axis is not None else 0,
+                                 total_repeat_length=total), x)
+    return apply_op(
+        lambda v: jnp.repeat(v if axis is not None else v.reshape(-1),
+                             repeats, axis=axis if axis is not None else 0),
+        x)
+
+
+def unfold(x, axis, size, step, name=None):
+    def g(v):
+        dim = v.shape[axis]
+        n = (dim - size) // step + 1
+        idx = (jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :])
+        taken = jnp.take(v, idx.reshape(-1), axis=axis)
+        new_shape = list(v.shape[:axis]) + [n, size] + list(v.shape[axis + 1:])
+        taken = taken.reshape(new_shape)
+        # move the window dims to the end? paddle returns (..., n, size) at axis
+        return taken
+    return apply_op(g, x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, Tensor):
+        axes = np.asarray(axes._value).tolist()
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply_op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], -1), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda v: jnp.diagonal(v, offset, axis1, axis2), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(v):
+        n = v.shape[-1] + builtins_abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + (-offset if offset < 0 else 0)
+        c = idx + (offset if offset > 0 else 0)
+        out = out.at[..., r, c].set(v)
+        src = list(range(out.ndim))
+        d1 = dim1 % out.ndim
+        d2 = dim2 % out.ndim
+        if (d1, d2) != (out.ndim - 2, out.ndim - 1):
+            out = jnp.moveaxis(out, (out.ndim - 2, out.ndim - 1), (d1, d2))
+        return out
+    return apply_op(f, x)
+
+
+def builtins_abs(v):
+    return v if v >= 0 else -v
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    v = x._value
+    n = min(v.shape[-2], v.shape[-1])
+    idx = jnp.arange(n - builtins_abs(offset))
+    r = idx + (-offset if offset < 0 else 0)
+    c = idx + (offset if offset > 0 else 0)
+    x._value = v.at[..., r, c].set(value)
+    return x
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_1d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_2d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_3d, t) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(v):
+        size = index_num // nshards
+        lo = shard_id * size
+        in_shard = (v >= lo) & (v < lo + size)
+        return jnp.where(in_shard, v - lo, ignore_value)
+    return apply_op(f, input)
+
+
+# ---------------------------------------------------------------------------
+# getitem: numpy-style indexing with Tensor indices
+# ---------------------------------------------------------------------------
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        v = idx._value
+        if v.dtype == jnp.bool_:
+            return np.asarray(v)  # boolean mask: host (dynamic shape)
+        return v.astype(jnp.int32) if jnp.issubdtype(
+            v.dtype, jnp.integer) else v
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+def getitem(x, idx):
+    uidx = _unwrap_index(idx)
+    return apply_op(lambda v: v[uidx], x)

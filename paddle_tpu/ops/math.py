@@ -1,0 +1,676 @@
+"""Math ops: elementwise, reductions, linalg, comparisons, logical.
+
+Reference parity: python/paddle/tensor/{math,linalg,logic,stat}.py — verify.
+All ops are thin pure-jnp functions dispatched through apply_op so they tape
+in eager mode and trace cleanly under jit.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype
+from ..tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    # elementwise binary
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
+    "logaddexp", "heaviside", "nextafter", "copysign", "hypot", "gcd", "lcm",
+    # elementwise unary
+    "abs", "neg", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt",
+    "rsqrt", "square", "reciprocal", "sign", "floor", "ceil", "round",
+    "trunc", "frac", "sin", "cos", "tan", "asin", "acos", "atan", "sinh",
+    "cosh", "tanh", "asinh", "acosh", "atanh", "erf", "erfinv", "sigmoid",
+    "logit", "deg2rad", "rad2deg", "angle", "conj", "real", "imag",
+    "digamma", "lgamma", "i0", "i1", "nan_to_num",
+    # clip / scale
+    "clip", "scale", "lerp", "addmm",
+    # reductions
+    "sum", "mean", "max", "min", "prod", "all", "any", "amax", "amin",
+    "std", "var", "median", "nanmedian", "nansum", "nanmean", "logsumexp",
+    "count_nonzero", "quantile",
+    # cum/scan
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp", "diff",
+    # compare
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "equal_all", "allclose", "isclose", "isnan", "isinf",
+    "isfinite", "isneginf", "isposinf",
+    # logical / bitwise
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift",
+    # sort / search
+    "argmax", "argmin", "argsort", "sort", "topk", "kthvalue", "mode",
+    "searchsorted", "bucketize", "index_sample",
+    # linalg
+    "matmul", "mm", "bmm", "dot", "outer", "inner", "t", "transpose_matmul",
+    "norm", "dist", "cross", "trace", "kron", "einsum", "mv", "matrix_power",
+    "histogram", "bincount",
+    # misc
+    "cast", "isreal", "rsub", "stanh", "softplus_op", "floor_mod",
+    "multiply_", "add_", "subtract_", "scale_", "clip_", "remainder_",
+    "increment", "any_op",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._value)
+        return tuple(int(i) for i in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary
+# ---------------------------------------------------------------------------
+
+def _bin(fn):
+    def op(x, y, name=None):
+        return apply_op(fn, x, y)
+    return op
+
+
+add = _bin(jnp.add)
+subtract = _bin(jnp.subtract)
+multiply = _bin(jnp.multiply)
+divide = _bin(lambda a, b: jnp.divide(a, b))
+floor_divide = _bin(jnp.floor_divide)
+mod = _bin(jnp.mod)
+remainder = mod
+floor_mod = mod
+maximum = _bin(jnp.maximum)
+minimum = _bin(jnp.minimum)
+fmax = _bin(jnp.fmax)
+fmin = _bin(jnp.fmin)
+atan2 = _bin(jnp.arctan2)
+logaddexp = _bin(jnp.logaddexp)
+heaviside = _bin(jnp.heaviside)
+nextafter = _bin(jnp.nextafter)
+copysign = _bin(jnp.copysign)
+hypot = _bin(jnp.hypot)
+gcd = _bin(jnp.gcd)
+lcm = _bin(jnp.lcm)
+
+
+def pow(x, y, name=None):
+    return apply_op(jnp.power, x, y)
+
+
+def rsub(x, y):
+    return apply_op(lambda a, b: jnp.subtract(b, a), x, y)
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary
+# ---------------------------------------------------------------------------
+
+def _un(fn):
+    def op(x, name=None):
+        return apply_op(fn, x)
+    return op
+
+
+abs = _un(jnp.abs)
+neg = _un(jnp.negative)
+exp = _un(jnp.exp)
+expm1 = _un(jnp.expm1)
+log = _un(jnp.log)
+log2 = _un(jnp.log2)
+log10 = _un(jnp.log10)
+log1p = _un(jnp.log1p)
+sqrt = _un(jnp.sqrt)
+rsqrt = _un(jax.lax.rsqrt)
+square = _un(jnp.square)
+reciprocal = _un(jnp.reciprocal)
+sign = _un(jnp.sign)
+floor = _un(jnp.floor)
+ceil = _un(jnp.ceil)
+round = _un(jnp.round)
+trunc = _un(jnp.trunc)
+frac = _un(lambda v: v - jnp.trunc(v))
+sin = _un(jnp.sin)
+cos = _un(jnp.cos)
+tan = _un(jnp.tan)
+asin = _un(jnp.arcsin)
+acos = _un(jnp.arccos)
+atan = _un(jnp.arctan)
+sinh = _un(jnp.sinh)
+cosh = _un(jnp.cosh)
+tanh = _un(jnp.tanh)
+asinh = _un(jnp.arcsinh)
+acosh = _un(jnp.arccosh)
+atanh = _un(jnp.arctanh)
+erf = _un(jax.scipy.special.erf)
+erfinv = _un(jax.scipy.special.erfinv)
+sigmoid = _un(jax.nn.sigmoid)
+deg2rad = _un(jnp.deg2rad)
+rad2deg = _un(jnp.rad2deg)
+angle = _un(jnp.angle)
+conj = _un(jnp.conj)
+real = _un(jnp.real)
+imag = _un(jnp.imag)
+digamma = _un(jax.scipy.special.digamma)
+lgamma = _un(jax.scipy.special.gammaln)
+i0 = _un(jnp.i0)
+i1 = _un(lambda v: jax.scipy.special.i1(v) if hasattr(
+    jax.scipy.special, "i1") else v)
+isreal = _un(jnp.isreal)
+stanh = _un(lambda v: 1.7159 * jnp.tanh(0.66667 * v))
+
+
+def logit(x, eps=None, name=None):
+    def f(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v / (1.0 - v))
+    return apply_op(f, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                             neginf=neginf), x)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = _v(min) if min is not None else None
+    hi = _v(max) if max is not None else None
+    return apply_op(lambda v: jnp.clip(v, lo, hi), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = _v(scale), _v(bias)
+
+    def f(v):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out
+    return apply_op(f, x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op(lambda a, b, w: a + w * (b - a), x, y, weight)
+    return apply_op(lambda a, b: a + weight * (b - a), x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def increment(x, value=1.0):
+    x._value = x._value + value
+    return x
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = convert_dtype(dtype)
+    return apply_op(lambda v: jnp.sum(v, axis=_axis(axis), dtype=d,
+                                      keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.mean(v, axis=_axis(axis),
+                                       keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.max(v, axis=_axis(axis),
+                                      keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.min(v, axis=_axis(axis),
+                                      keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    return apply_op(lambda v: jnp.prod(v, axis=_axis(axis), dtype=d,
+                                       keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.all(v, axis=_axis(axis),
+                                      keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.any(v, axis=_axis(axis),
+                                      keepdims=keepdim), x)
+
+
+any_op = any
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.std(v, axis=_axis(axis),
+                                      ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.var(v, axis=_axis(axis),
+                                      ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.median(v, axis=_axis(axis),
+                                         keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.nanmedian(v, axis=_axis(axis),
+                                            keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.nansum(v, axis=_axis(axis),
+                                         dtype=convert_dtype(dtype),
+                                         keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.nanmean(v, axis=_axis(axis),
+                                          keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jax.scipy.special.logsumexp(
+        v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.count_nonzero(
+        v, axis=_axis(axis), keepdims=keepdim).astype(jnp.int32), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.quantile(v, jnp.asarray(q),
+                                           axis=_axis(axis),
+                                           keepdims=keepdim), x)
+
+
+# ---------------------------------------------------------------------------
+# cumulative
+# ---------------------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1), dtype=convert_dtype(dtype))
+        return jnp.cumsum(v, axis=int(axis), dtype=convert_dtype(dtype))
+    return apply_op(f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def f(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=convert_dtype(dtype))
+        return jnp.cumprod(v, axis=int(dim), dtype=convert_dtype(dtype))
+    return apply_op(f, x)
+
+
+def cummax(x, axis=None, dtype="int32", name=None):
+    def f(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=a)
+        return vals
+    vals = apply_op(f, x)
+    # indices via argmax of running max equality — eager helper
+    v = x._value.reshape(-1) if axis is None else x._value
+    a = 0 if axis is None else int(axis)
+    eq = jnp.equal(v, vals._value)
+    idx = jnp.arange(v.shape[a]).reshape(
+        [-1 if i == a % v.ndim else 1 for i in range(v.ndim)])
+    inds = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(eq, idx, -1), axis=a)
+    return vals, Tensor(inds.astype(convert_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int32", name=None):
+    from . import math as _m
+    neg_vals, inds = cummax(_m.neg(x), axis=axis, dtype=dtype)
+    return _m.neg(neg_vals), inds
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(v):
+        a = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=a)
+    return apply_op(f, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = _v(prepend) if prepend is not None else None
+    app = _v(append) if append is not None else None
+    return apply_op(lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre,
+                                       append=app), x)
+
+
+# ---------------------------------------------------------------------------
+# comparison
+# ---------------------------------------------------------------------------
+
+equal = _bin(jnp.equal)
+not_equal = _bin(jnp.not_equal)
+greater_than = _bin(jnp.greater)
+greater_equal = _bin(jnp.greater_equal)
+less_than = _bin(jnp.less)
+less_equal = _bin(jnp.less_equal)
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan), x, y)
+
+
+isnan = _un(jnp.isnan)
+isinf = _un(jnp.isinf)
+isfinite = _un(jnp.isfinite)
+isneginf = _un(jnp.isneginf)
+isposinf = _un(jnp.isposinf)
+
+logical_and = _bin(jnp.logical_and)
+logical_or = _bin(jnp.logical_or)
+logical_xor = _bin(jnp.logical_xor)
+logical_not = _un(jnp.logical_not)
+bitwise_and = _bin(jnp.bitwise_and)
+bitwise_or = _bin(jnp.bitwise_or)
+bitwise_xor = _bin(jnp.bitwise_xor)
+bitwise_not = _un(jnp.bitwise_not)
+bitwise_left_shift = _bin(jnp.left_shift)
+bitwise_right_shift = _bin(jnp.right_shift)
+
+
+# ---------------------------------------------------------------------------
+# sort / search
+# ---------------------------------------------------------------------------
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        out = jnp.argmax(v if axis is not None else v.reshape(-1),
+                         axis=axis if axis is not None else 0)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(convert_dtype(dtype))
+    return apply_op(f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        out = jnp.argmin(v if axis is not None else v.reshape(-1),
+                         axis=axis if axis is not None else 0)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(convert_dtype(dtype))
+    return apply_op(f, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=True, name=None):
+    def f(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable,
+                          descending=descending)
+        return idx.astype(jnp.int32)
+    return apply_op(f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=True, name=None):
+    def f(v):
+        return jnp.sort(v, axis=axis, stable=stable, descending=descending)
+    return apply_op(f, x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(v):
+        ax = axis % v.ndim
+        vv = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vv, k)
+        else:
+            vals, idx = jax.lax.top_k(-vv, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(jnp.int32))
+    vals, idx = apply_op(f, x)
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v):
+        sv = jnp.sort(v, axis=axis)
+        si = jnp.argsort(v, axis=axis)
+        vals = jnp.take(sv, k - 1, axis=axis)
+        idx = jnp.take(si, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int32)
+    vals, idx = apply_op(f, x)
+    idx.stop_gradient = True
+    return vals, idx
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    v = x._value
+    sv = jnp.sort(v, axis=axis)
+    # most frequent: scan run lengths (eager small helper)
+    arr = np.asarray(sv)
+    vals = np.apply_along_axis(
+        lambda r: np.unique(r, return_counts=True)[0][
+            np.argmax(np.unique(r, return_counts=True)[1])], axis, arr)
+    out = jnp.asarray(vals, v.dtype)
+    idxs = jnp.argmax(jnp.equal(
+        v, jnp.expand_dims(out, axis)).astype(jnp.int32), axis=axis)
+    if keepdim:
+        out = jnp.expand_dims(out, axis)
+        idxs = jnp.expand_dims(idxs, axis)
+    return Tensor(out), Tensor(idxs.astype(jnp.int32))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    return apply_op(
+        lambda s, v: jnp.searchsorted(s, v, side=side).astype(
+            jnp.int32), sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def index_sample(x, index):
+    return apply_op(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=1),
+        x, index)
+
+
+# ---------------------------------------------------------------------------
+# linalg
+# ---------------------------------------------------------------------------
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        from ..amp import get_amp_dtype
+        d = get_amp_dtype()
+        if d is not None and jnp.issubdtype(a.dtype, jnp.floating):
+            a, b = a.astype(d), b.astype(d)
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op(f, x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y)
+
+
+def dot(x, y, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, x, vec)
+
+
+def outer(x, y, name=None):
+    return apply_op(jnp.outer, x, y)
+
+
+def inner(x, y, name=None):
+    return apply_op(jnp.inner, x, y)
+
+
+def t(x, name=None):
+    return apply_op(lambda v: v.T if v.ndim >= 2 else v, x)
+
+
+transpose_matmul = matmul
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(v):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(v * v))
+            return jnp.linalg.norm(v, ord=None, axis=_axis(axis),
+                                   keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            o = jnp.inf
+        elif p == float("-inf"):
+            o = -jnp.inf
+        else:
+            o = p
+        if axis is None:
+            return jnp.linalg.norm(v.reshape(-1), ord=o, keepdims=False)
+        return jnp.linalg.norm(v, ord=o, axis=_axis(axis), keepdims=keepdim)
+    return apply_op(f, x)
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.count_nonzero(d).astype(a.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply_op(f, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis of size 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op(f, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda v: jnp.trace(v, offset, axis1, axis2), x)
+
+
+def kron(x, y, name=None):
+    return apply_op(jnp.kron, x, y)
+
+
+def einsum(equation, *operands):
+    return apply_op(lambda *ops: jnp.einsum(equation, *ops), *operands)
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    v = x._value
+    lo, hi = (min, max) if (min != 0 or max != 0) else (
+        float(jnp.min(v)), float(jnp.max(v)))
+    h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor(h.astype(jnp.int32))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        return apply_op(lambda v, w: jnp.bincount(
+            v, w, minlength=minlength,
+            length=int(np.asarray(v).max()) + 1 if minlength == 0 else None),
+            x, weights)
+    v = np.asarray(x._value)
+    return Tensor(jnp.asarray(np.bincount(v, minlength=minlength)))
+
+
+# ---------------------------------------------------------------------------
+# cast + in-place aliases
+# ---------------------------------------------------------------------------
+
+def cast(x, dtype):
+    d = convert_dtype(dtype)
+    return apply_op(lambda v: v.astype(d), x)
+
+
+def _inplace(op):
+    def f(x, *a, **k):
+        out = op(x, *a, **k)
+        x._value = out._value
+        x._node = out._node
+        x._out_index = out._out_index
+        x.stop_gradient = out.stop_gradient
+        return x
+    return f
+
+
+add_ = _inplace(add)
+subtract_ = _inplace(subtract)
+multiply_ = _inplace(multiply)
+scale_ = _inplace(scale)
+clip_ = _inplace(clip)
+remainder_ = _inplace(remainder)
+softplus_op = _un(jax.nn.softplus)

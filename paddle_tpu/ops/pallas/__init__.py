@@ -1,0 +1,4 @@
+"""Pallas/Mosaic TPU kernels for the fused hot set (reference's CUDA fused
+kernels: paddle/phi/kernels/fusion/, flash_attn — verify). Each kernel has an
+XLA fallback used on CPU / when shapes don't fit the kernel grid."""
+from . import flash_attention  # noqa: F401

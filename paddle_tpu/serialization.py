@@ -1,0 +1,69 @@
+"""paddle.save / paddle.load parity (reference:
+python/paddle/framework/io.py — verify). Tensors are stored as numpy inside
+a pickle; nested dicts/lists (state_dicts, opt states) round-trip."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, Parameter
+
+__all__ = ["save", "load"]
+
+_MAGIC = b"PDTPU1\x00"
+
+
+def _pack(obj):
+    if isinstance(obj, Parameter):
+        return {"__pdtpu__": "param", "v": np.asarray(obj._value),
+                "trainable": obj.trainable}
+    if isinstance(obj, Tensor):
+        return {"__pdtpu__": "tensor", "v": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        tag = obj.get("__pdtpu__")
+        if tag == "param":
+            if return_numpy:
+                return obj["v"]
+            p = Parameter(jnp.asarray(obj["v"]),
+                          trainable=obj.get("trainable", True))
+            return p
+        if tag == "tensor":
+            if return_numpy:
+                return obj["v"]
+            return Tensor(jnp.asarray(obj["v"]),
+                          stop_gradient=obj.get("stop_gradient", True))
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            f.seek(0)
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy)

@@ -1,0 +1,66 @@
+"""paddle_tpu.static — static-graph API parity layer.
+
+Reference: python/paddle/static/ (Program/Executor) — verify. TPU-native:
+the "static graph" IS the jitted XLA program; this module provides
+InputSpec and thin aliases so reference code importing paddle.static keeps
+working. Program-construction APIs raise with guidance toward jit."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import convert_dtype
+
+__all__ = ["InputSpec", "default_main_program", "default_startup_program",
+           "name_scope", "device_guard", "amp"]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(-1 if s is None else s for s in shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        import jax.numpy as jnp
+        return cls(tuple(tensor.shape), jnp.dtype(tensor.dtype).name, name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, " \
+               f"name={self.name})"
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "static Program API is not part of the TPU-native design; "
+        "use paddle_tpu.jit.to_static (the jit boundary IS the program)")
+
+
+default_startup_program = default_main_program
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    import jax
+    with jax.named_scope(prefix or "scope"):
+        yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    yield
+
+
+class amp:
+    """paddle.static.amp namespace shim."""
+    @staticmethod
+    def decorate(*a, **k):
+        raise NotImplementedError("use paddle_tpu.amp.decorate")

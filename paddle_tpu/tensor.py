@@ -1,0 +1,400 @@
+"""Tensor façade + eager autograd tape.
+
+Reference parity: paddle's eager ``Tensor`` (pybind class) with
+``stop_gradient`` semantics, ``.grad`` accumulation, ``backward()``
+(reference: paddle/fluid/pybind/eager_method.cc, paddle/fluid/eager/ — verify).
+
+TPU-native design: a ``Tensor`` is a thin host wrapper over a ``jax.Array``
+(or a tracer while inside a compiled step). Eager autograd is implemented as
+a *vjp tape*: each differentiable op call runs ``jax.vjp`` immediately and
+records the pullback; ``backward()`` replays the tape in reverse creation
+order. Eager mode is the debug path — the perf path functionalizes whole
+steps into one XLA program via ``paddle_tpu.jit`` where the tape is bypassed
+and ``jax.grad`` differentiates the traced program (reference's dichotomy:
+dygraph vs to_static/PIR).
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import framework
+from .framework import convert_dtype
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "apply_op", "reset_tape"]
+
+
+# ---------------------------------------------------------------------------
+# The tape
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded differentiable op application."""
+    __slots__ = ("vjp_fn", "inputs", "outputs", "idx", "multi")
+
+    def __init__(self, vjp_fn, inputs, outputs, idx, multi):
+        self.vjp_fn = vjp_fn      # pullback: cotangents(out) -> cotangents(in)
+        self.inputs = inputs      # list[Tensor] (diff inputs, tape order)
+        self.outputs = outputs    # list[Tensor]
+        self.idx = idx
+        self.multi = multi        # fn returned a tuple/list of arrays
+
+
+class _Tape:
+    def __init__(self):
+        self.nodes: list[TapeNode] = []
+
+    def record(self, vjp_fn, inputs, outputs, multi=False):
+        node = TapeNode(vjp_fn, inputs, outputs, len(self.nodes), multi)
+        self.nodes.append(node)
+        return node
+
+    def clear(self):
+        self.nodes.clear()
+
+
+_TAPE = _Tape()
+
+
+def reset_tape():
+    _TAPE.clear()
+
+
+def _tape():
+    return _TAPE
+
+
+# ---------------------------------------------------------------------------
+# Tensor
+# ---------------------------------------------------------------------------
+
+class Tensor:
+    __slots__ = ("_value", "stop_gradient", "grad", "_node", "_out_index",
+                 "name", "persistable", "is_leaf", "trainable", "__weakref__")
+
+    def __init__(self, value, stop_gradient: bool = True,
+                 name: Optional[str] = None):
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._node: Optional[TapeNode] = None
+        self._out_index: int = 0
+        self.name = name
+        self.persistable = False
+        self.is_leaf = True
+        self.trainable = not stop_gradient
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        from .framework import Place
+        try:
+            dev = next(iter(self._value.devices()))
+            return Place(dev.platform, dev.id)
+        except Exception:
+            return Place(framework.get_device())
+
+    @property
+    def T(self):
+        from . import ops
+        return ops.t(self)
+
+    def dim(self):
+        return self.ndim
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return jnp.dtype(self.dtype).itemsize
+
+    # -- host interop -------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-D tensor")
+        return self._value.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        grad_s = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={jnp.dtype(self.dtype).name}"
+                f"{grad_s},\n       {np.asarray(self._value)!r})")
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False):
+        from .autograd import backward
+        backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value))
+        else:
+            self.grad = None
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from . import ops
+        return ops.assign(self)
+
+    def stop_gradient_(self, v: bool):
+        self.stop_gradient = v
+        return self
+
+    def register_hook(self, hook):
+        # eager grad hook: applied when backward deposits into .grad
+        if not hasattr(self, "_hooks"):
+            pass
+        # stored on the node at deposit time via autograd module
+        from .autograd import _register_tensor_hook
+        return _register_tensor_hook(self, hook)
+
+    # -- in-place-ish mutators (replace payload; used by optimizers) --------
+    def set_value(self, v):
+        if isinstance(v, Tensor):
+            v = v._value
+        v = jnp.asarray(v, dtype=self.dtype)
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch {v.shape} vs {self._value.shape}")
+        self._value = v
+        return self
+
+    def copy_(self, other, blocking: bool = True):
+        return self.set_value(other)
+
+    def _update_value(self, v):
+        """Unchecked payload swap (step compiler / optimizers)."""
+        self._value = v
+
+    def fill_(self, v):
+        self._value = jnp.full_like(self._value, v)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    # -- dunder arithmetic (defined in ops/__init__.py monkey-attach) -------
+    # __add__ etc. attached by paddle_tpu.ops at import time.
+
+    def astype(self, dtype):
+        from . import ops
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def cuda(self, *a, **k):
+        return self  # parity no-op: data already on accelerator
+
+    def cpu(self):
+        t = Tensor(jax.device_get(self._value), self.stop_gradient)
+        return t
+
+    def pin_memory(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a in framework.DTYPE_MAP:
+                dtype = a
+            elif not isinstance(a, str):
+                dtype = a
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        from . import ops
+        return ops.getitem(self, idx)
+
+    def __setitem__(self, idx, val):
+        if isinstance(val, Tensor):
+            val = val._value
+        self._value = self._value.at[idx].set(val)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle.base.framework.Parameter — verify)."""
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average",
+                 "need_clip", "is_distributed", "_sharding_spec")
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.need_clip = True
+        self.is_distributed = False
+        self._sharding_spec = None  # jax PartitionSpec for auto-parallel
+
+    def __repr__(self):
+        return "Parameter " + super().__repr__()
+
+
+# ---------------------------------------------------------------------------
+# op application: the single dispatch point of the framework
+# ---------------------------------------------------------------------------
+
+def _wrap_outputs(out, diff: bool, node_setter):
+    if isinstance(out, (tuple, list)):
+        outs = []
+        for i, o in enumerate(out):
+            t = Tensor(o, stop_gradient=not diff)
+            t.is_leaf = False
+            if diff:
+                node_setter(t, i)
+            outs.append(t)
+        return type(out)(outs) if isinstance(out, tuple) else outs
+    t = Tensor(out, stop_gradient=not diff)
+    t.is_leaf = False
+    if diff:
+        node_setter(t, 0)
+    return t
+
+
+def apply_op(fn, *args, **kwargs):
+    """Run pure-jax `fn` on Tensor/array args; record vjp on the tape when
+    eager grad is enabled and any Tensor input requires grad.
+
+    Non-Tensor args (ints, axis tuples, python scalars) are closed over as
+    statics. Returns Tensor or tuple/list of Tensors mirroring fn's output.
+    """
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    want_grad = (framework.is_grad_enabled()
+                 and any(not args[i].stop_gradient for i in tensor_pos))
+
+    if not want_grad:
+        vals = [a._value if isinstance(a, Tensor) else a for a in args]
+        out = fn(*vals, **kwargs)
+        return _wrap_outputs(out, False, None)
+
+    in_tensors = [args[i] for i in tensor_pos]
+    in_vals = tuple(t._value for t in in_tensors)
+
+    out_type_box = [None]
+
+    def pure(*tvals):
+        full = list(args)
+        for p, v in zip(tensor_pos, tvals):
+            full[p] = v
+        full = [a._value if isinstance(a, Tensor) else a for a in full]
+        r = fn(*full, **kwargs)
+        if isinstance(r, (tuple, list)):
+            out_type_box[0] = type(r)
+            return tuple(r)  # normalize pytree so cotangents are tuples
+        return r
+
+    out, vjp_fn = jax.vjp(pure, *in_vals)
+    if out_type_box[0] is list:
+        out = list(out)
+
+    outputs_box: list = []
+    node = _TAPE.record(vjp_fn, in_tensors, outputs_box,
+                        multi=isinstance(out, (tuple, list)))
+
+    def setter(t, i):
+        t._node = node
+        t._out_index = i
+        outputs_box.append(t)
+
+    return _wrap_outputs(out, True, setter)
+
+
+# ---------------------------------------------------------------------------
+# to_tensor
+# ---------------------------------------------------------------------------
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
+    """paddle.to_tensor parity (reference: python/paddle/tensor/creation.py
+    — verify)."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(convert_dtype(dtype))
+        return Tensor(v, stop_gradient=stop_gradient)
+    if isinstance(data, (list, tuple)):
+        # may contain Tensors
+        def unwrap(x):
+            if isinstance(x, Tensor):
+                return np.asarray(x._value)
+            if isinstance(x, (list, tuple)):
+                return [unwrap(e) for e in x]
+            return x
+        data = unwrap(data)
+    d = convert_dtype(dtype)
+    arr = np.asarray(data)
+    if d is None:
+        if arr.dtype == np.float64:
+            d = framework.state().default_dtype
+        elif arr.dtype == np.int64:
+            d = jnp.int32
+    v = jnp.asarray(arr, dtype=d)
+    return Tensor(v, stop_gradient=stop_gradient)

@@ -1,0 +1,11 @@
+"""Utilities (reference: python/paddle/utils/ — verify)."""
+from . import flags        # noqa: F401
+from .run_check import run_check  # noqa: F401
+
+
+def try_import(module_name):
+    import importlib
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        return None

@@ -1,0 +1,59 @@
+"""FLAGS registry (reference: paddle/common/flags.cc PD_DEFINE_EXPORTED_*,
+paddle.set_flags/get_flags — verify). Env override: FLAGS_<name>."""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+__all__ = ["define_flag", "get_flags", "set_flags", "FLAGS"]
+
+_REGISTRY: dict[str, Any] = {}
+
+
+def _env_cast(raw: str, default):
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return float(raw)
+    return raw
+
+
+def define_flag(name: str, default, help_: str = ""):
+    env = os.environ.get(f"FLAGS_{name}")
+    _REGISTRY[name] = _env_cast(env, default) if env is not None else default
+
+
+def get_flags(flags=None):
+    if flags is None:
+        return dict(_REGISTRY)
+    if isinstance(flags, str):
+        flags = [flags]
+    return {f: _REGISTRY[f.replace("FLAGS_", "")] for f in flags}
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        _REGISTRY[k.replace("FLAGS_", "")] = v
+
+
+class _Flags:
+    def __getattr__(self, name):
+        try:
+            return _REGISTRY[name]
+        except KeyError:
+            raise AttributeError(name)
+
+
+FLAGS = _Flags()
+
+# core flags (subset of the reference's ~200 FLAGS_* — verify)
+define_flag("allocator_strategy", "auto_growth")
+define_flag("cudnn_deterministic", False)
+define_flag("embedding_deterministic", 0)
+define_flag("check_nan_inf", False)
+define_flag("benchmark", False)
+define_flag("use_flash_attention", True)
+define_flag("log_level", 0)
+define_flag("tpu_matmul_precision", "default")

@@ -1,0 +1,35 @@
+"""paddle.utils.run_check parity (reference:
+python/paddle/utils/install_check.py — verify): smoke-test the install —
+one matmul+grad on the default device, then a sharded matmul on all local
+devices via a 1-D mesh."""
+from __future__ import annotations
+
+
+def run_check():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    plat = devs[0].platform
+    print(f"Running verify on 1 {plat} device.")
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.random.rand(16, 16).astype("float32"),
+                         stop_gradient=False)
+    w = paddle.to_tensor(np.random.rand(16, 16).astype("float32"),
+                         stop_gradient=False)
+    y = paddle.matmul(x, w).sum()
+    y.backward()
+    assert x.grad is not None
+    print(f"paddle_tpu works on 1 {plat} device.")
+
+    if len(devs) > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(devs), ("dp",))
+        a = jax.device_put(jnp.ones((len(devs) * 8, 16)),
+                           NamedSharding(mesh, P("dp", None)))
+        b = jnp.ones((16, 16))
+        out = jax.jit(lambda a, b: a @ b)(a, b)
+        out.block_until_ready()
+        print(f"paddle_tpu works on {len(devs)} {plat} devices.")
+    print("paddle_tpu is installed successfully!")

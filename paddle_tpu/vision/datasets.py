@@ -1,0 +1,158 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — verify).
+No network in this environment: the standard named datasets raise with a
+download hint unless data files exist locally; FakeData provides the
+synthetic path used by tests/benchmarks."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["FakeData", "MNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder"]
+
+
+class FakeData(Dataset):
+    """Synthetic images+labels (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.int32(rng.randint(self.num_classes))
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+
+class _FileBacked(Dataset):
+    URL_HINT = ""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                f"{type(self).__name__}: dataset file not found "
+                f"(no network egress in this environment; place the file "
+                f"locally and pass data_file=). {self.URL_HINT}")
+        self.data_file = data_file
+        self._load()
+
+    def _load(self):
+        raise NotImplementedError
+
+
+class MNIST(_FileBacked):
+    URL_HINT = "expects the idx-format images/labels gz pair"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        import gzip
+        import struct
+        for p in (image_path, label_path):
+            if p is None or not os.path.exists(p):
+                raise RuntimeError(
+                    "MNIST: pass local image_path/label_path (no egress)")
+        with gzip.open(image_path, "rb") as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            self.images = np.frombuffer(f.read(), np.uint8).reshape(
+                n, rows, cols)
+        with gzip.open(label_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            self.labels = np.frombuffer(f.read(), np.uint8)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int32(self.labels[idx])
+
+
+class Cifar10(_FileBacked):
+    URL_HINT = "expects the python-pickle cifar batches tar"
+
+    def _load(self):
+        import pickle
+        import tarfile
+        datas, labels = [], []
+        with tarfile.open(self.data_file) as tf:
+            names = [m for m in tf.getmembers()
+                     if ("data_batch" in m.name if self.mode == "train"
+                         else "test_batch" in m.name)]
+            for m in names:
+                d = pickle.load(tf.extractfile(m), encoding="bytes")
+                datas.append(d[b"data"])
+                labels.extend(d[b"labels"])
+        self.data = np.concatenate(datas).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int32)
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32) / 255.0
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+class DatasetFolder(Dataset):
+    """ImageNet-style folder-per-class dataset; requires an image decoder
+    backend (PIL/cv2) present locally."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        exts = extensions or (".npy",)
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(exts):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+        self.loader = loader or (lambda p: np.load(p))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int32(target)
+
+
+class ImageFolder(DatasetFolder):
+    def __getitem__(self, idx):
+        path, _ = self.samples[idx]
+        img = self.loader(path)
+        if self.transform:
+            img = self.transform(img)
+        return (img,)

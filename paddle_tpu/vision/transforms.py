@@ -1,0 +1,131 @@
+"""Vision transforms on numpy/Tensor (reference:
+python/paddle/vision/transforms/ — verify)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "Transpose", "normalize",
+           "to_tensor_fn"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return to_tensor(arr)
+
+
+to_tensor_fn = ToTensor
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        arr = np.asarray(img._value if isinstance(img, Tensor) else img,
+                         dtype=np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        arr = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return to_tensor(arr) if isinstance(img, Tensor) else arr
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def __call__(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = np.asarray(img._value if isinstance(img, Tensor) else img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        if chw:
+            out_shape = (arr.shape[0],) + tuple(self.size)
+        elif arr.ndim == 3:
+            out_shape = tuple(self.size) + (arr.shape[2],)
+        else:
+            out_shape = tuple(self.size)
+        out = jax.image.resize(jnp.asarray(arr, jnp.float32), out_shape,
+                               "linear")
+        return to_tensor(out) if isinstance(img, Tensor) else np.asarray(out)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def __call__(self, img):
+        arr = np.asarray(img._value if isinstance(img, Tensor) else img)
+        h_axis = 1 if (arr.ndim == 3 and arr.shape[0] in (1, 3)) else 0
+        h, w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        sl = [slice(None)] * arr.ndim
+        sl[h_axis] = slice(i, i + th)
+        sl[h_axis + 1] = slice(j, j + tw)
+        out = arr[tuple(sl)]
+        return to_tensor(out) if isinstance(img, Tensor) else out
+
+
+class RandomCrop(CenterCrop):
+    def __call__(self, img):
+        arr = np.asarray(img._value if isinstance(img, Tensor) else img)
+        h_axis = 1 if (arr.ndim == 3 and arr.shape[0] in (1, 3)) else 0
+        h, w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        th, tw = self.size
+        i = np.random.randint(0, max(h - th, 0) + 1)
+        j = np.random.randint(0, max(w - tw, 0) + 1)
+        sl = [slice(None)] * arr.ndim
+        sl[h_axis] = slice(i, i + th)
+        sl[h_axis + 1] = slice(j, j + tw)
+        out = arr[tuple(sl)]
+        return to_tensor(out) if isinstance(img, Tensor) else out
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img._value if isinstance(img, Tensor) else img)
+            out = arr[..., ::-1].copy()
+            return to_tensor(out) if isinstance(img, Tensor) else out
+        return img
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        arr = np.asarray(img._value if isinstance(img, Tensor) else img)
+        out = arr.transpose(self.order)
+        return to_tensor(out) if isinstance(img, Tensor) else out

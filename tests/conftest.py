@@ -1,0 +1,30 @@
+"""Test env: 8 virtual CPU devices so mesh/sharding tests run without TPU
+hardware (SURVEY §4: the reference tests multi-device logic with
+multi-process Gloo-on-CPU; here one process with 8 XLA host devices).
+
+NOTE: this environment pre-imports jax at interpreter startup with
+JAX_PLATFORMS=axon (a real exclusive-access TPU tunnel), so we must flip
+the already-imported jax config to cpu — env vars alone are too late."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert not jax.config.jax_platforms or jax.config.jax_platforms == "cpu"
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

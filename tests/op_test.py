@@ -1,0 +1,103 @@
+"""OpTest harness — numpy-reference op checking.
+
+Reference parity: test/legacy_test/op_test.py (declare inputs/attrs, numpy
+reference, check_output(atol), check_grad via numeric finite difference
+— verify). Here check_output compares eager AND jitted execution against
+the numpy reference; check_grad compares tape gradients against central
+finite differences."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+
+
+class OpTest:
+    """Subclass-or-instantiate harness.
+
+    ot = OpTest(op=paddle.add, ref=np.add)
+    ot.check_output([x_np, y_np], atol=1e-6)
+    ot.check_grad([x_np, y_np], wrt=[0, 1])
+    """
+
+    def __init__(self, op, ref=None, kwargs=None):
+        self.op = op
+        self.ref = ref
+        self.kwargs = kwargs or {}
+
+    def _run_eager(self, inputs, stop_gradient=True):
+        ts = [paddle.to_tensor(i, stop_gradient=stop_gradient)
+              if isinstance(i, np.ndarray) else i for i in inputs]
+        out = self.op(*ts, **self.kwargs)
+        return ts, out
+
+    def check_output(self, inputs, atol=1e-6, rtol=1e-5, jit=True):
+        _, out = self._run_eager(inputs)
+        expect = self.ref(*inputs, **self.kwargs) if self.ref else None
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        expects = expect if isinstance(expect, (tuple, list)) else [expect]
+        if expect is not None:
+            for o, e in zip(outs, expects):
+                np.testing.assert_allclose(
+                    np.asarray(o._value), np.asarray(e), atol=atol,
+                    rtol=rtol,
+                    err_msg=f"op {getattr(self.op, '__name__', self.op)}")
+        if jit:
+            import jax
+
+            def pure(*vals):
+                ts = [Tensor(v) for v in vals]
+                r = self.op(*ts, **self.kwargs)
+                rs = r if isinstance(r, (tuple, list)) else [r]
+                return tuple(t._value for t in rs)
+            arr_inputs = [i for i in inputs if isinstance(i, np.ndarray)]
+            jout = jax.jit(pure)(*arr_inputs)
+            for o, j in zip(outs, jout):
+                np.testing.assert_allclose(
+                    np.asarray(o._value), np.asarray(j), atol=atol,
+                    rtol=rtol, err_msg="eager vs jit mismatch")
+        return outs
+
+    def check_grad(self, inputs, wrt=(0,), eps=1e-3, atol=1e-2, rtol=1e-2,
+                   out_index=0):
+        ts, out = self._run_eager(inputs, stop_gradient=False)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        loss = outs[out_index].sum() if outs[out_index].size > 1 \
+            else outs[out_index]
+        loss.backward()
+        for i in wrt:
+            analytic = np.asarray(ts[i].grad._value)
+            numeric = self._numeric_grad(inputs, i, eps, out_index)
+            np.testing.assert_allclose(
+                analytic, numeric, atol=atol, rtol=rtol,
+                err_msg=f"grad wrt input {i} of "
+                        f"{getattr(self.op, '__name__', self.op)}")
+
+    def _numeric_grad(self, inputs, i, eps, out_index):
+        base = [np.array(x, dtype=np.float64) if isinstance(x, np.ndarray)
+                else x for x in inputs]
+        x = base[i]
+        grad = np.zeros_like(x, dtype=np.float64)
+
+        def f(vals):
+            ts = [paddle.to_tensor(v.astype(np.float32))
+                  if isinstance(v, np.ndarray) else v for v in vals]
+            with paddle.no_grad():
+                r = self.op(*ts, **self.kwargs)
+            rs = r if isinstance(r, (tuple, list)) else [r]
+            return float(np.asarray(rs[out_index]._value,
+                                    dtype=np.float64).sum())
+
+        it = np.nditer(x, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            orig = x[idx]
+            x[idx] = orig + eps
+            fp = f(base)
+            x[idx] = orig - eps
+            fm = f(base)
+            x[idx] = orig
+            grad[idx] = (fp - fm) / (2 * eps)
+            it.iternext()
+        return grad.astype(np.float32)

@@ -1,0 +1,228 @@
+"""Op surface tests vs numpy references (reference pattern:
+test/legacy_test/test_*_op.py — verify)."""
+import numpy as np
+import pytest
+import scipy.special
+
+import paddle_tpu as paddle
+from op_test import OpTest
+
+
+def rnd(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+BINARY_CASES = [
+    (paddle.add, np.add), (paddle.subtract, np.subtract),
+    (paddle.multiply, np.multiply), (paddle.divide, np.divide),
+    (paddle.maximum, np.maximum), (paddle.minimum, np.minimum),
+    (paddle.pow, np.power), (paddle.atan2, np.arctan2),
+]
+
+
+@pytest.mark.parametrize("op,ref", BINARY_CASES,
+                         ids=[o.__name__ for o, _ in BINARY_CASES])
+def test_binary_ops(op, ref):
+    x, y = rnd(3, 4) + 0.5, rnd(3, 4) + 0.5
+    OpTest(op, ref).check_output([x, y])
+    OpTest(op, ref).check_grad([x, y], wrt=(0, 1))
+
+
+UNARY_CASES = [
+    (paddle.exp, np.exp), (paddle.log, np.log), (paddle.sqrt, np.sqrt),
+    (paddle.tanh, np.tanh), (paddle.sin, np.sin), (paddle.cos, np.cos),
+    (paddle.sigmoid, scipy.special.expit), (paddle.abs, np.abs),
+    (paddle.square, np.square), (paddle.floor, np.floor),
+    (paddle.erf, scipy.special.erf),
+    (paddle.log1p, np.log1p), (paddle.rsqrt, lambda v: 1 / np.sqrt(v)),
+]
+
+
+@pytest.mark.parametrize(
+    "op,ref", UNARY_CASES,
+    ids=["exp", "log", "sqrt", "tanh", "sin", "cos", "sigmoid", "abs",
+         "square", "floor", "erf", "log1p", "rsqrt"])
+def test_unary_ops(op, ref):
+    x = rnd(3, 4) + 0.5
+    OpTest(op, ref).check_output([x], atol=1e-4, rtol=1e-3)
+
+
+def test_unary_grads():
+    x = rnd(3, 3) + 0.5
+    for op in (paddle.exp, paddle.log, paddle.tanh, paddle.sqrt):
+        OpTest(op).check_grad([x])
+
+
+def test_broadcasting():
+    x, y = rnd(3, 1, 4), rnd(5, 1)
+    OpTest(paddle.add, np.add).check_output([x, y])
+    OpTest(paddle.multiply, np.multiply).check_grad([x, y], wrt=(0, 1))
+
+
+def test_matmul():
+    a, b = rnd(3, 4), rnd(4, 5)
+    OpTest(paddle.matmul, np.matmul).check_output([a, b])
+    OpTest(paddle.matmul, np.matmul).check_grad([a, b], wrt=(0, 1))
+    # batched + transpose flags
+    a3, b3 = rnd(2, 3, 4), rnd(2, 5, 4)
+    ot = OpTest(paddle.matmul, lambda x, y, **kw: np.matmul(
+        x, np.swapaxes(y, -1, -2)), kwargs={"transpose_y": True})
+    ot.check_output([a3, b3])
+
+
+def test_reductions():
+    x = rnd(3, 4, 5)
+    OpTest(paddle.sum, np.sum).check_output([x])
+    OpTest(paddle.mean, np.mean, {"axis": 1}).check_output(
+        [x], atol=1e-6)
+    OpTest(paddle.max, lambda v, axis, keepdim: np.max(
+        v, axis=axis, keepdims=keepdim),
+        {"axis": 2, "keepdim": True}).check_output([x])
+    OpTest(paddle.prod, np.prod, {"axis": 0}).check_output([x], atol=1e-5)
+    OpTest(paddle.sum, lambda v, axis: np.sum(v, axis=tuple(axis)),
+           {"axis": [0, 2]}).check_output([x], atol=1e-5)
+    OpTest(paddle.mean, np.mean).check_grad([x])
+    np.testing.assert_allclose(
+        paddle.std(paddle.to_tensor(x)).item(), x.std(ddof=1), rtol=1e-5)
+
+
+def test_manipulation():
+    x = rnd(2, 3, 4)
+    OpTest(paddle.reshape, lambda v, shape: np.reshape(v, shape),
+           {"shape": (6, 4)}).check_output([x])
+    OpTest(paddle.transpose, lambda v, perm: np.transpose(v, perm),
+           {"perm": (2, 0, 1)}).check_output([x])
+    OpTest(paddle.flatten, lambda v, start_axis: v.reshape(2, -1),
+           {"start_axis": 1}).check_output([x])
+    t = paddle.to_tensor(x)
+    assert paddle.concat([t, t], axis=1).shape == [2, 6, 4]
+    assert paddle.stack([t, t]).shape == [2, 2, 3, 4]
+    parts = paddle.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts = paddle.split(t, [1, 3], axis=2)
+    assert parts[1].shape == [2, 3, 3]
+    assert paddle.squeeze(paddle.ones((2, 1, 3)), 1).shape == [2, 3]
+    assert paddle.unsqueeze(t, [0, 2]).shape == [1, 2, 1, 3, 4]
+    assert paddle.tile(paddle.ones((2, 3)), [2, 2]).shape == [4, 6]
+    assert paddle.expand(paddle.ones((1, 3)), [5, 3]).shape == [5, 3]
+    assert paddle.flip(t, [0]).shape == [2, 3, 4]
+    assert paddle.roll(t, 1, 0).shape == [2, 3, 4]
+
+
+def test_concat_grad():
+    def op(a, b):
+        return paddle.concat([a, b], axis=0)
+    OpTest(op, lambda a, b: np.concatenate([a, b])).check_output(
+        [rnd(2, 3), rnd(4, 3)])
+    OpTest(op).check_grad([rnd(2, 3), rnd(4, 3)], wrt=(0, 1))
+
+
+def test_gather_scatter():
+    x = rnd(5, 3)
+    idx = np.array([0, 2, 4], np.int32)
+    OpTest(paddle.gather, lambda v, i: v[i]).check_output([x, idx])
+    out = paddle.gather_nd(paddle.to_tensor(x),
+                           paddle.to_tensor(np.array([[0, 1], [2, 2]],
+                                                     np.int32)))
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.array([x[0, 1], x[2, 2]]))
+    t = paddle.to_tensor(x)
+    upd = paddle.to_tensor(rnd(2, 3))
+    res = paddle.scatter(t, paddle.to_tensor(np.array([1, 3], np.int32)),
+                         upd)
+    expect = x.copy()
+    expect[[1, 3]] = np.asarray(upd._value)
+    np.testing.assert_allclose(np.asarray(res._value), expect)
+
+
+def test_index_sort_topk():
+    x = rnd(4, 6)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(np.asarray(paddle.sort(t, 1)._value),
+                               np.sort(x, 1))
+    np.testing.assert_allclose(np.asarray(paddle.argsort(t, 1)._value),
+                               np.argsort(x, 1, kind="stable"))
+    vals, idx = paddle.topk(t, 3, axis=1)
+    np.testing.assert_allclose(np.asarray(vals._value),
+                               -np.sort(-x, 1)[:, :3], rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(paddle.argmax(t, 1)._value), np.argmax(x, 1))
+    np.testing.assert_allclose(
+        np.asarray(paddle.cumsum(t, 1)._value), np.cumsum(x, 1), rtol=1e-5)
+
+
+def test_where_comparison():
+    x, y = rnd(3, 4), rnd(3, 4)
+    t, u = paddle.to_tensor(x), paddle.to_tensor(y)
+    np.testing.assert_array_equal(
+        np.asarray((t > u)._value), x > y)
+    out = paddle.where(t > u, t, u)
+    np.testing.assert_allclose(np.asarray(out._value), np.maximum(x, y))
+    assert bool(paddle.allclose(t, paddle.to_tensor(x.copy())).item())
+
+
+def test_einsum_norm():
+    a, b = rnd(3, 4), rnd(4, 5)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                        paddle.to_tensor(b))
+    np.testing.assert_allclose(np.asarray(out._value), a @ b, rtol=1e-5)
+    x = rnd(3, 4)
+    np.testing.assert_allclose(
+        paddle.norm(paddle.to_tensor(x)).item(),
+        np.linalg.norm(x), rtol=1e-5)
+
+
+def test_creation():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert str(paddle.ones([2], dtype="int32").dtype) == "int32"
+    np.testing.assert_array_equal(
+        np.asarray(paddle.arange(0, 10, 2)._value), np.arange(0, 10, 2))
+    assert paddle.eye(3).shape == [3, 3]
+    assert paddle.full([2, 2], 7.0).numpy()[0, 0] == 7.0
+    assert paddle.linspace(0, 1, 5).shape == [5]
+    tr = paddle.tril(paddle.ones([4, 4]))
+    assert tr.numpy()[0, 3] == 0 and tr.numpy()[3, 0] == 1
+    x = paddle.rand([100, 100])
+    assert 0.4 < float(x.mean()) < 0.6
+    r = paddle.randn([1000])
+    assert abs(float(r.mean())) < 0.2
+    p = paddle.randperm(16)
+    assert sorted(p.tolist()) == list(range(16))
+
+
+def test_random_seed_determinism():
+    paddle.seed(7)
+    a = paddle.rand([4, 4]).numpy()
+    paddle.seed(7)
+    b = paddle.rand([4, 4]).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_cast_dtypes():
+    x = paddle.to_tensor(np.array([1.7, -2.3], np.float32))
+    assert str(paddle.cast(x, "int32").dtype) == "int32"
+    assert str(x.astype("bfloat16").dtype) == "bfloat16"
+    # int64/float64 degrade (documented)
+    y = paddle.to_tensor(np.array([1, 2], np.int64))
+    assert str(y.dtype) == "int32"
+
+
+def test_indexing():
+    x = rnd(4, 5, 6)
+    t = paddle.to_tensor(x)
+    np.testing.assert_allclose(np.asarray(t[1]._value), x[1])
+    np.testing.assert_allclose(np.asarray(t[1:3, ::2]._value), x[1:3, ::2])
+    np.testing.assert_allclose(np.asarray(t[..., -1]._value), x[..., -1])
+    idx = paddle.to_tensor(np.array([0, 2], np.int32))
+    np.testing.assert_allclose(np.asarray(t[idx]._value), x[[0, 2]])
+    t2 = paddle.to_tensor(x.copy())
+    t2[0] = 0.0
+    assert float(t2[0].sum()) == 0.0
+
+
+def test_inplace_and_item():
+    t = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    t.add_(paddle.to_tensor(np.array([1.0, 1.0], np.float32)))
+    np.testing.assert_allclose(t.numpy(), [2.0, 3.0])
+    assert paddle.to_tensor(3.5).item() == 3.5
+    assert paddle.to_tensor([[1, 2]]).tolist() == [[1, 2]]
