@@ -1,0 +1,95 @@
+"""Headline benchmark: Llama pretrain step throughput on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+vs_baseline = achieved MFU / 0.45 (the BASELINE.json north-star MFU target;
+no reference throughput numbers were recoverable — see BASELINE.md)."""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# chip peak bf16 FLOP/s by generation (public specs)
+PEAK_FLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v4": 275e12,
+              "v5p": 459e12, "v6e": 918e12, "cpu": 1e12}
+
+
+def main():
+    import os
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower() if on_tpu \
+        else "cpu"
+    peak = PEAK_FLOPS.get(gen, 197e12 if on_tpu else 1e12)
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=1024,
+                          tensor_parallel=False)
+        batch, seq, steps, warmup = 8, 1024, 12, 3
+    else:  # smoke path for CPU dev runs
+        from paddle_tpu.models.llama import llama_tiny_config
+        cfg = llama_tiny_config(tensor_parallel=False)
+        batch, seq, steps, warmup = 2, 64, 4, 1
+
+    model = LlamaForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters(),
+                          multi_precision=True)
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def loss_fn(m, b):
+        ids, labels = b
+        loss, _ = m(ids, labels)
+        return loss
+
+    step = TrainStep(model, loss_fn, opt)
+    ids = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+    batch_t = (paddle.to_tensor(ids), paddle.to_tensor(labels))
+
+    for _ in range(warmup):
+        loss = step(batch_t)
+    float(loss.item())  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(batch_t)
+    final = float(loss.item())  # sync
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tok_per_s = tokens / dt
+    flops_per_token = model.flops_per_token(seq)
+    mfu = tok_per_s * flops_per_token / peak
+    n_params = model.num_params()
+
+    print(json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": round(tok_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "mfu": round(mfu, 4),
+        "model_params": int(n_params),
+        "chip": gen,
+        "batch": batch, "seq": seq,
+        "final_loss": round(final, 4),
+        "step_ms": round(dt / steps * 1000, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

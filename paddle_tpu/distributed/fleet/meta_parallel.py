@@ -31,15 +31,30 @@ __all__ = ["ColumnParallelLinear", "RowParallelLinear",
 
 
 def _constrain(x, spec: P):
-    """with_sharding_constraint under trace; no-op when not in a mesh ctx."""
+    """with_sharding_constraint against the active mesh; no-op when no mesh
+    is set or an axis in the spec isn't on the mesh."""
+    from ..mesh import get_current_mesh
+    if not framework.in_functional_mode():
+        return x
+    mesh = get_current_mesh()
+    if mesh is None:
+        # fall back to an ambient `with mesh:` context if one is active
+        def g(v):
+            try:
+                return jax.lax.with_sharding_constraint(v, spec)
+            except Exception:
+                return v
+        return apply_op(g, x)
+    axes = set(mesh.axis_names)
+    for s in spec:
+        for a in (s if isinstance(s, tuple) else (s,)):
+            if a is not None and a not in axes:
+                return x
+
     def f(v):
-        try:
-            return jax.lax.with_sharding_constraint(v, spec)
-        except Exception:
-            return v
-    if framework.in_functional_mode():
-        return apply_op(f, x)
-    return x
+        from jax.sharding import NamedSharding
+        return jax.lax.with_sharding_constraint(v, NamedSharding(mesh, spec))
+    return apply_op(f, x)
 
 
 class ColumnParallelLinear(Layer):

@@ -26,17 +26,32 @@ __all__ = ["HybridCommunicateGroup", "get_hybrid_communicate_group",
 AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
 
 _HCG: Optional["HybridCommunicateGroup"] = None
+_CURRENT_MESH: Optional[Mesh] = None
 
 
-def build_device_mesh(axis_dims: dict, devices=None) -> Mesh:
-    """axis_dims: {"dp": 2, "mp": 4, ...}; missing axes get degree 1."""
+def set_current_mesh(mesh: Optional[Mesh]):
+    global _CURRENT_MESH
+    _CURRENT_MESH = mesh
+
+
+def get_current_mesh() -> Optional[Mesh]:
+    return _CURRENT_MESH
+
+
+def build_device_mesh(axis_dims: dict, devices=None,
+                      allow_subset: bool = False) -> Mesh:
+    """axis_dims: {"dp": 2, "mp": 4, ...}; missing axes get degree 1.
+    With allow_subset, uses the first prod(dims) devices (driver dryruns);
+    otherwise a size mismatch is an error — silently idling chips hides
+    config typos."""
     devices = list(devices if devices is not None else jax.devices())
     dims = [int(axis_dims.get(a, 1)) for a in AXIS_ORDER]
     total = int(np.prod(dims))
-    if total != len(devices):
+    if total > len(devices) or (total < len(devices) and not allow_subset):
         raise ValueError(
             f"topology {dict(zip(AXIS_ORDER, dims))} needs {total} devices, "
-            f"have {len(devices)}")
+            f"have {len(devices)} (pass allow_subset=True to use a prefix)")
+    devices = devices[:total]
     try:
         from jax.experimental import mesh_utils
         arr = mesh_utils.create_device_mesh(dims, devices=devices)
@@ -64,15 +79,18 @@ class CommunicateTopology:
 
 class HybridCommunicateGroup:
     def __init__(self, dp_degree=1, mp_degree=1, pp_degree=1,
-                 sharding_degree=1, sep_degree=1, order=None, devices=None):
+                 sharding_degree=1, sep_degree=1, order=None, devices=None,
+                 allow_subset=False):
         self._dims = {"dp": dp_degree, "mp": mp_degree, "pp": pp_degree,
                       "sharding": sharding_degree, "sep": sep_degree}
-        self.mesh = build_device_mesh(self._dims, devices)
+        self.mesh = build_device_mesh(self._dims, devices,
+                                      allow_subset=allow_subset)
         self._topo = CommunicateTopology(list(AXIS_ORDER),
                                          [self._dims.get(a, 1)
                                           for a in AXIS_ORDER])
         global _HCG
         _HCG = self
+        set_current_mesh(self.mesh)
 
     # -- mesh-native accessors ---------------------------------------------
     @property

@@ -1,0 +1,75 @@
+"""Placement helpers: put model params / batches onto a mesh.
+
+TPU-native core of fleet.distributed_model: parameters carry
+``_sharding_spec`` (set by TP layers, FSDP annotation, or shard_tensor);
+this module materializes those specs as NamedSharding placements so jitted
+steps inherit them (GSPMD then propagates through the whole program)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+__all__ = ["place_model", "shard_batch", "replicate", "filter_spec"]
+
+
+def filter_spec(spec: Optional[P], mesh: Mesh, ndim: int) -> P:
+    """Drop axes the mesh doesn't have; default replicated."""
+    if spec is None:
+        return P()
+    axes = set(mesh.axis_names)
+    out = []
+    for s in tuple(spec):
+        if s is None:
+            out.append(None)
+        elif isinstance(s, tuple):
+            kept = tuple(a for a in s if a in axes)
+            out.append(kept if kept else None)
+        else:
+            out.append(s if s in axes else None)
+    return P(*out)
+
+
+def _divisible(shape, spec: P, mesh: Mesh) -> bool:
+    for dim, s in zip(shape, tuple(spec)):
+        if s is None:
+            continue
+        names = s if isinstance(s, tuple) else (s,)
+        total = int(np.prod([mesh.shape[a] for a in names]))
+        if total and dim % total != 0:
+            return False
+    return True
+
+
+def place_model(model: Layer, mesh: Mesh, shard_specs: bool = True):
+    """device_put every param per its _sharding_spec (replicated if none or
+    not divisible); buffers replicated."""
+    for _, p in model.named_parameters():
+        spec = filter_spec(p._sharding_spec if shard_specs else None,
+                           mesh, p._value.ndim)
+        if not _divisible(p._value.shape, spec, mesh):
+            spec = P()
+        p._update_value(jax.device_put(
+            p._value, NamedSharding(mesh, spec)))
+    for _, b in model.named_buffers():
+        b._update_value(jax.device_put(
+            b._value, NamedSharding(mesh, P())))
+    return model
+
+
+def shard_batch(mesh: Mesh, value, spec: P):
+    v = value._value if isinstance(value, Tensor) else value
+    spec = filter_spec(spec, mesh, getattr(v, "ndim", 0))
+    if not _divisible(v.shape, spec, mesh):
+        spec = P()
+    out = jax.device_put(v, NamedSharding(mesh, spec))
+    return Tensor(out) if isinstance(value, Tensor) else out
+
+
+def replicate(mesh: Mesh, value):
+    return shard_batch(mesh, value, P())

@@ -218,10 +218,16 @@ class TrainStep:
 
     def _build(self):
         model, loss_fn, opt = self.model, self.loss_fn, self.optimizer
-        ptensors = {n: p for n, p in model.named_parameters()
-                    if not p.stop_gradient}
-        frozen = {n: p for n, p in model.named_parameters()
-                  if p.stop_gradient}
+        # key trainable params by the OPTIMIZER's unique names so opt-state
+        # slots and grads line up inside the functional update
+        opt_name_of = {id(p): n for n, p in
+                       zip(opt._param_names, opt._param_list)}
+        ptensors, frozen = {}, {}
+        for n, p in model.named_parameters():
+            if not p.stop_gradient and id(p) in opt_name_of:
+                ptensors[opt_name_of[id(p)]] = p
+            else:
+                frozen[n] = p
         btensors = dict(model.named_buffers())
         self._pnames = list(ptensors)
 

@@ -449,23 +449,56 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      data_format="NCHW", output_size=None, name=None):
+    """Transposed conv as a forward conv with lhs dilation (paddle output
+    size semantics: (H-1)*stride - 2*pad + dilation*(k-1) + 1 + out_pad).
+    Weight layout (in, out/groups, kh, kw)."""
     strides = _pair(stride, 2)
     dils = _pair(dilation, 2)
     pads = _conv_padding(padding, 2, strides, weight.shape[2:], dils)
-    if isinstance(pads, str):
-        pad_arg = pads
-    else:
-        pad_arg = pads
+    op = output_padding if not isinstance(output_padding, (list, tuple)) \
+        or len(output_padding) != 1 else output_padding[0]
+    opad = _pair(op, 2)
+    if data_format not in ("NCHW",):
+        raise NotImplementedError(
+            "conv2d_transpose currently supports NCHW only")
 
     def f(v, w, *b):
-        # weight layout (in, out/groups, kh, kw) — paddle conv_transpose
-        out = jax.lax.conv_transpose(
-            v, jnp.swapaxes(w, 0, 1) if groups == 1 else w,
-            strides=strides, padding=pad_arg if isinstance(pad_arg, str)
-            else [(p[0], p[1]) for p in pad_arg],
-            rhs_dilation=dils,
+        kh, kw = w.shape[2], w.shape[3]
+        # (in, out/g, kh, kw) -> (out, in/g, kh, kw) flipped spatially
+        if groups == 1:
+            w2 = jnp.swapaxes(w, 0, 1)
+        else:
+            ig = w.shape[0] // groups
+            wg = w.reshape(groups, ig, w.shape[1], kh, kw)
+            w2 = jnp.swapaxes(wg, 1, 2).reshape(
+                groups * w.shape[1], ig, kh, kw)
+        w2 = jnp.flip(w2, axis=(2, 3))
+        keff = [(kh - 1) * dils[0] + 1, (kw - 1) * dils[1] + 1]
+        if isinstance(pads, str):
+            p_list = [(0, 0), (0, 0)] if pads == "VALID" else [
+                ((keff[i] - strides[i]) // 2,) * 2 for i in range(2)]
+        else:
+            p_list = pads
+        opad_eff = list(opad)
+        if output_size is not None:
+            os_ = _pair(output_size, 2)
+            for i in range(2):
+                base = (v.shape[2 + i] - 1) * strides[i] - \
+                    (p_list[i][0] + p_list[i][1]) + keff[i]
+                opad_eff[i] = os_[i] - base
+        pad_arg = [
+            (keff[i] - 1 - p_list[i][0],
+             keff[i] - 1 - p_list[i][1] + opad_eff[i])
+            for i in range(2)]
+        out = jax.lax.conv_general_dilated(
+            v, w2, window_strides=(1, 1), padding=pad_arg,
+            lhs_dilation=strides, rhs_dilation=dils,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            transpose_kernel=True)
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32
+            if v.dtype == jnp.bfloat16 else None)
+        if v.dtype == jnp.bfloat16:
+            out = out.astype(v.dtype)
         if b:
             out = out + b[0].reshape(1, -1, 1, 1)
         return out

@@ -93,8 +93,15 @@ class Optimizer:
         self._multi_precision = multi_precision
         self._slots: dict[str, dict] = {}      # pname -> slot dict
         self._step_count = 0
-        self._param_names = [p.name or f"param_{i}"
-                             for i, p in enumerate(self._param_list)]
+        names, seen = [], set()
+        for i, p in enumerate(self._param_list):
+            base = p.name or f"param_{i}"
+            while base in seen:
+                base = f"{base}_{i}"
+                i += len(self._param_list)  # guarantee progress
+            seen.add(base)
+            names.append(base)
+        self._param_names = names
         # regularization coeff in paddle may be L2Decay object
         wd = self._weight_decay
         if hasattr(wd, "_coeff"):

@@ -1,0 +1,84 @@
+"""AMP tests (reference pattern: test/amp/ — verify)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn, optimizer
+
+
+def rnd(*s):
+    return np.random.rand(*s).astype(np.float32)
+
+
+def test_autocast_casts_matmul():
+    x = paddle.to_tensor(rnd(4, 4))
+    w = paddle.to_tensor(rnd(4, 4))
+    with amp.auto_cast(dtype="bfloat16"):
+        y = paddle.matmul(x, w)
+    assert str(y.dtype) == "bfloat16"
+    y2 = paddle.matmul(x, w)
+    assert str(y2.dtype) == "float32"
+
+
+def test_autocast_disabled():
+    x = paddle.to_tensor(rnd(2, 2))
+    with amp.auto_cast(enable=False):
+        assert str(paddle.matmul(x, x).dtype) == "float32"
+
+
+def test_decorate_o2():
+    m = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8), nn.Linear(8, 2))
+    opt = optimizer.AdamW(parameters=m.parameters())
+    m, opt = amp.decorate(m, opt, level="O2", dtype="bfloat16")
+    assert str(m[0].weight.dtype) == "bfloat16"
+    # norms excluded (kept fp32)
+    assert str(m[1].weight.dtype) == "float32"
+    assert opt._multi_precision
+
+
+def test_grad_scaler_scales_and_unscales():
+    m = nn.Linear(2, 1)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.to_tensor(rnd(4, 2))
+    loss = m(x).sum()
+    scaled = scaler.scale(loss)
+    np.testing.assert_allclose(scaled.item(), loss.item() * 1024.0,
+                               rtol=1e-6)
+    scaled.backward()
+    w0 = m.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    # grads unscaled before the step: step magnitude matches lr*unscaled g
+    expect_g = np.broadcast_to(x.numpy().sum(0)[:, None], (2, 1))
+    np.testing.assert_allclose(m.weight.numpy(), w0 - 0.1 * expect_g,
+                               rtol=1e-4)
+
+
+def test_grad_scaler_skips_on_inf():
+    m = nn.Linear(2, 1)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=4.0)
+    w0 = m.weight.numpy().copy()
+    m.weight.grad = paddle.to_tensor(
+        np.array([[np.inf], [1.0]], np.float32))
+    scaler.step(opt)
+    np.testing.assert_array_equal(m.weight.numpy(), w0)  # step skipped
+    assert scaler.get_loss_scaling() < 4.0  # backed off
+
+
+def test_bf16_training_via_trainstep():
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    opt = optimizer.AdamW(learning_rate=0.01, parameters=m.parameters())
+    m, opt = amp.decorate(m, opt, level="O2", dtype="bfloat16")
+    step = TrainStep(m, lambda mm, b: ((mm(b[0]) - b[1]) ** 2).mean(), opt)
+    x = rnd(32, 8)
+    y = (x.sum(1, keepdims=True) / 4).astype(np.float32)
+    first = float(step((paddle.to_tensor(x).astype("bfloat16"),
+                        paddle.to_tensor(y).astype("bfloat16"))).item())
+    for _ in range(40):
+        last = float(step((paddle.to_tensor(x).astype("bfloat16"),
+                           paddle.to_tensor(y).astype("bfloat16"))).item())
+    assert last < first * 0.5
+    assert str(m[0].weight.dtype) == "bfloat16"

@@ -1,0 +1,166 @@
+"""jit/to_static + TrainStep tests (reference pattern:
+test/dygraph_to_static/: run eager vs to_static, assert allclose — verify)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.jit import TrainStep, EvalStep, to_static
+
+
+def rnd(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+def test_to_static_function_parity():
+    l = nn.Linear(4, 3)
+
+    def f(x):
+        return paddle.tanh(l(x)) * 2
+
+    x = paddle.to_tensor(rnd(2, 4))
+    eager = f(x).numpy()
+    static_f = to_static(f)
+    np.testing.assert_allclose(static_f(x).numpy(), eager, rtol=1e-5,
+                               atol=1e-6)
+    # second call hits the jit cache
+    np.testing.assert_allclose(static_f(x).numpy(), eager, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_to_static_layer_parity():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.to_tensor(rnd(3, 4))
+    eager = m(x).numpy()
+    to_static(m)
+    np.testing.assert_allclose(m(x).numpy(), eager, rtol=1e-5, atol=1e-6)
+
+
+def test_to_static_backward():
+    m = nn.Linear(4, 2)
+    to_static(m)
+    x = paddle.to_tensor(rnd(3, 4))
+    loss = m(x).sum()
+    loss.backward()
+    assert m.weight.grad is not None
+    np.testing.assert_allclose(
+        m.weight.grad.numpy(),
+        np.broadcast_to(x.numpy().sum(0)[:, None], (4, 2)), rtol=1e-5)
+
+
+def test_to_static_batchnorm_buffer_update():
+    bn = nn.BatchNorm2D(3)
+    to_static(bn)
+    x = paddle.to_tensor(rnd(4, 3, 5, 5) + 2.0)
+    bn(x)
+    assert not np.allclose(bn._mean.numpy(), 0.0)  # buffer threaded out
+
+
+def test_trainstep_loss_decreases():
+    paddle.seed(1)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 1))
+    opt = optimizer.Adam(learning_rate=0.01,
+                         parameters=model.parameters())
+
+    def loss_fn(m, batch):
+        x, y = batch
+        return ((m(x) - y) ** 2).mean()
+
+    step = TrainStep(model, loss_fn, opt)
+    x = rnd(64, 8)
+    y = (x @ np.ones((8, 1)) * 0.5).astype(np.float32)
+    losses = []
+    for _ in range(60):
+        losses.append(float(step((paddle.to_tensor(x),
+                                  paddle.to_tensor(y))).item()))
+    assert losses[-1] < losses[0] * 0.05, losses[-5:]
+
+
+def test_trainstep_matches_eager():
+    """Fused jitted step must produce the same trajectory as eager
+    backward+step (the serial-vs-parallel golden pattern, SURVEY §4)."""
+    def build():
+        paddle.seed(7)
+        m = nn.Linear(4, 2)
+        o = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        return m, o
+
+    x = rnd(8, 4)
+    y = rnd(8, 2)
+
+    m1, o1 = build()
+    for _ in range(5):
+        loss = ((m1(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        o1.step()
+        o1.clear_grad()
+
+    m2, o2 = build()
+    step = TrainStep(m2, lambda m, b: ((m(b[0]) - b[1]) ** 2).mean(), o2)
+    for _ in range(5):
+        step((paddle.to_tensor(x), paddle.to_tensor(y)))
+
+    np.testing.assert_allclose(m1.weight.numpy(), m2.weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m1.bias.numpy(), m2.bias.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_trainstep_aux_outputs():
+    m = nn.Linear(2, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+
+    def loss_fn(model, batch):
+        out = model(batch)
+        loss = out.sum()
+        return loss, out
+
+    step = TrainStep(m, loss_fn, opt)
+    res = step(paddle.to_tensor(rnd(3, 2)))
+    assert isinstance(res, tuple)
+    loss, out = res
+    assert out.shape == [3, 2]
+
+
+def test_evalstep():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    es = EvalStep(m, lambda model, b: model(b))
+    x = paddle.to_tensor(rnd(2, 4))
+    out1 = es(x).numpy()
+    out2 = es(x).numpy()
+    np.testing.assert_array_equal(out1, out2)  # dropout off in eval
+
+
+def test_static_dropout_varies_across_calls():
+    m = nn.Dropout(0.5)
+    f = to_static(lambda x: m(x))
+    x = paddle.to_tensor(np.ones((100,), np.float32))
+    a = f(x).numpy()
+    b = f(x).numpy()
+    assert not np.array_equal(a, b)  # fresh rng key per call
+
+
+def test_recompute_in_trainstep():
+    from paddle_tpu.distributed.fleet import utils as fleet_utils
+    paddle.seed(5)
+    l1, l2 = nn.Linear(4, 16), nn.Linear(16, 1)
+
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.l1, self.l2 = l1, l2
+
+        def forward(self, x):
+            h = fleet_utils.recompute(
+                lambda v: paddle.tanh(self.l1(v)), x)
+            return self.l2(h)
+
+    m = M()
+    opt = optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    step = TrainStep(m, lambda mm, b: (mm(b[0]) - b[1]).pow(2).mean(), opt)
+    x, y = rnd(16, 4), rnd(16, 1)
+    l0 = float(step((paddle.to_tensor(x), paddle.to_tensor(y))).item())
+    for _ in range(40):
+        last = float(step((paddle.to_tensor(x),
+                           paddle.to_tensor(y))).item())
+    assert last < l0

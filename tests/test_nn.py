@@ -1,0 +1,270 @@
+"""nn layer tests (reference pattern: test/legacy_test/test_*_api.py +
+numpy parity — verify)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+def rnd(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+def test_linear():
+    l = nn.Linear(4, 3)
+    x = paddle.to_tensor(rnd(2, 4))
+    y = l(x)
+    assert y.shape == [2, 3]
+    np.testing.assert_allclose(
+        y.numpy(), x.numpy() @ l.weight.numpy() + l.bias.numpy(),
+        rtol=1e-5)
+
+
+def test_layer_registration_and_state_dict():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.register_buffer("counter", paddle.zeros([1]))
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    m = M()
+    names = [n for n, _ in m.named_parameters()]
+    assert "fc1.weight" in names and "fc2.bias" in names
+    assert len(m.parameters()) == 4
+    sd = m.state_dict()
+    assert "counter" in sd and len(sd) == 5
+    m2 = M()
+    m2.set_state_dict(sd)
+    np.testing.assert_array_equal(m2.fc1.weight.numpy(),
+                                  m.fc1.weight.numpy())
+    out = m(paddle.to_tensor(rnd(3, 4)))
+    assert out.shape == [3, 2]
+
+
+def test_sequential_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(seq) == 3
+    assert seq(paddle.to_tensor(rnd(2, 4))).shape == [2, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(nn.Sequential(*ll).parameters()) == 8
+
+
+def test_conv2d_shapes_and_ref():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.to_tensor(rnd(2, 3, 16, 16))
+    y = conv(x)
+    assert y.shape == [2, 8, 8, 8]
+    # depthwise
+    dw = nn.Conv2D(8, 8, 3, groups=8, padding=1)
+    assert dw(y).shape == [2, 8, 8, 8]
+    # conv transpose doubles spatial
+    ct = nn.Conv2DTranspose(8, 4, 2, stride=2)
+    assert ct(y).shape == [2, 4, 16, 16]
+
+
+def test_conv2d_numpy_ref():
+    # 1x1 conv == per-pixel matmul
+    conv = nn.Conv2D(3, 5, 1, bias_attr=False)
+    x = rnd(2, 3, 4, 4)
+    y = conv(paddle.to_tensor(x)).numpy()
+    w = conv.weight.numpy()  # (5, 3, 1, 1)
+    expect = np.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+    np.testing.assert_allclose(y, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_pooling():
+    x = paddle.to_tensor(rnd(2, 3, 8, 8))
+    assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+    np.testing.assert_allclose(
+        nn.AdaptiveAvgPool2D(1)(x).numpy()[..., 0, 0],
+        x.numpy().mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_layernorm_ref():
+    ln = nn.LayerNorm(6)
+    x = rnd(2, 3, 6)
+    y = ln(paddle.to_tensor(x)).numpy()
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    expect = (x - mu) / np.sqrt(var + 1e-5) * ln.weight.numpy() + \
+        ln.bias.numpy()
+    np.testing.assert_allclose(y, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_rmsnorm_ref():
+    rn = nn.RMSNorm(6)
+    x = rnd(2, 6)
+    y = rn(paddle.to_tensor(x)).numpy()
+    expect = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3, momentum=0.9)
+    x = rnd(4, 3, 5, 5) * 2 + 1
+    y = bn(paddle.to_tensor(x)).numpy()
+    # normalized per-channel over N,H,W
+    np.testing.assert_allclose(y.mean(axis=(0, 2, 3)), 0.0, atol=1e-5)
+    np.testing.assert_allclose(y.std(axis=(0, 2, 3)), 1.0, atol=1e-3)
+    # running stats moved toward batch stats
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    y2 = bn(paddle.to_tensor(x))
+    assert y2.shape == [4, 3, 5, 5]
+    bn.train()
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.to_tensor(np.ones((1000,), np.float32))
+    y = d(x).numpy()
+    assert 0.3 < (y == 0).mean() < 0.7
+    np.testing.assert_allclose(y[y != 0], 2.0)  # upscale_in_train
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([[1, 0, 3]], np.int32))
+    out = emb(idx)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 1], 0.0)
+
+
+def test_activations_shapes():
+    x = paddle.to_tensor(rnd(3, 4) - 0.5)
+    for layer in [nn.ReLU(), nn.GELU(), nn.Silu(), nn.Tanh(), nn.Sigmoid(),
+                  nn.LeakyReLU(), nn.ELU(), nn.Hardswish(), nn.Mish(),
+                  nn.Softmax(), nn.LogSoftmax(), nn.Softplus()]:
+        assert layer(x).shape == [3, 4]
+    np.testing.assert_allclose(
+        nn.Softmax()(x).numpy().sum(-1), 1.0, rtol=1e-5)
+
+
+def test_losses():
+    logits = rnd(4, 10)
+    labels = np.array([1, 3, 5, 7], np.int32)
+    loss = F.cross_entropy(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels))
+    # numpy reference
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = -np.log(p[np.arange(4), labels]).mean()
+    np.testing.assert_allclose(loss.item(), expect, rtol=1e-5)
+    # mse
+    a, b = rnd(3, 4), rnd(3, 4)
+    np.testing.assert_allclose(
+        F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).item(),
+        ((a - b) ** 2).mean(), rtol=1e-5)
+    # bce with logits
+    z, y = rnd(4) - 0.5, (rnd(4) > 0.5).astype(np.float32)
+    got = F.binary_cross_entropy_with_logits(
+        paddle.to_tensor(z), paddle.to_tensor(y)).item()
+    sig = 1 / (1 + np.exp(-z))
+    expect = -(y * np.log(sig) + (1 - y) * np.log(1 - sig)).mean()
+    np.testing.assert_allclose(got, expect, rtol=1e-4)
+    # ignore_index
+    labels2 = np.array([1, -100, 5, -100], np.int32)
+    l2 = F.cross_entropy(paddle.to_tensor(logits),
+                         paddle.to_tensor(labels2))
+    expect2 = -np.log(p[np.arange(4), np.maximum(labels2, 0)])[[0, 2]].mean()
+    np.testing.assert_allclose(l2.item(), expect2, rtol=1e-5)
+
+
+def test_mha_and_encoder():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.to_tensor(rnd(2, 5, 16))
+    out = mha(x, x, x)
+    assert out.shape == [2, 5, 16]
+    enc_layer = nn.TransformerEncoderLayer(16, 4, 32)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    assert enc(x).shape == [2, 5, 16]
+    # distinct per-layer parameters (deepcopy)
+    p = list(enc.parameters())
+    assert len({id(t) for t in p}) == len(p)
+    assert len(p) > len(list(enc_layer.parameters()))
+
+
+def test_sdpa_matches_manual():
+    q = rnd(2, 3, 2, 8)
+    k = rnd(2, 4, 2, 8)
+    v = rnd(2, 4, 2, 8)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v))
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(8)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = np.einsum("bhqk,bkhd->bqhd", p, v)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_sdpa_causal():
+    q = rnd(1, 4, 1, 8)
+    out = F.scaled_dot_product_attention(
+        paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+        is_causal=True)
+    s = np.einsum("bqhd,bkhd->bhqk", q, q) / np.sqrt(8)
+    mask = np.tril(np.ones((4, 4), bool))
+    s = np.where(mask, s, -np.inf)
+    e = np.exp(s - s.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = np.einsum("bhqk,bkhd->bqhd", p, q)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_gru():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.to_tensor(rnd(4, 5, 8))
+    out, (h, c) = lstm(x)
+    assert out.shape == [4, 5, 16]
+    assert h.shape == [2, 4, 16] and c.shape == [2, 4, 16]
+    gru = nn.GRU(8, 16, direction="bidirect")
+    out, h = gru(x)
+    assert out.shape == [4, 5, 32]
+    assert h.shape == [2, 4, 16]
+
+
+def test_rnn_grad_flows():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.to_tensor(rnd(2, 3, 4), stop_gradient=False)
+    out, _ = lstm(x)
+    out.sum().backward()
+    assert x.grad is not None
+    assert lstm.weight_ih_l0.grad is not None
+
+
+def test_train_eval_recursive():
+    m = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    m.eval()
+    assert not m[1].training
+    m.train()
+    assert m[1].training
+
+
+def test_layer_hooks():
+    l = nn.Linear(2, 2)
+    calls = []
+    h = l.register_forward_post_hook(
+        lambda layer, inp, out: calls.append(1))
+    l(paddle.to_tensor(rnd(1, 2)))
+    assert calls == [1]
+    h.remove()
+    l(paddle.to_tensor(rnd(1, 2)))
+    assert calls == [1]
+
+
+def test_to_dtype():
+    m = nn.Linear(2, 2)
+    m.to(dtype="bfloat16")
+    assert str(m.weight.dtype) == "bfloat16"

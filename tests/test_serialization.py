@@ -1,0 +1,95 @@
+"""paddle.save/load + hapi Model + run_check."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def test_save_load_state_dict(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), path)
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    m2.set_state_dict(paddle.load(path))
+    for (n1, p1), (n2, p2) in zip(m.named_parameters(),
+                                  m2.named_parameters()):
+        np.testing.assert_array_equal(p1.numpy(), p2.numpy())
+
+
+def test_save_load_nested(tmp_path):
+    obj = {"a": paddle.to_tensor(np.arange(4, dtype=np.float32)),
+           "b": [1, "two", paddle.ones([2, 2])],
+           "c": {"d": 3.5}}
+    path = str(tmp_path / "obj.pd")
+    paddle.save(obj, path)
+    back = paddle.load(path)
+    np.testing.assert_array_equal(back["a"].numpy(), obj["a"].numpy())
+    assert back["b"][1] == "two"
+    assert back["c"]["d"] == 3.5
+
+
+def test_optimizer_checkpoint_resume(tmp_path):
+    paddle.seed(0)
+    m = nn.Linear(2, 2)
+    opt = optimizer.Adam(learning_rate=0.01, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.rand(4, 2).astype(np.float32))
+    for _ in range(3):
+        m(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    paddle.save(m.state_dict(), str(tmp_path / "m.pdparams"))
+    paddle.save(opt.state_dict(), str(tmp_path / "o.pdopt"))
+
+    m2 = nn.Linear(2, 2)
+    opt2 = optimizer.Adam(learning_rate=0.01, parameters=m2.parameters())
+    m2.set_state_dict(paddle.load(str(tmp_path / "m.pdparams")))
+    opt2.set_state_dict(paddle.load(str(tmp_path / "o.pdopt")))
+
+    # one more step on both must match exactly
+    for mm, oo in ((m, opt), (m2, opt2)):
+        mm(x).sum().backward()
+        oo.step()
+        oo.clear_grad()
+    np.testing.assert_allclose(m.weight.numpy(), m2.weight.numpy(),
+                               rtol=1e-6)
+
+
+def test_hapi_model_fit(tmp_path):
+    from paddle_tpu.io import Dataset
+
+    class Line(Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            x = rng.rand(4).astype(np.float32)
+            return x, np.float32(x.sum())
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = paddle.Model(net)
+    model.prepare(optimizer.Adam(learning_rate=0.01,
+                                 parameters=net.parameters()),
+                  nn.MSELoss())
+    hist = model.fit(Line(), batch_size=16, epochs=3, verbose=0)
+    assert hist[-1] < hist[0]
+    res = model.evaluate(Line(), batch_size=16, verbose=0)
+    assert res["loss"][0] < hist[0]
+    model.save(str(tmp_path / "ckpt"))
+    assert os.path.exists(str(tmp_path / "ckpt") + ".pdparams")
+
+
+def test_summary():
+    net = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    info = paddle.summary(net)
+    assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+
+
+def test_run_check(capsys):
+    paddle.run_check()
+    out = capsys.readouterr().out
+    assert "works" in out
