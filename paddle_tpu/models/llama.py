@@ -12,6 +12,7 @@ constraints over "sep" on the seq dim; the pipeline axis is applied by the
 trainer splitting `layers` into stages."""
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass, field
 
@@ -26,7 +27,8 @@ from ..ops.manipulation import concat, reshape, transpose
 from ..tensor import Tensor, apply_op
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
-           "llama_tiny_config", "llama_7b_config", "llama_13b_config"]
+           "LlamaDecoderStack", "llama_tiny_config", "llama_7b_config",
+           "llama_13b_config"]
 
 
 @dataclass
@@ -44,14 +46,19 @@ class LlamaConfig:
     use_flash_attention: bool = True
     tensor_parallel: bool = True        # attach "mp" partition specs
     sequence_parallel: bool = False     # constrain activations over "sep"
+    pipeline_parallel: bool = False     # stacked trunk + scan/ppermute PP
+    pp_num_microbatches: int = 4
+    scan_layers: bool = False           # stacked trunk, scan over layers
+    recompute: bool = False             # per-layer activation checkpointing
     dtype: str = "float32"
 
 
 def llama_tiny_config(**kw):
-    return LlamaConfig(vocab_size=512, hidden_size=128,
-                       intermediate_size=384, num_hidden_layers=2,
-                       num_attention_heads=4, num_key_value_heads=4,
-                       max_position_embeddings=256, **kw)
+    base = dict(vocab_size=512, hidden_size=128, intermediate_size=384,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=4, max_position_embeddings=256)
+    base.update(kw)
+    return LlamaConfig(**base)
 
 
 def llama_7b_config(**kw):
@@ -153,6 +160,117 @@ class LlamaDecoderLayer(nn.Layer):
         return out
 
 
+class LlamaDecoderStack(nn.Layer):
+    """Stacked decoder trunk: ONE prototype layer supplies the structure;
+    parameters are stacked (L, ...) Parameters so the trunk runs as a
+    ``lax.scan`` over layers (faster compiles than an unrolled python
+    loop) and — when a "pp" mesh axis is active — as the scan+ppermute
+    pipeline of paddle_tpu.distributed.pipeline (reference:
+    fleet/meta_parallel/pipeline_parallel.py — verify)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        L = config.num_hidden_layers
+        proto = LlamaDecoderLayer(config)
+        # structure donor only — bypass registration so its (per-layer
+        # shaped) params never appear in named_parameters
+        object.__setattr__(self, "_proto", proto)
+        names, stacks, specs = [], {}, {}
+        for i in range(L):
+            layer = proto if i == 0 else LlamaDecoderLayer(config)
+            for n, p in layer.named_parameters():
+                if i == 0:
+                    names.append(n)
+                    stacks[n] = []
+                    specs[n] = getattr(p, "_sharding_spec", None)
+                stacks[n].append(p._value)
+        self._pnames = names
+        lead = "pp" if config.pipeline_parallel else None
+        for n in names:
+            from ..tensor import Parameter
+            p = Parameter(jnp.stack(stacks[n]))
+            base = specs[n]
+            if base is not None:
+                p._sharding_spec = P(lead, *tuple(base))
+            elif lead is not None:
+                p._sharding_spec = P(lead)
+            self.add_parameter(n.replace(".", "__"), p)
+            stacks[n] = None
+
+    def forward(self, x, cos, sin, attn_mask=None):
+        leaves = [self._parameters[n.replace(".", "__")]
+                  for n in self._pnames]
+        mask_val = attn_mask._value if isinstance(attn_mask, Tensor) \
+            else attn_mask
+
+        def pure(xv, *leafvals):
+            return self._pure_forward(leafvals, xv, cos, sin, mask_val)
+        return apply_op(pure, x, *leaves)
+
+    def _layer_fwd(self, proto_params, slices, hv, cos, sin, mask):
+        from .. import framework
+        names = self._pnames
+        saved = [(proto_params[n], proto_params[n]._value) for n in names]
+        try:
+            for n, v in zip(names, slices):
+                proto_params[n]._value = v
+            with framework.functional_mode():
+                out = self._proto(
+                    Tensor(hv), cos, sin,
+                    Tensor(mask) if mask is not None else None)
+            return out._value
+        finally:
+            for t, v in saved:
+                t._value = v
+
+    def _pure_forward(self, leafvals, xv, cos, sin, mask):
+        from ..distributed.mesh import get_current_mesh
+        from ..distributed.pipeline import (num_pipeline_stages,
+                                            pipeline_spmd,
+                                            split_microbatches,
+                                            merge_microbatches)
+        cfg = self.config
+        proto_params = dict(self._proto.named_parameters())
+        fwd = functools.partial(self._layer_fwd, proto_params)
+        if cfg.recompute:
+            fwd = jax.checkpoint(fwd, static_argnums=())
+
+        mesh = get_current_mesh()
+        S = num_pipeline_stages(mesh) if cfg.pipeline_parallel else 1
+        if S > 1:
+            L = cfg.num_hidden_layers
+            if L % S != 0:
+                raise ValueError(f"num_hidden_layers={L} not divisible by "
+                                 f"pp degree {S}")
+            stacked = tuple(v.reshape(S, L // S, *v.shape[1:])
+                            for v in leafvals)
+            x_mb = split_microbatches(xv, cfg.pp_num_microbatches)
+            has_mask = mask is not None
+            mb_extras = ()
+            if has_mask:
+                mb_extras = (split_microbatches(mask,
+                                                x_mb.shape[0]),)
+
+            def stage_fn(local, h, *rest):
+                mk = rest[0] if has_mask else None
+                c, s_ = rest[-2], rest[-1]
+
+                def body(hh, sl):
+                    return fwd(sl, hh, c, s_, mk), None
+                out, _ = jax.lax.scan(body, h, local)
+                return out
+
+            y_mb = pipeline_spmd(stage_fn, stacked, x_mb, mesh=mesh,
+                                 mb_extras=mb_extras, extras=(cos, sin))
+            return merge_microbatches(y_mb)
+
+        def body(hh, sl):
+            return fwd(sl, hh, cos, sin, mask), None
+        out, _ = jax.lax.scan(body, xv, tuple(leafvals))
+        return out
+
+
 class LlamaModel(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -161,9 +279,12 @@ class LlamaModel(nn.Layer):
                                          config.hidden_size)
         if config.tensor_parallel:
             self.embed_tokens.weight._sharding_spec = P("mp", None)
-        self.layers = nn.LayerList(
-            [LlamaDecoderLayer(config)
-             for _ in range(config.num_hidden_layers)])
+        if config.pipeline_parallel or config.scan_layers:
+            self.layers = LlamaDecoderStack(config)
+        else:
+            self.layers = nn.LayerList(
+                [LlamaDecoderLayer(config)
+                 for _ in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         cos, sin = _rope_cache(config)
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
@@ -172,8 +293,11 @@ class LlamaModel(nn.Layer):
     def forward(self, input_ids, attn_mask=None):
         x = self.embed_tokens(input_ids)
         cos, sin = self.rope_cos._value, self.rope_sin._value
-        for layer in self.layers:
-            x = layer(x, cos, sin, attn_mask)
+        if isinstance(self.layers, LlamaDecoderStack):
+            x = self.layers(x, cos, sin, attn_mask)
+        else:
+            for layer in self.layers:
+                x = layer(x, cos, sin, attn_mask)
         return self.norm(x)
 
 

@@ -1,0 +1,254 @@
+"""Pipeline parallelism: scan+ppermute schedule parity vs serial execution.
+
+Mirrors the reference's golden pattern (SURVEY §4: fleet hybrid tests run a
+small model under PP and compare losses/params against a serial run)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.mesh import (HybridCommunicateGroup,
+                                         set_current_mesh)
+from paddle_tpu.distributed.pipeline import (merge_microbatches,
+                                             pipeline_spmd,
+                                             split_microbatches)
+from paddle_tpu.distributed.sharding_utils import place_model, shard_batch
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models.llama import (LlamaForCausalLM, llama_tiny_config)
+from paddle_tpu.tensor import Tensor
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh():
+    yield
+    set_current_mesh(None)
+
+
+def _pp_mesh(pp):
+    devs = jax.devices()[:pp]
+    return Mesh(np.array(devs), ("pp",))
+
+
+class TestFunctionalPipeline:
+    def _setup(self, S=4, M=8, mb=2, d=16, layers_per_stage=2):
+        L = S * layers_per_stage
+        key = jax.random.PRNGKey(0)
+        W = jax.random.normal(key, (L, d, d)) * 0.3
+        x = jax.random.normal(jax.random.PRNGKey(1), (M, mb, d))
+        return W, x, S, M, d
+
+    @staticmethod
+    def _stage_fn(w, x):
+        def body(h, wi):
+            return jnp.tanh(h @ wi), None
+        h, _ = jax.lax.scan(body, x, w)
+        return h
+
+    @staticmethod
+    def _ref(W, x_mb):
+        M, mb, d = x_mb.shape
+        def body(h, wi):
+            return jnp.tanh(h @ wi), None
+        h, _ = jax.lax.scan(body, x_mb.reshape(M * mb, d), W)
+        return h.reshape(M, mb, d)
+
+    def test_forward_parity(self):
+        W, x, S, M, d = self._setup()
+        mesh = _pp_mesh(S)
+        Wst = W.reshape(S, W.shape[0] // S, d, d)
+        out = jax.jit(lambda w, xx: pipeline_spmd(
+            self._stage_fn, w, xx, mesh=mesh))(Wst, x)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(W, x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_parity(self):
+        W, x, S, M, d = self._setup()
+        mesh = _pp_mesh(S)
+        Wst = W.reshape(S, W.shape[0] // S, d, d)
+
+        def loss_pipe(w, xx):
+            return pipeline_spmd(self._stage_fn, w, xx, mesh=mesh).sum()
+
+        def loss_ref(w, xx):
+            return self._ref(w.reshape(-1, d, d), xx).sum()
+
+        g1 = jax.jit(jax.grad(loss_pipe))(Wst, x)
+        g2 = jax.grad(loss_ref)(Wst, x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_single_stage_fallback(self):
+        W, x, S, M, d = self._setup(S=1, layers_per_stage=4)
+        mesh = _pp_mesh(1)
+        Wst = W.reshape(1, -1, d, d)
+        out = pipeline_spmd(self._stage_fn, Wst, x, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(W, x)),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_remat(self):
+        W, x, S, M, d = self._setup()
+        mesh = _pp_mesh(S)
+        Wst = W.reshape(S, W.shape[0] // S, d, d)
+
+        def loss(w, xx):
+            return pipeline_spmd(self._stage_fn, w, xx, mesh=mesh,
+                                 remat=True).sum()
+        g1 = jax.jit(jax.grad(loss))(Wst, x)
+        g2 = jax.grad(lambda w, xx: self._ref(
+            w.reshape(-1, d, d), xx).sum())(Wst, x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mb_extras_travel_with_microbatch(self):
+        """Per-microbatch extras must reach stage i alongside microbatch
+        t-i (they ride the ppermute ring), not stage 0's current index."""
+        S, M, mb, d = 4, 8, 2, 8
+        mesh = _pp_mesh(S)
+        W = jnp.zeros((S, 1, d, d))  # unused weights; scale comes from extra
+        x = jnp.ones((M, mb, d))
+        scales = jnp.arange(1.0, M + 1.0)  # microbatch m scaled by (m+1)
+
+        def stage_fn(w, h, scale):
+            return h * scale
+
+        out = jax.jit(lambda w, xx, s: pipeline_spmd(
+            stage_fn, w, xx, mesh=mesh, mb_extras=(s,)))(W, x, scales)
+        # serial reference: each microbatch scaled by scale**S
+        expected = x * (scales ** S)[:, None, None]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(expected),
+                                   rtol=1e-6)
+
+    def test_microbatch_split_merge(self):
+        x = jnp.arange(24.0).reshape(6, 4)
+        mb = split_microbatches(x, 4)   # 4 doesn't divide 6 -> clamps to 3
+        assert mb.shape == (3, 2, 4)
+        np.testing.assert_array_equal(np.asarray(merge_microbatches(mb)),
+                                      np.asarray(x))
+
+
+def _stack_from_layers(serial, stacked):
+    """Copy per-layer weights of a serial model into a stacked model."""
+    import collections
+    per_layer = collections.defaultdict(dict)
+    sd = {k: v for k, v in serial.state_dict().items()}
+    for k, v in sd.items():
+        if ".layers." not in k:
+            continue
+        rest = k.split(".layers.", 1)[1]
+        idx, pname = rest.split(".", 1)
+        per_layer[pname][int(idx)] = v
+    new_state = {}
+    for k, v in stacked.state_dict().items():
+        if ".layers." in k and "__" in k:
+            pname = k.split(".layers.", 1)[1].replace("__", ".")
+            vals = per_layer[pname]
+            new_state[k] = jnp.stack(
+                [vals[i]._value for i in sorted(vals)])
+        else:
+            new_state[k] = sd[k]
+    stacked.set_state_dict(new_state)
+
+
+class TestLlamaStackedTrunk:
+    def _models(self, **cfg_kw):
+        paddle.seed(7)
+        cfg_serial = llama_tiny_config(tensor_parallel=False)
+        serial = LlamaForCausalLM(cfg_serial)
+        cfg_st = llama_tiny_config(tensor_parallel=False, **cfg_kw)
+        stacked = LlamaForCausalLM(cfg_st)
+        _stack_from_layers(serial, stacked)
+        np.random.seed(3)
+        ids = np.random.randint(0, cfg_serial.vocab_size, (4, 16))
+        ids = ids.astype(np.int32)
+        labels = np.roll(ids, -1, 1).astype(np.int32)
+        return serial, stacked, ids, labels
+
+    def test_scan_layers_parity(self):
+        serial, stacked, ids, labels = self._models(scan_layers=True)
+        l1, _ = serial(Tensor(jnp.asarray(ids)), Tensor(jnp.asarray(labels)))
+        l2, _ = stacked(Tensor(jnp.asarray(ids)), Tensor(jnp.asarray(labels)))
+        np.testing.assert_allclose(float(l1.item()), float(l2.item()),
+                                   rtol=1e-5)
+
+    def test_scan_layers_backward(self):
+        _, stacked, ids, labels = self._models(scan_layers=True)
+        loss, _ = stacked(Tensor(jnp.asarray(ids)),
+                          Tensor(jnp.asarray(labels)))
+        loss.backward()
+        g = stacked.llama.layers._parameters[
+            "self_attn__q_proj__weight"].grad
+        assert g is not None and np.isfinite(np.asarray(g._value)).all()
+
+    def test_pipeline_parity(self):
+        serial, pp_model, ids, labels = self._models(
+            pipeline_parallel=True, pp_num_microbatches=2)
+        mesh = _pp_mesh(2)
+        set_current_mesh(mesh)
+        place_model(pp_model, mesh)
+        l_ref, _ = serial(Tensor(jnp.asarray(ids)),
+                          Tensor(jnp.asarray(labels)))
+        l_pp, _ = pp_model(Tensor(jnp.asarray(ids)),
+                           Tensor(jnp.asarray(labels)))
+        np.testing.assert_allclose(float(l_ref.item()), float(l_pp.item()),
+                                   rtol=2e-5)
+
+    def test_pipeline_trains(self):
+        paddle.seed(11)
+        cfg = llama_tiny_config(tensor_parallel=False,
+                                pipeline_parallel=True,
+                                pp_num_microbatches=2)
+        model = LlamaForCausalLM(cfg)
+        mesh = _pp_mesh(2)
+        set_current_mesh(mesh)
+        place_model(model, mesh)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+
+        def loss_fn(m, batch):
+            ids, labels = batch
+            loss, _ = m(ids, labels)
+            return loss
+
+        step = TrainStep(model, loss_fn, opt)
+        np.random.seed(5)
+        ids = np.random.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+        labels = np.roll(ids, -1, 1).astype(np.int32)
+        batch = (shard_batch(mesh, paddle.to_tensor(ids), P()),
+                 shard_batch(mesh, paddle.to_tensor(labels), P()))
+        losses = [float(step(batch).item()) for _ in range(6)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_pipeline_with_tp(self):
+        """pp × mp on a 2×2 mesh: constraints over auto axes must compose
+        with the manual pp shard_map."""
+        paddle.seed(13)
+        cfg = llama_tiny_config(tensor_parallel=True,
+                                pipeline_parallel=True,
+                                pp_num_microbatches=2)
+        model = LlamaForCausalLM(cfg)
+        hcg = HybridCommunicateGroup(dp_degree=1, mp_degree=2, pp_degree=2,
+                                     devices=jax.devices()[:4])
+        mesh = hcg.jax_mesh
+        place_model(model, mesh)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+
+        def loss_fn(m, batch):
+            ids, labels = batch
+            loss, _ = m(ids, labels)
+            return loss
+
+        step = TrainStep(model, loss_fn, opt)
+        np.random.seed(5)
+        ids = np.random.randint(0, cfg.vocab_size, (4, 16)).astype(np.int32)
+        labels = np.roll(ids, -1, 1).astype(np.int32)
+        batch = (shard_batch(mesh, paddle.to_tensor(ids), P()),
+                 shard_batch(mesh, paddle.to_tensor(labels), P()))
+        loss = float(step(batch).item())
+        assert np.isfinite(loss)
