@@ -1,0 +1,168 @@
+"""Context / sequence parallelism for long sequences.
+
+Reference parity: (1) the SEP/Ulysses axis of HybridCommunicateGroup
+(python/paddle/distributed/fleet/base/topology.py — verify):
+DeepSpeed-Ulysses-style all-to-all swapping seq-sharding for head-sharding
+around attention; (2) ring flash attention (ecosystem
+PaddleNLP ring_flash_attention.py, enabled by the core flash-attn kernel's
+softmax_lse output — SURVEY §2.3 CP row).
+
+TPU-native design (SURVEY §5): the sequence axis is a first-class mesh
+dim.  Ring attention = shard_map over the axis with KV blocks rotating via
+``ppermute`` over ICI and an online-softmax merge (the softmax_lse the
+reference threads between kernel calls is just the (m, l) accumulator pair
+here).  Ulysses = two ``all_to_all``s around a plain flash attention.
+Both are differentiable (ppermute/all_to_all have transpose rules), so
+the backward pass is the reverse ring — no hand-written grad kernels.
+
+Layout convention is paddle's bshd: (batch, seq, num_heads, head_dim).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["ring_attention_spmd", "ulysses_attention_spmd",
+           "RingAttention", "sep_degree"]
+
+
+def sep_degree(mesh: Optional[Mesh], axis: str = "sep") -> int:
+    if mesh is None or axis not in mesh.axis_names:
+        return 1
+    return int(mesh.shape[axis])
+
+
+def _repeat_kv(q, k, v):
+    if k.shape[2] != q.shape[2]:  # GQA: repeat kv heads to match q
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+def ring_attention_spmd(q, k, v, *, mesh: Mesh, axis: str = "sep",
+                        causal: bool = True, scale: Optional[float] = None):
+    """Ring attention over the seq-sharded ``axis``.
+
+    q/k/v: (b, s, h, d) with s sharded over ``axis`` (global views).
+    Each of the S steps computes one (q-shard × kv-shard) block with the
+    flash online-softmax update, then rotates K/V one hop around the ring.
+    Peak memory per device: O(s/S × s/S) scores + two KV shards.
+    """
+    S = sep_degree(mesh, axis)
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    k, v = _repeat_kv(q, k, v)
+    if S == 1:
+        from ..ops.pallas.flash_attention import _xla_sdpa
+        return _xla_sdpa(q, k, v, None, causal, 0.0, scale_)
+
+    def inner(ql, kl, vl):
+        b, sl, h, d = ql.shape
+        idx = jax.lax.axis_index(axis)
+        qpos = idx * sl + jnp.arange(sl)
+        qf = ql.astype(jnp.float32)
+
+        def vary(x):
+            return jax.lax.pcast(x, (axis,), to="varying")
+        m0 = vary(jnp.full((b, h, sl), -jnp.inf, jnp.float32))
+        l0 = vary(jnp.zeros((b, h, sl), jnp.float32))
+        o0 = vary(jnp.zeros((b, h, sl, d), jnp.float32))
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def step_fn(carry, step):
+            m, l, o, kc, vc = carry
+            # after `step` rotations this device holds shard (idx - step)
+            j = (idx - step) % S
+            kpos = j * sl + jnp.arange(sl)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                           kc.astype(jnp.float32),
+                           preferred_element_type=jnp.float32) * scale_
+            if causal:
+                mask = qpos[:, None] >= kpos[None, :]
+                s = jnp.where(mask[None, None], s, -jnp.inf)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            neg = m_new == -jnp.inf  # row fully masked so far
+            p = jnp.where(neg[..., None], 0.0,
+                          jnp.exp(s - m_new[..., None]))
+            alpha = jnp.where(neg, 1.0, jnp.exp(m - m_new))
+            l = l * alpha + jnp.sum(p, axis=-1)
+            o = o * alpha[..., None] + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32))
+            kc = jax.lax.ppermute(kc, axis, perm)
+            vc = jax.lax.ppermute(vc, axis, perm)
+            return (m_new, l, o, kc, vc), None
+
+        (m, l, o, _, _), _ = jax.lax.scan(
+            step_fn, (m0, l0, o0, kl, vl), jnp.arange(S))
+        out = o / jnp.maximum(l, 1e-30)[..., None]
+        return jnp.einsum("bhqd->bqhd", out).astype(ql.dtype)
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(inner, mesh=mesh, axis_names={axis},
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+def ulysses_attention_spmd(q, k, v, *, mesh: Mesh, axis: str = "sep",
+                           causal: bool = True,
+                           scale: Optional[float] = None):
+    """DeepSpeed-Ulysses SEP: all_to_all swaps seq-sharding for
+    head-sharding, full-sequence flash attention runs locally on h/S
+    heads, and a second all_to_all swaps back.  Cheaper than the ring when
+    h >= S and the full sequence fits (comm volume 2·bshd/S vs the ring's
+    (S-1)·2·bshd/S)."""
+    S = sep_degree(mesh, axis)
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    k, v = _repeat_kv(q, k, v)
+    if S == 1:
+        from ..ops.pallas.flash_attention import _xla_sdpa
+        return _xla_sdpa(q, k, v, None, causal, 0.0, scale_)
+    if q.shape[2] % S != 0:
+        raise ValueError(f"num_heads={q.shape[2]} not divisible by "
+                         f"sep degree {S} (required for Ulysses)")
+
+    def inner(ql, kl, vl):
+        def fwd(x):   # (b, s/S, h, d) -> (b, s, h/S, d)
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+        from ..ops.pallas.flash_attention import sdpa
+        out = sdpa(fwd(ql), fwd(kl), fwd(vl), None, is_causal=causal,
+                   scale=scale_)
+        # (b, s, h/S, d) -> (b, s/S, h, d)
+        return jax.lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(inner, mesh=mesh, axis_names={axis},
+                         in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
+class RingAttention:
+    """Layer-ish façade (PaddleNLP RingFlashAttention parity): callable on
+    Tensor q/k/v; picks the active mesh's sep axis."""
+
+    def __init__(self, axis: str = "sep", mode: str = "ring"):
+        self.axis = axis
+        self.mode = mode
+
+    def __call__(self, q, k, v, causal=True):
+        from ..tensor import Tensor, apply_op
+        from .mesh import get_current_mesh
+        mesh = get_current_mesh()
+        fn = ring_attention_spmd if self.mode == "ring" \
+            else ulysses_attention_spmd
+        if mesh is None or self.axis not in mesh.axis_names:
+            from ..ops.pallas.flash_attention import _xla_sdpa
+
+            def f(qv, kv, vv):
+                return _xla_sdpa(qv, kv, vv, None, causal, 0.0, None)
+            return apply_op(f, q, k, v)
+
+        def f(qv, kv, vv):
+            return fn(qv, kv, vv, mesh=mesh, axis=self.axis, causal=causal)
+        return apply_op(f, q, k, v)

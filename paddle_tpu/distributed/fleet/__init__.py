@@ -115,7 +115,9 @@ def distributed_model(model: Layer):
                     spec[best] = "sharding"
                     p._sharding_spec = PartitionSpec(*spec)
     if isinstance(model, PipelineLayer):
-        return model
+        from ..pipeline import PipelineParallel
+        return PipelineParallel(model, hcg=hcg,
+                                strategy=_FLEET["strategy"])
     if hcg.axis_size("dp") > 1:
         return DataParallel(model)
     return model

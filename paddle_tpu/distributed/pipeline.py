@@ -174,24 +174,27 @@ class PipelineParallel:
     def __call__(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
 
+    def _require_loss_fn(self):
+        if self._loss_fn is None:
+            raise ValueError(
+                "PipelineLayer was built without loss_fn; pipeline "
+                "training needs it (PipelineLayer(..., loss_fn=...))")
+        return self._loss_fn
+
     def _ensure_step(self, optimizer):
         if self._step is None or self._step.optimizer is not optimizer:
             from ..jit import TrainStep
+            layer_loss = self._require_loss_fn()
 
             def loss_fn(model, batch):
                 x, y = batch
-                out = model(x)
-                if self._loss_fn is not None:
-                    return self._loss_fn(out, y)
-                return out.mean()
+                return layer_loss(model(x), y)
             self._step = TrainStep(self._layers, loss_fn, optimizer)
         return self._step
 
     def forward_backward_pipeline(self, data, scaler=None):
         x, y = data
-        out = self._layers(x)
-        loss = self._loss_fn(out, y) if self._loss_fn is not None \
-            else out.mean()
+        loss = self._require_loss_fn()(self._layers(x), y)
         loss.backward()
         return loss
 

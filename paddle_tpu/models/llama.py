@@ -46,11 +46,29 @@ class LlamaConfig:
     use_flash_attention: bool = True
     tensor_parallel: bool = True        # attach "mp" partition specs
     sequence_parallel: bool = False     # constrain activations over "sep"
+    # "megatron": seq-sharded activations via constraints (GSPMD gathers);
+    # "ring": ring flash attention over the sep axis (KV ppermute ring);
+    # "ulysses": all-to-all seq<->head swap around attention
+    sequence_parallel_mode: str = "megatron"
     pipeline_parallel: bool = False     # stacked trunk + scan/ppermute PP
     pp_num_microbatches: int = 4
     scan_layers: bool = False           # stacked trunk, scan over layers
     recompute: bool = False             # per-layer activation checkpointing
     dtype: str = "float32"
+
+    def __post_init__(self):
+        if self.sequence_parallel_mode not in ("megatron", "ring",
+                                               "ulysses"):
+            raise ValueError(
+                f"unknown sequence_parallel_mode="
+                f"{self.sequence_parallel_mode!r}; expected 'megatron', "
+                f"'ring', or 'ulysses'")
+        if self.pipeline_parallel and \
+                self.sequence_parallel_mode in ("ring", "ulysses"):
+            raise ValueError(
+                "ring/ulysses attention runs its own shard_map and cannot "
+                "nest inside the pipeline's manual pp region; use "
+                "sequence_parallel_mode='megatron' with pipeline_parallel")
 
 
 def llama_tiny_config(**kw):
@@ -118,8 +136,25 @@ class LlamaAttention(nn.Layer):
         k = reshape(self.k_proj(x), (b, s, self.num_kv_heads, self.head_dim))
         v = reshape(self.v_proj(x), (b, s, self.num_kv_heads, self.head_dim))
         q, k = apply_op(lambda qv, kv_: _apply_rope(qv, kv_, cos, sin), q, k)
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask,
-                                             is_causal=attn_mask is None)
+        out = None
+        cfg = self.config
+        if (cfg.sequence_parallel
+                and cfg.sequence_parallel_mode in ("ring", "ulysses")
+                and attn_mask is None):
+            from ..distributed.context_parallel import (
+                ring_attention_spmd, ulysses_attention_spmd, sep_degree)
+            from ..distributed.mesh import get_current_mesh
+            mesh = get_current_mesh()
+            if sep_degree(mesh) > 1:
+                fn = ring_attention_spmd \
+                    if cfg.sequence_parallel_mode == "ring" \
+                    else ulysses_attention_spmd
+                out = apply_op(
+                    lambda qv, kv_, vv: fn(qv, kv_, vv, mesh=mesh,
+                                           causal=True), q, k, v)
+        if out is None:
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask,
+                                                 is_causal=attn_mask is None)
         out = reshape(out, (b, s, self.num_heads * self.head_dim))
         return self.o_proj(out)
 

@@ -283,7 +283,8 @@ class Tensor:
 class Parameter(Tensor):
     """Trainable tensor (reference: paddle.base.framework.Parameter — verify)."""
     __slots__ = ("optimize_attr", "regularizer", "do_model_average",
-                 "need_clip", "is_distributed", "_sharding_spec")
+                 "need_clip", "is_distributed", "_sharding_spec",
+                 "pp_stage", "sequence_parallel")
 
     def __init__(self, value, name=None, trainable=True):
         super().__init__(value, stop_gradient=not trainable, name=name)

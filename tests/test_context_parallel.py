@@ -1,0 +1,160 @@
+"""Ring attention + Ulysses SEP parity vs dense attention (SURVEY §4:
+serial-vs-parallel parity for every parallelism dimension)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.context_parallel import (ring_attention_spmd,
+                                                     ulysses_attention_spmd)
+from paddle_tpu.distributed.mesh import set_current_mesh
+from paddle_tpu.distributed.sharding_utils import place_model, shard_batch
+from paddle_tpu.ops.pallas.flash_attention import _xla_sdpa
+from paddle_tpu.tensor import Tensor
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh():
+    yield
+    set_current_mesh(None)
+
+
+def _sep_mesh(S):
+    return Mesh(np.array(jax.devices()[:S]), ("sep",))
+
+
+def _qkv(b=2, s=32, h=4, hk=None, d=8, seed=0):
+    hk = hk or h
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, h, d))
+    k = jax.random.normal(ks[1], (b, s, hk, d))
+    v = jax.random.normal(ks[2], (b, s, hk, d))
+    return q, k, v
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_parity(self, causal):
+        q, k, v = _qkv()
+        mesh = _sep_mesh(4)
+        out = jax.jit(lambda *a: ring_attention_spmd(
+            *a, mesh=mesh, causal=causal))(q, k, v)
+        ref = _xla_sdpa(q, k, v, None, causal, 0.0, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gqa(self):
+        q, k, v = _qkv(h=8, hk=2)
+        mesh = _sep_mesh(4)
+        out = jax.jit(lambda *a: ring_attention_spmd(
+            *a, mesh=mesh, causal=True))(q, k, v)
+        ref = _xla_sdpa(q, k, v, None, True, 0.0, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_parity(self):
+        q, k, v = _qkv()
+        mesh = _sep_mesh(4)
+
+        def loss_ring(q, k, v):
+            return ring_attention_spmd(q, k, v, mesh=mesh,
+                                       causal=True).sum()
+
+        def loss_ref(q, k, v):
+            return _xla_sdpa(q, k, v, None, True, 0.0, None).sum()
+
+        g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_sep1_fallback(self):
+        q, k, v = _qkv()
+        mesh = _sep_mesh(1)
+        out = ring_attention_spmd(q, k, v, mesh=mesh, causal=True)
+        ref = _xla_sdpa(q, k, v, None, True, 0.0, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6)
+
+
+class TestUlysses:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_parity(self, causal):
+        q, k, v = _qkv(h=8)
+        mesh = _sep_mesh(4)
+        out = jax.jit(lambda *a: ulysses_attention_spmd(
+            *a, mesh=mesh, causal=causal))(q, k, v)
+        ref = _xla_sdpa(q, k, v, None, causal, 0.0, None)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grad_parity(self):
+        q, k, v = _qkv(h=8)
+        mesh = _sep_mesh(4)
+
+        def loss_u(q, k, v):
+            return ulysses_attention_spmd(q, k, v, mesh=mesh,
+                                          causal=True).sum()
+
+        def loss_ref(q, k, v):
+            return _xla_sdpa(q, k, v, None, True, 0.0, None).sum()
+
+        g1 = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_heads_not_divisible_raises(self):
+        q, k, v = _qkv(h=6)
+        mesh = _sep_mesh(4)
+        with pytest.raises(ValueError, match="not divisible"):
+            ulysses_attention_spmd(q, k, v, mesh=mesh)
+
+
+class TestLlamaContextParallel:
+    @pytest.mark.parametrize("mode", ["ring", "ulysses"])
+    def test_loss_parity_and_train(self, mode):
+        from paddle_tpu import optimizer
+        from paddle_tpu.jit import TrainStep
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(21)
+        cfg_ref = llama_tiny_config(tensor_parallel=False)
+        ref = LlamaForCausalLM(cfg_ref)
+        paddle.seed(21)
+        cfg_cp = llama_tiny_config(tensor_parallel=False,
+                                   sequence_parallel=True,
+                                   sequence_parallel_mode=mode)
+        cp = LlamaForCausalLM(cfg_cp)
+        cp.set_state_dict(ref.state_dict())
+
+        np.random.seed(9)
+        ids = np.random.randint(0, cfg_ref.vocab_size, (2, 32))
+        ids = ids.astype(np.int32)
+        labels = np.roll(ids, -1, 1).astype(np.int32)
+
+        l_ref, _ = ref(Tensor(jnp.asarray(ids)), Tensor(jnp.asarray(labels)))
+
+        mesh = _sep_mesh(4)
+        set_current_mesh(mesh)
+        place_model(cp, mesh)
+
+        def loss_fn(m, batch):
+            i, l = batch
+            loss, _ = m(i, l)
+            return loss
+
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=cp.parameters())
+        step = TrainStep(cp, loss_fn, opt)
+        batch = (shard_batch(mesh, paddle.to_tensor(ids), P(None, "sep")),
+                 shard_batch(mesh, paddle.to_tensor(labels),
+                             P(None, "sep")))
+        l0 = float(step(batch).item())
+        np.testing.assert_allclose(l0, float(l_ref.item()), rtol=2e-4)
+        l1 = float(step(batch).item())
+        assert np.isfinite(l1) and l1 < l0

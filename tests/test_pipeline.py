@@ -224,6 +224,59 @@ class TestLlamaStackedTrunk:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]
 
+    def test_config_rejects_ring_with_pp(self):
+        with pytest.raises(ValueError, match="nest inside"):
+            llama_tiny_config(pipeline_parallel=True,
+                              sequence_parallel=True,
+                              sequence_parallel_mode="ring")
+
+    def test_config_rejects_unknown_sp_mode(self):
+        with pytest.raises(ValueError, match="sequence_parallel_mode"):
+            llama_tiny_config(sequence_parallel_mode="ullyses")
+
+    def test_fleet_pipeline_wrapper(self):
+        """fleet.distributed_model wraps PipelineLayer in PipelineParallel
+        and train_batch drives a fused step (loss decreases)."""
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                                PipelineLayer)
+        from paddle_tpu.distributed.pipeline import PipelineParallel
+        paddle.seed(3)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": len(jax.devices()),
+                                   "mp_degree": 1, "pp_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+
+        def mse(out, y):
+            return ((out - y) ** 2).mean()
+
+        model = PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.ReLU),
+                    LayerDesc(nn.Linear, 16, 8)],
+            num_stages=1, loss_fn=mse)
+        wrapped = fleet.distributed_model(model)
+        assert isinstance(wrapped, PipelineParallel)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        x = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+        y = paddle.to_tensor(np.random.randn(16, 8).astype("float32"))
+        losses = [float(wrapped.train_batch((x, y), opt).item())
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_fleet_pipeline_wrapper_requires_loss_fn(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                                PipelineLayer)
+        from paddle_tpu.distributed.pipeline import PipelineParallel
+        model = PipelineLayer(layers=[LayerDesc(nn.Linear, 4, 4)],
+                              num_stages=1)
+        wrapped = PipelineParallel(model)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=model.parameters())
+        x = paddle.to_tensor(np.zeros((2, 4), "float32"))
+        with pytest.raises(ValueError, match="loss_fn"):
+            wrapped.train_batch((x, x), opt)
+
     def test_pipeline_with_tp(self):
         """pp × mp on a 2×2 mesh: constraints over auto axes must compose
         with the manual pp shard_map."""
