@@ -21,6 +21,8 @@ from .auto_parallel_api import (ProcessMesh, shard_tensor, dtensor_from_fn,
                                 to_static as dist_to_static, DistAttr)  # noqa
 from . import fleet                                               # noqa
 from . import checkpoint                                          # noqa
+from . import sharding                                            # noqa
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa
 from .launch_utils import spawn                                   # noqa
 
 # short aliases matching paddle.distributed.*

@@ -1,0 +1,147 @@
+"""Group-sharded data parallelism (ZeRO stages 1/2/3).
+
+Reference parity: ``paddle.distributed.sharding.group_sharded_parallel``
+(reference: python/paddle/distributed/sharding/group_sharded.py — verify)
+and the fleet stage wrappers (python/paddle/distributed/fleet/meta_parallel/
+sharding/group_sharded_stage{2,3}.py, sharding_optimizer.py — verify).
+
+TPU-native design: the reference implements each stage with hand-written
+broadcast/reduce-scatter/allgather choreography over NCCL. On TPU all of
+that is a *placement decision* handed to GSPMD:
+
+- stage "os"      (ZeRO-1): optimizer slots are device_put sharded over the
+  sharding axis at creation and kept sharded inside the jitted train step
+  via with_sharding_constraint — XLA emits the reduce-scatter/allgather
+  pair around the update automatically.
+- stage "os_g"    (ZeRO-2): additionally the gradients are constrained to
+  the same sharded placement before the update (reduce-scatter of grads).
+- stage "p_g_os"  (ZeRO-3): additionally parameters themselves carry a
+  sharded placement (allgather-on-use is native GSPMD behavior).
+
+No bucketing/fusion machinery is needed: the XLA latency-hiding scheduler
+overlaps the collectives with compute, which is what the reference's
+comm-overlap options hand-build.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...nn.layer import Layer
+from ...optimizer import Optimizer
+from ..mesh import get_current_mesh
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "ShardingConstrainer"]
+
+
+def _pick_axis(group=None) -> str:
+    """Prefer an explicit "sharding" mesh axis; else shard over "dp".
+    `group` is accepted for reference-API compatibility but the axis choice
+    is mesh-driven — a (Mesh, axis) pair IS the process group on TPU."""
+    mesh = get_current_mesh()
+    if mesh is None:
+        return "sharding"
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if sizes.get("sharding", 1) > 1:
+        return "sharding"
+    if sizes.get("dp", 1) > 1:
+        return "dp"
+    return "sharding"
+
+
+def _sharded_spec(shape, axis: str, mesh: Mesh) -> Optional[P]:
+    """Spec sharding the largest divisible dim over `axis`; None if no dim
+    divides (stay replicated — the reference pads instead; we keep exact
+    shapes so XLA never sees ragged tiles)."""
+    if axis not in mesh.axis_names:
+        return None
+    n = int(np.prod([s for a, s in zip(mesh.axis_names, mesh.devices.shape)
+                     if a == axis]))
+    if n <= 1 or not shape:
+        return None
+    order = sorted(range(len(shape)), key=lambda i: -int(shape[i]))
+    for i in order:
+        if int(shape[i]) % n == 0 and int(shape[i]) >= n:
+            spec = [None] * len(shape)
+            spec[i] = axis
+            return P(*spec)
+    return None
+
+
+class ShardingConstrainer:
+    """Callable attached to the optimizer; maps (array, pname) -> array with
+    the group-sharded placement applied (constraint inside jit, device_put
+    outside)."""
+
+    def __init__(self, axis: str):
+        self.axis = axis
+
+    def __call__(self, value, pname=None):
+        mesh = get_current_mesh()
+        if mesh is None or not hasattr(value, "ndim") or value.ndim == 0:
+            return value
+        spec = _sharded_spec(value.shape, self.axis, mesh)
+        if spec is None:
+            return value
+        sharding = NamedSharding(mesh, spec)
+        # under tracing, device_put is NOT a sharding constraint — it
+        # silently replicates; with_sharding_constraint is the in-program
+        # placement op GSPMD honors
+        if isinstance(value, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(value, sharding)
+        return jax.device_put(value, sharding)
+
+
+def group_sharded_parallel(model: Optional[Layer], optimizer: Optimizer,
+                           level: str, scaler=None, group=None,
+                           offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """Apply ZeRO-style group sharding. level ∈ {"os", "os_g", "p_g_os"}.
+
+    Returns (model, optimizer, scaler) like the reference API. `model` may
+    be None to attach only the optimizer-side hooks (fleet wires the model
+    placement separately in distributed_model).
+    """
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(
+            f"level must be one of os / os_g / p_g_os, got {level!r}")
+    axis = _pick_axis(group)
+    constrainer = ShardingConstrainer(axis)
+    # stage >= 1: shard optimizer slots
+    optimizer._slot_constrain = constrainer
+    if level in ("os_g", "p_g_os"):
+        optimizer._grad_constrain = constrainer
+    if level == "p_g_os" and model is not None:
+        mesh = get_current_mesh()
+        for _, p in model.named_parameters():
+            if p.stop_gradient:
+                continue
+            if getattr(p, "_sharding_spec", None) is None and mesh is not None:
+                spec = _sharded_spec(p._value.shape, axis, mesh)
+                if spec is not None:
+                    p._sharding_spec = spec
+        if mesh is not None:
+            from ..sharding_utils import place_model
+            place_model(model, mesh)
+    # re-place any already-created slots
+    if optimizer._slots:
+        for n, s in optimizer._slots.items():
+            optimizer._slots[n] = {k: constrainer(v, n) for k, v in s.items()}
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model: Layer, output: str, optimizer=None):
+    """Reference: save_group_sharded_model gathers stage-3 params first; on
+    TPU jax arrays are addressable globally, so a plain state_dict works."""
+    import os
+    from ...serialization import save
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

@@ -93,6 +93,10 @@ class Optimizer:
         self._multi_precision = multi_precision
         self._slots: dict[str, dict] = {}      # pname -> slot dict
         self._step_count = 0
+        # group-sharded (ZeRO) placement hooks, set by
+        # paddle_tpu.distributed.sharding.group_sharded_parallel
+        self._slot_constrain = None   # (array, pname) -> sharded array
+        self._grad_constrain = None
         names, seen = [], set()
         for i, p in enumerate(self._param_list):
             base = p.name or f"param_{i}"
@@ -136,6 +140,9 @@ class Optimizer:
             if self._multi_precision and p._value.dtype in (
                     jnp.float16, jnp.bfloat16):
                 slots["master"] = p._value.astype(jnp.float32)
+            if self._slot_constrain is not None:
+                slots = {k: self._slot_constrain(v, name)
+                         for k, v in slots.items()}
             self._slots[name] = slots
         return self._slots[name]
 
@@ -200,6 +207,9 @@ class Optimizer:
                           lr_value):
         """Pure: (params, grads, state, lr) -> (new_params, new_state).
         Used inside jitted train steps."""
+        if self._grad_constrain is not None:
+            grads = {n: self._grad_constrain(g, n)
+                     for n, g in grads.items()}
         if self._grad_clip is not None:
             grads = self._grad_clip.apply(grads)
         step = state["step"] + 1
@@ -223,6 +233,10 @@ class Optimizer:
             else:
                 new_params[n], new_slots[n] = self._apply(p, g, s, lr_value,
                                                           step)
+        if self._slot_constrain is not None:
+            new_slots = {n: {k: self._slot_constrain(v, n)
+                             for k, v in s.items()}
+                         for n, s in new_slots.items()}
         return new_params, {"slots": new_slots, "step": step}
 
     # -- state dict ---------------------------------------------------------
@@ -245,8 +259,10 @@ class Optimizer:
             if k in ("@step", "LR_Scheduler"):
                 continue
             n, slot = k.rsplit(".", 1)
-            self._slots.setdefault(n, {})[slot] = \
-                v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+            if self._slot_constrain is not None:
+                val = self._slot_constrain(val, n)
+            self._slots.setdefault(n, {})[slot] = val
 
     def _wd(self, p, g):
         """L2 regularization folded into grad (non-decoupled)."""

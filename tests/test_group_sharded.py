@@ -1,0 +1,149 @@
+"""Group-sharded (ZeRO 1/2/3) parity vs serial training.
+
+Golden pattern from the reference test suite (SURVEY §4): run a small model
+under each sharding stage on the device mesh and compare losses/params with
+a serial single-device run; additionally assert the optimizer state really
+is sharded over the sharding axis (the point of ZeRO-1)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.mesh import set_current_mesh
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+from paddle_tpu.distributed.sharding_utils import place_model
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.tensor import Tensor
+
+
+@pytest.fixture(autouse=True)
+def _clear_mesh():
+    yield
+    set_current_mesh(None)
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed import fleet
+    mesh_mod._HCG = None
+    fleet._FLEET.update(initialized=False, strategy=None, hcg=None)
+
+
+def _mlp(d=16, h=32):
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(d, h)
+            self.fc2 = nn.Linear(h, d)
+
+        def forward(self, x):
+            return self.fc2(nn.functional.relu(self.fc1(x)))
+    return MLP()
+
+
+def _loss_fn(model, batch):
+    x, y = batch
+    out = model(x)
+    return ((out - y) ** 2).mean()
+
+
+def _run(level, steps=4, d=16):
+    paddle.seed(7)
+    model = _mlp(d)
+    init_state = {k: np.asarray(v._value)
+                  for k, v in model.state_dict().items()}
+    opt = optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+    if level is not None:
+        mesh = Mesh(np.array(jax.devices()[:8]), ("sharding",))
+        set_current_mesh(mesh)
+        model, opt, _ = group_sharded_parallel(model, opt, level)
+        place_model(model, mesh)
+    step = TrainStep(model, _loss_fn, opt)
+    x = jnp.asarray(np.random.RandomState(0).randn(8, d), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randn(8, d), jnp.float32)
+    losses = [float(step((Tensor(x), Tensor(y)))._value)
+              for _ in range(steps)]
+    final = {k: np.asarray(v._value) for k, v in model.state_dict().items()}
+    return init_state, losses, final, opt
+
+
+class TestGroupSharded:
+    def test_stage1_parity_and_sharded_slots(self):
+        init_a, serial, final_a, _ = _run(None)
+        init_b, sharded, final_b, opt = _run("os")
+        for k in init_a:
+            np.testing.assert_allclose(init_a[k], init_b[k], atol=1e-6)
+        np.testing.assert_allclose(serial, sharded, rtol=1e-4, atol=1e-5)
+        for k in final_a:
+            np.testing.assert_allclose(final_a[k], final_b[k],
+                                       rtol=1e-4, atol=1e-5)
+        # optimizer moments must actually live sharded over the axis
+        sharded_any = False
+        for slots in opt._slots.values():
+            for name, v in slots.items():
+                spec = getattr(v.sharding, "spec", None)
+                if spec is not None and "sharding" in jax.tree.leaves(
+                        tuple(spec)):
+                    sharded_any = True
+        assert sharded_any, "no optimizer slot was sharded under stage os"
+
+    @pytest.mark.parametrize("level", ["os_g", "p_g_os"])
+    def test_stage23_parity(self, level):
+        _, serial, final_a, _ = _run(None)
+        _, sharded, final_b, _ = _run(level)
+        np.testing.assert_allclose(serial, sharded, rtol=1e-4, atol=1e-5)
+        for k in final_a:
+            np.testing.assert_allclose(final_a[k], final_b[k],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_stage3_params_sharded(self):
+        _, _, _, _ = _run(None)
+        paddle.seed(7)
+        model = _mlp()
+        opt = optimizer.AdamW(learning_rate=1e-2,
+                              parameters=model.parameters())
+        mesh = Mesh(np.array(jax.devices()[:8]), ("sharding",))
+        set_current_mesh(mesh)
+        model, opt, _ = group_sharded_parallel(model, opt, "p_g_os")
+        specs = [p._sharding_spec for _, p in model.named_parameters()]
+        assert any(s is not None and "sharding" in jax.tree.leaves(tuple(s))
+                   for s in specs)
+
+    def test_in_jit_constraint_shards_slots(self):
+        """Even with fully replicated inputs, the compiled update must
+        constrain new slots onto the sharding axis (regression: device_put
+        under tracing is a silent no-op)."""
+        paddle.seed(7)
+        model = _mlp()
+        opt = optimizer.AdamW(learning_rate=1e-2,
+                              parameters=model.parameters())
+        mesh = Mesh(np.array(jax.devices()[:8]), ("sharding",))
+        set_current_mesh(mesh)
+        _, opt, _ = group_sharded_parallel(None, opt, "os")
+        params = {n: p._value for n, p in
+                  zip(opt._param_names, opt._param_list)}
+        grads = {n: jnp.ones_like(v) for n, v in params.items()}
+        state = opt.functional_state()
+        # force-replicate the state so only the in-jit constraint can shard
+        state = jax.tree.map(
+            lambda v: jax.device_put(np.asarray(v)), state)
+        upd = jax.jit(lambda p, g, s: opt.functional_update(p, g, s, 1e-2))
+        _, new_state = upd(params, grads, state)
+        specs = [getattr(v.sharding, "spec", None)
+                 for s in new_state["slots"].values() for v in s.values()]
+        assert any(s is not None and "sharding" in jax.tree.leaves(tuple(s))
+                   for s in specs)
+
+    def test_fleet_strategy_wires_sharding(self):
+        from paddle_tpu.distributed import fleet
+        strategy = fleet.DistributedStrategy()
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 2}
+        strategy.hybrid_configs = {"dp_degree": 1, "sharding_degree": 8}
+        fleet.init(is_collective=True, strategy=strategy)
+        model = _mlp()
+        opt = optimizer.AdamW(learning_rate=1e-2,
+                              parameters=model.parameters())
+        opt = fleet.distributed_optimizer(opt)
+        assert opt._slot_constrain is not None
+        assert opt._grad_constrain is not None
