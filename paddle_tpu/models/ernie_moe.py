@@ -1,0 +1,164 @@
+"""ERNIE-style Mixture-of-Experts LM — the EP (expert-parallel) baseline.
+
+Reference parity: ERNIE-MoE trained through
+paddle.incubate.distributed.models.moe.MoELayer with the expert comm group
+from HybridCommunicateGroup (reference: python/paddle/incubate/distributed/
+models/moe/moe_layer.py — verify); the model itself lives in the ERNIE
+ecosystem repo, SURVEY §1 requires an in-repo equivalent.
+
+TPU-native design: transformer decoder where every `moe_every`-th layer's
+FFN is a GShard top-2 MoELayer whose stacked expert weights carry a
+partition spec over the "ep" mesh axis — the dispatch/combine einsums
+lower to exactly the all-to-all the reference's global_scatter /
+global_gather ops implement by hand (SURVEY §2.3 EP row)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..incubate.distributed.models.moe import MoELayer
+from ..ops.creation import arange
+from ..ops.manipulation import reshape
+
+__all__ = ["ErnieMoEConfig", "ErnieMoEModel", "ErnieMoEForCausalLM",
+           "ernie_moe_tiny_config", "ernie_moe_base_config"]
+
+
+@dataclass
+class ErnieMoEConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_every: int = 2              # every 2nd layer is MoE (GShard style)
+    gate: str = "gshard"
+    aux_loss_weight: float = 0.01
+    expert_parallel: bool = True    # partition experts over "ep"
+    tensor_parallel: bool = False
+    dtype: str = "float32"
+
+
+def ernie_moe_tiny_config(**kw):
+    base = dict(vocab_size=512, hidden_size=128, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=256,
+                max_position_embeddings=128, num_experts=4)
+    base.update(kw)
+    return ErnieMoEConfig(**base)
+
+
+def ernie_moe_base_config(**kw):
+    return ErnieMoEConfig(**kw)
+
+
+class ErnieMoEAttention(nn.Layer):
+    def __init__(self, config: ErnieMoEConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv_proj = nn.Linear(h, 3 * h)
+        self.out_proj = nn.Linear(h, h)
+        if config.tensor_parallel:
+            self.qkv_proj.weight._sharding_spec = P(None, "mp")
+            self.qkv_proj.bias._sharding_spec = P("mp")
+            self.out_proj.weight._sharding_spec = P("mp", None)
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = reshape(self.qkv_proj(x),
+                      (b, s, 3, self.num_heads, self.head_dim))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask,
+                                             is_causal=attn_mask is None)
+        return self.out_proj(reshape(out, (b, s, h)))
+
+
+class ErnieMoEBlock(nn.Layer):
+    def __init__(self, config: ErnieMoEConfig, use_moe: bool):
+        super().__init__()
+        h = config.hidden_size
+        self.ln_1 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.attn = ErnieMoEAttention(config)
+        self.ln_2 = nn.LayerNorm(h, epsilon=config.layer_norm_epsilon)
+        self.use_moe = use_moe
+        if use_moe:
+            self.mlp = MoELayer(
+                d_model=h, num_expert=config.num_experts,
+                d_hidden=config.intermediate_size, top_k=config.top_k,
+                capacity_factor=config.capacity_factor, gate=config.gate,
+                expert_axis="ep" if config.expert_parallel else None)
+        else:
+            self.mlp = nn.Sequential(
+                nn.Linear(h, config.intermediate_size), nn.GELU(),
+                nn.Linear(config.intermediate_size, h))
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.attn(self.ln_1(x), attn_mask)
+        return x + self.mlp(self.ln_2(x))
+
+
+class ErnieMoEModel(nn.Layer):
+    def __init__(self, config: ErnieMoEConfig):
+        super().__init__()
+        self.config = config
+        # N(0, 0.02) embedding init (see gpt.py: wider init + tied head
+        # degenerates the logits at init)
+        from ..param_attr import ParamAttr
+        from ..nn import initializer as I
+        emb_attr = lambda: ParamAttr(initializer=I.Normal(0.0, 0.02))
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size,
+                                weight_attr=emb_attr())
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size, weight_attr=emb_attr())
+        self.layers = nn.LayerList([
+            ErnieMoEBlock(config,
+                          use_moe=(i % config.moe_every ==
+                                   config.moe_every - 1))
+            for i in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, attn_mask=None):
+        b, s = input_ids.shape
+        pos = arange(0, s, dtype="int64")
+        x = self.wte(input_ids) + self.wpe(pos)
+        for block in self.layers:
+            x = block(x, attn_mask)
+        return self.ln_f(x)
+
+    def aux_loss(self):
+        """Sum of gate load-balance losses from the last forward."""
+        total = None
+        for layer in self.layers:
+            if layer.use_moe and layer.mlp.l_aux is not None:
+                total = layer.mlp.l_aux if total is None \
+                    else total + layer.mlp.l_aux
+        return total
+
+
+class ErnieMoEForCausalLM(nn.Layer):
+    def __init__(self, config: ErnieMoEConfig):
+        super().__init__()
+        self.config = config
+        self.ernie = ErnieMoEModel(config)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        from ..ops.math import matmul
+        h = self.ernie(input_ids, attn_mask)
+        logits = matmul(h, self.ernie.wte.weight, transpose_y=True)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits, labels, reduction="mean")
+        aux = self.ernie.aux_loss()
+        if aux is not None:
+            loss = loss + self.config.aux_loss_weight * aux
+        return loss, logits

@@ -1,0 +1,166 @@
+"""GPT-2/3 family decoder-only LM.
+
+Reference parity: the fleet hybrid-parallel GPT configs the reference's
+distributed tests train (test/collective/fleet hybrid_parallel_*_model.py
+use a small GPT — verify); the full model lives in PaddleNLP, SURVEY §1
+requires an in-repo equivalent.
+
+TPU-native design: pre-LN blocks, learned positions, attention through
+scaled_dot_product_attention (Pallas flash kernel on TPU); tensor
+parallelism is partition specs over "mp" (Column/Row pattern), exactly the
+Megatron split the reference builds with ColumnParallelLinear /
+RowParallelLinear."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from .. import nn
+from ..nn import functional as F
+from ..ops.creation import arange
+from ..ops.manipulation import reshape
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny_config",
+           "gpt2_small_config", "gpt2_medium_config", "gpt2_large_config"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    dropout: float = 0.0
+    tensor_parallel: bool = True
+    dtype: str = "float32"
+
+
+def gpt_tiny_config(**kw):
+    base = dict(vocab_size=512, hidden_size=128, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=256,
+                max_position_embeddings=128)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def gpt2_small_config(**kw):
+    return GPTConfig(**kw)
+
+
+def gpt2_medium_config(**kw):
+    return GPTConfig(hidden_size=1024, num_hidden_layers=24,
+                     num_attention_heads=16, intermediate_size=4096, **kw)
+
+
+def gpt2_large_config(**kw):
+    return GPTConfig(hidden_size=1280, num_hidden_layers=36,
+                     num_attention_heads=20, intermediate_size=5120, **kw)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // self.num_heads
+        self.qkv_proj = nn.Linear(h, 3 * h)
+        self.out_proj = nn.Linear(h, h)
+        self.dropout = nn.Dropout(config.dropout)
+        if config.tensor_parallel:
+            self.qkv_proj.weight._sharding_spec = P(None, "mp")
+            self.qkv_proj.bias._sharding_spec = P("mp")
+            self.out_proj.weight._sharding_spec = P("mp", None)
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = reshape(self.qkv_proj(x), (b, s, 3, self.num_heads,
+                                         self.head_dim))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask,
+                                             is_causal=attn_mask is None)
+        out = reshape(out, (b, s, h))
+        return self.dropout(self.out_proj(out))
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, ff = config.hidden_size, config.intermediate_size
+        self.fc_in = nn.Linear(h, ff)
+        self.fc_out = nn.Linear(ff, h)
+        self.dropout = nn.Dropout(config.dropout)
+        if config.tensor_parallel:
+            self.fc_in.weight._sharding_spec = P(None, "mp")
+            self.fc_in.bias._sharding_spec = P("mp")
+            self.fc_out.weight._sharding_spec = P("mp", None)
+
+    def forward(self, x):
+        return self.dropout(self.fc_out(F.gelu(self.fc_in(x),
+                                               approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.attn(self.ln_1(x), attn_mask)
+        return x + self.mlp(self.ln_2(x))
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        # GPT-2 init: N(0, 0.02) embeddings — with the weight-tied head a
+        # wider init makes logits degenerate-diagonal (h·wte^T self-dot
+        # scales with hidden_size, so init CE collapses to ~0)
+        from ..param_attr import ParamAttr
+        from ..nn import initializer as I
+        emb_attr = lambda: ParamAttr(initializer=I.Normal(0.0, 0.02))
+        self.wte = nn.Embedding(config.vocab_size, config.hidden_size,
+                                weight_attr=emb_attr())
+        self.wpe = nn.Embedding(config.max_position_embeddings,
+                                config.hidden_size, weight_attr=emb_attr())
+        if config.tensor_parallel:
+            self.wte.weight._sharding_spec = P("mp", None)
+        self.drop = nn.Dropout(config.dropout)
+        self.h = nn.LayerList(
+            [GPTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size,
+                                 epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids, attn_mask=None):
+        b, s = input_ids.shape
+        pos = arange(0, s, dtype="int64")
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for block in self.h:
+            x = block(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        from ..ops.math import matmul
+        h = self.gpt(input_ids, attn_mask)
+        # weight-tied head (GPT-2 convention)
+        logits = matmul(h, self.gpt.wte.weight, transpose_y=True)
+        if labels is None:
+            return logits
+        loss = F.cross_entropy(logits, labels, reduction="mean")
+        return loss, logits

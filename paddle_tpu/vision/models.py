@@ -197,3 +197,139 @@ class LeNet(Layer):
         x = self.features(x)
         x = flatten(x, 1)
         return self.fc(x)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV2 (reference: python/paddle/vision/models/mobilenetv2.py — verify)
+# ---------------------------------------------------------------------------
+
+def _make_divisible(v, divisor=8, min_value=None):
+    """Round channels to multiples of `divisor` (reference: mobilenetv2.py
+    _make_divisible — verify); keeps shapes checkpoint-compatible."""
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        from ..nn import ReLU6
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers += [Conv2D(inp, hidden, 1, bias_attr=False),
+                       BatchNorm2D(hidden), ReLU6()]
+        layers += [Conv2D(hidden, hidden, 3, stride=stride, padding=1,
+                          groups=hidden, bias_attr=False),
+                   BatchNorm2D(hidden), ReLU6(),
+                   Conv2D(hidden, oup, 1, bias_attr=False),
+                   BatchNorm2D(oup)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        from ..nn import ReLU6
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        inp = _make_divisible(32 * scale)
+        last = _make_divisible(1280 * max(1.0, scale))
+        features = [Conv2D(3, inp, 3, stride=2, padding=1, bias_attr=False),
+                    BatchNorm2D(inp), ReLU6()]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(_InvertedResidual(
+                    inp, out_c, s if i == 0 else 1, t))
+                inp = out_c
+        features += [Conv2D(inp, last, 1, bias_attr=False),
+                     BatchNorm2D(last), ReLU6()]
+        self.features = Sequential(*features)
+        self.with_pool = with_pool
+        self.pool2d_avg = AdaptiveAvgPool2D(1) if with_pool else None
+        self.classifier = Linear(last, num_classes) if num_classes > 0 \
+            else None
+
+    def forward(self, x):
+        from ..ops.manipulation import flatten
+        x = self.features(x)
+        if self.pool2d_avg is not None:
+            x = self.pool2d_avg(x)
+        if self.classifier is not None:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Vision Transformer (reference: python/paddle/vision/models/_vision_
+# transformer-alike in ecosystem PaddleClas — verify). Attention rides the
+# same scaled_dot_product_attention fast path as the LMs.
+# ---------------------------------------------------------------------------
+
+class VisionTransformer(Layer):
+    def __init__(self, image_size=224, patch_size=16, embed_dim=768,
+                 depth=12, num_heads=12, mlp_ratio=4.0, num_classes=1000,
+                 in_channels=3):
+        super().__init__()
+        from ..nn import LayerNorm
+        from ..tensor import Parameter
+        import jax.numpy as jnp
+        self.patch_embed = Conv2D(in_channels, embed_dim, patch_size,
+                                  stride=patch_size)
+        n_patches = (image_size // patch_size) ** 2
+        self.cls_token = Parameter(jnp.zeros((1, 1, embed_dim),
+                                             jnp.float32))
+        import jax
+        self.pos_embed = Parameter(
+            0.02 * jax.random.normal(jax.random.PRNGKey(0),
+                                     (1, n_patches + 1, embed_dim),
+                                     jnp.float32))
+        from ..nn.transformer import TransformerEncoderLayer
+        self.blocks = Sequential(*[
+            TransformerEncoderLayer(embed_dim, num_heads,
+                                    int(embed_dim * mlp_ratio), dropout=0.0,
+                                    activation="gelu", normalize_before=True)
+            for _ in range(depth)])
+        self.norm = LayerNorm(embed_dim)
+        self.head = Linear(embed_dim, num_classes)
+
+    def forward(self, x):
+        from ..ops.manipulation import concat, reshape, transpose
+        from ..ops.creation import zeros
+        b = x.shape[0]
+        h = self.patch_embed(x)                       # (b, d, gh, gw)
+        d = h.shape[1]
+        h = reshape(h, (b, d, -1))
+        h = transpose(h, (0, 2, 1))                   # (b, n, d)
+        cls = self.cls_token + zeros((b, 1, d), dtype=h.dtype)
+        h = concat([cls, h], axis=1) + self.pos_embed
+        h = self.blocks(h)
+        h = self.norm(h)
+        return self.head(h[:, 0])
+
+
+def vit_b_16(pretrained=False, **kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=768, depth=12,
+                             num_heads=12, **kwargs)
+
+
+def vit_l_16(pretrained=False, **kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=1024, depth=24,
+                             num_heads=16, **kwargs)
+
+
+__all__ += ["MobileNetV2", "mobilenet_v2", "VisionTransformer", "vit_b_16",
+            "vit_l_16"]

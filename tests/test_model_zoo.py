@@ -1,0 +1,147 @@
+"""Model-zoo coverage: GPT, ERNIE-MoE, diffusion UNet, ViT, MobileNetV2.
+
+Each family gets a forward-shape check plus (for the trainable LMs /
+diffusion) a couple of fused train steps asserting the loss moves — the
+reference's model tests assert convergence on toy data (SURVEY §4)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.tensor import Tensor
+
+
+def _ids(b, s, vocab, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).randint(0, vocab, (b, s)), jnp.int32)
+
+
+class TestGPT:
+    def test_forward_and_train(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+        cfg = gpt_tiny_config()
+        model = GPTForCausalLM(cfg)
+        ids = _ids(2, 16, cfg.vocab_size)
+        logits = model(Tensor(ids))
+        assert tuple(logits.shape) == (2, 16, cfg.vocab_size)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+
+        def loss_fn(m, batch):
+            x, y = batch
+            loss, _ = m(x, labels=y)
+            return loss
+        step = TrainStep(model, loss_fn, opt)
+        lab = _ids(2, 16, cfg.vocab_size, seed=1)
+        losses = [float(step((Tensor(ids), Tensor(lab)))._value)
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+
+class TestErnieMoE:
+    def test_forward_aux_loss_and_train(self):
+        from paddle_tpu.models.ernie_moe import (ErnieMoEForCausalLM,
+                                                 ernie_moe_tiny_config)
+        cfg = ernie_moe_tiny_config()
+        model = ErnieMoEForCausalLM(cfg)
+        ids = _ids(2, 16, cfg.vocab_size)
+        logits = model(Tensor(ids))
+        assert tuple(logits.shape) == (2, 16, cfg.vocab_size)
+        assert model.ernie.aux_loss() is not None  # MoE layers engaged
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+
+        def loss_fn(m, batch):
+            x, y = batch
+            loss, _ = m(x, labels=y)
+            return loss
+        step = TrainStep(model, loss_fn, opt)
+        lab = _ids(2, 16, cfg.vocab_size, seed=1)
+        losses = [float(step((Tensor(ids), Tensor(lab)))._value)
+                  for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+    def test_expert_params_carry_ep_spec(self):
+        from paddle_tpu.models.ernie_moe import (ErnieMoEModel,
+                                                 ernie_moe_tiny_config)
+        model = ErnieMoEModel(ernie_moe_tiny_config())
+        specs = [p._sharding_spec for n, p in model.named_parameters()
+                 if "experts" in n]
+        assert specs and all(
+            s is not None and "ep" in jax.tree.leaves(tuple(s))
+            for s in specs)
+
+
+class TestDiffusion:
+    def test_unet_shapes_and_train(self):
+        from paddle_tpu.models.diffusion import (LatentDiffusion,
+                                                 sdxl_tiny_config)
+        cfg = sdxl_tiny_config()
+        model = LatentDiffusion(cfg)
+        b, hw = 2, cfg.sample_size
+        rs = np.random.RandomState(0)
+        latents = jnp.asarray(rs.randn(b, cfg.in_channels, hw, hw),
+                              jnp.float32)
+        ctx = jnp.asarray(rs.randn(b, 8, cfg.cross_attention_dim),
+                          jnp.float32)
+        noise = jnp.asarray(rs.randn(*latents.shape), jnp.float32)
+        ts = jnp.asarray([10, 500], jnp.int32)
+        # direct UNet output shape
+        out = model.unet(Tensor(latents), Tensor(ts), Tensor(ctx))
+        assert tuple(out.shape) == tuple(latents.shape)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+
+        def loss_fn(m, batch):
+            l, c, n, t = batch
+            return m(l, c, n, t)
+        step = TrainStep(model, loss_fn, opt)
+        batch = tuple(map(Tensor, (latents, ctx, noise, ts)))
+        losses = [float(step(batch)._value) for _ in range(4)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_ddpm_roundtrip(self):
+        from paddle_tpu.models.diffusion import DDPMScheduler
+        sched = DDPMScheduler(num_train_timesteps=100)
+        x0 = jnp.ones((1, 2, 4, 4))
+        noise = jnp.zeros_like(x0)
+        # zero noise at t=0 stays ~x0
+        noisy = sched.add_noise(x0, noise, jnp.asarray([0]))
+        np.testing.assert_allclose(np.asarray(noisy),
+                                   np.sqrt(float(sched.alphas_cumprod[0])) *
+                                   np.asarray(x0), rtol=1e-5)
+
+    def test_ddim_step_recovers_x0_with_true_noise(self):
+        from paddle_tpu.models.diffusion import DDIMScheduler
+        sched = DDIMScheduler(num_train_timesteps=100)
+        rs = np.random.RandomState(0)
+        x0 = jnp.asarray(rs.randn(1, 2, 4, 4), jnp.float32)
+        eps = jnp.asarray(rs.randn(1, 2, 4, 4), jnp.float32)
+        t = jnp.asarray(50)
+        xt = sched.add_noise(x0, eps, t)
+        # stepping all the way to alpha=1 with the true noise returns x0
+        x_prev = sched.step(eps, t, jnp.asarray(-1), xt)
+        np.testing.assert_allclose(np.asarray(x_prev), np.asarray(x0),
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestVision:
+    def test_vit_forward(self):
+        from paddle_tpu.vision.models import VisionTransformer
+        model = VisionTransformer(image_size=32, patch_size=8, embed_dim=64,
+                                  depth=2, num_heads=4, num_classes=10)
+        x = Tensor(jnp.ones((2, 3, 32, 32), jnp.float32))
+        out = model(x)
+        assert tuple(out.shape) == (2, 10)
+
+    def test_mobilenet_v2_forward(self):
+        from paddle_tpu.vision.models import mobilenet_v2
+        model = mobilenet_v2(scale=0.25, num_classes=10)
+        model.eval()
+        x = Tensor(jnp.ones((1, 3, 32, 32), jnp.float32))
+        out = model(x)
+        assert tuple(out.shape) == (1, 10)
