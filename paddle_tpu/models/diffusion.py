@@ -13,8 +13,8 @@ loss)."""
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
-from typing import List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..ops.manipulation import concat, reshape, transpose
-from ..tensor import Tensor, apply_op
+from ..tensor import apply_op
 
 __all__ = ["UNetConfig", "UNet2DConditionModel", "DDPMScheduler",
            "DDIMScheduler", "LatentDiffusion", "sdxl_tiny_config",
@@ -382,9 +382,16 @@ class DDPMScheduler:
 
 
 class DDIMScheduler(DDPMScheduler):
-    """Deterministic DDIM step (eta=0)."""
+    """Deterministic DDIM step (eta=0). Signature matches the DDPM base
+    (`step(model_output, timestep, sample, ...)`) so either scheduler can
+    drive the same sampling loop; `prev_timestep` defaults to the previous
+    training timestep."""
 
-    def step(self, model_output, timestep, prev_timestep, sample):
+    def step(self, model_output, timestep, sample, key=None,
+             prev_timestep=None):
+        del key  # deterministic
+        if prev_timestep is None:
+            prev_timestep = timestep - 1
         alpha_t = self.alphas_cumprod[timestep]
         alpha_prev = jnp.where(prev_timestep >= 0,
                                self.alphas_cumprod[prev_timestep], 1.0)

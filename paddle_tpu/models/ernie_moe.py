@@ -44,6 +44,7 @@ class ErnieMoEConfig:
     aux_loss_weight: float = 0.01
     expert_parallel: bool = True    # partition experts over "ep"
     tensor_parallel: bool = False
+    dropout: float = 0.0
     dtype: str = "float32"
 
 
@@ -59,27 +60,9 @@ def ernie_moe_base_config(**kw):
     return ErnieMoEConfig(**kw)
 
 
-class ErnieMoEAttention(nn.Layer):
-    def __init__(self, config: ErnieMoEConfig):
-        super().__init__()
-        h = config.hidden_size
-        self.num_heads = config.num_attention_heads
-        self.head_dim = h // self.num_heads
-        self.qkv_proj = nn.Linear(h, 3 * h)
-        self.out_proj = nn.Linear(h, h)
-        if config.tensor_parallel:
-            self.qkv_proj.weight._sharding_spec = P(None, "mp")
-            self.qkv_proj.bias._sharding_spec = P("mp")
-            self.out_proj.weight._sharding_spec = P("mp", None)
-
-    def forward(self, x, attn_mask=None):
-        b, s, h = x.shape
-        qkv = reshape(self.qkv_proj(x),
-                      (b, s, 3, self.num_heads, self.head_dim))
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        out = F.scaled_dot_product_attention(q, k, v, attn_mask,
-                                             is_causal=attn_mask is None)
-        return self.out_proj(reshape(out, (b, s, h)))
+# Attention is identical to GPT's (duck-typed on hidden_size /
+# num_attention_heads / dropout / tensor_parallel config fields).
+from .gpt import GPTAttention as ErnieMoEAttention  # noqa: E402
 
 
 class ErnieMoEBlock(nn.Layer):

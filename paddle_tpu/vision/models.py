@@ -292,11 +292,9 @@ class VisionTransformer(Layer):
         n_patches = (image_size // patch_size) ** 2
         self.cls_token = Parameter(jnp.zeros((1, 1, embed_dim),
                                              jnp.float32))
-        import jax
+        from ..nn.initializer import Normal
         self.pos_embed = Parameter(
-            0.02 * jax.random.normal(jax.random.PRNGKey(0),
-                                     (1, n_patches + 1, embed_dim),
-                                     jnp.float32))
+            Normal(std=0.02)((1, n_patches + 1, embed_dim), jnp.float32))
         from ..nn.transformer import TransformerEncoderLayer
         self.blocks = Sequential(*[
             TransformerEncoderLayer(embed_dim, num_heads,

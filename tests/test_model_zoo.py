@@ -124,7 +124,7 @@ class TestDiffusion:
         t = jnp.asarray(50)
         xt = sched.add_noise(x0, eps, t)
         # stepping all the way to alpha=1 with the true noise returns x0
-        x_prev = sched.step(eps, t, jnp.asarray(-1), xt)
+        x_prev = sched.step(eps, t, xt, prev_timestep=jnp.asarray(-1))
         np.testing.assert_allclose(np.asarray(x_prev), np.asarray(x0),
                                    rtol=1e-3, atol=1e-4)
 
