@@ -33,6 +33,12 @@ from . import profiler
 from . import incubate
 from . import static
 from . import models
+from . import linalg
+from . import distribution
+from . import fft
+from . import signal
+from . import sparse
+from . import quantization
 from . import utils
 from . import hapi
 from .hapi import Model, summary

@@ -277,3 +277,114 @@ class PyLayer:
 
 class LegacyPyLayer(PyLayer):
     pass
+
+
+# ---------------------------------------------------------------------------
+# functional autodiff (paddle.autograd / paddle.incubate.autograd parity:
+# python/paddle/autograd/functional.py — verify). These functionalize the
+# wrapped callable and hand it to jax's transforms, so they compose with
+# jit and give exact (not finite-difference) derivatives.
+# ---------------------------------------------------------------------------
+
+def _functionalize(func):
+    """Wrap a Tensor->Tensor callable as a jax-array pure function."""
+    def fn(*arrays):
+        with framework.functional_mode():
+            args = [Tensor(a) for a in arrays]
+            for a in args:
+                a.stop_gradient = False
+            out = func(*args)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+    return fn
+
+
+def _unpack(xs):
+    single = not isinstance(xs, (list, tuple))
+    xs = [xs] if single else list(xs)
+    return single, [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                    for x in xs]
+
+
+def _pack(vals, single):
+    wrapped = jax.tree_util.tree_map(Tensor, vals)
+    if single and isinstance(wrapped, (list, tuple)) and len(wrapped) == 1:
+        return wrapped[0]
+    return wrapped
+
+
+def vjp(func, xs, v=None):
+    """(outputs, vjp_result): pullback of ``func`` at ``xs`` along ``v``."""
+    single, arrays = _unpack(xs)
+    out, pull = jax.vjp(_functionalize(func), *arrays)
+    if v is None:
+        ct = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        _, cts = _unpack(v)
+        ct = cts[0] if not isinstance(out, tuple) else tuple(cts)
+    grads = pull(ct)
+    return _pack(out, True), _pack(list(grads), single)
+
+
+def jvp(func, xs, v=None):
+    """(outputs, jvp_result): pushforward of ``func`` at ``xs`` along
+    ``v`` (defaults to ones)."""
+    single, arrays = _unpack(xs)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        _, tangents = _unpack(v)
+    out, tan = jax.jvp(_functionalize(func), tuple(arrays), tuple(tangents))
+    return _pack(out, True), _pack(tan, True)
+
+
+class Jacobian:
+    """Lazy Jacobian matrix (paddle.autograd.jacobian result object):
+    index/slice it like a 2-D tensor over (flat_out, flat_in)."""
+
+    def __init__(self, mat):
+        self._mat = mat
+
+    def __getitem__(self, idx):
+        return Tensor(self._mat[idx])
+
+    @property
+    def shape(self):
+        return list(self._mat.shape)
+
+    def numpy(self):
+        import numpy as _np
+        return _np.asarray(self._mat)
+
+    def as_tensor(self):
+        return Tensor(self._mat)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False,
+             batch_axis=None):
+    """Exact Jacobian via jax.jacrev. Returns a Jacobian view per input
+    (single input -> single Jacobian)."""
+    single, arrays = _unpack(xs)
+    jac = jax.jacrev(_functionalize(func), argnums=tuple(range(len(arrays))))
+    mats = jac(*arrays)
+    if isinstance(mats, tuple):
+        out = [Jacobian(m) for m in mats]
+        return out[0] if single else out
+    return Jacobian(mats)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False,
+            batch_axis=None):
+    """Exact Hessian of a scalar-valued ``func`` via forward-over-reverse."""
+    single, arrays = _unpack(xs)
+    hess = jax.hessian(_functionalize(func),
+                       argnums=tuple(range(len(arrays))))
+    mats = hess(*arrays)
+    if isinstance(mats, tuple):
+        if single:
+            return Jacobian(mats[0][0] if isinstance(mats[0], tuple)
+                            else mats[0])
+        return [[Jacobian(m) for m in row] for row in mats]
+    return Jacobian(mats)

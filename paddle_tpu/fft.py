@@ -1,0 +1,108 @@
+"""FFT namespace (``paddle.fft`` parity).
+
+Reference parity: python/paddle/fft.py (fft/ifft/rfft/... over cuFFT —
+verify). TPU-native: jnp.fft lowers to XLA's FFT HLO; complex64 is the
+working dtype on TPU. All entry points tape through ``apply_op`` so they
+differentiate in eager mode and fuse under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor, apply_op
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    return None if norm in (None, "backward") else norm
+
+
+def _mk1(jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)),
+                        x)
+    return op
+
+
+def _mk2(jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op(lambda v: jfn(v, s=s, axes=tuple(axes),
+                                      norm=_norm(norm)), x)
+    return op
+
+
+def _mkn(jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op(
+            lambda v: jfn(v, s=s, axes=None if axes is None
+                          else tuple(axes), norm=_norm(norm)), x)
+    return op
+
+
+fft = _mk1(jnp.fft.fft)
+ifft = _mk1(jnp.fft.ifft)
+rfft = _mk1(jnp.fft.rfft)
+irfft = _mk1(jnp.fft.irfft)
+hfft = _mk1(jnp.fft.hfft)
+ihfft = _mk1(jnp.fft.ihfft)
+
+fft2 = _mk2(jnp.fft.fft2)
+ifft2 = _mk2(jnp.fft.ifft2)
+rfft2 = _mk2(jnp.fft.rfft2)
+irfft2 = _mk2(jnp.fft.irfft2)
+
+fftn = _mkn(jnp.fft.fftn)
+ifftn = _mkn(jnp.fft.ifftn)
+rfftn = _mkn(jnp.fft.rfftn)
+irfftn = _mkn(jnp.fft.irfftn)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    y = ifftn(x, s=None if s is None else tuple(s[:-1]) + (None,),
+              axes=tuple(axes)[:-1], norm=norm)
+    return hfft(y, n=None if s is None else s[-1], axis=tuple(axes)[-1],
+                norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    y = ihfft(x, n=None if s is None else s[-1], axis=tuple(axes)[-1],
+              norm=norm)
+    return fftn(y, axes=tuple(axes)[:-1], norm=norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    if axes is None:
+        axes = tuple(range(-x.ndim, 0))
+    y = ifftn(x, axes=tuple(axes)[:-1], norm=norm)
+    return hfft(y, n=None if s is None else s[-1], axis=tuple(axes)[-1],
+                norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    if axes is None:
+        axes = tuple(range(-x.ndim, 0))
+    y = ihfft(x, n=None if s is None else s[-1], axis=tuple(axes)[-1],
+              norm=norm)
+    return fftn(y, axes=tuple(axes)[:-1], norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda v: jnp.fft.fftshift(v, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), x)

@@ -1,0 +1,34 @@
+"""Linear-algebra namespace (``paddle.linalg`` parity).
+
+Reference parity: python/paddle/tensor/linalg.py and the
+``paddle.linalg`` namespace re-exports (cholesky, svd, qr, eig, lu,
+lstsq, pinv, solve, ... — verify).
+
+TPU-native design: decompositions lower through jnp.linalg /
+jax.scipy.linalg to XLA's native QR/SVD/eigh/cholesky custom calls; no
+LAPACK shim is needed. Everything routes through ``apply_op`` so the ops
+tape in eager mode and trace into jitted steps, and the jnp vjps give the
+backward passes for free (the reference hand-writes e.g. svd_grad in
+paddle/phi/kernels — verify).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ops.math import (cholesky, cholesky_solve, cond, corrcoef, cov, cross,
+                       det, dist, dot, eig, eigh, eigvals, eigvalsh,
+                       householder_product, inv, lstsq, lu, lu_unpack,
+                       matmul, matrix_exp, matrix_norm, matrix_power,
+                       matrix_rank, multi_dot, mv, norm, pca_lowrank, pinv,
+                       qr, slogdet, solve, svd, svd_lowrank, t,
+                       triangular_solve, vecdot, vector_norm)
+
+__all__ = [
+    "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "cross", "det",
+    "dist", "dot", "eig", "eigh", "eigvals", "eigvalsh",
+    "householder_product", "inv", "lstsq", "lu", "lu_unpack", "matmul",
+    "matrix_exp", "matrix_norm", "matrix_power", "matrix_rank", "multi_dot",
+    "mv", "norm", "pca_lowrank", "pinv", "qr", "slogdet", "solve", "svd",
+    "svd_lowrank", "t", "triangular_solve", "vecdot", "vector_norm",
+]

@@ -6,6 +6,8 @@ in eager mode and trace cleanly under jit.
 """
 from __future__ import annotations
 
+import builtins
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -48,6 +50,12 @@ __all__ = [
     # linalg
     "matmul", "mm", "bmm", "dot", "outer", "inner", "t", "transpose_matmul",
     "norm", "dist", "cross", "trace", "kron", "einsum", "mv", "matrix_power",
+    # linalg decompositions / solvers (surfaced via paddle_tpu.linalg)
+    "cholesky", "cholesky_solve", "det", "slogdet", "inv", "pinv", "solve",
+    "triangular_solve", "lstsq", "qr", "svd", "svd_lowrank", "pca_lowrank",
+    "eig", "eigvals", "eigh", "eigvalsh", "lu", "lu_unpack", "matrix_exp",
+    "matrix_rank", "householder_product", "cond", "multi_dot", "corrcoef",
+    "cov", "vector_norm", "matrix_norm", "vecdot",
     "histogram", "bincount",
     # misc
     "cast", "isreal", "rsub", "stanh", "softplus_op", "floor_mod",
@@ -627,6 +635,254 @@ def einsum(equation, *operands):
 
 def matrix_power(x, n, name=None):
     return apply_op(lambda v: jnp.linalg.matrix_power(v, n), x)
+
+
+# ---------------------------------------------------------------------------
+# decompositions / solvers (paddle.linalg namespace; python/paddle/tensor/
+# linalg.py — verify). XLA has native qr/svd/eigh/cholesky lowerings.
+# ---------------------------------------------------------------------------
+
+def cholesky(x, upper=False, name=None):
+    return apply_op(
+        lambda v: jnp.linalg.cholesky(v).mT.conj() if upper
+        else jnp.linalg.cholesky(v), x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, chol):
+        lower = not upper
+        z = jax.scipy.linalg.solve_triangular(
+            chol, b, lower=lower, trans="C" if upper else "N")
+        return jax.scipy.linalg.solve_triangular(
+            chol, z, lower=lower, trans="N" if upper else "C")
+    return apply_op(f, x, y)
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def f(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+    return apply_op(f, x)
+
+
+def inv(x, name=None):
+    return apply_op(jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(
+        lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), x)
+
+
+def solve(x, y, name=None):
+    return apply_op(
+        lambda a, b: jnp.linalg.solve(
+            a, b[..., None])[..., 0] if b.ndim == a.ndim - 1
+        else jnp.linalg.solve(a, b), x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return apply_op(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans="T" if transpose else "N",
+            unit_diagonal=unitriangular), x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        s = jnp.linalg.svd(a, compute_uv=False)
+        sol = jnp.linalg.lstsq(a, b, rcond=rcond)[0]
+        res = jnp.sum((a @ sol - b) ** 2, axis=-2)
+        tol = jnp.finfo(a.dtype).eps * builtins.max(a.shape[-2],
+                                                    a.shape[-1])
+        rank = jnp.sum(s > tol * s[..., :1], axis=-1)
+        return sol, res, rank, s
+    return apply_op(f, x, y)
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return apply_op(lambda v: jnp.linalg.qr(v, mode="r"), x)
+    return apply_op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op(
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), x)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (subspace iteration; the reference wraps
+    the same algorithm — verify python/paddle/tensor/linalg.py)."""
+    k = q
+
+    def f(a):
+        m, n = a.shape[-2], a.shape[-1]
+        key = jax.random.PRNGKey(0)
+        # NB: bare min/max in this module are the reduction ops
+        omega = jax.random.normal(key, (*a.shape[:-2], n,
+                                        builtins.min(k, n)), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (a.mT @ y)
+        qmat, _ = jnp.linalg.qr(y)
+        b = qmat.mT @ a
+        ub, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ ub, s, vh.mT
+
+    xm = x if M is None else subtract(x, M)
+    return apply_op(f, xm)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    n = x.shape[-2]
+    if q is None:
+        q = builtins.min(6, x.shape[-2], x.shape[-1])
+    if center:
+        x = subtract(x, mean(x, axis=-2, keepdim=True))
+    return svd_lowrank(x, q=q, niter=niter)
+
+
+def eig(x, name=None):
+    return apply_op(lambda v: tuple(jnp.linalg.eig(v)), x)
+
+
+def eigvals(x, name=None):
+    return apply_op(jnp.linalg.eigvals, x)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(v):
+        lu_v, piv_v = jax.scipy.linalg.lu_factor(v)
+        return lu_v, piv_v.astype(jnp.int32)
+    lu_mat, piv = apply_op(f, x)
+    if get_infos:
+        info = Tensor(jnp.zeros(x.shape[:-2], jnp.int32))
+        return lu_mat, piv, info
+    return lu_mat, piv
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    def perm(v, piv):
+        n = v.shape[-2]
+
+        def unbatched(pv):
+            p = jnp.arange(n)
+            for i in range(pv.shape[-1]):
+                j = pv[i]
+                pi, pj = p[i], p[j]
+                p = p.at[i].set(pj).at[j].set(pi)
+            return jnp.eye(n, dtype=v.dtype)[p].mT
+
+        f = unbatched
+        for _ in range(piv.ndim - 1):
+            f = jax.vmap(f)
+        return f(piv)
+
+    p = apply_op(lambda v, pv: perm(v, pv), lu_data, lu_pivots)
+    l = apply_op(
+        lambda v: jnp.tril(v, -1)[..., :, :v.shape[-2]]
+        + jnp.eye(v.shape[-2], builtins.min(v.shape[-2], v.shape[-1]),
+                  dtype=v.dtype),
+        lu_data)
+    u = apply_op(
+        lambda v: jnp.triu(v)[..., :builtins.min(v.shape[-2], v.shape[-1]),
+                              :], lu_data)
+    return p, l, u
+
+
+def matrix_exp(x, name=None):
+    return apply_op(jax.scipy.linalg.expm, x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(
+        lambda v: jnp.linalg.matrix_rank(v, tol=tol), x)
+
+
+def householder_product(x, tau, name=None):
+    """Accumulate Householder reflectors (geqrf convention) into Q."""
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.broadcast_to(jnp.eye(m, dtype=a.dtype),
+                             (*a.shape[:-2], m, m))
+        for i in range(t.shape[-1] - 1, -1, -1):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[..., i].set(1.0)
+            vv = v[..., :, None] * v[..., None, :]
+            q = q - t[..., i, None, None] * (vv @ q)
+        return q[..., :, :n]
+    return apply_op(f, x, tau)
+
+
+def cond(x, p=None, name=None):
+    def f(v):
+        if p in (None, 2):
+            s = jnp.linalg.svd(v, compute_uv=False)
+            return s[..., 0] / s[..., -1]
+        if p == -2:
+            s = jnp.linalg.svd(v, compute_uv=False)
+            return s[..., -1] / s[..., 0]
+        return jnp.linalg.norm(v, ord=p, axis=(-2, -1)) * \
+            jnp.linalg.norm(jnp.linalg.inv(v), ord=p, axis=(-2, -1))
+    return apply_op(f, x)
+
+
+def multi_dot(tensors, name=None):
+    return apply_op(lambda *vs: jnp.linalg.multi_dot(vs), *tensors)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    kw = {}
+    args = [x]
+    if fweights is not None:
+        args.append(fweights)
+    if aweights is not None:
+        args.append(aweights)
+
+    def f(v, *ws):
+        fw = ws[0] if fweights is not None else None
+        aw = ws[-1] if aweights is not None else None
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+    return apply_op(f, *args)
+
+
+def vector_norm(x, p=2, axis=None, keepdim=False, name=None):
+    def f(v):
+        if axis is None:
+            out = jnp.linalg.norm(v.reshape(-1), ord=p)
+            return out.reshape((1,) * v.ndim) if keepdim else out
+        return jnp.linalg.norm(v, ord=p, axis=axis, keepdims=keepdim)
+    return apply_op(f, x)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply_op(lambda v: jnp.linalg.norm(v, ord=p, axis=tuple(axis),
+                                              keepdims=keepdim), x)
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return apply_op(lambda a, b: jnp.sum(jnp.conj(a) * b, axis=axis), x, y)
 
 
 def histogram(x, bins=100, min=0, max=0, name=None):

@@ -1,0 +1,305 @@
+"""Quantization (``paddle.quantization`` parity: PTQ observers + QAT
+fake-quant).
+
+Reference parity: python/paddle/quantization/ (QuantConfig, PTQ, QAT,
+observers in observer/, quanters in quanters/, nn.quant layers — verify).
+
+TPU-native design: quantization here is *simulated* (fake-quant) in the
+graph — quantize→dequantize pairs that XLA folds into the surrounding
+ops — plus int8 weight conversion for export. The straight-through
+estimator comes from jax's custom-vjp-free trick: round(x) + stop_grad
+keeps the backward pass identity, so QAT trains inside the same jitted
+step as the float model (the reference implements STE as separate CUDA
+fake_quantize kernels with hand-written grads — verify
+paddle/phi/kernels/gpu/fake_quantize_kernel).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import Layer
+from ..tensor import Tensor, apply_op
+
+__all__ = [
+    "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+    "HistObserver", "BaseQuanter", "FakeQuanterWithAbsMaxObserver",
+    "FakeQuanterChannelWiseAbsMaxObserver", "QuantConfig", "PTQ", "QAT",
+    "quant_dequant", "quantize_weight", "dequantize_weight",
+    "QuantedLinear", "QuantedConv2D",
+]
+
+
+def _ste_round(v):
+    """Straight-through round: forward rounds, backward is identity."""
+    return v + jax.lax.stop_gradient(jnp.round(v) - v)
+
+
+def quant_dequant(v, scale, bit_length=8):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(_ste_round(v / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+# ---------------------------------------------------------------------------
+# observers (PTQ: watch activations, derive scales)
+# ---------------------------------------------------------------------------
+
+class BaseObserver(Layer):
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scales(self):
+        return Tensor(jnp.asarray(self._scale if self._scale is not None
+                                  else 1.0, jnp.float32))
+
+    def quant_axis(self):
+        return -1
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def forward(self, x):
+        self._observe(x._value if isinstance(x, Tensor) else x)
+        return x
+
+
+class AbsmaxObserver(BaseObserver):
+    def _observe(self, v):
+        m = float(jnp.max(jnp.abs(v)))
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    def __init__(self, quant_bits=8, moving_rate=0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def _observe(self, v):
+        m = float(jnp.max(jnp.abs(v)))
+        self._scale = m if self._scale is None else \
+            self.moving_rate * self._scale + (1 - self.moving_rate) * m
+
+
+class HistObserver(BaseObserver):
+    """Percentile-of-histogram observer (clips outliers)."""
+
+    def __init__(self, quant_bits=8, bins_count=2048, percent=0.999):
+        super().__init__(quant_bits)
+        self.bins_count, self.percent = bins_count, percent
+        self._samples = []
+
+    def _observe(self, v):
+        import numpy as np
+        self._samples.append(np.abs(np.asarray(v)).reshape(-1))
+
+    def scales(self):
+        import numpy as np
+        if self._samples:
+            allv = np.concatenate(self._samples)
+            self._scale = float(np.quantile(allv, self.percent))
+        return super().scales()
+
+
+# ---------------------------------------------------------------------------
+# quanters (QAT: fake-quant with learned/tracked scale in the graph)
+# ---------------------------------------------------------------------------
+
+class BaseQuanter(Layer):
+    pass
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    def __init__(self, quant_bits=8, moving_rate=0.9, name=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.ones((), jnp.float32)))
+
+    def forward(self, x):
+        def f(v, s):
+            cur = jnp.max(jnp.abs(v))
+            new_s = jnp.where(s == 1.0, cur,
+                              self.moving_rate * s
+                              + (1 - self.moving_rate) * cur)
+            return quant_dequant(v, new_s, self.quant_bits)
+        out = apply_op(f, x, self.scale)
+        # track scale on host (buffer update; no-op under trace)
+        try:
+            cur = float(jnp.max(jnp.abs(x._value)))
+            s = float(self.scale._value)
+            self.scale._value = jnp.asarray(
+                cur if s == 1.0 else self.moving_rate * s
+                + (1 - self.moving_rate) * cur, jnp.float32)
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            pass
+        return out
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(BaseQuanter):
+    def __init__(self, quant_bits=8, quant_axis=0, name=None):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.quant_axis = quant_axis
+
+    def forward(self, x):
+        def f(v):
+            axes = tuple(i for i in range(v.ndim) if i != self.quant_axis)
+            s = jnp.max(jnp.abs(v), axis=axes, keepdims=True)
+            return quant_dequant(v, s, self.quant_bits)
+        return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# config + PTQ / QAT drivers
+# ---------------------------------------------------------------------------
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer2config = {}
+        self._type2config = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for l in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer2config[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type2config[t] = (activation, weight)
+
+    def _config_for(self, layer):
+        if id(layer) in self._layer2config:
+            return self._layer2config[id(layer)]
+        for t, cfg in self._type2config.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.activation, self.weight)
+
+
+def _make(factory):
+    if factory is None:
+        return None
+    return factory() if callable(factory) else factory
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quant on activation and weight."""
+
+    def __init__(self, base: nn.Linear, a_quanter, w_quanter):
+        super().__init__()
+        self.base = base
+        self.activation_quanter = a_quanter
+        self.weight_quanter = w_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.base.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+        return F.linear(x, w, self.base.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, base: nn.Conv2D, a_quanter, w_quanter):
+        super().__init__()
+        self.base = base
+        self.activation_quanter = a_quanter
+        self.weight_quanter = w_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.base.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        from ..nn import functional as F
+        return F.conv2d(x, w, self.base.bias, stride=self.base.stride,
+                        padding=self.base.padding,
+                        dilation=self.base.dilation,
+                        groups=self.base.groups)
+
+
+_QUANTABLE = {}
+
+
+def _register_quantable():
+    _QUANTABLE[nn.Linear] = QuantedLinear
+    _QUANTABLE[nn.Conv2D] = QuantedConv2D
+
+
+_register_quantable()
+
+
+class _Quantizer:
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace=False):
+        """Swap quantable sublayers for observed/fake-quant versions."""
+        for name, child in list(model.named_children()):
+            cls = _QUANTABLE.get(type(child))
+            if cls is not None:
+                act, w = self.config._config_for(child)
+                setattr(model, name, cls(child, _make(act), _make(w)))
+            else:
+                self.quantize(child, inplace=True)
+        return model
+
+    def convert(self, model: Layer, inplace=False):
+        """Fold quanters away: bake weight fake-quant into weights and
+        strip observers, returning an inference model."""
+        for name, child in list(model.named_children()):
+            if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                base = child.base
+                if child.weight_quanter is not None:
+                    base.weight._value = \
+                        child.weight_quanter(base.weight)._value
+                setattr(model, name, base)
+            else:
+                self.convert(child, inplace=True)
+        return model
+
+
+class PTQ(_Quantizer):
+    pass
+
+
+class QAT(_Quantizer):
+    pass
+
+
+# --- int8 weight export -----------------------------------------------------
+
+def quantize_weight(w, bit_length=8, quant_axis=None):
+    """float weight -> (int8 weight, float scale per channel/tensor)."""
+    v = w._value if isinstance(w, Tensor) else jnp.asarray(w)
+    qmax = float(2 ** (bit_length - 1) - 1)
+    if quant_axis is None:
+        scale = jnp.max(jnp.abs(v))
+    else:
+        axes = tuple(i for i in range(v.ndim) if i != quant_axis)
+        scale = jnp.max(jnp.abs(v), axis=axes, keepdims=True)
+    q = jnp.clip(jnp.round(v / jnp.maximum(scale, 1e-9) * qmax),
+                 -qmax - 1, qmax).astype(jnp.int8)
+    return Tensor(q), Tensor(jnp.squeeze(scale))
+
+
+def dequantize_weight(q, scale, bit_length=8, quant_axis=None):
+    qmax = float(2 ** (bit_length - 1) - 1)
+    qv = q._value.astype(jnp.float32)
+    s = scale._value
+    if quant_axis is not None and s.ndim:
+        shape = [1] * qv.ndim
+        shape[quant_axis] = -1
+        s = s.reshape(shape)
+    return Tensor(qv * s / qmax)

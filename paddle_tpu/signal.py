@@ -1,0 +1,117 @@
+"""Signal processing (``paddle.signal`` parity: stft/istft).
+
+Reference parity: python/paddle/signal.py — verify. TPU-native: framing
+is a gather (XLA dynamic-slice batch), the transform itself is the XLA
+FFT HLO via paddle_tpu.fft.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor import Tensor, apply_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames: (..., n) -> (..., frame_length, n_frames)
+    for axis=-1 (paddle layout)."""
+    def f(v):
+        n = v.shape[-1]
+        n_frames = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        frames = v[..., idx]                     # (..., n_frames, flen)
+        return jnp.swapaxes(frames, -1, -2)      # (..., flen, n_frames)
+    if axis == 0:
+        xt = apply_op(lambda v: jnp.moveaxis(v, 0, -1), x)
+        out = apply_op(f, xt)
+        return apply_op(lambda v: jnp.moveaxis(v, (-2, -1), (0, 1)), out)
+    return apply_op(f, x)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: (..., frame_length, n_frames) -> (..., n)."""
+    def f(v):
+        flen, n_frames = v.shape[-2], v.shape[-1]
+        n = flen + hop_length * (n_frames - 1)
+        out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+        for i in range(n_frames):
+            out = out.at[..., i * hop_length:i * hop_length + flen].add(
+                v[..., :, i])
+        return out
+    if axis == 0:
+        xt = apply_op(lambda v: jnp.moveaxis(v, (0, 1), (-2, -1)), x)
+        out = apply_op(f, xt)
+        return apply_op(lambda v: jnp.moveaxis(v, -1, 0), out)
+    return apply_op(f, x)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """(batch?, n) -> (batch?, n_fft//2+1 | n_fft, n_frames) complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def f(v, *w):
+        win = w[0] if w else jnp.ones(win_length, v.dtype)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        if center:
+            pad = [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            v = jnp.pad(v, pad, mode=pad_mode)
+        n = v.shape[-1]
+        n_frames = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(n_frames) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = v[..., idx] * win                    # (..., n_frames, n_fft)
+        spec = jnp.fft.rfft(frames, axis=-1) if onesided \
+            else jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)             # (..., freq, frames)
+
+    args = (x, window) if window is not None else (x,)
+    return apply_op(f, *args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def f(v, *w):
+        win = w[0] if w else jnp.ones(win_length, jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        spec = jnp.swapaxes(v, -1, -2)                # (..., frames, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided \
+            else jnp.fft.ifft(spec, axis=-1).real
+        if return_complex:
+            frames = jnp.fft.ifft(spec, axis=-1)
+        frames = frames * win
+        n_frames = frames.shape[-2]
+        n = n_fft + hop_length * (n_frames - 1)
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        wsum = jnp.zeros((n,), jnp.float32)
+        for i in range(n_frames):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            wsum = wsum.at[sl].add(win.astype(jnp.float32) ** 2)
+        out = out / jnp.where(wsum > 1e-11, wsum, 1.0)
+        if center:
+            out = out[..., n_fft // 2:]
+            if length is None:
+                out = out[..., :out.shape[-1] - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    args = (x, window) if window is not None else (x,)
+    return apply_op(f, *args)

@@ -391,6 +391,11 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
             return x
         data = unwrap(data)
     d = convert_dtype(dtype)
+    if isinstance(data, jax.Array) or isinstance(data, jax.core.Tracer):
+        # already on device (or a tracer inside jit) — never round-trip
+        # through host numpy
+        v = data if d is None else data.astype(d)
+        return Tensor(v, stop_gradient=stop_gradient)
     arr = np.asarray(data)
     if d is None:
         if arr.dtype == np.float64:
