@@ -1,0 +1,101 @@
+"""Native runtime core: C++ host tracer, TCPStore, shared-memory queue.
+
+Reference parity: the reference's native host runtime —
+paddle/fluid/platform/profiler (host tracer), paddle/phi/core/distributed/
+store/tcp_store (rendezvous), DataLoader shm transport [— verify].
+Compute stays with XLA; these are the host-side native subsystems a TPU
+framework still genuinely needs in C++.
+
+The shared library is compiled on demand with g++ (this image has no
+pybind11; bindings are ctypes over a C ABI). Pure-Python fallbacks keep
+every feature working when no compiler is available.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SRC = os.path.join(_NATIVE_DIR, "ptcore.cc")
+_LIB = os.path.join(_NATIVE_DIR, "libptcore.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_error = None
+
+
+def _build():
+    cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", _LIB + ".tmp"]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+    os.replace(_LIB + ".tmp", _LIB)
+
+
+def load_native():
+    """Load (building if needed) libptcore; returns None if unavailable."""
+    global _lib, _build_error
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        try:
+            if not os.path.exists(_LIB) or (
+                    os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                _build()
+            lib = ctypes.CDLL(_LIB)
+        except (OSError, subprocess.SubprocessError) as e:
+            _build_error = e
+            return None
+        lib.pt_trace_begin.argtypes = [ctypes.c_char_p]
+        lib.pt_trace_instant.argtypes = [ctypes.c_char_p]
+        lib.pt_trace_counter.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.pt_trace_dump.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.pt_trace_event_count.restype = ctypes.c_int64
+        lib.pt_store_server_start.argtypes = [ctypes.c_int]
+        lib.pt_store_server_start.restype = ctypes.c_void_p
+        lib.pt_store_server_port.argtypes = [ctypes.c_void_p]
+        lib.pt_store_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pt_store_client_connect.argtypes = [ctypes.c_char_p,
+                                                ctypes.c_int, ctypes.c_int]
+        lib.pt_store_client_connect.restype = ctypes.c_void_p
+        lib.pt_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p, ctypes.c_int]
+        lib.pt_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_void_p, ctypes.c_int]
+        lib.pt_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64]
+        lib.pt_store_add.restype = ctypes.c_int64
+        lib.pt_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pt_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pt_store_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pt_store_client_close.argtypes = [ctypes.c_void_p]
+        lib.pt_shmq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.pt_shmq_create.restype = ctypes.c_void_p
+        lib.pt_shmq_open.argtypes = [ctypes.c_char_p]
+        lib.pt_shmq_open.restype = ctypes.c_void_p
+        lib.pt_shmq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.c_int]
+        lib.pt_shmq_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_uint64, ctypes.c_int]
+        lib.pt_shmq_pop.restype = ctypes.c_int64
+        lib.pt_shmq_size.argtypes = [ctypes.c_void_p]
+        lib.pt_shmq_size.restype = ctypes.c_uint64
+        lib.pt_shmq_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+from .native_api import (NativeTracer, TCPStore, ShmQueue,  # noqa: E402
+                         MasterDaemon)
+
+__all__ = ["load_native", "native_available", "NativeTracer", "TCPStore",
+           "ShmQueue", "MasterDaemon"]

@@ -24,6 +24,9 @@ from . import checkpoint                                          # noqa
 from . import sharding                                            # noqa
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa
 from .launch_utils import spawn                                   # noqa
+# rendezvous KV store (C++ libptcore server/client; reference:
+# paddle/phi/core/distributed/store/tcp_store — verify)
+from ..core.native_api import TCPStore, MasterDaemon              # noqa
 
 # short aliases matching paddle.distributed.*
 is_initialized = parallel_initialized = \

@@ -4,8 +4,10 @@ Reference parity: python/paddle/io/ — verify (Dataset/IterableDataset,
 samplers, DistributedBatchSampler per-rank sharding, multiprocess DataLoader
 with shared-memory queues). TPU-native design: the loader yields host numpy
 batches (collated) that feed jitted steps; prefetching is a background
-thread pool (XLA dispatch is already async; device transfer overlaps), and a
-native C++ shared-ring prefetcher is the planned fast path (csrc/)."""
+thread pool (XLA dispatch is already async; device transfer overlaps), and
+``num_workers>0, use_shared_memory=True`` uses forked worker PROCESSES
+pushing batches through the C++ shared-memory ring of paddle_tpu.core
+(one memcpy each way — the reference's shm _SharedQueue path)."""
 from __future__ import annotations
 
 import bisect
@@ -291,6 +293,9 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
         self.prefetch_factor = max(2, prefetch_factor)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -330,7 +335,93 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self._fetch(indices)
             return
-        yield from self._iter_prefetch()
+        if self.use_shared_memory and self._shm_usable():
+            yield from self._iter_multiprocess()
+        else:
+            yield from self._iter_prefetch()
+
+    def _shm_usable(self):
+        import multiprocessing
+        if multiprocessing.get_start_method(allow_none=True) not in (
+                None, "fork"):
+            return False  # dataset state must arrive in workers via fork
+        from ..core import native_available
+        return native_available()
+
+    def _iter_multiprocess(self):
+        """Forked worker processes; batches return through per-worker C++
+        shared-memory rings (pickled numpy, one memcpy per side).
+
+        Worker w owns batches w, w+nw, ... and its own ring, so the parent
+        always pops exactly the ring that holds the next batch in order —
+        no reorder buffer, and memory is bounded by nw ring capacities
+        (a full ring back-pressures its worker)."""
+        import multiprocessing
+        import os
+        import pickle
+
+        from ..core.native_api import ShmQueue
+
+        batches = list(self.batch_sampler)
+        if not batches:
+            return
+        capacity = 32 << 20
+        base = f"pt_dl_{os.getpid()}_{id(self) & 0xffffff}"
+        queues = [ShmQueue(f"{base}_{w}", capacity=capacity, create=True)
+                  for w in range(self.num_workers)]
+        ctx = multiprocessing.get_context("fork")
+
+        def worker_main(worker_id):
+            global _worker_info
+            _worker_info = _WorkerInfo(num_workers=self.num_workers,
+                                       id=worker_id, dataset=self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(worker_id)
+            wq = ShmQueue(f"{base}_{worker_id}", capacity=capacity,
+                          create=False)
+            try:
+                for i in range(worker_id, len(batches), self.num_workers):
+                    try:
+                        # raw samples only — collation happens in the
+                        # parent so the forked child never touches jax
+                        # (a child initialising the exclusive TPU client
+                        # would wedge the chip)
+                        data = [self.dataset[j] for j in batches[i]]
+                        payload = pickle.dumps(
+                            data, protocol=pickle.HIGHEST_PROTOCOL)
+                    except Exception as e:  # surface in parent
+                        payload = pickle.dumps(e)
+                    if len(payload) + 8 > capacity:
+                        payload = pickle.dumps(ValueError(
+                            f"batch {i} ({len(payload)}B) exceeds the "
+                            f"shared-memory ring capacity ({capacity}B); "
+                            "lower batch_size or pass "
+                            "use_shared_memory=False"))
+                    wq.put(payload)
+            finally:
+                wq.close()
+
+        procs = [ctx.Process(target=worker_main, args=(w,), daemon=True)
+                 for w in range(self.num_workers)]
+        for p in procs:
+            p.start()
+        # paddle contract: timeout=0 means block indefinitely
+        timeout = self.timeout if self.timeout else None
+        try:
+            for i in range(len(batches)):
+                data = pickle.loads(
+                    queues[i % self.num_workers].get(timeout=timeout))
+                if isinstance(data, Exception):
+                    raise data
+                yield self.collate_fn(data)
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+            for q in queues:
+                q.close()
 
     def _iter_prefetch(self):
         q: queue.Queue = queue.Queue(self.num_workers * self.prefetch_factor)

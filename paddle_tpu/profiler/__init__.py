@@ -48,6 +48,14 @@ _EVENTS_LOCK = threading.Lock()
 _ACTIVE = [False]
 
 
+def _tracer():
+    """The C++ host tracer (paddle_tpu.core libptcore); None when the
+    native library is unavailable — spans then use the Python path."""
+    from ..core.native_api import global_tracer
+    t = global_tracer()
+    return t if t.is_native else None
+
+
 class RecordEvent:
     """Host span (reference: paddle.profiler.RecordEvent / C++ RecordEvent
     — verify). Usable as context manager or begin()/end()."""
@@ -57,9 +65,19 @@ class RecordEvent:
         self._begin = None
 
     def begin(self):
+        t = _tracer()
+        if t is not None:
+            t.begin(self.name)
+            self._begin = "native"
+            return
         self._begin = time.perf_counter_ns()
 
     def end(self):
+        if self._begin == "native":
+            t = _tracer()
+            if t is not None:
+                t.end()
+            return
         if self._begin is None or not _ACTIVE[0]:
             return
         now = time.perf_counter_ns()
@@ -164,11 +182,27 @@ class Profiler:
         with _EVENTS_LOCK:
             ev = list(_EVENTS)
             _EVENTS.clear()
+        t = _tracer()
+        if t is not None and t.event_count():
+            import tempfile
+            with tempfile.NamedTemporaryFile(suffix=".json",
+                                             delete=False) as f:
+                tmp = f.name
+            try:
+                t.dump(tmp, pid=os.getpid())
+                with open(tmp) as f:
+                    ev.extend(json.load(f).get("traceEvents", []))
+                t.clear()
+            finally:
+                os.unlink(tmp)
         return ev
 
     # -- lifecycle ----------------------------------------------------------
     def start(self):
         _ACTIVE[0] = True
+        t = _tracer()
+        if t is not None:
+            t.enable(True)
         self._state = self.scheduler(self._step) if self.scheduler else \
             ProfilerState.RECORD
         if self._state in (ProfilerState.RECORD,
@@ -178,6 +212,9 @@ class Profiler:
     def stop(self):
         self._stop_device_trace()
         _ACTIVE[0] = False
+        t = _tracer()
+        if t is not None:
+            t.enable(False)
         if self.on_trace_ready:
             self.on_trace_ready(self)
 
@@ -213,9 +250,11 @@ class Profiler:
         ev = self._drain_events()
         agg: dict = {}
         for e in ev:
+            if e.get("ph") != "X":
+                continue
             a = agg.setdefault(e["name"], {"calls": 0, "total_us": 0.0})
             a["calls"] += 1
-            a["total_us"] += e["dur"]
+            a["total_us"] += e.get("dur", 0.0)
         lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(ms)':>12}"]
         for name, a in sorted(agg.items(), key=lambda kv: -kv[1]["total_us"]):
             lines.append(f"{name:<40}{a['calls']:>8}"
