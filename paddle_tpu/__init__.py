@@ -39,6 +39,9 @@ from . import fft
 from . import signal
 from . import sparse
 from . import quantization
+from . import inference
+from . import audio
+from . import text
 from . import utils
 from . import hapi
 from .hapi import Model, summary

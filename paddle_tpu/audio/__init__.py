@@ -1,0 +1,186 @@
+"""Audio features (``paddle.audio`` parity: functional + features).
+
+Reference parity: python/paddle/audio/ (features.layers Spectrogram /
+MelSpectrogram / LogMelSpectrogram / MFCC, functional window/mel helpers
+— verify). Built on paddle_tpu.signal.stft (XLA FFT HLO), so feature
+extraction fuses into jitted pipelines.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import signal as _signal
+from ..nn import Layer
+from ..tensor import Tensor, apply_op, to_tensor
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]
+
+
+class functional:
+    @staticmethod
+    def get_window(window: str, win_length: int, fftbins: bool = True):
+        n = win_length
+        if window in ("hann", "hanning"):
+            w = np.hanning(n + 1)[:-1] if fftbins else np.hanning(n)
+        elif window == "hamming":
+            w = np.hamming(n + 1)[:-1] if fftbins else np.hamming(n)
+        elif window == "blackman":
+            w = np.blackman(n + 1)[:-1] if fftbins else np.blackman(n)
+        elif window == "bartlett":
+            w = np.bartlett(n + 1)[:-1] if fftbins else np.bartlett(n)
+        elif window in ("ones", "boxcar", "rectangular"):
+            w = np.ones(n)
+        else:
+            raise ValueError(f"unsupported window {window!r}")
+        return to_tensor(w.astype(np.float32))
+
+    @staticmethod
+    def hz_to_mel(f, htk: bool = False):
+        if htk:
+            return 2595.0 * np.log10(1.0 + np.asarray(f) / 700.0)
+        f = np.asarray(f, np.float64)
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        return np.where(f >= min_log_hz,
+                        min_log_mel + np.log(f / min_log_hz) / logstep,
+                        mels)
+
+    @staticmethod
+    def mel_to_hz(m, htk: bool = False):
+        if htk:
+            return 700.0 * (10.0 ** (np.asarray(m) / 2595.0) - 1.0)
+        m = np.asarray(m, np.float64)
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        return np.where(m >= min_log_mel,
+                        min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                        freqs)
+
+    @staticmethod
+    def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                             f_min: float = 0.0,
+                             f_max: float = None, htk: bool = False,
+                             norm: str = "slaney"):
+        """(n_mels, n_fft//2+1) triangular mel filterbank."""
+        f_max = f_max or sr / 2
+        fft_freqs = np.linspace(0, sr / 2, n_fft // 2 + 1)
+        mel_pts = np.linspace(functional.hz_to_mel(f_min, htk),
+                              functional.hz_to_mel(f_max, htk), n_mels + 2)
+        hz_pts = functional.mel_to_hz(mel_pts, htk)
+        fb = np.zeros((n_mels, len(fft_freqs)))
+        for i in range(n_mels):
+            lo, ctr, hi = hz_pts[i], hz_pts[i + 1], hz_pts[i + 2]
+            up = (fft_freqs - lo) / max(ctr - lo, 1e-10)
+            down = (hi - fft_freqs) / max(hi - ctr, 1e-10)
+            fb[i] = np.maximum(0, np.minimum(up, down))
+        if norm == "slaney":
+            enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+            fb *= enorm[:, None]
+        return to_tensor(fb.astype(np.float32))
+
+    @staticmethod
+    def power_to_db(x, ref_value: float = 1.0, amin: float = 1e-10,
+                    top_db: float = 80.0):
+        def f(v):
+            db = 10.0 * jnp.log10(jnp.maximum(v, amin))
+            db -= 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+            if top_db is not None:
+                db = jnp.maximum(db, jnp.max(db) - top_db)
+            return db
+        return apply_op(f, x)
+
+    @staticmethod
+    def create_dct(n_mfcc: int, n_mels: int, norm: str = "ortho"):
+        n = np.arange(n_mels)
+        k = np.arange(n_mfcc)[:, None]
+        dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+        if norm == "ortho":
+            dct[0] *= 1.0 / math.sqrt(2)
+            dct *= math.sqrt(2.0 / n_mels)
+        return to_tensor(dct.astype(np.float32).T)   # (n_mels, n_mfcc)
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: int = None,
+                 win_length: int = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", functional.get_window(window, self.win_length))
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length,
+                            self.win_length, window=self.window,
+                            center=self.center, pad_mode=self.pad_mode)
+        return apply_op(lambda s: jnp.abs(s) ** self.power, spec)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: int = None, win_length: int = None,
+                 window: str = "hann", power: float = 2.0,
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: float = None, htk: bool = False,
+                 norm: str = "slaney"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power)
+        self.register_buffer("fbank", functional.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)          # (..., freq, frames)
+        return apply_op(lambda f, s: jnp.einsum("mf,...ft->...mt", f, s),
+                        self.fbank, spec)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, **kw):
+        super().__init__()
+        self.top_db = kw.pop("top_db", 80.0)
+        self.ref_value = kw.pop("ref_value", 1.0)
+        self.amin = kw.pop("amin", 1e-10)
+        self.mel_spectrogram = MelSpectrogram(sr, n_fft, **kw)
+
+    def forward(self, x):
+        return functional.power_to_db(self.mel_spectrogram(x),
+                                      self.ref_value, self.amin,
+                                      self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 n_mels: int = 64, **kw):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr, n_fft, n_mels=n_mels, **kw)
+        self.register_buffer("dct",
+                             functional.create_dct(n_mfcc, n_mels))
+
+    def forward(self, x):
+        logmel = self.log_mel(x)            # (..., n_mels, frames)
+        return apply_op(lambda d, s: jnp.einsum("mk,...mt->...kt", d, s),
+                        self.dct, logmel)
+
+
+class features:
+    Spectrogram = Spectrogram
+    MelSpectrogram = MelSpectrogram
+    LogMelSpectrogram = LogMelSpectrogram
+    MFCC = MFCC

@@ -27,10 +27,17 @@ _build_error = None
 
 
 def _build():
+    # per-pid temp name: concurrent first-use builds (launch with several
+    # local workers) must not interleave writes into one temp file
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = ["g++", "-std=c++17", "-O2", "-shared", "-fPIC", "-pthread",
-           _SRC, "-o", _LIB + ".tmp"]
-    subprocess.run(cmd, check=True, capture_output=True, timeout=180)
-    os.replace(_LIB + ".tmp", _LIB)
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, _LIB)   # atomic: losers just overwrite with same
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load_native():

@@ -25,6 +25,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -53,18 +54,26 @@ struct TraceEvent {
 
 namespace {
 
+// Each thread owns a buffer with its own mutex: writers take only their
+// (uncontended) buffer lock; dump/clear/count take the registry lock and
+// every buffer lock, so a reader never races a concurrent push_back.
+struct EventBuf {
+  std::mutex mu;
+  std::vector<TraceEvent> events;
+};
+
 std::mutex g_trace_mu;
-std::vector<std::vector<TraceEvent>*> g_all_buffers;
+std::vector<EventBuf*> g_all_buffers;
 std::atomic<bool> g_trace_enabled{false};
 
 struct ThreadBuf {
-  std::vector<TraceEvent>* buf;
-  ThreadBuf() : buf(new std::vector<TraceEvent>()) {
-    buf->reserve(4096);
+  EventBuf* buf;
+  ThreadBuf() : buf(new EventBuf()) {
+    buf->events.reserve(4096);
     std::lock_guard<std::mutex> lk(g_trace_mu);
     g_all_buffers.push_back(buf);
   }
-  // leak on thread exit: dump() may run after thread death; events are
+  // leak on thread exit: dump() may run after thread death; entries are
   // owned by g_all_buffers once registered.
 };
 
@@ -102,7 +111,8 @@ void pt_trace_end() {
   e.ts_ns = t0;
   e.dur_ns = now_ns() - t0;
   e.tid = this_tid();
-  t_buf.buf->push_back(e);
+  std::lock_guard<std::mutex> lk(t_buf.buf->mu);
+  t_buf.buf->events.push_back(e);
 }
 
 void pt_trace_instant(const char* name) {
@@ -112,7 +122,8 @@ void pt_trace_instant(const char* name) {
   e.ts_ns = now_ns();
   e.dur_ns = -1;
   e.tid = this_tid();
-  t_buf.buf->push_back(e);
+  std::lock_guard<std::mutex> lk(t_buf.buf->mu);
+  t_buf.buf->events.push_back(e);
 }
 
 void pt_trace_counter(const char* name, int64_t value) {
@@ -123,19 +134,26 @@ void pt_trace_counter(const char* name, int64_t value) {
   e.dur_ns = -2;
   e.value = value;
   e.tid = this_tid();
-  t_buf.buf->push_back(e);
+  std::lock_guard<std::mutex> lk(t_buf.buf->mu);
+  t_buf.buf->events.push_back(e);
 }
 
 int64_t pt_trace_event_count() {
   std::lock_guard<std::mutex> lk(g_trace_mu);
   int64_t n = 0;
-  for (auto* b : g_all_buffers) n += static_cast<int64_t>(b->size());
+  for (auto* b : g_all_buffers) {
+    std::lock_guard<std::mutex> blk(b->mu);
+    n += static_cast<int64_t>(b->events.size());
+  }
   return n;
 }
 
 void pt_trace_clear() {
   std::lock_guard<std::mutex> lk(g_trace_mu);
-  for (auto* b : g_all_buffers) b->clear();
+  for (auto* b : g_all_buffers) {
+    std::lock_guard<std::mutex> blk(b->mu);
+    b->events.clear();
+  }
 }
 
 // Dump all spans as chrome://tracing JSON. pid is caller-provided so
@@ -148,7 +166,8 @@ int pt_trace_dump(const char* path, int pid) {
   {
     std::lock_guard<std::mutex> lk(g_trace_mu);
     for (auto* b : g_all_buffers) {
-      for (const auto& e : *b) {
+      std::lock_guard<std::mutex> blk(b->mu);
+      for (const auto& e : b->events) {
         if (!first) fputc(',', f);
         first = false;
         double ts_us = e.ts_ns / 1000.0;
@@ -194,6 +213,7 @@ struct StoreServer {
   int listen_fd = -1;
   std::thread accept_thread;
   std::vector<std::thread> workers;
+  std::vector<int> client_fds;   // guarded by mu; for shutdown wakeup
   std::map<std::string, std::string> kv;
   std::mutex mu;
   std::condition_variable cv;
@@ -294,6 +314,12 @@ void serve_client(StoreServer* s, int fd) {
       break;
     }
   }
+  {
+    // deregister before closing so stop() never shutdown()s a reused fd
+    std::lock_guard<std::mutex> lk(s->mu);
+    auto it = std::find(s->client_fds.begin(), s->client_fds.end(), fd);
+    if (it != s->client_fds.end()) s->client_fds.erase(it);
+  }
   close(fd);
 }
 
@@ -326,6 +352,11 @@ void* pt_store_server_start(int port) {
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> lk(s->mu);
+      if (s->stop.load()) {
+        close(fd);
+        break;
+      }
+      s->client_fds.push_back(fd);
       s->workers.emplace_back(serve_client, s, fd);
     }
   });
@@ -350,8 +381,13 @@ void pt_store_server_stop(void* handle) {
   shutdown(s->listen_fd, SHUT_RDWR);
   close(s->listen_fd);
   if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // wake workers blocked in recv() on live client sockets
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (int fd : s->client_fds) shutdown(fd, SHUT_RDWR);
+  }
   for (auto& w : s->workers)
-    if (w.joinable()) w.detach();  // blocked clients die with their socket
+    if (w.joinable()) w.join();  // must all exit before s is freed
   delete s;
 }
 

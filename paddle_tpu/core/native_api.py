@@ -297,16 +297,18 @@ class TCPStore:
 
     def get(self, key: str) -> bytes:
         if self._h is not None:
-            buf = ctypes.create_string_buffer(1 << 16)
-            n = self._lib.pt_store_get(self._h, key.encode(), buf,
-                                       len(buf))
-            if n < 0:
-                raise ConnectionError("store get failed")
-            if n > len(buf):
-                buf = ctypes.create_string_buffer(n)
+            size = 1 << 16
+            while True:
+                buf = ctypes.create_string_buffer(size)
                 n = self._lib.pt_store_get(self._h, key.encode(), buf,
                                            len(buf))
-            return buf.raw[:n]
+                if n < 0:
+                    raise ConnectionError("store get failed")
+                if n <= len(buf):
+                    return buf.raw[:n]
+                # value larger than the buffer (and may grow between
+                # fetches — loop until a fetch fits)
+                size = n * 2
         return self._py_call("get", key)
 
     def add(self, key: str, delta: int) -> int:
@@ -402,7 +404,11 @@ class ShmQueue:
             if rc != 0:
                 raise TimeoutError("shm queue push timed out")
         else:
-            self._py.put(data, timeout=timeout)
+            import queue as _q
+            try:
+                self._py.put(data, timeout=timeout)
+            except _q.Full:
+                raise TimeoutError("shm queue push timed out") from None
 
     def get(self, timeout: Optional[float] = None) -> bytes:
         if self._h is not None:
@@ -421,7 +427,11 @@ class ShmQueue:
                     f"({self._capacity}B) and was dropped — open both ends "
                     "with the same capacity")
             return buf.raw[:n]
-        return self._py.get(timeout=timeout)
+        import queue as _q
+        try:
+            return self._py.get(timeout=timeout)
+        except _q.Empty:
+            raise TimeoutError("shm queue pop timed out") from None
 
     def qsize_bytes(self) -> int:
         if self._h is not None:

@@ -27,6 +27,8 @@ from .launch_utils import spawn                                   # noqa
 # rendezvous KV store (C++ libptcore server/client; reference:
 # paddle/phi/core/distributed/store/tcp_store — verify)
 from ..core.native_api import TCPStore, MasterDaemon              # noqa
+from . import launch                                              # noqa
+from . import elastic                                             # noqa
 
 # short aliases matching paddle.distributed.*
 is_initialized = parallel_initialized = \

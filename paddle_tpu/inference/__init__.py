@@ -1,0 +1,233 @@
+"""Inference deployment (``paddle.inference`` parity).
+
+Reference parity: paddle/fluid/inference/ — AnalysisConfig +
+AnalysisPredictor + zero-copy tensors (paddle/fluid/inference/api/
+analysis_predictor.cc, paddle_inference_api.h — verify).
+
+TPU-native design: "analysis passes + saved program" becomes AOT
+compilation — the model is traced once, exported as serialized
+StableHLO (jax.export) with weights stored alongside, and the
+predictor executes the compiled artifact. XLA does the reference's
+fusion/quant passes at compile time; TensorRT-subgraph offload has no
+TPU analog (XLA *is* the whole-graph compiler)."""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "export_model",
+           "convert_to_predictor", "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Bfloat16 = "bfloat16"
+    Half = "float16"
+    Int8 = "int8"
+
+
+class Config:
+    """AnalysisConfig analog. IR/memory switches are accepted for API
+    parity; XLA already performs those optimizations."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self.model_path = prog_file
+        self.params_path = params_file
+        self._precision = PrecisionType.Float32
+        self._device = None
+        self._glog_info = True
+        self._memory_optim = True
+        self._ir_optim = True
+
+    def set_model(self, prog_file, params_file=None):
+        self.model_path = prog_file
+        self.params_path = params_file
+
+    def set_prog_file(self, path):
+        self.model_path = path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = f"tpu:{device_id}"  # gpu calls map to the TPU chip
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def precision(self):
+        return self._precision
+
+
+class _IOHandle:
+    """Zero-copy-style tensor handle (paddle_infer.Tensor analog)."""
+
+    def __init__(self, name: str, spec: jax.ShapeDtypeStruct):
+        self.name = name
+        self._spec = spec
+        self._value = None
+
+    def shape(self):
+        return list(self._spec.shape)
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = jnp.asarray(arr)
+
+    def share_external_data(self, arr):
+        self._value = arr if isinstance(arr, jax.Array) else \
+            jnp.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+
+def export_model(layer, input_spec: Sequence, path: str):
+    """Trace + AOT-export a Layer: serialized StableHLO with weights.
+    ``input_spec``: static.InputSpec / Tensor / ndarray examples."""
+    from ..nn import Layer
+    from ..static import InputSpec
+    from ..tensor import Tensor
+    from .. import framework
+
+    def to_sds(s):
+        if isinstance(s, InputSpec):
+            return jax.ShapeDtypeStruct(tuple(s.shape),
+                                        framework.convert_dtype(s.dtype))
+        if isinstance(s, Tensor):
+            return jax.ShapeDtypeStruct(tuple(s.shape),
+                                        s._value.dtype)
+        arr = np.asarray(s)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    specs = [to_sds(s) for s in input_spec]
+    ptensors = dict(layer.named_parameters())
+    btensors = dict(layer.named_buffers())
+    pvals = {k: t._value for k, t in ptensors.items()}
+    bvals = {k: t._value for k, t in btensors.items()}
+
+    def fn(pv, bv, *inputs):
+        saved = [(t, t._value) for t in
+                 list(ptensors.values()) + list(btensors.values())]
+        try:
+            for k, v in pv.items():
+                ptensors[k]._value = v
+            for k, v in bv.items():
+                btensors[k]._value = v
+            was_training = layer.training
+            layer.eval()
+            try:
+                with framework.functional_mode(), framework.rng_context(
+                        jax.random.PRNGKey(0)):
+                    out = layer(*[Tensor(x) for x in inputs])
+            finally:
+                if was_training:
+                    layer.train()
+            return jax.tree_util.tree_map(
+                lambda o: o._value if isinstance(o, Tensor) else o, out,
+                is_leaf=lambda o: isinstance(o, Tensor))
+        finally:
+            for t, v in saved:
+                t._value = v
+
+    pspecs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+              for k, v in pvals.items()}
+    bspecs = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+              for k, v in bvals.items()}
+    exported = jax.export.export(jax.jit(fn))(pspecs, bspecs, *specs)
+    blob = {
+        "stablehlo": exported.serialize(),
+        "params": {k: np.asarray(v) for k, v in pvals.items()},
+        "buffers": {k: np.asarray(v) for k, v in bvals.items()},
+        "input_specs": [(tuple(s.shape), str(s.dtype)) for s in specs],
+        "input_names": [f"x{i}" for i in range(len(specs))],
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+    return path + ".pdmodel"
+
+
+class Predictor:
+    """AnalysisPredictor analog over a serialized StableHLO artifact."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        path = config.model_path
+        if path is None:
+            raise ValueError("Config.set_model(path) before "
+                             "create_predictor")
+        if not path.endswith(".pdmodel"):
+            path = path + ".pdmodel"
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self._exported = jax.export.deserialize(blob["stablehlo"])
+        self._params = {k: jnp.asarray(v)
+                        for k, v in blob["params"].items()}
+        self._buffers = {k: jnp.asarray(v)
+                         for k, v in blob["buffers"].items()}
+        self._input_names: List[str] = blob["input_names"]
+        self._input_specs = [
+            jax.ShapeDtypeStruct(shape, np.dtype(dtype))
+            for shape, dtype in blob["input_specs"]]
+        self._inputs: Dict[str, _IOHandle] = {
+            n: _IOHandle(n, s)
+            for n, s in zip(self._input_names, self._input_specs)}
+        self._outputs: List = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name) -> _IOHandle:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        if inputs is not None:
+            for n, arr in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(np.asarray(arr))
+        args = [self._inputs[n]._value for n in self._input_names]
+        if any(a is None for a in args):
+            missing = [n for n in self._input_names
+                       if self._inputs[n]._value is None]
+            raise RuntimeError(f"inputs not set: {missing}")
+        out = self._exported.call(self._params, self._buffers, *args)
+        self._outputs = list(out) if isinstance(out, (tuple, list)) \
+            else [out]
+        if inputs is not None:
+            return [np.asarray(o) for o in self._outputs]
+        return None
+
+    def get_output_names(self):
+        return [f"out{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name) -> _IOHandle:
+        i = int(name.replace("out", "") or 0)
+        h = _IOHandle(name, jax.ShapeDtypeStruct(
+            self._outputs[i].shape, self._outputs[i].dtype))
+        h._value = self._outputs[i]
+        return h
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def convert_to_predictor(layer, input_spec, path) -> Predictor:
+    """export_model + create_predictor in one step."""
+    model_path = export_model(layer, input_spec, path)
+    cfg = Config(model_path)
+    return Predictor(cfg)

@@ -405,12 +405,26 @@ class DataLoader:
                  for w in range(self.num_workers)]
         for p in procs:
             p.start()
-        # paddle contract: timeout=0 means block indefinitely
-        timeout = self.timeout if self.timeout else None
+        # paddle contract: timeout=0 means block indefinitely — but a dead
+        # worker must raise, not hang, so poll in slices and check liveness
+        deadline = self.timeout if self.timeout else None
         try:
             for i in range(len(batches)):
-                data = pickle.loads(
-                    queues[i % self.num_workers].get(timeout=timeout))
+                w = i % self.num_workers
+                waited = 0.0
+                while True:
+                    try:
+                        data = pickle.loads(queues[w].get(timeout=5.0))
+                        break
+                    except TimeoutError:
+                        waited += 5.0
+                        if procs[w].exitcode not in (None, 0):
+                            raise RuntimeError(
+                                f"DataLoader worker {w} died with exit "
+                                f"code {procs[w].exitcode} (killed/OOM?)"
+                            ) from None
+                        if deadline is not None and waited >= deadline:
+                            raise
                 if isinstance(data, Exception):
                     raise data
                 yield self.collate_fn(data)

@@ -1,0 +1,220 @@
+"""Text utilities (``paddle.text`` parity scope).
+
+Reference parity: python/paddle/text/ (dataset wrappers: Imdb, Imikolov,
+Movielens, UCIHousing, WMT14/16, Conll05 — verify). The reference
+datasets download from public mirrors; this environment has no egress,
+so constructors accept a local ``data_file`` and raise a clear error
+otherwise. ``Vocab`` + ``BasicTokenizer`` cover the preprocessing
+surface the reference ships in its examples.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import re
+import tarfile
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Vocab", "BasicTokenizer", "Imdb", "UCIHousing",
+           "ViterbiDecoder", "viterbi_decode"]
+
+
+class Vocab:
+    """Token <-> id mapping with special tokens (parity with the vocab
+    object PaddleNLP builds; minimal in-core version)."""
+
+    def __init__(self, counter=None, max_size=None, min_freq=1,
+                 unk_token="<unk>", pad_token="<pad>",
+                 bos_token=None, eos_token=None):
+        self._token_to_idx = {}
+        self._idx_to_token = []
+        for tok in (pad_token, unk_token, bos_token, eos_token):
+            if tok is not None and tok not in self._token_to_idx:
+                self._token_to_idx[tok] = len(self._idx_to_token)
+                self._idx_to_token.append(tok)
+        self.unk_token, self.pad_token = unk_token, pad_token
+        if counter:
+            items = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            for tok, freq in items:
+                if freq < min_freq:
+                    continue
+                if max_size and len(self._idx_to_token) >= max_size:
+                    break
+                if tok not in self._token_to_idx:
+                    self._token_to_idx[tok] = len(self._idx_to_token)
+                    self._idx_to_token.append(tok)
+
+    @classmethod
+    def build_vocab(cls, iterator: Iterable[List[str]], **kw):
+        counter = collections.Counter()
+        for tokens in iterator:
+            counter.update(tokens)
+        return cls(counter, **kw)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def __contains__(self, token):
+        return token in self._token_to_idx
+
+    def to_indices(self, tokens):
+        unk = self._token_to_idx.get(self.unk_token, 0)
+        if isinstance(tokens, str):
+            return self._token_to_idx.get(tokens, unk)
+        return [self._token_to_idx.get(t, unk) for t in tokens]
+
+    def to_tokens(self, indices):
+        if isinstance(indices, int):
+            return self._idx_to_token[indices]
+        return [self._idx_to_token[i] for i in indices]
+
+    @property
+    def idx_to_token(self):
+        return list(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return dict(self._token_to_idx)
+
+
+class BasicTokenizer:
+    """Lowercase + punctuation-splitting word tokenizer."""
+
+    def __init__(self, lower: bool = True):
+        self.lower = lower
+        self._pat = re.compile(r"\w+|[^\w\s]")
+
+    def __call__(self, text: str) -> List[str]:
+        if self.lower:
+            text = text.lower()
+        return self._pat.findall(text)
+
+
+def _no_download(name, url_hint):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable in this environment "
+        f"(no network egress). Fetch the archive yourself ({url_hint}) "
+        "and pass data_file=<local path>.")
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference: python/paddle/text/datasets/imdb.py —
+    verify). Reads the stanford aclImdb tar.gz from a local path."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150):
+        if data_file is None or not os.path.exists(data_file):
+            _no_download("Imdb", "ai.stanford.edu/~amaas/data/sentiment")
+        self.mode = mode
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs, labels = [], []
+        tok = BasicTokenizer()
+        with tarfile.open(data_file) as tf:
+            names = [n for n in tf.getnames() if pat.match(n)]
+            for n in sorted(names):
+                text = tf.extractfile(n).read().decode("utf-8",
+                                                       errors="ignore")
+                docs.append(tok(text))
+                labels.append(0 if "/neg/" in n else 1)
+        counter = collections.Counter()
+        for d in docs:
+            counter.update(d)
+        self.vocab = Vocab(collections.Counter(
+            {t: c for t, c in counter.items() if c >= cutoff}))
+        self.docs = [np.asarray(self.vocab.to_indices(d), np.int64)
+                     for d in docs]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """UCI Boston housing (reference: python/paddle/text/datasets/
+    uci_housing.py — verify). data_file: whitespace-separated 14-col."""
+
+    def __init__(self, data_file: Optional[str] = None,
+                 mode: str = "train"):
+        if data_file is None or not os.path.exists(data_file):
+            _no_download("UCIHousing", "UCI ML housing dataset")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats, target = raw[:, :-1], raw[:, -1:]
+        mins, maxs = feats.min(0), feats.max(0)
+        feats = (feats - mins) / np.maximum(maxs - mins, 1e-8)
+        split = int(len(raw) * 0.8)
+        if mode == "train":
+            self.x, self.y = feats[:split], target[:split]
+        else:
+            self.x, self.y = feats[split:], target[split:]
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=False):
+    """Batch Viterbi decode (reference: paddle.text.viterbi_decode /
+    paddle/phi/kernels/gpu/viterbi_decode_kernel — verify). Pure-jnp
+    scan, so it jits onto TPU.
+
+    potentials: (B, T, N) emission scores; transition_params: (N, N).
+    Returns (scores (B,), paths (B, T) int64).
+    """
+    import jax
+    import jax.numpy as jnp
+    from ..tensor import Tensor
+
+    def decode(emis, trans):
+        B, T, N = emis.shape
+
+        def step(carry, emit_t):
+            score = carry                       # (B, N)
+            # (B, N_prev, N_next)
+            cand = score[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(cand, axis=1)            # (B, N)
+            score = jnp.max(cand, axis=1) + emit_t          # (B, N)
+            return score, best_prev
+
+        init = emis[:, 0, :]
+        score, backptrs = jax.lax.scan(step, init,
+                                       jnp.swapaxes(emis[:, 1:], 0, 1))
+        last = jnp.argmax(score, axis=-1)                   # (B,)
+        best_score = jnp.max(score, axis=-1)
+
+        def backtrack(carry, bp_t):
+            tag = carry
+            prev = jnp.take_along_axis(bp_t, tag[:, None],
+                                       axis=1)[:, 0]
+            return prev, prev
+
+        _, rev_path = jax.lax.scan(backtrack, last, backptrs,
+                                   reverse=True)
+        path = jnp.concatenate([rev_path, last[None]], axis=0)  # (T, B)
+        return best_score, jnp.swapaxes(path, 0, 1).astype(jnp.int64)
+
+    pv = potentials._value if isinstance(potentials, Tensor) \
+        else potentials
+    tv = transition_params._value if isinstance(transition_params, Tensor) \
+        else transition_params
+    score, path = decode(pv, tv)
+    return Tensor(score), Tensor(path)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=False):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,186 @@
+"""inference predictor / cpp_extension / audio / text tests."""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, audio, text, inference
+from paddle_tpu.tensor import Tensor
+
+
+def rnd(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+def has_gxx():
+    try:
+        subprocess.run(["g++", "--version"], capture_output=True)
+        return True
+    except OSError:
+        return False
+
+
+class TestInference:
+    def _make(self):
+        paddle.seed(11)
+        return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+    def test_export_and_predict(self, tmp_path):
+        net = self._make()
+        x = rnd(3, 4)
+        ref = net(paddle.to_tensor(x)).numpy()
+        path = str(tmp_path / "model")
+        model_file = inference.export_model(
+            net, [paddle.static.InputSpec([3, 4], "float32")], path)
+        assert os.path.exists(model_file)
+        cfg = inference.Config(model_file)
+        pred = inference.create_predictor(cfg)
+        assert pred.get_input_names() == ["x0"]
+        out = pred.run([x])[0]
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_handle_api(self, tmp_path):
+        net = self._make()
+        x = rnd(3, 4)
+        pred = inference.convert_to_predictor(
+            net, [paddle.static.InputSpec([3, 4], "float32")],
+            str(tmp_path / "m2"))
+        h = pred.get_input_handle("x0")
+        assert h.shape() == [3, 4]
+        h.copy_from_cpu(x)
+        pred.run()
+        out = pred.get_output_handle("out0").copy_to_cpu()
+        np.testing.assert_allclose(out,
+                                   net(paddle.to_tensor(x)).numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_export_survives_weight_mutation(self, tmp_path):
+        # the artifact must freeze weights at export time
+        net = self._make()
+        x = rnd(2, 4)
+        pred = inference.convert_to_predictor(
+            net, [paddle.static.InputSpec([2, 4], "float32")],
+            str(tmp_path / "m3"))
+        before = pred.run([x])[0]
+        for p in net.parameters():
+            p.set_value(paddle.to_tensor(np.zeros(p.shape, np.float32)))
+        after = pred.run([x])[0]
+        np.testing.assert_array_equal(before, after)
+
+    def test_missing_input_error(self, tmp_path):
+        net = self._make()
+        pred = inference.convert_to_predictor(
+            net, [paddle.static.InputSpec([2, 4], "float32")],
+            str(tmp_path / "m4"))
+        with pytest.raises(RuntimeError, match="inputs not set"):
+            pred.run()
+
+
+@pytest.mark.skipif(not has_gxx(), reason="g++ unavailable")
+class TestCppExtension:
+    def test_custom_op_with_grad(self, tmp_path):
+        src = tmp_path / "myops.cc"
+        src.write_text("""
+        #include <cstdint>
+        #include <cmath>
+        extern "C" void my_softsign(const float* in, float* out,
+                                    int64_t n) {
+          for (int64_t i = 0; i < n; ++i)
+            out[i] = in[i] / (1.0f + std::fabs(in[i]));
+        }
+        extern "C" void my_softsign_grad(const float* in, float* out,
+                                         int64_t n) {
+          for (int64_t i = 0; i < n; ++i) {
+            float d = 1.0f + std::fabs(in[i]);
+            out[i] = 1.0f / (d * d);
+          }
+        }
+        """)
+        from paddle_tpu.utils import cpp_extension
+        mod = cpp_extension.load(
+            "myops_test", [str(src)],
+            functions=["my_softsign"],
+            backward_map={"my_softsign": "my_softsign_grad"})
+        x = rnd(4, 5) * 4 - 2
+        out = mod.my_softsign(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), x / (1 + np.abs(x)),
+                                   rtol=1e-6)
+        # gradient through the C++ backward
+        t = paddle.to_tensor(x, stop_gradient=False)
+        y = mod.my_softsign(t)
+        y.sum().backward()
+        np.testing.assert_allclose(t.grad.numpy(),
+                                   1.0 / (1 + np.abs(x)) ** 2, rtol=1e-5)
+
+    def test_composes_with_jit(self, tmp_path):
+        src = tmp_path / "sq.cc"
+        src.write_text("""
+        #include <cstdint>
+        extern "C" void c_square(const float* in, float* out, int64_t n) {
+          for (int64_t i = 0; i < n; ++i) out[i] = in[i] * in[i];
+        }
+        """)
+        from paddle_tpu.utils import cpp_extension
+        import jax
+        mod = cpp_extension.load("sq_test", [str(src)],
+                                 functions=["c_square"])
+        f = jax.jit(lambda v: mod.c_square(v) + 1.0)
+        x = np.asarray([[1.0, 2.0]], np.float32)
+        np.testing.assert_allclose(np.asarray(f(x)), x * x + 1)
+
+
+class TestAudio:
+    def test_spectrogram_matches_stft(self):
+        x = paddle.to_tensor(rnd(1, 2048) - 0.5)
+        spec = audio.Spectrogram(n_fft=256, hop_length=128)(x)
+        assert spec.shape[1] == 129
+        assert np.all(spec.numpy() >= 0)
+
+    def test_mel_and_mfcc_shapes(self):
+        sr = 16000
+        x = paddle.to_tensor(rnd(2, sr) - 0.5)
+        mel = audio.MelSpectrogram(sr=sr, n_fft=512, n_mels=40)(x)
+        assert mel.shape[0] == 2 and mel.shape[1] == 40
+        logmel = audio.LogMelSpectrogram(sr=sr, n_fft=512, n_mels=40)(x)
+        assert float(logmel.numpy().max()) <= float(
+            logmel.numpy().min()) + 80.0 + 1e-3
+        mfcc = audio.MFCC(sr=sr, n_mfcc=13, n_fft=512, n_mels=40)(x)
+        assert mfcc.shape[1] == 13
+
+    def test_mel_filterbank_properties(self):
+        fb = audio.functional.compute_fbank_matrix(16000, 512, 40).numpy()
+        assert fb.shape == (40, 257)
+        assert np.all(fb >= 0)
+        # every filter has support
+        assert np.all(fb.sum(axis=1) > 0)
+
+    def test_windows(self):
+        w = audio.functional.get_window("hann", 128).numpy()
+        np.testing.assert_allclose(w, np.hanning(129)[:-1], rtol=1e-6)
+
+
+class TestText:
+    def test_vocab_tokenizer(self):
+        tok = text.BasicTokenizer()
+        toks = tok("Hello, TPU world! hello")
+        assert toks == ["hello", ",", "tpu", "world", "!", "hello"]
+        vocab = text.Vocab.build_vocab([toks])
+        assert vocab.to_tokens(vocab.to_indices("hello")) == "hello"
+        assert vocab.to_indices("unseen") == vocab.to_indices("<unk>")
+
+    def test_viterbi_decode(self):
+        # hand-checkable 2-state chain: strong self-transition
+        emis = np.asarray([[[2.0, 0.0], [0.0, 1.0], [2.0, 0.0]]],
+                          np.float32)
+        trans = np.asarray([[1.0, -1.0], [-1.0, 1.0]], np.float32)
+        score, path = text.viterbi_decode(paddle.to_tensor(emis),
+                                          paddle.to_tensor(trans))
+        # staying in state 0 throughout: 2 + 1 + 0 + 1 + 2 = 6
+        assert path.numpy().tolist() == [[0, 0, 0]]
+        np.testing.assert_allclose(score.numpy(), [6.0])
+
+    def test_dataset_download_error(self):
+        with pytest.raises(RuntimeError, match="no network egress"):
+            text.Imdb()

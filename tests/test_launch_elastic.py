@@ -1,0 +1,133 @@
+"""Launcher CLI + elastic manager tests (reference pattern: the launch
+tests run N worker processes on one host — SURVEY §4)."""
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed import MasterDaemon
+from paddle_tpu.distributed.elastic import ElasticManager, ElasticStatus
+from paddle_tpu.distributed.launch import LaunchConfig, launch_pod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_script(tmp_path, body):
+    p = tmp_path / "train.py"
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+class TestLaunch:
+    def test_pod_env_contract(self, tmp_path):
+        script = write_script(tmp_path, """
+            import os, sys
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            with open(os.path.join(sys.argv[1], f"rank{rank}"), "w") as f:
+                f.write(",".join([
+                    os.environ["PADDLE_TRAINERS_NUM"],
+                    os.environ["PADDLE_MASTER"],
+                    os.environ["PADDLE_LOCAL_RANK"],
+                    os.environ["JAX_PROCESS_ID"],
+                ]))
+        """)
+        cfg = LaunchConfig(script, [str(tmp_path)], nproc_per_node=2,
+                           log_dir=str(tmp_path / "log"))
+        assert launch_pod(cfg) == 0
+        for rank in (0, 1):
+            parts = (tmp_path / f"rank{rank}").read_text().split(",")
+            assert parts[0] == "2"
+            assert parts[1] == cfg.master
+            assert parts[2] == str(rank)
+            assert parts[3] == str(rank)
+
+    def test_failure_exit_code(self, tmp_path):
+        script = write_script(tmp_path, """
+            import os, sys
+            sys.exit(7 if os.environ["PADDLE_TRAINER_ID"] == "1" else 0)
+        """)
+        cfg = LaunchConfig(script, [], nproc_per_node=2,
+                           log_dir=str(tmp_path / "log"))
+        assert launch_pod(cfg) == 7
+
+    def test_elastic_relaunch(self, tmp_path):
+        # worker 0 crashes on the first generation only; with
+        # max_restarts=2 the pod relaunches and the second run succeeds
+        script = write_script(tmp_path, """
+            import os, sys
+            marker = os.path.join(sys.argv[1], "crashed_once")
+            if os.environ["PADDLE_TRAINER_ID"] == "0" and \\
+                    not os.path.exists(marker):
+                open(marker, "w").close()
+                sys.exit(1)
+            restart = os.environ["PADDLE_RESTART_COUNT"]
+            open(os.path.join(
+                sys.argv[1],
+                f"ok{os.environ['PADDLE_TRAINER_ID']}_{restart}"),
+                "w").close()
+        """)
+        cfg = LaunchConfig(script, [str(tmp_path)], nproc_per_node=2,
+                           log_dir=str(tmp_path / "log"), max_restarts=2)
+        assert launch_pod(cfg) == 0
+        assert (tmp_path / "ok0_1").exists()
+        assert (tmp_path / "ok1_1").exists()
+
+    def test_cli_entrypoint(self, tmp_path):
+        script = write_script(tmp_path, """
+            import os, sys
+            open(os.path.join(
+                sys.argv[1], "cli" + os.environ["PADDLE_TRAINER_ID"]),
+                "w").close()
+        """)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--log_dir",
+             str(tmp_path / "log"), script, str(tmp_path)],
+            env=env, timeout=120, capture_output=True)
+        assert r.returncode == 0, r.stderr.decode()
+        assert (tmp_path / "cli0").exists() and (tmp_path / "cli1").exists()
+
+
+class TestElasticManager:
+    def test_membership_and_scale_event(self):
+        daemon = MasterDaemon(0)
+        m1 = ElasticManager("127.0.0.1", daemon.port, node_id="n1",
+                            heartbeat_interval=0.2, heartbeat_timeout=1.5)
+        m1.register()
+        assert m1.alive_nodes() == ["n1"]
+
+        m2 = ElasticManager("127.0.0.1", daemon.port, node_id="n2",
+                            heartbeat_interval=0.2, heartbeat_timeout=1.5)
+        m2.register()
+        assert sorted(m1.alive_nodes()) == ["n1", "n2"]
+
+        # n1 watches; n2's join already changed the count vs m1's snapshot
+        status = m1.watch(poll=0.1)
+        assert status == ElasticStatus.RESTART
+
+        # generation bump is visible to the other node too
+        assert m2.generation >= 1
+
+        # node leaves -> next watch returns RESTART again
+        m2.close()
+        t0 = time.time()
+        status = m1.watch(poll=0.1)
+        assert status == ElasticStatus.RESTART
+        assert time.time() - t0 < 10
+        m1.close()
+        daemon.stop()
+
+    def test_completion_via_should_stop(self):
+        daemon = MasterDaemon(0)
+        m = ElasticManager("127.0.0.1", daemon.port, node_id="solo",
+                           heartbeat_interval=0.2)
+        m.register()
+        assert m.watch(poll=0.05,
+                       should_stop=lambda: True) == ElasticStatus.COMPLETED
+        m.close()
+        daemon.stop()
