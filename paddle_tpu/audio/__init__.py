@@ -136,12 +136,13 @@ class MelSpectrogram(Layer):
     def __init__(self, sr: int = 22050, n_fft: int = 512,
                  hop_length: int = None, win_length: int = None,
                  window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
                  n_mels: int = 64, f_min: float = 50.0,
                  f_max: float = None, htk: bool = False,
                  norm: str = "slaney"):
         super().__init__()
         self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
-                                       window, power)
+                                       window, power, center, pad_mode)
         self.register_buffer("fbank", functional.compute_fbank_matrix(
             sr, n_fft, n_mels, f_min, f_max, htk, norm))
 

@@ -67,6 +67,9 @@ class ElasticManager:
         concurrent joins cannot lose each other."""
         slot = self._store.add("elastic/nslots", 1)
         self._store.set(f"elastic/member/{slot}", self.node_id)
+        # a relaunched node reuses its node_id: clear any tombstone from
+        # the previous generation or it stays excluded forever
+        self._store.delete_key(f"elastic/left/{self.node_id}")
         self._beat()
         self._thread = threading.Thread(target=self._heartbeat_loop,
                                         daemon=True)

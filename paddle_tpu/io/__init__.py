@@ -413,11 +413,14 @@ class DataLoader:
                 w = i % self.num_workers
                 waited = 0.0
                 while True:
+                    slice_s = 5.0 if deadline is None \
+                        else min(5.0, max(0.01, deadline - waited))
                     try:
-                        data = pickle.loads(queues[w].get(timeout=5.0))
+                        data = pickle.loads(
+                            queues[w].get(timeout=slice_s))
                         break
                     except TimeoutError:
-                        waited += 5.0
+                        waited += slice_s
                         if procs[w].exitcode not in (None, 0):
                             raise RuntimeError(
                                 f"DataLoader worker {w} died with exit "

@@ -100,16 +100,13 @@ def _rope_cache(config: LlamaConfig):
 
 
 def _apply_rope(q, k, cos, sin, offset=0):
-    """q/k: (b, s, h, d); neox-style rotate-half."""
-    def rope(t):
-        s = t.shape[1]
-        c = cos[offset:offset + s][None, :, None, :].astype(t.dtype)
-        sn = sin[offset:offset + s][None, :, None, :].astype(t.dtype)
-        half = t.shape[-1] // 2
-        t1, t2 = t[..., :half], t[..., half:]
-        rot = jnp.concatenate([-t2, t1], axis=-1)
-        return t * c + rot * sn
-    return rope(q), rope(k)
+    """q/k: (b, s, h, d); neox-style rotate-half. One fused Pallas
+    launch for q and k on TPU (ops.pallas.fused.fused_rope)."""
+    from ..ops.pallas.fused import fused_rope
+    s = q.shape[1]
+    c = cos[offset:offset + s].astype(q.dtype)
+    sn = sin[offset:offset + s].astype(q.dtype)
+    return fused_rope(q, k, c, sn)
 
 
 class LlamaAttention(nn.Layer):

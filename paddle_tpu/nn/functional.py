@@ -255,7 +255,13 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
-    """TPU-first: fused by XLA; Pallas kernel available in ops.pallas."""
+    """TPU-first: one-pass Pallas kernel on TPU (ops.pallas.fused),
+    XLA-fused jnp elsewhere."""
+    if weight is not None and axis in (-1, x.ndim - 1):
+        from ..ops.pallas.fused import fused_rms_norm
+        return apply_op(lambda v, w: fused_rms_norm(v, w, epsilon),
+                        x, weight)
+
     def f(v, *w):
         ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=axis,
                       keepdims=True)

@@ -330,6 +330,16 @@ class Adam(Optimizer):
         return s
 
     def _apply(self, p, g, slots, lr, step):
+        if self._decoupled and not self._amsgrad:
+            # AdamW fast path: one-pass fused Pallas update on TPU
+            # (reference: multi-tensor adamw_kernel.cu — verify); the
+            # fallback inside fused_adamw is the same math in jnp.
+            from ..ops.pallas.fused import fused_adamw
+            new_p, m, v = fused_adamw(
+                p, g, slots["moment1"], slots["moment2"], lr,
+                self._beta1, self._beta2, self._eps,
+                self._weight_decay or 0.0, step)
+            return new_p, {"moment1": m, "moment2": v}
         if not self._decoupled:
             g = self._wd(p, g)
         gf = g.astype(jnp.float32)

@@ -174,20 +174,29 @@ def viterbi_decode(potentials, transition_params, lengths=None,
     import jax.numpy as jnp
     from ..tensor import Tensor
 
-    def decode(emis, trans):
+    def decode(emis, trans, lens):
         B, T, N = emis.shape
 
-        def step(carry, emit_t):
+        def step(carry, inp):
             score = carry                       # (B, N)
+            emit_t, active = inp                # (B, N), (B,)
             # (B, N_prev, N_next)
             cand = score[:, :, None] + trans[None, :, :]
             best_prev = jnp.argmax(cand, axis=1)            # (B, N)
-            score = jnp.max(cand, axis=1) + emit_t          # (B, N)
+            new = jnp.max(cand, axis=1) + emit_t            # (B, N)
+            # past a sequence's length the score freezes and the
+            # backpointer is identity, so backtracking passes through
+            score = jnp.where(active[:, None], new, score)
+            ident = jnp.broadcast_to(jnp.arange(N)[None, :], (B, N))
+            best_prev = jnp.where(active[:, None], best_prev, ident)
             return score, best_prev
 
         init = emis[:, 0, :]
-        score, backptrs = jax.lax.scan(step, init,
-                                       jnp.swapaxes(emis[:, 1:], 0, 1))
+        ts = jnp.arange(1, T)
+        active = ts[None, :] < lens[:, None]                # (B, T-1)
+        score, backptrs = jax.lax.scan(
+            step, init, (jnp.swapaxes(emis[:, 1:], 0, 1),
+                         jnp.swapaxes(active, 0, 1)))
         last = jnp.argmax(score, axis=-1)                   # (B,)
         best_score = jnp.max(score, axis=-1)
 
@@ -206,7 +215,12 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         else potentials
     tv = transition_params._value if isinstance(transition_params, Tensor) \
         else transition_params
-    score, path = decode(pv, tv)
+    if lengths is None:
+        lens = jnp.full((pv.shape[0],), pv.shape[1], jnp.int32)
+    else:
+        lens = lengths._value if isinstance(lengths, Tensor) else \
+            jnp.asarray(lengths)
+    score, path = decode(pv, tv, lens)
     return Tensor(score), Tensor(path)
 
 

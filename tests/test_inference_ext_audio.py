@@ -184,3 +184,22 @@ class TestText:
     def test_dataset_download_error(self):
         with pytest.raises(RuntimeError, match="no network egress"):
             text.Imdb()
+
+    def test_viterbi_lengths_masking(self):
+        # batch of 2; second sequence has length 2 — pad emissions after
+        # position 1 must not affect its score/path
+        emis = np.asarray([
+            [[2.0, 0.0], [0.0, 1.0], [2.0, 0.0]],
+            [[0.0, 3.0], [1.5, 0.0], [99.0, -99.0]],   # pad at t=2
+        ], np.float32)
+        trans = np.asarray([[1.0, -1.0], [-1.0, 1.0]], np.float32)
+        score, path = text.viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans),
+            lengths=np.asarray([3, 2], np.int64))
+        np.testing.assert_allclose(score.numpy()[0], 6.0)
+        # seq 1 over 2 steps: state1 (3) -> state1 (3 + 1 + 0) = 4 beats
+        # any path ending in state0; pad t=2 (which would favor state0 by
+        # +99) must not flip it
+        np.testing.assert_allclose(score.numpy()[1], 4.0)
+        assert path.numpy()[0].tolist() == [0, 0, 0]
+        assert path.numpy()[1][:2].tolist() == [1, 1]
