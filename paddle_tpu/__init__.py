@@ -50,8 +50,14 @@ from .hapi import Model, summary
 from .serialization import save, load
 from .utils.run_check import run_check
 
-disable_static = lambda *a, **k: None   # parity no-op: we are dygraph-first
-enable_static = lambda *a, **k: None
+def enable_static():
+    """Switch to static-graph mode: op calls record a program instead of
+    computing (see paddle_tpu.static)."""
+    framework.set_static_mode(True)
+
+
+def disable_static():
+    framework.set_static_mode(False)
 
 
 def is_compiled_with_cuda() -> bool:
@@ -72,7 +78,8 @@ def is_compiled_with_tpu() -> bool:
 
 
 def in_dynamic_mode() -> bool:
-    return not framework.in_functional_mode()
+    return not framework.in_functional_mode() \
+        and not framework.in_static_mode()
 
 
 def get_flags(flags=None):

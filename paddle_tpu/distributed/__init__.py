@@ -29,6 +29,9 @@ from .launch_utils import spawn                                   # noqa
 from ..core.native_api import TCPStore, MasterDaemon              # noqa
 from . import launch                                              # noqa
 from . import elastic                                             # noqa
+from . import consistency                                         # noqa
+from .consistency import (program_fingerprint,                    # noqa
+                          check_program_consistency)
 
 # short aliases matching paddle.distributed.*
 is_initialized = parallel_initialized = \

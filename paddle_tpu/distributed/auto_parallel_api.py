@@ -164,7 +164,8 @@ def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None,
     else:
         out = Tensor(v, stop_gradient=t.stop_gradient
                      if stop_gradient is None else stop_gradient)
-    out._sharding_spec = spec if isinstance(out, Parameter) else None
+    if isinstance(out, Parameter):
+        out._sharding_spec = spec
     out.dist_attr = DistAttr(mesh, placements)
     out.process_mesh = mesh
     out.placements = list(placements)

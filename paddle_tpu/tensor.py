@@ -74,7 +74,11 @@ def _tape():
 
 class Tensor:
     __slots__ = ("_value", "stop_gradient", "grad", "_node", "_out_index",
-                 "name", "persistable", "is_leaf", "trainable", "__weakref__")
+                 "name", "persistable", "is_leaf", "trainable",
+                 # semi-auto parallel metadata (set by dist.shard_tensor)
+                 "dist_attr", "process_mesh", "placements",
+                 # static-graph mode: producer record (paddle_tpu.static)
+                 "_static_src", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True,
                  name: Optional[str] = None):
@@ -321,6 +325,47 @@ def _wrap_outputs(out, diff: bool, node_setter):
     return t
 
 
+class _StaticSrc:
+    """Producer record for a symbolic tensor in static-graph mode: the
+    pure fn plus its input Tensors (paddle_tpu.static replays these)."""
+    __slots__ = ("pure", "inputs", "multi")
+
+    def __init__(self, pure, inputs, multi):
+        self.pure = pure
+        self.inputs = inputs
+        self.multi = multi
+
+
+def _apply_op_static(fn, args, kwargs, tensor_pos):
+    """Static-graph branch: no compute — infer output avals with
+    jax.eval_shape and record the producer so Executor.run can replay
+    the graph into one jitted XLA program (the reference's
+    ProgramDesc/PIR build step)."""
+    in_tensors = [args[i] for i in tensor_pos]
+
+    def pure(*tvals):
+        full = list(args)
+        for p, v in zip(tensor_pos, tvals):
+            full[p] = v
+        full = [a._value if isinstance(a, Tensor) else a for a in full]
+        return fn(*full, **kwargs)
+
+    out_aval = jax.eval_shape(pure, *[t._value for t in in_tensors])
+    multi = isinstance(out_aval, (tuple, list))
+    src = _StaticSrc(pure, in_tensors, multi)
+    outs = []
+    for i, av in enumerate(out_aval if multi else [out_aval]):
+        t = Tensor(av, stop_gradient=all(x.stop_gradient
+                                         for x in in_tensors))
+        t._static_src = src
+        t._out_index = i
+        t.is_leaf = False
+        outs.append(t)
+    if multi:
+        return type(out_aval)(outs)
+    return outs[0]
+
+
 def apply_op(fn, *args, **kwargs):
     """Run pure-jax `fn` on Tensor/array args; record vjp on the tape when
     eager grad is enabled and any Tensor input requires grad.
@@ -329,6 +374,10 @@ def apply_op(fn, *args, **kwargs):
     statics. Returns Tensor or tuple/list of Tensors mirroring fn's output.
     """
     tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+    if framework.in_static_mode() and not framework.in_functional_mode():
+        return _apply_op_static(fn, args, kwargs, tensor_pos)
+
     want_grad = (framework.is_grad_enabled()
                  and any(not args[i].stop_gradient for i in tensor_pos))
 

@@ -1,0 +1,192 @@
+"""Semi-auto parallel API tests on the 8-device CPU mesh.
+
+Reference pattern: test/auto_parallel/ — spmd_rule tests (given input
+placements -> expected output placements), per-case reshard tests
+(reshard_s_to_r.py etc.), Engine end-to-end on a toy model, and
+distributed checkpoint save/load across different meshes (SURVEY §4)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import (ProcessMesh, Shard, Replicate, Partial,
+                                    shard_tensor, reshard)
+
+
+def rnd(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+def mesh2d():
+    return ProcessMesh(np.arange(8).reshape(4, 2).tolist(),
+                       dim_names=["x", "y"])
+
+
+class TestShardTensor:
+    def test_shard_dim0(self):
+        m = mesh2d()
+        t = shard_tensor(paddle.to_tensor(rnd(8, 6)), m,
+                         [Shard(0), Replicate()])
+        shards = t._value.addressable_shards
+        assert len(shards) == 8
+        # dim0 split over 4 "x" devices -> local (2, 6)
+        assert all(s.data.shape == (2, 6) for s in shards)
+        np.testing.assert_allclose(np.asarray(t._value).shape, (8, 6))
+
+    def test_shard_both_dims(self):
+        m = mesh2d()
+        x = rnd(8, 4)
+        t = shard_tensor(paddle.to_tensor(x), m, [Shard(0), Shard(1)])
+        assert t._value.addressable_shards[0].data.shape == (2, 2)
+        np.testing.assert_allclose(np.asarray(t._value), x)
+
+    def test_replicated(self):
+        m = mesh2d()
+        x = rnd(4, 4)
+        t = shard_tensor(paddle.to_tensor(x), m,
+                         [Replicate(), Replicate()])
+        assert t._value.addressable_shards[0].data.shape == (4, 4)
+
+
+class TestReshard:
+    def test_s_to_r(self):
+        m = mesh2d()
+        x = rnd(8, 6)
+        t = shard_tensor(paddle.to_tensor(x), m, [Shard(0), Replicate()])
+        r = reshard(t, m, [Replicate(), Replicate()])
+        assert r._value.addressable_shards[0].data.shape == (8, 6)
+        np.testing.assert_allclose(np.asarray(r._value), x, rtol=1e-6)
+
+    def test_r_to_s(self):
+        m = mesh2d()
+        x = rnd(8, 6)
+        t = shard_tensor(paddle.to_tensor(x), m,
+                         [Replicate(), Replicate()])
+        s = reshard(t, m, [Shard(0), Replicate()])
+        assert s._value.addressable_shards[0].data.shape == (2, 6)
+        np.testing.assert_allclose(np.asarray(s._value), x, rtol=1e-6)
+
+    def test_s_to_s_transpose(self):
+        m = mesh2d()
+        x = rnd(8, 8)
+        t = shard_tensor(paddle.to_tensor(x), m, [Shard(0), Replicate()])
+        s = reshard(t, m, [Replicate(), Shard(1)])
+        np.testing.assert_allclose(np.asarray(s._value), x, rtol=1e-6)
+        assert s._value.addressable_shards[0].data.shape == (8, 4)
+
+
+class TestSpmdPropagation:
+    """GSPMD takes the role of the reference's per-op SPMD rules: ops on
+    DistTensors must produce correct global values with sharded inputs."""
+
+    def test_matmul_row_sharded(self):
+        m = mesh2d()
+        a, b = rnd(8, 16), rnd(16, 4)
+        ta = shard_tensor(paddle.to_tensor(a), m, [Shard(0), Replicate()])
+        tb = shard_tensor(paddle.to_tensor(b), m,
+                          [Replicate(), Replicate()])
+        out = paddle.matmul(ta, tb)
+        np.testing.assert_allclose(np.asarray(out._value), a @ b,
+                                   rtol=1e-5)
+
+    def test_matmul_contracting_sharded(self):
+        # contraction dim sharded: GSPMD must insert the partial-sum
+        # reduction (the reference's Partial -> Replicate reshard)
+        m = mesh2d()
+        a, b = rnd(6, 8), rnd(8, 6)
+        ta = shard_tensor(paddle.to_tensor(a), m, [Replicate(), Shard(0)])
+        tb = shard_tensor(paddle.to_tensor(b), m, [Shard(0), Replicate()])
+        out = paddle.matmul(ta, tb)
+        np.testing.assert_allclose(np.asarray(out._value), a @ b,
+                                   rtol=1e-5)
+
+    def test_elementwise_mixed_placement(self):
+        m = mesh2d()
+        a, b = rnd(8, 4), rnd(8, 4)
+        ta = shard_tensor(paddle.to_tensor(a), m, [Shard(0), Replicate()])
+        tb = shard_tensor(paddle.to_tensor(b), m,
+                          [Replicate(), Shard(1)])
+        out = ta + tb
+        np.testing.assert_allclose(np.asarray(out._value), a + b,
+                                   rtol=1e-6)
+
+    def test_reduction_over_sharded_axis(self):
+        m = mesh2d()
+        a = rnd(8, 4)
+        ta = shard_tensor(paddle.to_tensor(a), m, [Shard(0), Replicate()])
+        out = ta.sum()
+        np.testing.assert_allclose(float(np.asarray(out._value)),
+                                   a.sum(), rtol=1e-5)
+
+
+class TestShardLayer:
+    def test_sharded_training_matches_serial(self):
+        from paddle_tpu import nn, optimizer
+
+        def build():
+            paddle.seed(42)
+            return nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                 nn.Linear(16, 1))
+
+        x, y = rnd(16, 8), rnd(16, 1)
+
+        # serial run
+        net_s = build()
+        opt_s = optimizer.SGD(learning_rate=0.1,
+                              parameters=net_s.parameters())
+        for _ in range(5):
+            loss_s = ((net_s(paddle.to_tensor(x))
+                       - paddle.to_tensor(y)) ** 2).mean()
+            loss_s.backward()
+            opt_s.step()
+            opt_s.clear_grad()
+
+        # dp-sharded run over the same data
+        m = ProcessMesh(list(range(8)), dim_names=["dp"])
+        net_p = build()
+        for p in net_p.parameters():
+            shard_tensor(p, m, [Replicate()])
+        opt_p = optimizer.SGD(learning_rate=0.1,
+                              parameters=net_p.parameters())
+        xb = shard_tensor(paddle.to_tensor(x), m, [Shard(0)])
+        yb = shard_tensor(paddle.to_tensor(y), m, [Shard(0)])
+        for _ in range(5):
+            loss_p = ((net_p(xb) - yb) ** 2).mean()
+            loss_p.backward()
+            opt_p.step()
+            opt_p.clear_grad()
+
+        np.testing.assert_allclose(float(loss_p.numpy()),
+                                   float(loss_s.numpy()), rtol=1e-4)
+        for a, b in zip(net_p.parameters(), net_s.parameters()):
+            np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-4,
+                                       atol=1e-6)
+
+
+class TestDistCheckpointReshard:
+    def test_save_sharded_load_replicated(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+        m = mesh2d()
+        x = rnd(8, 6)
+        t = shard_tensor(paddle.to_tensor(x), m, [Shard(0), Replicate()])
+        ckpt.save_state_dict({"w": t}, str(tmp_path))
+        # target: fully replicated tensor of same global shape
+        tgt = paddle.to_tensor(np.zeros((8, 6), np.float32))
+        ckpt.load_state_dict({"w": tgt}, str(tmp_path))
+        np.testing.assert_allclose(tgt.numpy(), x, rtol=1e-6)
+
+    def test_save_then_load_into_different_sharding(self, tmp_path):
+        from paddle_tpu.distributed import checkpoint as ckpt
+        m = mesh2d()
+        x = rnd(8, 8)
+        t = shard_tensor(paddle.to_tensor(x), m, [Shard(0), Replicate()])
+        ckpt.save_state_dict({"w": t}, str(tmp_path))
+        tgt = shard_tensor(paddle.to_tensor(np.zeros((8, 8), np.float32)),
+                           m, [Replicate(), Shard(1)])
+        ckpt.load_state_dict({"w": tgt}, str(tmp_path))
+        np.testing.assert_allclose(np.asarray(tgt._value), x, rtol=1e-6)
+        # target keeps ITS sharding after load
+        assert tgt._value.addressable_shards[0].data.shape == (8, 4)

@@ -131,3 +131,34 @@ class TestElasticManager:
                        should_stop=lambda: True) == ElasticStatus.COMPLETED
         m.close()
         daemon.stop()
+
+
+class TestProgramConsistency:
+    def test_fingerprint_stable_and_sensitive(self):
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.consistency import program_fingerprint
+        f1 = program_fingerprint(lambda x: x * 2 + 1, jnp.ones((4,)))
+        f2 = program_fingerprint(lambda x: x * 2 + 1, jnp.ones((4,)))
+        f3 = program_fingerprint(lambda x: x * 3 + 1, jnp.ones((4,)))
+        f4 = program_fingerprint(lambda x: x * 2 + 1, jnp.ones((8,)))
+        assert f1 == f2
+        assert f1 != f3 and f1 != f4
+
+    def test_cross_rank_check(self):
+        from paddle_tpu.core.native_api import TCPStore
+        from paddle_tpu.distributed.consistency import (
+            ConsistencyError, check_program_consistency)
+        daemon = MasterDaemon(0)
+        s0 = TCPStore("127.0.0.1", daemon.port)
+        s1 = TCPStore("127.0.0.1", daemon.port)
+        # matching programs pass on both ranks
+        assert check_program_consistency("aaa", store=s0, rank=0,
+                                         world_size=2)
+        assert check_program_consistency("aaa", store=s1, rank=1,
+                                         world_size=2)
+        # diverging rank is named in the error
+        s0.set("consistency2/0", "aaa")
+        with pytest.raises(ConsistencyError, match=r"rank\(s\) \[0\]"):
+            check_program_consistency("bbb", store=s1, rank=1,
+                                      world_size=2, key="consistency2")
+        s0.close(); s1.close(); daemon.stop()

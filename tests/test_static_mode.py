@@ -1,0 +1,171 @@
+"""Static-graph mode tests (reference pattern: dygraph<->static parity
+tests under test/dygraph_to_static and static Program tests — verify)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    prog = static.Program()
+    startup = static.Program()
+    with static.program_guard(prog, startup):
+        yield prog
+    paddle.disable_static()
+
+
+def rnd(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+class TestStaticInference:
+    def test_data_and_run(self, static_mode):
+        x = static.data("x", [4, 3])
+        y = x * 2.0 + 1.0
+        # symbolic: no concrete value yet, but shape/dtype known
+        assert y.shape == [4, 3]
+        exe = static.Executor()
+        xv = rnd(4, 3)
+        out, = exe.run(feed={"x": xv}, fetch_list=[y])
+        np.testing.assert_allclose(out, xv * 2 + 1, rtol=1e-6)
+
+    def test_layers_build_static_graph(self, static_mode):
+        paddle.seed(0)
+        x = static.data("x", [2, 8])
+        net = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 1))
+        y = net(x)
+        exe = static.Executor()
+        xv = rnd(2, 8)
+        out, = exe.run(feed={"x": xv}, fetch_list=[y])
+        # parity vs dygraph with the same weights
+        paddle.disable_static()
+        ref = net(paddle.to_tensor(xv)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_multiple_fetches_and_cache(self, static_mode):
+        x = static.data("x", [3, 3])
+        a = x.sum()
+        b = x * x
+        exe = static.Executor()
+        xv = rnd(3, 3)
+        o1, o2 = exe.run(feed={"x": xv}, fetch_list=[a, b])
+        np.testing.assert_allclose(o1, xv.sum(), rtol=1e-5)
+        np.testing.assert_allclose(o2, xv * xv, rtol=1e-6)
+        # second run reuses the compiled executable
+        o1b, _ = exe.run(feed={"x": xv + 1}, fetch_list=[a, b])
+        np.testing.assert_allclose(o1b, (xv + 1).sum(), rtol=1e-5)
+
+
+class TestStaticTraining:
+    def test_minimize_and_train(self, static_mode):
+        paddle.seed(7)
+        x = static.data("x", [16, 4])
+        label = static.data("label", [16, 1])
+        net = nn.Linear(4, 1)
+        pred = net(x)
+        loss = ((pred - label) ** 2).mean()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters())
+        opt.minimize(loss)
+
+        exe = static.Executor()
+        xv = rnd(16, 4)
+        w = rnd(4, 1)
+        yv = xv @ w
+        losses = []
+        for _ in range(30):
+            lv, = exe.run(feed={"x": xv, "label": yv},
+                          fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < losses[0] * 0.1
+
+    def test_static_matches_dygraph_training(self):
+        xv, w = rnd(8, 4), rnd(4, 1)
+        yv = xv @ w
+
+        def build():
+            paddle.seed(3)
+            return nn.Linear(4, 1)
+
+        # dygraph
+        net_d = build()
+        opt_d = optimizer.SGD(learning_rate=0.05,
+                              parameters=net_d.parameters())
+        for _ in range(5):
+            l_d = ((net_d(paddle.to_tensor(xv))
+                    - paddle.to_tensor(yv)) ** 2).mean()
+            l_d.backward()
+            opt_d.step()
+            opt_d.clear_grad()
+
+        # static
+        paddle.enable_static()
+        try:
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [8, 4])
+                label = static.data("label", [8, 1])
+                net_s = build()
+                loss = ((net_s(x) - label) ** 2).mean()
+                opt_s = optimizer.SGD(learning_rate=0.05,
+                                      parameters=net_s.parameters())
+                opt_s.minimize(loss)
+                exe = static.Executor()
+                for _ in range(5):
+                    lv, = exe.run(prog, feed={"x": xv, "label": yv},
+                                  fetch_list=[loss])
+        finally:
+            paddle.disable_static()
+        np.testing.assert_allclose(float(lv), float(l_d.numpy()),
+                                   rtol=1e-4)
+        for a, b in zip(net_s.parameters(), net_d.parameters()):
+            np.testing.assert_allclose(a.numpy(), b.numpy(), rtol=1e-4,
+                                       atol=1e-6)
+
+    def test_program_clone_for_test(self, static_mode):
+        x = static.data("x", [2, 2])
+        net = nn.Linear(2, 1)
+        loss = net(x).mean()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters())
+        opt.minimize(loss)
+        prog = static.default_main_program()
+        test_prog = prog.clone(for_test=True)
+        assert prog._train is not None and test_prog._train is None
+        exe = static.Executor()
+        before = [p.numpy().copy() for p in net.parameters()]
+        exe.run(test_prog, feed={"x": rnd(2, 2)}, fetch_list=[loss])
+        for p, b in zip(net.parameters(), before):
+            np.testing.assert_array_equal(p.numpy(), b)  # eval: no update
+
+
+class TestASP:
+    def test_mask_and_prune(self):
+        from paddle_tpu.incubate import asp
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        asp.prune_model(net)
+        for name, p in net.named_parameters():
+            if p.ndim >= 2:
+                assert asp.check_sparsity(p), name
+                assert abs(asp.calculate_density(p) - 0.5) < 0.05
+
+    def test_sparsity_survives_training(self):
+        from paddle_tpu.incubate import asp
+        paddle.seed(1)
+        net = nn.Linear(8, 8)
+        asp.prune_model(net)
+        opt = asp.decorate(optimizer.SGD(learning_rate=0.05,
+                                         parameters=net.parameters()))
+        x, y = rnd(16, 8), rnd(16, 8)
+        for _ in range(4):
+            loss = ((net(paddle.to_tensor(x)) - paddle.to_tensor(y))
+                    ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert asp.check_sparsity(net.weight)
+        assert abs(asp.calculate_density(net.weight) - 0.5) < 0.01
