@@ -1,18 +1,32 @@
-"""Eager collective communication API.
+"""Eager collective + point-to-point communication API.
 
 Reference parity: python/paddle/distributed/communication/ +
 paddle/phi/core/distributed/ProcessGroup* (NCCL) — verify.
 
 TPU-native design: the *perf path* never calls these eagerly — GSPMD emits
 collectives inside jitted programs over the mesh (SURVEY §2.4). This module
-provides the paddle-compatible eager API for host-level coordination and
-tests: across processes it lowers to jax multihost utilities (which run tiny
-XLA collective programs over DCN/ICI); with one process and a sharded
-array, the "group" is a mesh axis and the op runs as a tiny jitted
-shard_map collective."""
+provides the paddle-compatible eager API for host-level coordination:
+
+- world-scoped collectives lower to jax multihost utilities (tiny XLA
+  collective programs over DCN/ICI);
+- subset ``Group`` collectives and all point-to-point ops (send/recv/
+  isend/irecv/batch_isend_irecv) ride the C++ TCPStore key-value rendezvous
+  (``paddle_tpu.core.native_api.TCPStore``) — the same transport the
+  reference's gloo/TCPStore host path uses. They are host-bandwidth
+  control-plane ops by design; bulk tensor exchange belongs inside jitted
+  programs (shard_map ppermute / collective_permute).
+
+Eager ``reduce_scatter``/``alltoall`` across processes are implemented via
+allgather-then-slice: O(world) traffic, correctness-only — documented,
+deliberate (the O(shard) path is the GSPMD one inside jit).
+"""
 from __future__ import annotations
 
 import dataclasses
+import io
+import os
+import pickle
+import threading
 from typing import Optional
 
 import jax
@@ -24,8 +38,8 @@ from ..tensor import Tensor
 __all__ = ["ReduceOp", "Group", "all_reduce", "all_gather",
            "all_gather_object", "reduce_scatter", "broadcast", "scatter",
            "reduce", "alltoall", "alltoall_single", "send", "recv",
-           "barrier", "new_group", "get_group", "wait", "stream", "P2POp",
-           "batch_isend_irecv", "isend", "irecv"]
+           "barrier", "new_group", "get_group", "destroy_process_group",
+           "wait", "stream", "P2POp", "batch_isend_irecv", "isend", "irecv"]
 
 
 class ReduceOp:
@@ -52,11 +66,14 @@ class Group:
 
     @property
     def rank(self):
-        pid = jax.process_index()
+        pid = _my_rank()
         return self.ranks.index(pid) if pid in self.ranks else -1
 
     def get_group_rank(self, rank):
         return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self):
+        return _my_rank() in self.ranks
 
     def __repr__(self):
         return f"Group(id={self.id}, ranks={self.ranks})"
@@ -66,17 +83,30 @@ _GROUPS: dict[int, Group] = {}
 _NEXT_GID = [1]
 
 
+def _my_rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+
+
+def _world_size() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+
+
 def _world():
     if 0 not in _GROUPS:
-        _GROUPS[0] = Group(list(range(jax.process_count())), 0, "world")
+        _GROUPS[0] = Group(list(range(_world_size())), 0, "world")
     return _GROUPS[0]
 
 
 def new_group(ranks=None, backend=None, timeout=None):
+    """Create a communication group over ``ranks``.
+
+    Group ids are assigned from a process-local monotonically increasing
+    counter; as in the reference, every rank must call ``new_group`` in the
+    same order so ids agree across the job."""
     gid = _NEXT_GID[0]
     _NEXT_GID[0] += 1
-    g = Group(ranks if ranks is not None
-              else list(range(jax.process_count())), gid)
+    g = Group(sorted(ranks) if ranks is not None
+              else list(range(_world_size())), gid)
     _GROUPS[gid] = g
     return g
 
@@ -85,12 +115,289 @@ def get_group(gid=0):
     return _GROUPS.get(gid, _world())
 
 
+def destroy_process_group(group=None):
+    global _STORE
+    if group is not None and group.id in _GROUPS and group.id != 0:
+        del _GROUPS[group.id]
+        return
+    _GROUPS.clear()
+    with _STORE_LOCK:
+        if _STORE is not None and hasattr(_STORE, "close"):
+            try:
+                _STORE.close()
+            except Exception:
+                pass
+        _STORE = None
+    # reset sequence counters so a re-initialized job starts in lock-step
+    # with fresh peers (elastic restart path)
+    with _SEQ_LOCK:
+        _SEND_SEQ.clear()
+        _RECV_SEQ.clear()
+        _COLL_SEQ.clear()
+    _NEXT_GID[0] = 1
+
+
 def _val(t):
     return t._value if isinstance(t, Tensor) else jnp.asarray(t)
 
 
 def _single_process() -> bool:
-    return jax.process_count() == 1
+    return _world_size() == 1
+
+
+def _is_world(group) -> bool:
+    return group is None or group.id == 0 or \
+        sorted(group.ranks) == list(range(_world_size()))
+
+
+# --------------------------------------------------------------------------
+# store transport (p2p + subset-group collectives)
+# --------------------------------------------------------------------------
+
+class _LocalStore:
+    """In-process store with TCPStore semantics, used when world_size == 1
+    (self-sends, and multi-"rank" tests driven from threads)."""
+
+    def __init__(self):
+        self._d: dict[str, bytes] = {}
+        self._cv = threading.Condition()
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        with self._cv:
+            self._d[key] = bytes(value)
+            self._cv.notify_all()
+
+    def get(self, key):
+        with self._cv:
+            self._cv.wait_for(lambda: key in self._d, timeout=60)
+            return self._d[key]
+
+    def wait(self, key):
+        with self._cv:
+            if not self._cv.wait_for(lambda: key in self._d, timeout=60):
+                raise TimeoutError(f"store wait timed out on {key!r}")
+
+    def add(self, key, delta):
+        with self._cv:
+            cur = int.from_bytes(self._d.get(key, b"\0" * 8), "little",
+                                 signed=True)
+            cur += int(delta)
+            self._d[key] = cur.to_bytes(8, "little", signed=True)
+            self._cv.notify_all()
+            return cur
+
+    def check(self, key):
+        with self._cv:
+            return key in self._d
+
+    def delete_key(self, key):
+        with self._cv:
+            self._d.pop(key, None)
+
+    def close(self):
+        pass
+
+
+_STORE = None
+_STORE_LOCK = threading.Lock()
+
+
+def _get_store():
+    """Lazily connect to the job's TCPStore (PADDLE_MASTER env from the
+    launch contract — distributed/launch). Falls back to an in-process
+    store for world_size == 1."""
+    global _STORE
+    with _STORE_LOCK:
+        if _STORE is not None:
+            return _STORE
+        master = os.environ.get("PADDLE_MASTER")
+        if _single_process() or not master:
+            if not _single_process():
+                raise RuntimeError(
+                    "point-to-point / subset-group eager comm needs the "
+                    "TCPStore rendezvous: launch with paddle_tpu.distributed."
+                    "launch (sets PADDLE_MASTER) or set PADDLE_MASTER="
+                    "host:port")
+            _STORE = _LocalStore()
+            return _STORE
+        from ..core.native_api import TCPStore
+        host, port = master.rsplit(":", 1)
+        _STORE = TCPStore(host, int(port), is_master=_my_rank() == 0,
+                          world_size=_world_size())
+        return _STORE
+
+
+def _pack(arr) -> bytes:
+    a = np.asarray(arr)
+    buf = io.BytesIO()
+    # npy format keeps dtype (incl. bfloat16 via jax's ml_dtypes) + shape
+    if a.dtype == jnp.bfloat16:
+        np.save(buf, a.view(np.uint16))
+        return b"BF16" + buf.getvalue()
+    np.save(buf, a)
+    return b"RAW0" + buf.getvalue()
+
+
+def _unpack(data: bytes):
+    tag, body = data[:4], data[4:]
+    a = np.load(io.BytesIO(body))
+    if tag == b"BF16":
+        a = a.view(jnp.bfloat16)
+    return jnp.asarray(a)
+
+
+# per-(src,dst) monotonically increasing sequence numbers so repeated
+# sends/recvs between the same pair match deterministically
+_SEND_SEQ: dict[tuple, int] = {}
+_RECV_SEQ: dict[tuple, int] = {}
+_SEQ_LOCK = threading.Lock()
+
+
+class Task:
+    """Async handle returned by isend/irecv (paddle task.wait() parity)."""
+
+    def __init__(self, thread: Optional[threading.Thread] = None,
+                 result_box: Optional[list] = None):
+        self._thread = thread
+        self._box = result_box
+
+    def wait(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("p2p task did not complete")
+            self._thread = None
+        if self._box and isinstance(self._box[0], BaseException):
+            raise self._box[0]
+        return True
+
+    def is_completed(self):
+        return self._thread is None or not self._thread.is_alive()
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Host-level point-to-point send over the TCPStore transport."""
+    store = _get_store()
+    src = group.rank if (group and not _is_world(group)) else _my_rank()
+    dstg = dst
+    gid = group.id if group else 0
+    with _SEQ_LOCK:
+        seq = _SEND_SEQ.get((gid, src, dstg), 0)
+        _SEND_SEQ[(gid, src, dstg)] = seq + 1
+    store.set(f"p2p/{gid}/{src}->{dstg}/{seq}", _pack(_val(tensor)))
+    return None
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    """Blocking receive matching :func:`send` from ``src``."""
+    store = _get_store()
+    me = group.rank if (group and not _is_world(group)) else _my_rank()
+    gid = group.id if group else 0
+    with _SEQ_LOCK:
+        seq = _RECV_SEQ.get((gid, src, me), 0)
+        _RECV_SEQ[(gid, src, me)] = seq + 1
+    key = f"p2p/{gid}/{src}->{me}/{seq}"
+    store.wait(key)
+    v = _unpack(store.get(key))
+    store.delete_key(key)
+    if isinstance(tensor, Tensor):
+        tensor._update_value(v.astype(_val(tensor).dtype)
+                             if v.dtype != _val(tensor).dtype else v)
+        return tensor
+    return Tensor(v)
+
+
+def _async(fn, *args, **kw):
+    box = [None]
+
+    def run():
+        try:
+            box[0] = fn(*args, **kw)
+        except BaseException as e:  # surfaced in Task.wait
+            box[0] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return Task(t, box)
+
+
+def isend(tensor, dst=0, group=None):
+    return _async(send, tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return _async(recv, tensor, src, group)
+
+
+@dataclasses.dataclass
+class P2POp:
+    op: object
+    tensor: object
+    peer: int
+    group: object = None
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Issue a batch of P2POps concurrently; returns list of Tasks.
+
+    Sends are issued first (store sets never block), then receives — the
+    standard deadlock-free ordering for symmetric exchange patterns."""
+    for p in p2p_op_list:
+        if p.op not in (send, isend, recv, irecv):
+            raise ValueError(
+                f"P2POp.op must be send/isend/recv/irecv, got {p.op}")
+    tasks = []
+    for p in p2p_op_list:
+        if p.op in (send, isend):
+            tasks.append(_async(send, p.tensor, p.peer, p.group))
+    for p in p2p_op_list:
+        if p.op in (recv, irecv):
+            tasks.append(_async(recv, p.tensor, p.peer, p.group))
+    return tasks
+
+
+# --------------------------------------------------------------------------
+# store-based subset-group collectives
+# --------------------------------------------------------------------------
+
+_COLL_SEQ: dict[tuple, int] = {}
+
+
+def _coll_round(group, op_name, me) -> int:
+    # keyed per member rank: counters advance in lock-step across members
+    # whether they live in separate processes or threads of one process
+    with _SEQ_LOCK:
+        k = (group.id, op_name, me)
+        seq = _COLL_SEQ.get(k, 0)
+        _COLL_SEQ[k] = seq + 1
+        return seq
+
+
+def _store_gather(value, group, op_name):
+    """All group members contribute `value`; returns the list of all
+    members' values ordered by group.ranks. Last reader cleans up."""
+    store = _get_store()
+    me = group.rank
+    rnd = _coll_round(group, op_name, me)
+    if me < 0:
+        raise RuntimeError(
+            f"rank {_my_rank()} called {op_name} on {group} it is not a "
+            f"member of")
+    base = f"coll/{group.id}/{op_name}/{rnd}"
+    store.set(f"{base}/{me}", _pack(value))
+    outs = []
+    for r in range(group.nranks):
+        key = f"{base}/{r}"
+        store.wait(key)
+        outs.append(_unpack(store.get(key)))
+    done = store.add(f"{base}/done", 1)
+    if done == group.nranks:
+        for r in range(group.nranks):
+            store.delete_key(f"{base}/{r}")
+        store.delete_key(f"{base}/done")
+    return outs
 
 
 def _reduce_terms(op, parts):
@@ -107,67 +414,88 @@ def _reduce_terms(op, parts):
     return out
 
 
+def _gather_all(v, group, op_name):
+    """Gather `v` from every member of `group`, ordered by group rank.
+
+    World groups take the multihost fast path; proper subsets ride the
+    store so non-members need not participate."""
+    if _single_process() and _is_world(group):
+        return [v]
+    if _is_world(group):
+        from jax.experimental import multihost_utils
+        g = multihost_utils.process_allgather(v)
+        return [jnp.asarray(g[i]) for i in range(_world_size())]
+    return _store_gather(v, group, op_name)
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    if _single_process():
-        return tensor  # single process: tensor is already global
-    from jax.experimental import multihost_utils
     v = _val(tensor)
-    gathered = multihost_utils.process_allgather(v)
-    out = _reduce_terms(op, list(gathered))
+    parts = _gather_all(v, group, f"allreduce_{op}")
+    if len(parts) == 1:
+        return tensor
+    out = _reduce_terms(op, parts)
     tensor._update_value(out.astype(v.dtype))
     return tensor
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
-    if _single_process():
-        tensor_list.append(Tensor(_val(tensor)))
-        return tensor_list
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(_val(tensor))
-    for row in gathered:
-        tensor_list.append(Tensor(jnp.asarray(row)))
+    parts = _gather_all(_val(tensor), group, "allgather")
+    tensor_list.extend(Tensor(p) for p in parts)
     return tensor_list
 
 
 def all_gather_object(object_list, obj, group=None):
-    if _single_process():
+    if _single_process() and _is_world(group):
         object_list.append(obj)
         return object_list
-    import pickle
-    from jax.experimental import multihost_utils
     data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    # pad to max length across processes
-    n = np.array([data.size], np.int32)
-    sizes = multihost_utils.process_allgather(jnp.asarray(n))
-    maxn = int(np.max(sizes))
-    padded = np.zeros(maxn, np.uint8)
-    padded[:data.size] = data
-    rows = multihost_utils.process_allgather(jnp.asarray(padded))
-    for row, size in zip(rows, np.asarray(sizes).reshape(-1)):
-        object_list.append(pickle.loads(bytes(np.asarray(row)[:int(size)])))
+    if _is_world(group):
+        from jax.experimental import multihost_utils
+        n = np.array([data.size], np.int32)
+        sizes = multihost_utils.process_allgather(jnp.asarray(n))
+        maxn = int(np.max(sizes))
+        padded = np.zeros(maxn, np.uint8)
+        padded[:data.size] = data
+        rows = multihost_utils.process_allgather(jnp.asarray(padded))
+        for row, size in zip(rows, np.asarray(sizes).reshape(-1)):
+            object_list.append(
+                pickle.loads(bytes(np.asarray(row)[:int(size)])))
+        return object_list
+    rows = _store_gather(data, group, "allgather_obj")
+    object_list.extend(pickle.loads(bytes(np.asarray(r))) for r in rows)
     return object_list
 
 
 def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
-    if _single_process():
+    g = group or _world()
+    if _single_process() and _is_world(group):
         tensor._update_value(_val(tensor_list[0]))
         return tensor
-    from jax.experimental import multihost_utils
     stacked = jnp.stack([_val(t) for t in tensor_list])
-    summed = multihost_utils.process_allgather(stacked)
-    total = _reduce_terms(op, list(summed))
-    tensor._update_value(total[jax.process_index()])
+    parts = _gather_all(stacked, g, f"reducescatter_{op}")
+    total = _reduce_terms(op, parts)
+    me = g.rank if not _is_world(g) else _my_rank()
+    tensor._update_value(total[me])
     return tensor
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    if _single_process():
+    if _single_process() and _is_world(group):
         return tensor
-    from jax.experimental import multihost_utils
-    v = multihost_utils.broadcast_one_to_all(
-        _val(tensor), is_source=jax.process_index() == src)
-    tensor._update_value(jnp.asarray(v))
+    v = _val(tensor)
+    if _is_world(group):
+        from jax.experimental import multihost_utils
+        out = multihost_utils.broadcast_one_to_all(
+            v, is_source=_my_rank() == src)
+        tensor._update_value(jnp.asarray(out))
+        return tensor
+    # subset group: src is the GLOBAL rank (reference semantics)
+    parts = _store_gather(v, group, "broadcast")
+    idx = group.get_group_rank(src)
+    if idx < 0:
+        raise ValueError(f"broadcast src={src} is not a member of {group}")
+    tensor._update_value(parts[idx].astype(v.dtype))
     return tensor
 
 
@@ -177,41 +505,48 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if _single_process():
+    g = group or _world()
+    if _single_process() and _is_world(group):
         if tensor_list:
             tensor._update_value(_val(tensor_list[0]))
         return tensor
-    from jax.experimental import multihost_utils
     stacked = jnp.stack([_val(t) for t in tensor_list]) if tensor_list \
-        else jnp.zeros((jax.process_count(),) + tuple(tensor.shape),
-                       tensor.dtype)
-    v = multihost_utils.broadcast_one_to_all(
-        stacked, is_source=jax.process_index() == src)
-    tensor._update_value(jnp.asarray(v)[jax.process_index()])
+        else jnp.zeros((g.nranks,) + tuple(tensor.shape), tensor.dtype)
+    if _is_world(group):
+        from jax.experimental import multihost_utils
+        v = multihost_utils.broadcast_one_to_all(
+            stacked, is_source=_my_rank() == src)
+        tensor._update_value(jnp.asarray(v)[_my_rank()])
+        return tensor
+    parts = _store_gather(stacked, g, "scatter")
+    idx = g.get_group_rank(src)
+    if idx < 0:
+        raise ValueError(f"scatter src={src} is not a member of {g}")
+    tensor._update_value(parts[idx][g.rank])
     return tensor
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    g = group or _world()
     if out_tensor_list is None:
         out_tensor_list = []
-    if _single_process():
+    if _single_process() and _is_world(group):
         out_tensor_list.extend(Tensor(_val(t)) for t in in_tensor_list)
         return out_tensor_list
-    from jax.experimental import multihost_utils
     stacked = jnp.stack([_val(t) for t in in_tensor_list])
-    rows = multihost_utils.process_allgather(stacked)  # (P, P, ...)
-    me = jax.process_index()
-    for p in range(jax.process_count()):
+    rows = _gather_all(stacked, g, "alltoall")  # [nranks](nranks, ...)
+    me = g.rank if not _is_world(g) else _my_rank()
+    for p in range(len(rows)):
         out_tensor_list.append(Tensor(jnp.asarray(rows[p][me])))
     return out_tensor_list
 
 
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
-    parts = jnp.split(_val(in_tensor),
-                      jax.process_count() if _single_process() is False
-                      else 1)
-    outs = alltoall([Tensor(p) for p in parts])
+    g = group or _world()
+    n = g.nranks if not _single_process() else 1
+    parts = jnp.split(_val(in_tensor), n)
+    outs = alltoall([Tensor(p) for p in parts], group=group)
     res = jnp.concatenate([_val(t) for t in outs])
     if out_tensor is not None:
         out_tensor._update_value(res)
@@ -219,45 +554,14 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
     return Tensor(res)
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv across processes uses the launch-level "
-        "store; inside compiled programs use shard_map ppermute "
-        "(paddle_tpu.distributed.fleet.meta_parallel pipeline)")
-
-
-def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "see send(): use ppermute inside compiled programs")
-
-
-def isend(tensor, dst=0, group=None):
-    return send(tensor, dst, group)
-
-
-def irecv(tensor, src=0, group=None):
-    return recv(tensor, src, group)
-
-
-@dataclasses.dataclass
-class P2POp:
-    op: object
-    tensor: object
-    peer: int
-    group: object = None
-
-
-def batch_isend_irecv(p2p_op_list):
-    raise NotImplementedError(
-        "host-level batched p2p: planned with the C++ store backend; "
-        "compiled pipelines use ppermute schedules instead")
-
-
 def barrier(group=None):
-    if _single_process():
+    if _single_process() and _is_world(group):
         return
-    from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    if _is_world(group):
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+        return
+    _store_gather(jnp.zeros((), jnp.int32), group, "barrier")
 
 
 def wait(tensor, group=None, use_calc_stream=True):
@@ -273,3 +577,5 @@ class stream:
     reduce_scatter = staticmethod(reduce_scatter)
     broadcast = staticmethod(broadcast)
     alltoall = staticmethod(alltoall)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

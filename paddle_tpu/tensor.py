@@ -230,11 +230,43 @@ class Tensor:
         """Unchecked payload swap (step compiler / optimizers)."""
         self._value = v
 
+    def _record_inplace(self, pure, extra_inputs=()):
+        """Tape-aware in-place update: record ``new = pure(old, *extras)``
+        with self as both input and output (the eager engine's version-bump;
+        reference tracks this via TensorWrapper inplace_version — verify).
+        Correct under the id-keyed cotangent walk because tape nodes replay
+        in reverse creation order: cotangents deposited by ops that read the
+        NEW value are popped by this node, pass through the pullback, and
+        re-deposit for ops that produced/read the OLD value."""
+        in_tensors = [self] + [t for t in extra_inputs
+                               if isinstance(t, Tensor)]
+        out, vjp_fn = jax.vjp(pure, self._value,
+                              *[t._value for t in in_tensors[1:]])
+        node = _TAPE.record(vjp_fn, in_tensors, [self], multi=False)
+        self._value = out
+        self._node = node
+        self._out_index = 0
+        self.is_leaf = False
+        self.stop_gradient = False
+        return self
+
+    def _inplace_wants_grad(self, val=None) -> bool:
+        return (framework.is_grad_enabled()
+                and not framework.in_static_mode()
+                and (not self.stop_gradient
+                     or (isinstance(val, Tensor) and not val.stop_gradient)))
+
     def fill_(self, v):
+        if self._inplace_wants_grad():
+            # constant overwrite: gradient to the old value is zero — the
+            # recorded pullback encodes exactly that cut
+            return self._record_inplace(lambda x: jnp.full_like(x, v))
         self._value = jnp.full_like(self._value, v)
         return self
 
     def zero_(self):
+        if self._inplace_wants_grad():
+            return self._record_inplace(lambda x: jnp.zeros_like(x))
         self._value = jnp.zeros_like(self._value)
         return self
 
@@ -275,8 +307,41 @@ class Tensor:
         return ops.getitem(self, idx)
 
     def __setitem__(self, idx, val):
+        def unwrap_idx(i):
+            if isinstance(i, Tensor):
+                return i._value
+            if isinstance(i, tuple):
+                return tuple(unwrap_idx(e) for e in i)
+            return i
+
+        idx = unwrap_idx(idx)
+
+        def fit(v, x):
+            # numpy-style assignment shapes: (1,) into a scalar slot etc.
+            # tgt computed only when an array value is actually assigned
+            # (eval_shape is trace-only but not free on the eager hot path)
+            v = v.astype(x.dtype) if v.dtype != x.dtype else v
+            tgt = jax.eval_shape(lambda a: a[idx], x).shape
+            if tuple(v.shape) != tuple(tgt):
+                if int(np.prod(v.shape)) == int(np.prod(tgt)):
+                    v = v.reshape(tgt)
+                else:
+                    v = jnp.broadcast_to(v, tgt)
+            return v
+
+        if self._inplace_wants_grad(val):
+            if isinstance(val, Tensor):
+                return self._record_inplace(
+                    lambda x, v: x.at[idx].set(fit(v, x)),
+                    extra_inputs=(val,))
+            if hasattr(val, "shape") and hasattr(val, "dtype"):
+                cv = fit(jnp.asarray(val), self._value)
+                return self._record_inplace(lambda x: x.at[idx].set(cv))
+            return self._record_inplace(lambda x: x.at[idx].set(val))
         if isinstance(val, Tensor):
             val = val._value
+        if hasattr(val, "shape") and hasattr(val, "dtype"):
+            val = fit(jnp.asarray(val), self._value)
         self._value = self._value.at[idx].set(val)
 
     def __iter__(self):

@@ -139,3 +139,81 @@ def test_retain_graph():
     x.clear_grad()
     y.backward()
     np.testing.assert_allclose(x.grad.numpy(), g1)
+
+
+# -- in-place op autograd (tape-aware __setitem__/fill_/zero_) --------------
+
+def test_setitem_constant_grad():
+    x = paddle.to_tensor(np.ones(4, np.float32), stop_gradient=False)
+    y = x * 2.0              # reads OLD value
+    x[0] = 5.0               # in-place constant write
+    z = (x * 3.0).sum() + y.sum()
+    z.backward()
+    # through y: 2 everywhere; through setitem: 3 masked at index 0
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 5.0, 5.0, 5.0])
+    np.testing.assert_allclose(x.numpy(), [5.0, 1.0, 1.0, 1.0])
+
+
+def test_setitem_tensor_value_grad():
+    x = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    v = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    x[1:3] = v
+    loss = (x * 3.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(v.grad.numpy(), [3.0, 3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 0.0, 0.0, 3.0])
+
+
+def test_setitem_into_stop_gradient_tensor_propagates():
+    x = paddle.to_tensor(np.zeros(3, np.float32))  # stop_gradient=True
+    v = paddle.to_tensor(np.array([7.0], np.float32), stop_gradient=False)
+    x[0] = v
+    assert not x.stop_gradient
+    (x.sum() * 2.0).backward()
+    np.testing.assert_allclose(v.grad.numpy(), [2.0])
+
+
+def test_fill_cuts_gradient():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    y = x * 2.0
+    x.fill_(7.0)
+    (x.sum() + y.sum()).backward()
+    # filled value contributes no grad; only the pre-fill read does
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0, 2.0])
+    np.testing.assert_allclose(x.numpy(), [7.0, 7.0, 7.0])
+
+
+def test_zero_cuts_gradient():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    y = x * 3.0
+    x.zero_()
+    (x.sum() + y.sum()).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_setitem_no_grad_mode_untracked():
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    with paddle.no_grad():
+        x[0] = 9.0
+    assert x._node is None
+    np.testing.assert_allclose(x.numpy(), [9.0, 1.0])
+
+
+def test_setitem_tensor_index():
+    x = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+    i = paddle.to_tensor(np.array([1, 3]))
+    x[i] = 2.0
+    loss = (x * x).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.numpy(), [0.0, 2.0, 0.0, 2.0])
+
+
+def test_setitem_array_value_grad_path():
+    # regression: array-shaped constant into a scalar slot on the grad path
+    x = paddle.to_tensor(np.zeros(3, np.float32), stop_gradient=False)
+    x[0] = np.array([5.0], np.float32)
+    (x.sum() * 2.0).backward()
+    np.testing.assert_allclose(x.numpy(), [5.0, 0.0, 0.0])
+    # the constant write masks index 0's gradient w.r.t. the old value
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
