@@ -4,10 +4,21 @@ Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 
 vs_baseline = achieved MFU / 0.45 (the BASELINE.json north-star MFU target;
-no reference throughput numbers were recoverable — see BASELINE.md)."""
+no reference throughput numbers were recoverable — see BASELINE.md).
+
+Robustness contract (VERDICT r1 #1): the parent process NEVER initializes a
+jax backend itself. The measurement runs in a child process under a hard
+deadline; if the axon TPU tunnel is wedged (backend init hangs or raises
+UNAVAILABLE — both observed), the child is killed and the parent emits a
+JSON line with "tpu_unavailable": true plus a CPU AOT compile-stats
+fallback, exiting 0 either way.
+"""
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -16,39 +27,25 @@ import numpy as np
 PEAK_FLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v4": 275e12,
               "v5p": 459e12, "v6e": 918e12, "cpu": 1e12}
 
+TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
+TPU_DEADLINE_S = float(os.environ.get("BENCH_TPU_DEADLINE_S", "480"))
+CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_DEADLINE_S", "420"))
 
-def main():
-    import os
-    import jax
 
-    dev = jax.devices()[0]
-    on_tpu = dev.platform != "cpu"
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower() if on_tpu \
-        else "cpu"
-    peak = PEAK_FLOPS.get(gen, 197e12 if on_tpu else 1e12)
-
+def _bench_train(model_cfg, batch, seq, steps, warmup, peak,
+                 multi_precision=True):
+    """Measure one-chip training throughput for one config. Runs inside the
+    child process (backend already chosen)."""
     import paddle_tpu as paddle
-    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu import amp, optimizer
     from paddle_tpu.jit import TrainStep
-    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama import LlamaForCausalLM
 
     paddle.seed(0)
-    if on_tpu:
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                          intermediate_size=2816, num_hidden_layers=16,
-                          num_attention_heads=16, num_key_value_heads=16,
-                          max_position_embeddings=1024,
-                          tensor_parallel=False)
-        batch, seq, steps, warmup = 8, 1024, 12, 3
-    else:  # smoke path for CPU dev runs
-        from paddle_tpu.models.llama import llama_tiny_config
-        cfg = llama_tiny_config(tensor_parallel=False)
-        batch, seq, steps, warmup = 2, 64, 4, 1
-
-    model = LlamaForCausalLM(cfg)
+    model = LlamaForCausalLM(model_cfg)
     opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
                           parameters=model.parameters(),
-                          multi_precision=True)
+                          multi_precision=multi_precision)
     model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
 
     def loss_fn(m, b):
@@ -57,7 +54,8 @@ def main():
         return loss
 
     step = TrainStep(model, loss_fn, opt)
-    ids = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    ids = np.random.randint(0, model_cfg.vocab_size,
+                            (batch, seq)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1).astype(np.int32)
     batch_t = (paddle.to_tensor(ids), paddle.to_tensor(labels))
 
@@ -71,23 +69,169 @@ def main():
     final = float(loss.item())  # sync
     dt = time.perf_counter() - t0
 
-    tokens = batch * seq * steps
-    tok_per_s = tokens / dt
-    flops_per_token = model.flops_per_token(seq)
-    mfu = tok_per_s * flops_per_token / peak
-    n_params = model.num_params()
+    tok_per_s = batch * seq * steps / dt
+    mfu = tok_per_s * model.flops_per_token(seq) / peak
+    return {"tokens_per_sec": round(tok_per_s, 1),
+            "mfu": round(mfu, 4),
+            "model_params": int(model.num_params()),
+            "batch": batch, "seq": seq,
+            "final_loss": round(final, 4),
+            "step_ms": round(dt / steps * 1000, 2)}
 
+
+def _child_tpu():
+    """Runs under the default (axon TPU) platform. Benches a 0.2B config
+    and the largest Llama that fits one chip in bf16, reports the Pallas
+    dispatch route, prints one JSON dict."""
+    import jax
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower() if on_tpu \
+        else "cpu"
+    peak = PEAK_FLOPS.get(gen, 197e12 if on_tpu else 1e12)
+
+    from paddle_tpu.models.llama import LlamaConfig, llama_tiny_config
+
+    if on_tpu:
+        cfg_small = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=1024,
+            tensor_parallel=False)
+        small = _bench_train(cfg_small, batch=8, seq=1024, steps=12,
+                             warmup=3, peak=peak)
+        # ~0.95B params; bf16 optimizer states (multi_precision off) +
+        # per-layer remat keep it inside a 16GB v5e HBM
+        cfg_big = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            tensor_parallel=False, recompute=True)
+        big = _bench_train(cfg_big, batch=4, seq=2048, steps=8, warmup=2,
+                           peak=peak, multi_precision=False)
+    else:
+        cfg = llama_tiny_config(tensor_parallel=False)
+        small = _bench_train(cfg, batch=2, seq=64, steps=4, warmup=1,
+                             peak=peak)
+        big = None
+
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    head = big or small
+    print("BENCH_JSON " + json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": head["tokens_per_sec"],
+        "unit": "tokens/s",
+        "vs_baseline": round(head["mfu"] / 0.45, 4),
+        "mfu": head["mfu"],
+        "chip": gen,
+        "sdpa_dispatch": fa.sdpa_last_dispatch(),
+        "config_small": small,
+        "config_big": big,
+        **{k: head[k] for k in ("model_params", "batch", "seq",
+                                "final_loss", "step_ms")},
+    }))
+
+
+def _child_cpu():
+    """TPU-unavailable fallback: CPU smoke throughput + AOT compile cost
+    stats for the 0.2B config, so the round still records a real artifact."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.models.llama import llama_tiny_config, LlamaForCausalLM
+
+    cfg = llama_tiny_config(tensor_parallel=False)
+    smoke = _bench_train(cfg, batch=2, seq=64, steps=4, warmup=1, peak=1e12)
+
+    # AOT compile the 0.2B single-chip step on the CPU backend and pull
+    # XLA's cost model numbers (flops/bytes) — hardware-independent
+    paddle.seed(0)
+    cfg2 = llama_tiny_config(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg2)
+    opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def loss_fn(m, b):
+        ids, labels = b
+        loss, _ = m(ids, labels)
+        return loss
+
+    step = TrainStep(model, loss_fn, opt)
+    ids = paddle.to_tensor(
+        np.zeros((2, 64), np.int32))
+    lowered = step.lower((ids, ids))
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    print("BENCH_JSON " + json.dumps({
+        "metric": "llama_pretrain_tokens_per_sec_per_chip",
+        "value": smoke["tokens_per_sec"],
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,
+        "chip": "cpu",
+        "aot_step_flops": float(cost.get("flops", -1.0)),
+        "aot_bytes_accessed": float(cost.get("bytes accessed", -1.0)),
+        **{k: smoke[k] for k in ("model_params", "batch", "seq",
+                                 "final_loss", "step_ms")},
+    }))
+
+
+def _run_child(mode: str, deadline: float):
+    """Run this script in child mode; returns parsed JSON dict or None."""
+    env = dict(os.environ)
+    if mode == "--child-cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__), mode],
+                           env=env, timeout=deadline,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None, "deadline exceeded (backend init or compile hang)"
+    for line in r.stdout.splitlines():
+        if line.startswith("BENCH_JSON "):
+            return json.loads(line[len("BENCH_JSON "):]), None
+    tail = (r.stdout + r.stderr)[-2000:]
+    return None, f"rc={r.returncode}: {tail}"
+
+
+def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-tpu":
+        _child_tpu()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-cpu":
+        _child_cpu()
+        return
+
+    errors = []
+    want_tpu = os.environ.get("JAX_PLATFORMS", "axon") != "cpu"
+    if want_tpu:
+        for attempt in range(TPU_ATTEMPTS):
+            result, err = _run_child("--child-tpu", TPU_DEADLINE_S)
+            if result is not None:
+                print(json.dumps(result))
+                return
+            errors.append(f"tpu attempt {attempt + 1}: {err}")
+            time.sleep(5)
+
+    result, err = _run_child("--child-cpu", CPU_DEADLINE_S)
+    if result is not None:
+        if want_tpu:
+            # a TPU run was attempted and failed — mark the outage
+            result["tpu_unavailable"] = True
+            result["chip"] = "cpu-fallback"
+            result["tpu_errors"] = errors[:2]
+        print(json.dumps(result))
+        return
+    # last resort: still one JSON line, rc 0, explicit marker
     print(json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
-        "value": round(tok_per_s, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.45, 4),
-        "mfu": round(mfu, 4),
-        "model_params": int(n_params),
-        "chip": gen,
-        "batch": batch, "seq": seq,
-        "final_loss": round(final, 4),
-        "step_ms": round(dt / steps * 1000, 2),
+        "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+        "tpu_unavailable": True, "cpu_fallback_failed": True,
+        "tpu_errors": errors[:2], "cpu_error": (err or "")[:500],
     }))
 
 

@@ -278,22 +278,23 @@ class Task:
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    """Host-level point-to-point send over the TCPStore transport."""
+    """Host-level point-to-point send over the TCPStore transport.
+    ``dst`` is the GLOBAL rank (reference semantics, same convention as
+    broadcast/scatter); ``group`` only namespaces the exchange."""
     store = _get_store()
-    src = group.rank if (group and not _is_world(group)) else _my_rank()
-    dstg = dst
+    src = _my_rank()
     gid = group.id if group else 0
     with _SEQ_LOCK:
-        seq = _SEND_SEQ.get((gid, src, dstg), 0)
-        _SEND_SEQ[(gid, src, dstg)] = seq + 1
-    store.set(f"p2p/{gid}/{src}->{dstg}/{seq}", _pack(_val(tensor)))
+        seq = _SEND_SEQ.get((gid, src, dst), 0)
+        _SEND_SEQ[(gid, src, dst)] = seq + 1
+    store.set(f"p2p/{gid}/{src}->{dst}/{seq}", _pack(_val(tensor)))
     return None
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    """Blocking receive matching :func:`send` from ``src``."""
+    """Blocking receive matching :func:`send` from GLOBAL rank ``src``."""
     store = _get_store()
-    me = group.rank if (group and not _is_world(group)) else _my_rank()
+    me = _my_rank()
     gid = group.id if group else 0
     with _SEQ_LOCK:
         seq = _RECV_SEQ.get((gid, src, me), 0)
@@ -414,18 +415,26 @@ def _reduce_terms(op, parts):
     return out
 
 
+def _use_multihost(group) -> bool:
+    """Multihost fast path is valid only when the group is the world AND
+    jax itself was initialized multi-process (jax.distributed). On
+    TCPStore-only jobs (each worker a 1-process jax runtime) world
+    collectives must ride the store too."""
+    return _is_world(group) and jax.process_count() == _world_size()
+
+
 def _gather_all(v, group, op_name):
     """Gather `v` from every member of `group`, ordered by group rank.
 
-    World groups take the multihost fast path; proper subsets ride the
-    store so non-members need not participate."""
+    World groups take the multihost fast path when jax is multi-process;
+    everything else rides the store so non-members need not participate."""
     if _single_process() and _is_world(group):
         return [v]
-    if _is_world(group):
+    if _use_multihost(group):
         from jax.experimental import multihost_utils
         g = multihost_utils.process_allgather(v)
         return [jnp.asarray(g[i]) for i in range(_world_size())]
-    return _store_gather(v, group, op_name)
+    return _store_gather(v, group or _world(), op_name)
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -449,7 +458,7 @@ def all_gather_object(object_list, obj, group=None):
         object_list.append(obj)
         return object_list
     data = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
-    if _is_world(group):
+    if _use_multihost(group):
         from jax.experimental import multihost_utils
         n = np.array([data.size], np.int32)
         sizes = multihost_utils.process_allgather(jnp.asarray(n))
@@ -461,7 +470,7 @@ def all_gather_object(object_list, obj, group=None):
             object_list.append(
                 pickle.loads(bytes(np.asarray(row)[:int(size)])))
         return object_list
-    rows = _store_gather(data, group, "allgather_obj")
+    rows = _store_gather(data, group or _world(), "allgather_obj")
     object_list.extend(pickle.loads(bytes(np.asarray(r))) for r in rows)
     return object_list
 
@@ -484,17 +493,18 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     if _single_process() and _is_world(group):
         return tensor
     v = _val(tensor)
-    if _is_world(group):
+    if _use_multihost(group):
         from jax.experimental import multihost_utils
         out = multihost_utils.broadcast_one_to_all(
             v, is_source=_my_rank() == src)
         tensor._update_value(jnp.asarray(out))
         return tensor
-    # subset group: src is the GLOBAL rank (reference semantics)
-    parts = _store_gather(v, group, "broadcast")
-    idx = group.get_group_rank(src)
+    # store path: src is the GLOBAL rank (reference semantics)
+    g = group or _world()
+    parts = _store_gather(v, g, "broadcast")
+    idx = g.get_group_rank(src)
     if idx < 0:
-        raise ValueError(f"broadcast src={src} is not a member of {group}")
+        raise ValueError(f"broadcast src={src} is not a member of {g}")
     tensor._update_value(parts[idx].astype(v.dtype))
     return tensor
 
@@ -512,7 +522,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         return tensor
     stacked = jnp.stack([_val(t) for t in tensor_list]) if tensor_list \
         else jnp.zeros((g.nranks,) + tuple(tensor.shape), tensor.dtype)
-    if _is_world(group):
+    if _use_multihost(group):
         from jax.experimental import multihost_utils
         v = multihost_utils.broadcast_one_to_all(
             stacked, is_source=_my_rank() == src)
@@ -544,7 +554,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     g = group or _world()
-    n = g.nranks if not _single_process() else 1
+    n = 1 if (_single_process() and _is_world(group)) else g.nranks
     parts = jnp.split(_val(in_tensor), n)
     outs = alltoall([Tensor(p) for p in parts], group=group)
     res = jnp.concatenate([_val(t) for t in outs])
@@ -557,11 +567,11 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
 def barrier(group=None):
     if _single_process() and _is_world(group):
         return
-    if _is_world(group):
+    if _use_multihost(group):
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("paddle_tpu_barrier")
         return
-    _store_gather(jnp.zeros((), jnp.int32), group, "barrier")
+    _store_gather(jnp.zeros((), jnp.int32), group or _world(), "barrier")
 
 
 def wait(tensor, group=None, use_calc_stream=True):

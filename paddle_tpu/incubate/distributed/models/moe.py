@@ -115,7 +115,16 @@ class ExpertMLP(Layer):
 class MoELayer(Layer):
     """reference: moe_layer.py MoELayer(gate, experts, ...) — verify.
 
-    forward(x: (b, s, d)) -> (b, s, d); aux loss on self.l_aux."""
+    forward(x: (b, s, d)) -> (b, s, d); aux loss on self.l_aux.
+
+    TPU-native dispatch (VERDICT r1 #3): sort-based capacity routing —
+    argsort token→expert assignments, position-within-expert from segment
+    starts, one scatter into an (E·cap, d) buffer, experts module applied
+    to the (E, cap, d) batch, one gather + gate-weighted combine back.
+    Memory is O(T·d + E·cap·d) — no dense (E, cap, T) one-hots.  Under jit
+    with expert weights sharded over the "ep" mesh axis GSPMD partitions
+    the expert batch over experts and inserts the token all-to-all the
+    reference's global_scatter/global_gather implement by hand."""
 
     def __init__(self, d_model, experts=None, gate=None, num_expert=None,
                  d_hidden=None, top_k=2, capacity_factor=1.25,
@@ -134,50 +143,121 @@ class MoELayer(Layer):
         self.experts = experts
         self.num_expert = num_expert or getattr(gate, "num_expert")
         self.top_k = getattr(gate, "topk", top_k)
-        self.capacity_factor = capacity_factor
+        # gate-level capacity_factor wins (reference keeps it on the gate)
+        self.capacity_factor = getattr(gate, "capacity_factor",
+                                       capacity_factor) or capacity_factor
         self.l_aux = None
         if expert_axis is not None and hasattr(experts, "set_expert_axis"):
             experts.set_expert_axis(expert_axis)
+
+    def _capacity(self, tokens: int) -> int:
+        cap = int(math.ceil(self.capacity_factor * tokens * self.top_k
+                            / self.num_expert))
+        return max(cap, self.top_k)
 
     def forward(self, x):
         from ....ops.manipulation import reshape
         b, s, d = x.shape
         tokens = b * s
-        e = self.num_expert
-        cap = int(math.ceil(self.capacity_factor * tokens * self.top_k / e))
-        cap = max(cap, self.top_k)
+        e, k = self.num_expert, self.top_k
+        cap = self._capacity(tokens)
         xt = reshape(x, (tokens, d))
         logits, l_aux = self.gate(xt)
         self.l_aux = l_aux
 
-        # one traced op: dispatch → experts → gate-weighted combine
-        def full2(xv, lg, w1, b1, w2, b2):
-            probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
-            topv, topi = jax.lax.top_k(probs, self.top_k)
-            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
-            onehot_flat = jax.nn.one_hot(
-                topi, e, dtype=jnp.int32).reshape(-1, e)
-            pos = jnp.cumsum(onehot_flat, axis=0) * onehot_flat - 1
-            pos_tk = jnp.max(pos.reshape(-1, self.top_k, e), axis=-1)
-            keep = (pos_tk < cap) & (pos_tk >= 0)
-            gates = jnp.where(keep, topv, 0.0).astype(xv.dtype)  # (T, K)
-            T = xv.shape[0]
-            tok_idx = jnp.broadcast_to(jnp.arange(T)[:, None],
-                                       (T, self.top_k))
-            eidx = topi.reshape(-1)
-            cidx = jnp.clip(pos_tk, 0, cap - 1).reshape(-1)
-            tidx = tok_idx.reshape(-1)
-            disp = jnp.zeros((e, cap, T), xv.dtype)
-            disp = disp.at[eidx, cidx, tidx].add(
-                keep.reshape(-1).astype(xv.dtype))          # 0/1 dispatch
-            comb_w = jnp.zeros((e, cap, T), xv.dtype)
-            comb_w = comb_w.at[eidx, cidx, tidx].add(gates.reshape(-1))
-            expert_in = jnp.einsum("ect,td->ecd", disp, xv)
-            h = jnp.einsum("ecd,edh->ech", expert_in, w1) + b1
-            h = jax.nn.gelu(h)
-            expert_out = jnp.einsum("ech,ehd->ecd", h, w2) + b2
-            return jnp.einsum("ect,ecd->td", comb_w, expert_out)
+        # 1) routing: pure integer work on DETACHED logits (indices carry
+        #    no gradient; detaching keeps int outputs off the vjp tape)
+        def route(lg):
+            _, topi = jax.lax.top_k(lg.astype(jnp.float32), k)  # (T, K)
+            flat_e = topi.reshape(-1)                       # (N,) N = T*K
+            sidx = jnp.argsort(flat_e)                      # stable
+            se = flat_e[sidx]
+            starts = jnp.searchsorted(se, jnp.arange(e))    # (E,)
+            pos_sorted = jnp.arange(se.shape[0]) - starts[se]
+            pos = jnp.zeros_like(flat_e).at[sidx].set(pos_sorted)
+            keep = pos < cap                                # (N,) bool
+            # slot in the flat (E*cap) expert buffer; dropped tokens get
+            # the out-of-range slot E*cap (scatter mode='drop' skips it)
+            slot = jnp.where(keep, flat_e * cap + pos, e * cap)
+            return topi, slot.astype(jnp.int32), keep
 
-        out = apply_op(full2, xt, logits, self.experts.w1, self.experts.b1,
-                       self.experts.w2, self.experts.b2)
+        topi, slot, keep = apply_op(route, logits.detach())
+
+        # 2) gate weights: differentiable in logits
+        def gate_weights(lg, ti, kp):
+            probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+            topv = jnp.take_along_axis(probs, ti, axis=-1)  # (T, K)
+            topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-9)
+            return jnp.where(kp.reshape(-1, k), topv, 0.0)
+
+        gates = apply_op(gate_weights, logits, topi, keep)
+
+        # 3) dispatch: one scatter into the expert batch
+        def dispatch(xv, sl):
+            tok = jnp.repeat(jnp.arange(tokens), k)         # (N,)
+            buf = jnp.zeros((e * cap, xv.shape[-1]), xv.dtype)
+            buf = buf.at[sl].set(xv[tok], mode="drop")
+            return buf.reshape(e, cap, xv.shape[-1])
+
+        expert_in = apply_op(dispatch, xt, slot)
+
+        # 4) the experts module — custom modules and their activation run
+        #    exactly as given (E, cap, d) -> (E, cap, d)
+        expert_out = self.experts(expert_in)
+
+        # 5) combine: gather each token's expert outputs, gate-weight, sum
+        def combine(eo, g, sl):
+            flat = eo.reshape(e * cap, eo.shape[-1])
+            out_tk = flat.at[sl].get(mode="fill", fill_value=0)  # (N, d)
+            out_tk = out_tk * g.reshape(-1, 1).astype(flat.dtype)
+            return jnp.sum(out_tk.reshape(tokens, k, eo.shape[-1]), axis=1)
+
+        out = apply_op(combine, expert_out, gates, slot)
+        return reshape(out, (b, s, d))
+
+    def forward_dense(self, x):
+        """Reference dense-dispatch path (one-hot (E, cap, T) tensors) kept
+        for parity testing of the sort-based dispatch; O(E·cap·T) memory —
+        do not use at scale."""
+        from ....ops.manipulation import reshape
+        b, s, d = x.shape
+        tokens = b * s
+        e, k = self.num_expert, self.top_k
+        cap = self._capacity(tokens)
+        xt = reshape(x, (tokens, d))
+        logits, l_aux = self.gate(xt)
+        self.l_aux = l_aux
+
+        def build(lg):
+            probs = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+            topv, topi = jax.lax.top_k(probs, k)
+            topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-9)
+            onehot_flat = jax.nn.one_hot(topi, e, dtype=jnp.int32)
+            pos = (jnp.cumsum(onehot_flat.reshape(-1, e), axis=0)
+                   * onehot_flat.reshape(-1, e) - 1)
+            pos_tk = jnp.max(pos.reshape(-1, k, e), axis=-1)
+            kp = (pos_tk < cap) & (pos_tk >= 0)
+            gates = jnp.where(kp, topv, 0.0)
+            T = lg.shape[0]
+            tidx = jnp.broadcast_to(jnp.arange(T)[:, None], (T, k))
+            disp = jnp.zeros((e, cap, T), jnp.float32).at[
+                topi.reshape(-1), jnp.clip(pos_tk, 0, cap - 1).reshape(-1),
+                tidx.reshape(-1)].add(kp.reshape(-1).astype(jnp.float32))
+            comb = jnp.zeros((e, cap, T), jnp.float32).at[
+                topi.reshape(-1), jnp.clip(pos_tk, 0, cap - 1).reshape(-1),
+                tidx.reshape(-1)].add(gates.reshape(-1))
+            return disp, comb
+
+        disp, comb = apply_op(build, logits)
+
+        def dispatch(dp, xv):
+            return jnp.einsum("ect,td->ecd", dp.astype(xv.dtype), xv)
+
+        expert_in = apply_op(dispatch, disp, xt)
+        expert_out = self.experts(expert_in)
+
+        def combine(cb, eo):
+            return jnp.einsum("ect,ecd->td", cb.astype(eo.dtype), eo)
+
+        out = apply_op(combine, comb, expert_out)
         return reshape(out, (b, s, d))

@@ -267,10 +267,7 @@ class TrainStep:
         self._ptensors, self._btensors, self._frozen = \
             ptensors, btensors, frozen
 
-    def __call__(self, batch):
-        """batch: pytree of Tensors/arrays. Returns loss Tensor (+aux)."""
-        if self._jitted is None:
-            self._build()
+    def _step_args(self, batch):
         pvals = {n: t._value for n, t in self._ptensors.items()}
         bvals = {n: t._value for n, t in self._btensors.items()}
         fvals = {n: t._value for n, t in self._frozen.items()}
@@ -280,8 +277,22 @@ class TrainStep:
         batch_vals = jax.tree.map(
             lambda x: x._value if isinstance(x, Tensor) else jnp.asarray(x),
             batch, is_leaf=lambda x: isinstance(x, Tensor))
+        return pvals, opt_state, bvals, fvals, key, lr_value, batch_vals
+
+    def lower(self, batch):
+        """AOT path: ``jax.jit(...).lower`` of the full fused train step —
+        compile-time cost/memory introspection without running it
+        (``.compile().cost_analysis()``, ``.memory_analysis()``)."""
+        if self._jitted is None:
+            self._build()
+        return self._jitted.lower(*self._step_args(batch))
+
+    def __call__(self, batch):
+        """batch: pytree of Tensors/arrays. Returns loss Tensor (+aux)."""
+        if self._jitted is None:
+            self._build()
         loss, new_params, new_opt_state, new_bufs, aux = self._jitted(
-            pvals, opt_state, bvals, fvals, key, lr_value, batch_vals)
+            *self._step_args(batch))
         for n, v in new_params.items():
             self._ptensors[n]._update_value(v)
         for n, v in new_bufs.items():

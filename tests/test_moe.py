@@ -1,0 +1,196 @@
+"""MoE sort-based dispatch tests (VERDICT r1 #3).
+
+- parity: sort-based scatter/gather dispatch == dense one-hot dispatch
+  (both prioritize earlier tokens on capacity overflow)
+- the experts= module and its activation are actually called
+- gradients flow to gate and expert weights
+- memory regression: at E=64 no traced intermediate reaches the dense
+  (E, cap, T) dispatch-tensor size — dispatch is O(T·d + E·cap·d)
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import framework, nn
+from paddle_tpu.incubate.distributed.models.moe import (
+    ExpertMLP, GShardGate, MoELayer, NaiveGate, SwitchGate)
+from paddle_tpu.tensor import Tensor
+
+
+def _x(b=2, s=8, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(rng.randn(b, s, d).astype(np.float32))
+
+
+def test_sparse_matches_dense_no_drop():
+    paddle.seed(0)
+    moe = MoELayer(d_model=16, num_expert=4, d_hidden=32, top_k=2,
+                   capacity_factor=8.0)  # capacity >= all tokens: no drops
+    x = _x()
+    np.testing.assert_allclose(moe(x).numpy(), moe.forward_dense(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_matches_dense_with_drops():
+    paddle.seed(1)
+    moe = MoELayer(d_model=16, num_expert=4, d_hidden=32, top_k=2,
+                   capacity_factor=0.5)  # forces capacity overflow drops
+    x = _x(seed=3)
+    np.testing.assert_allclose(moe(x).numpy(), moe.forward_dense(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_switch_top1_parity():
+    paddle.seed(2)
+    moe = MoELayer(d_model=8, num_expert=2, d_hidden=16, top_k=1,
+                   gate="switch")
+    x = _x(d=8, seed=4)
+    np.testing.assert_allclose(moe(x).numpy(), moe.forward_dense(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_custom_experts_module_is_called():
+    paddle.seed(3)
+    calls = []
+
+    class MyExperts(nn.Layer):
+        def __init__(self, e, d, h):
+            super().__init__()
+            self.inner = ExpertMLP(e, d, h, activation=lambda t: t.tanh()
+                                   if hasattr(t, "tanh") else jnp.tanh(t))
+            self.scale = 2.0
+
+        def forward(self, x):
+            calls.append(tuple(x.shape))
+            return self.inner(x) * self.scale
+
+    moe = MoELayer(d_model=16, num_expert=4, experts=MyExperts(4, 16, 32),
+                   top_k=2)
+    out = moe(_x())
+    assert calls, "custom experts module was never invoked"
+    assert calls[0][0] == 4          # (E, cap, d) batch reached the module
+    assert out.shape == [2, 8, 16]
+
+    # doubling the custom module's scale doubles the output: the module's
+    # own parameters/behavior (not hardcoded w1/w2) produce the result
+    moe.experts.scale = 4.0
+    out2 = moe(_x())
+    np.testing.assert_allclose(out2.numpy(), out.numpy() * 2.0,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_expert_activation_honored():
+    paddle.seed(4)
+    import paddle_tpu.nn.functional as F
+    relu_experts = ExpertMLP(4, 16, 32, activation=F.relu)
+    # build two layers sharing weights but different activations
+    gelu_experts = ExpertMLP(4, 16, 32, activation=F.gelu)
+    for a, b in zip(gelu_experts.parameters(), relu_experts.parameters()):
+        a.set_value(b)
+    m_relu = MoELayer(d_model=16, num_expert=4, experts=relu_experts,
+                      top_k=2)
+    m_gelu = MoELayer(d_model=16, num_expert=4, experts=gelu_experts,
+                      top_k=2)
+    # same gate weights
+    for a, b in zip(m_gelu.gate.parameters(), m_relu.gate.parameters()):
+        a.set_value(b)
+    x = _x(seed=7)
+    assert not np.allclose(m_relu(x).numpy(), m_gelu(x).numpy()), \
+        "activation argument ignored"
+
+
+def test_gradients_flow():
+    paddle.seed(5)
+    moe = MoELayer(d_model=16, num_expert=4, d_hidden=32, top_k=2)
+    for p in moe.parameters():
+        p.stop_gradient = False
+    x = _x()
+    out = moe(x)
+    loss = (out * out).mean() + 0.01 * moe.l_aux
+    loss.backward()
+    gate_w = moe.gate.gate.weight
+    assert gate_w.grad is not None and \
+        float(np.abs(gate_w.grad.numpy()).sum()) > 0
+    for p in (moe.experts.w1, moe.experts.w2):
+        assert p.grad is not None and \
+            float(np.abs(p.grad.numpy()).sum()) > 0
+
+
+def _trace_sizes(moe, x_val):
+    """Max traced intermediate array size (elements) of the forward."""
+    ptensors = dict(moe.named_parameters())
+
+    def pure(pvals, xv):
+        saved = [(t, t._value) for t in ptensors.values()]
+        try:
+            for n, v in pvals.items():
+                ptensors[n]._value = v
+            with framework.functional_mode():
+                return moe(Tensor(xv))._value
+        finally:
+            for t, v in saved:
+                t._value = v
+
+    pvals = {n: p._value for n, p in ptensors.items()}
+    jaxpr = jax.make_jaxpr(pure)(pvals, x_val)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            for v in eqn.outvars:
+                if hasattr(v.aval, "shape"):
+                    yield int(np.prod(v.aval.shape)) if v.aval.shape else 1
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    yield from walk(sub.jaxpr)
+
+    return max(walk(jaxpr.jaxpr))
+
+
+@pytest.mark.parametrize("e,toks", [(64, 2048)])
+def test_dispatch_memory_scales(e, toks):
+    paddle.seed(6)
+    d = 32
+    moe = MoELayer(d_model=d, num_expert=e, d_hidden=64, top_k=2)
+    cap = moe._capacity(toks)
+    x_val = jnp.zeros((1, toks, d), jnp.float32)
+    biggest = _trace_sizes(moe, x_val)
+    dense_size = e * cap * toks     # the (E, cap, T) dispatch one-hot
+    # sort-based dispatch must stay far below the dense dispatch tensor
+    assert biggest < dense_size // 4, \
+        f"intermediate of {biggest} elems ~ dense dispatch {dense_size}"
+    # sanity: the guard actually detects the dense path
+    moe_dense_trace = _trace_sizes_dense(moe, x_val)
+    assert moe_dense_trace >= dense_size
+
+
+def _trace_sizes_dense(moe, x_val):
+    ptensors = dict(moe.named_parameters())
+
+    def pure(pvals, xv):
+        saved = [(t, t._value) for t in ptensors.values()]
+        try:
+            for n, v in pvals.items():
+                ptensors[n]._value = v
+            with framework.functional_mode():
+                return moe.forward_dense(Tensor(xv))._value
+        finally:
+            for t, v in saved:
+                t._value = v
+
+    pvals = {n: p._value for n, p in ptensors.items()}
+    jaxpr = jax.make_jaxpr(pure)(pvals, x_val)
+    sizes = [1]
+    for eqn in jaxpr.jaxpr.eqns:
+        for v in eqn.outvars:
+            if hasattr(v.aval, "shape") and v.aval.shape:
+                sizes.append(int(np.prod(v.aval.shape)))
+    return max(sizes)
+
+
+def test_capacity_factor_from_gate():
+    gate = GShardGate(16, 4, topk=2, capacity_factor=2.5)
+    moe = MoELayer(d_model=16, num_expert=4, d_hidden=32, gate=gate)
+    assert moe.capacity_factor == 2.5

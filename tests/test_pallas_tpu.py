@@ -1,0 +1,100 @@
+"""On-hardware Pallas kernel validation (VERDICT r1 #1).
+
+These tests run ONLY on a real TPU backend: they compile the Pallas
+kernels with Mosaic (interpret=False) and assert (a) the fast path is
+actually TAKEN — no silent XLA fallback — and (b) numerics match the XLA
+reference. Off TPU the whole module is skipped; the CPU interpret-mode
+parity tests live in tests/test_pallas_fused.py.
+
+Run manually on hardware with:
+    JAX_PLATFORMS=axon python -m pytest tests/test_pallas_tpu.py -q
+(pytest's conftest flips the suite to CPU, so this module re-checks the
+actual backend at runtime and skips unless it is a TPU.)
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+if jax.default_backend() not in ("tpu", "axon"):
+    pytest.skip("requires a real TPU backend (conftest pins CPU)",
+                allow_module_level=True)
+
+from paddle_tpu.ops.pallas import flash_attention as fa
+from paddle_tpu.ops.pallas import fused
+
+
+def _rand(shape, dtype=jnp.bfloat16, seed=0):
+    return jax.random.normal(jax.random.PRNGKey(seed), shape, dtype)
+
+
+def test_sdpa_takes_pallas_path_and_matches_xla():
+    b, s, h, d = 2, 512, 8, 64
+    q, k, v = (_rand((b, s, h, d), seed=i) for i in range(3))
+    out = jax.jit(lambda *a: fa.sdpa(*a, is_causal=True))(q, k, v)
+    out.block_until_ready()
+    assert fa.sdpa_last_dispatch() in ("jax_flash", "fused_flash"), \
+        f"Pallas path NOT taken: {fa.sdpa_last_dispatch()}"
+    ref = fa._xla_sdpa(q, k, v, None, True, 0.0, 1.0 / np.sqrt(d))
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_sdpa_backward_on_hardware():
+    b, s, h, d = 1, 256, 4, 64
+    q, k, v = (_rand((b, s, h, d), jnp.float32, seed=i) for i in range(3))
+
+    def loss_pallas(q, k, v):
+        return fa.sdpa(q, k, v, is_causal=True).sum()
+
+    def loss_ref(q, k, v):
+        return fa._xla_sdpa(q, k, v, None, True, 0.0,
+                            1.0 / np.sqrt(d)).sum()
+
+    gp = jax.jit(jax.grad(loss_pallas, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_fused_rms_norm_on_hardware():
+    x = _rand((4, 512, 256), jnp.float32)
+    w = jnp.ones((256,), jnp.float32) * 1.5
+    out = jax.jit(lambda x, w: fused.fused_rms_norm(x, w))(x, w)
+    ref = fused._rms_ref(x, w, 1e-6, None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_rope_on_hardware():
+    b, s, h, d = 2, 128, 4, 64
+    q = _rand((b, s, h, d), jnp.float32, 0)
+    k = _rand((b, s, h, d), jnp.float32, 1)
+    pos = jnp.arange(s)[:, None]
+    inv = 1.0 / (10000 ** (jnp.arange(0, d, 2) / d))
+    ang = pos * inv[None, :]
+    cos = jnp.concatenate([jnp.cos(ang)] * 2, -1)
+    sin = jnp.concatenate([jnp.sin(ang)] * 2, -1)
+    oq, ok = jax.jit(fused.fused_rope)(q, k, cos, sin)
+    rq, rk = fused._rope_ref(q, k, cos, sin)
+    np.testing.assert_allclose(np.asarray(oq), np.asarray(rq),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ok), np.asarray(rk),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_fused_adamw_on_hardware():
+    n = 4096
+    p = _rand((n,), jnp.float32, 0)
+    g = _rand((n,), jnp.float32, 1)
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+    outs = jax.jit(lambda *a: fused.fused_adamw(
+        *a, lr=1e-3, weight_decay=0.0))(p, g, m, v)
+    refs = fused._adamw_ref(p, g, m, v, 1e-3, 0.9, 0.999, 1e-8, 0.0, 1)
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(np.asarray(o), np.asarray(r),
+                                   rtol=1e-5, atol=1e-6)
