@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time
 from typing import Optional
 
 __all__ = ["program_fingerprint", "check_program_consistency",
@@ -57,7 +58,18 @@ def check_program_consistency(fingerprint: str, store=None,
                          timeout=timeout)
     store.set(f"{key}/{rank}", fingerprint)
     mismatched = []
+    deadline = time.monotonic() + timeout
     for r in range(world_size):
+        # poll, don't block: TCPStore.get waits forever on a missing key,
+        # which would turn "rank r never compiled" into exactly the hang
+        # this check exists to prevent
+        while not store.check(f"{key}/{r}"):
+            if time.monotonic() > deadline:
+                raise ConsistencyError(
+                    f"rank {r} did not publish a program fingerprint "
+                    f"within {timeout:.0f}s — it likely crashed before "
+                    "compile or diverged in setup.")
+            time.sleep(0.02)
         other = store.get(f"{key}/{r}").decode()
         if other != fingerprint:
             mismatched.append((r, other[:12]))

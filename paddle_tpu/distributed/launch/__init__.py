@@ -65,6 +65,13 @@ def _worker_env(cfg: LaunchConfig, local_rank: int, restart: int) -> dict:
     })
     if cfg.backend:
         env["JAX_PLATFORMS"] = cfg.backend
+        if cfg.backend == "cpu":
+            # CPU workers must not dial the host's TPU plugin/tunnel at
+            # interpreter startup (site hooks key off these vars); doing
+            # so serializes every spawn behind an exclusive-chip claim.
+            for var in ("PALLAS_AXON_POOL_IPS", "TPU_NAME",
+                        "TPU_WORKER_HOSTNAMES"):
+                env.pop(var, None)
     return env
 
 

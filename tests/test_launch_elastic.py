@@ -151,14 +151,27 @@ class TestProgramConsistency:
         daemon = MasterDaemon(0)
         s0 = TCPStore("127.0.0.1", daemon.port)
         s1 = TCPStore("127.0.0.1", daemon.port)
-        # matching programs pass on both ranks
-        assert check_program_consistency("aaa", store=s0, rank=0,
-                                         world_size=2)
-        assert check_program_consistency("aaa", store=s1, rank=1,
-                                         world_size=2)
+        # matching programs pass on both ranks (concurrent, as in a real
+        # job: each rank blocks until the other publishes)
+        import threading
+        results = {}
+
+        def run(rank, store):
+            results[rank] = check_program_consistency(
+                "aaa", store=store, rank=rank, world_size=2)
+        threads = [threading.Thread(target=run, args=(r, s))
+                   for r, s in ((0, s0), (1, s1))]
+        [t.start() for t in threads]
+        [t.join(timeout=30) for t in threads]
+        assert results == {0: True, 1: True}
         # diverging rank is named in the error
         s0.set("consistency2/0", "aaa")
         with pytest.raises(ConsistencyError, match=r"rank\(s\) \[0\]"):
             check_program_consistency("bbb", store=s1, rank=1,
                                       world_size=2, key="consistency2")
+        # a rank that never publishes raises instead of hanging
+        with pytest.raises(ConsistencyError, match="did not publish"):
+            check_program_consistency("ccc", store=s0, rank=0,
+                                      world_size=2, key="consistency3",
+                                      timeout=0.5)
         s0.close(); s1.close(); daemon.stop()
