@@ -230,32 +230,116 @@ class SharedLayerDesc(LayerDesc):
         self.shared_weight_attr = shared_weight_attr
 
 
+class _SharedCall(Layer):
+    """A SharedLayerDesc call site: weight sharing is free in a single
+    program — every site reads the same Parameters. The first occurrence
+    ``owns`` (registers) the shared instance; later sites keep only an
+    unregistered reference. ``forward_func(shared_layer, x)`` is applied
+    at EVERY site that declared one (reference pp_layers.py wraps each
+    occurrence in partial(forward_func, layer))."""
+
+    def __init__(self, shared_layer, forward_func=None, owns=False):
+        super().__init__()
+        if owns:
+            self.shared = shared_layer  # registered: owns the params
+        object.__setattr__(self, "_shared", shared_layer)
+        object.__setattr__(self, "_forward_func", forward_func)
+
+    def forward(self, x):
+        if self._forward_func is not None:
+            return self._forward_func(self._shared, x)
+        return self._shared(x)
+
+
+def _layer_signature(layer):
+    """Structural identity used to find the pipelinable trunk: two layers
+    with equal signatures can share one compiled stage body. Includes
+    scalar config attrs (so Dropout(0.1) != Dropout(0.5)) and the bare
+    callable's name (so F.relu != F.gelu)."""
+    if not isinstance(layer, Layer):
+        return (getattr(layer, "__name__", type(layer).__name__),
+                None, None, 0)
+    params = tuple((n, tuple(p.shape), str(p._value.dtype))
+                   for n, p in layer.named_parameters())
+    config = tuple(sorted(
+        (k, v) for k, v in vars(layer).items()
+        if isinstance(v, (int, float, str, bool, type(None)))
+        and not k.startswith("_")))
+    bufs = tuple((n, tuple(b.shape)) for n, b in layer.named_buffers())
+    return (type(layer).__name__, params, config, bufs)
+
+
+def _find_periodic_trunk(sigs, min_units):
+    """Longest contiguous periodic region of ``sigs``: returns
+    (start, period, n_units) maximizing covered length (ties: more
+    units). Returns n_units=0 when no region has >= min_units units."""
+    n = len(sigs)
+    best = (0, 1, 0)  # start, period, units
+    for q in range(1, n // 2 + 1):
+        i = 0
+        while i + q <= n:
+            k = 1
+            while (i + (k + 1) * q <= n
+                   and sigs[i + k * q:i + (k + 1) * q] == sigs[i:i + q]):
+                k += 1
+            if k >= 2:
+                cov, best_cov = k * q, best[2] * best[1]
+                if cov > best_cov or (cov == best_cov and k > best[2]):
+                    best = (i, q, k)
+                i += k * q
+            else:
+                i += 1
+    return best if best[2] >= min_units else (0, 1, 0)
+
+
 class PipelineLayer(Layer):
     """Stage-partitioned sequential model (reference:
-    meta_parallel/parallel_layers/pp_layers.py — verify).
+    meta_parallel/parallel_layers/pp_layers.py PipelineLayer — verify).
 
-    TPU-native execution: all stages live in ONE program; each segment's
-    parameters carry a stage tag, and the pipelined schedule (1F1B as a
-    lax.scan over microbatches with ppermute between stage-sharded
-    segments) is applied by paddle_tpu.distributed.pipeline.
-    First-cut forward (no pp axis / pp=1) runs segments sequentially."""
+    TPU-native execution (SURVEY §7 hard part #2): instead of the
+    reference's per-stage processes exchanging activations over NCCL p2p,
+    all stages live in ONE XLA program. At build time the layer list is
+    scanned for its maximal periodic trunk (repeated structurally
+    identical units — e.g. transformer blocks, possibly multi-layer
+    units like [Linear, ReLU]); the trunk's parameters are stacked into
+    (S, U, ...) Parameters sharded over the "pp" mesh axis and executed
+    through :func:`paddle_tpu.distributed.pipeline.pipeline_spmd`
+    (microbatch scan + ppermute ring). Layers before/after the trunk run
+    replicated as prologue/epilogue (embedding/head — cheap relative to
+    the trunk, and GSPMD still shards their math over dp/mp).
+
+    When no pp mesh axis is active, the same stacked parameters run as a
+    plain lax.scan over units, so the two modes share weights and
+    numerics exactly.
+    """
 
     def __init__(self, layers, num_stages=None, topology=None,
                  loss_fn=None, seg_method="uniform", recompute_interval=0,
-                 **kwargs):
+                 num_microbatches=None, **kwargs):
         super().__init__()
         from ...nn.common import LayerList
         self._descs = list(layers)
         self.loss_fn = loss_fn
         self._num_stages = num_stages or 1
-        built = []
+        self.num_microbatches = num_microbatches
+        self._recompute = bool(recompute_interval)
+        built, shared = [], {}
         for d in self._descs:
-            if isinstance(d, LayerDesc):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in shared:
+                    built.append(_SharedCall(shared[d.layer_name],
+                                             d.forward_func))
+                else:
+                    inst = d.build_layer()
+                    shared[d.layer_name] = inst
+                    built.append(
+                        _SharedCall(inst, d.forward_func, owns=True)
+                        if d.forward_func is not None else inst)
+            elif isinstance(d, LayerDesc):
                 built.append(d.build_layer())
             else:
                 built.append(d)
-        self.run_function = LayerList(built)
-        # stage assignment: uniform split
+        # stage assignment (uniform split — reference seg_method default)
         n = len(built)
         per = max(1, n // self._num_stages)
         self._stage_of = [min(i // per, self._num_stages - 1)
@@ -265,10 +349,142 @@ class PipelineLayer(Layer):
                 for p in l.parameters():
                     p.pp_stage = self._stage_of[i]
 
+        self._pipelined = False
+        if self._num_stages > 1:
+            self._try_build_trunk(built)
+        if not self._pipelined:
+            if self._num_stages > 1:
+                import warnings
+                warnings.warn(
+                    "PipelineLayer: no periodic trunk of >= "
+                    f"{self._num_stages} structurally identical units "
+                    "found; falling back to sequential (un-pipelined) "
+                    "execution. Stack identical blocks (LayerDesc of the "
+                    "same class/shape) to enable the scan+ppermute "
+                    "pipeline.", stacklevel=3)
+            self.run_function = LayerList(built)
+
+    # -- trunk construction -------------------------------------------------
+    def _try_build_trunk(self, built):
+        from ...nn.common import LayerList
+        from ...tensor import Parameter
+        S = self._num_stages
+        sigs = [_layer_signature(l) for l in built]
+        start, q, k = _find_periodic_trunk(sigs, S)
+        k_used = (k // S) * S
+        if k_used < max(S, 2):
+            return
+        end = start + k_used * q
+        protos = built[start:start + q]
+        # buffers can't ride the stacked-substitution path (only params
+        # are swapped in _unit_fwd); a trunk with per-unit buffer state
+        # (e.g. BatchNorm running stats) must not be silently broken
+        for lay in built[start:end]:
+            if isinstance(lay, Layer) and lay.buffers():
+                return
+        # stack unit parameters: leaf (S, U, *shape), pp shards dim 0
+        unit_pmaps = [dict(built[start + u * q + j].named_parameters())
+                      if isinstance(built[start + u * q + j], Layer)
+                      else None
+                      for u in range(k_used) for j in range(q)]
+        pindex, handles, stacked = [], [], []
+        for j in range(q):
+            if not isinstance(protos[j], Layer):
+                continue
+            pmap = dict(protos[j].named_parameters())
+            for pname, proto_p in pmap.items():
+                vals = []
+                for u in range(k_used):
+                    vals.append(unit_pmaps[u * q + j][pname]._value)
+                leaf = jnp.stack(vals).reshape(
+                    S, k_used // S, *vals[0].shape)
+                reg = f"trunk_{j}__{pname.replace('.', '__')}"
+                param = Parameter(leaf)
+                base = getattr(proto_p, "_sharding_spec", None)
+                param._sharding_spec = (P("pp", None, *tuple(base))
+                                        if base is not None
+                                        else P("pp"))
+                param.is_distributed = True
+                self.add_parameter(reg, param)
+                pindex.append((j, pname, reg))
+                handles.append(proto_p)
+                stacked.append(reg)
+        if not stacked:
+            return
+        self.prologue = LayerList(built[:start])
+        self.epilogue = LayerList(built[end:])
+        object.__setattr__(self, "_protos", protos)
+        self._pindex = pindex
+        object.__setattr__(self, "_phandles", handles)
+        self._period = q
+        self._units = k_used
+        self._pipelined = True
+
     def get_stage_from_index(self, idx):
         return self._stage_of[idx]
 
+    # -- execution ----------------------------------------------------------
+    def _unit_fwd(self, slices, hv):
+        """Run one trunk unit with its parameter values substituted into
+        the prototype layers (same trick as models/llama.py
+        LlamaDecoderStack._layer_fwd)."""
+        saved = [(t, t._value) for t in self._phandles]
+        try:
+            for t, v in zip(self._phandles, slices):
+                t._value = v
+            h = Tensor(hv)
+            with framework.functional_mode():
+                for proto in self._protos:
+                    h = proto(h) if isinstance(proto, Layer) else proto(h)
+            return h._value
+        finally:
+            for t, v in saved:
+                t._value = v
+
+    def _pure_trunk(self, xv, *leafvals):
+        from ..mesh import get_current_mesh
+        from ..pipeline import (merge_microbatches, num_pipeline_stages,
+                                pipeline_spmd, split_microbatches)
+        mesh = get_current_mesh()
+        S_mesh = num_pipeline_stages(mesh)
+        S = self._num_stages
+
+        def unit_body(hh, sl):
+            return self._unit_fwd(sl, hh), None
+        if S_mesh == 1:
+            # no pp axis: same stacked weights, plain scan over all units
+            flat = tuple(l.reshape(self._units, *l.shape[2:])
+                         for l in leafvals)
+            body = jax.checkpoint(self._unit_fwd) if self._recompute \
+                else self._unit_fwd
+            out, _ = jax.lax.scan(lambda h, sl: (body(sl, h), None),
+                                  xv, flat)
+            return out
+        if S_mesh != S:
+            raise ValueError(
+                f"PipelineLayer was built with num_stages={S} but the "
+                f"active mesh has pp={S_mesh}; re-build the model or the "
+                "mesh so the degrees agree.")
+
+        def stage_fn(local, h):
+            out, _ = jax.lax.scan(lambda hh, sl: (self._unit_fwd(sl, hh),
+                                                  None), h, local)
+            return out
+        M = self.num_microbatches or S
+        x_mb = split_microbatches(xv, M)
+        y_mb = pipeline_spmd(stage_fn, tuple(leafvals), x_mb, mesh=mesh,
+                             remat=self._recompute)
+        return merge_microbatches(y_mb)
+
     def forward(self, x):
-        for fn in self.run_function:
+        if not self._pipelined:
+            for fn in self.run_function:
+                x = fn(x)
+            return x
+        for fn in self.prologue:
+            x = fn(x)
+        leaves = [self._parameters[reg] for _, _, reg in self._pindex]
+        x = apply_op(self._pure_trunk, x, *leaves)
+        for fn in self.epilogue:
             x = fn(x)
         return x

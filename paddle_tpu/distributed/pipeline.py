@@ -167,6 +167,14 @@ class PipelineParallel:
         self._strategy = strategy
         self._step = None
         self._loss_fn = getattr(layers, "loss_fn", None)
+        # strategy.pipeline_configs["accumulate_steps"] is the reference's
+        # microbatch count; route it into the scan schedule
+        if strategy is not None and \
+                getattr(layers, "num_microbatches", None) is None:
+            acc = int(getattr(strategy, "pipeline_configs", {})
+                      .get("accumulate_steps", 1))
+            if acc > 1:
+                layers.num_microbatches = acc
 
     def __getattr__(self, name):
         return getattr(self.__dict__["_layers"], name)

@@ -305,3 +305,183 @@ class TestLlamaStackedTrunk:
                  shard_batch(mesh, paddle.to_tensor(labels), P()))
         loss = float(step(batch).item())
         assert np.isfinite(loss)
+
+
+class _Block(nn.Layer):
+    """Structurally-identical trunk unit for PipelineLayer tests."""
+
+    def __init__(self, d):
+        super().__init__()
+        self.fc1 = nn.Linear(d, d * 2)
+        self.fc2 = nn.Linear(d * 2, d)
+
+    def forward(self, x):
+        return x + self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class TestPipelineLayerSpmd:
+    """VERDICT r1 #2: the fleet PipelineLayer API must actually route
+    into the scan+ppermute pipeline, with the 1F1B-class memory profile
+    (peak activation memory flat in the microbatch count)."""
+
+    def _model(self, S, d=8, units=None, num_microbatches=None,
+               recompute=0):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer)
+        units = units or 2 * S
+        return PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 4, d)]
+                   + [LayerDesc(_Block, d) for _ in range(units)]
+                   + [LayerDesc(nn.Linear, d, 2)],
+            num_stages=S, loss_fn=lambda o, y: ((o - y) ** 2).mean(),
+            num_microbatches=num_microbatches,
+            recompute_interval=recompute)
+
+    def test_trunk_detected_and_routed(self):
+        paddle.seed(0)
+        model = self._model(S=2)
+        assert model._pipelined
+        assert model._units == 4 and model._period == 1
+        assert len(model.prologue) == 1 and len(model.epilogue) == 1
+        # stacked params sharded over pp on dim 0
+        leaf = model._parameters[model._pindex[0][2]]
+        assert leaf.shape[0] == 2 and leaf._sharding_spec[0] == "pp"
+
+    def test_pp_forward_matches_serial(self):
+        paddle.seed(1)
+        model = self._model(S=2, num_microbatches=4)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(8, 4).astype("float32"))
+        ref = model(x).numpy()          # no mesh: sequential stacked scan
+        set_current_mesh(_pp_mesh(2))
+        out = model(x).numpy()          # pp=2: scan+ppermute pipeline
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_multi_layer_unit_period_detection(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer)
+        paddle.seed(2)
+        model = PipelineLayer(
+            layers=[LayerDesc(nn.Linear, 8, 8), LayerDesc(nn.ReLU)] * 4,
+            num_stages=2)
+        assert model._pipelined
+        assert model._period == 2 and model._units == 4
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(4, 8).astype("float32"))
+        ref = model(x).numpy()
+        set_current_mesh(_pp_mesh(2))
+        np.testing.assert_allclose(model(x).numpy(), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_trains_under_pp_mesh(self):
+        paddle.seed(3)
+        model = self._model(S=2, num_microbatches=2)
+        set_current_mesh(_pp_mesh(2))
+        from paddle_tpu.distributed.sharding_utils import place_model
+        place_model(model, _pp_mesh(2))
+        opt = optimizer.AdamW(learning_rate=1e-2,
+                              parameters=model.parameters())
+
+        def loss_fn(m, batch):
+            x, y = batch
+            return model.loss_fn(m(x), y)
+        step = TrainStep(model, loss_fn, opt)
+        rs = np.random.RandomState(2)
+        batch = (paddle.to_tensor(rs.randn(8, 4).astype("float32")),
+                 paddle.to_tensor(rs.randn(8, 2).astype("float32")))
+        losses = [float(step(batch).item()) for _ in range(8)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_heterogeneous_fallback_warns_and_runs(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer)
+        with pytest.warns(UserWarning, match="no periodic trunk"):
+            model = PipelineLayer(
+                layers=[LayerDesc(nn.Linear, 4, 8),
+                        LayerDesc(nn.Linear, 8, 2)],
+                num_stages=2)
+        assert not model._pipelined
+        x = paddle.to_tensor(np.zeros((2, 4), "float32"))
+        assert model(x).shape == [2, 2]
+
+    def test_mesh_degree_mismatch_raises(self):
+        paddle.seed(4)
+        model = self._model(S=4, units=4)
+        set_current_mesh(_pp_mesh(2))
+        x = paddle.to_tensor(np.zeros((4, 4), "float32"))
+        with pytest.raises(ValueError, match="pp=2"):
+            model(x)
+
+    def test_peak_memory_flat_in_microbatches(self):
+        """1F1B's contract: at fixed stage count and GLOBAL batch, more
+        microbatches must not increase peak activation memory (with
+        per-unit remat the scan saves only the (mb, d) carries)."""
+        paddle.seed(5)
+        S, d, b = 4, 32, 32
+        mesh = _pp_mesh(S)
+        temps = {}
+        for M in (4, 16):
+            model = self._model(S=S, d=d, units=S, num_microbatches=M,
+                                recompute=1)
+            set_current_mesh(mesh)
+            leaves = [model._parameters[reg]._value
+                      for _, _, reg in model._pindex]
+            x = jnp.zeros((b, d), jnp.float32)
+
+            def loss(leafvals, xv):
+                return model._pure_trunk(xv, *leafvals).sum()
+
+            with mesh:
+                c = (jax.jit(jax.grad(loss))
+                     .lower(tuple(leaves), x).compile())
+            temps[M] = c.memory_analysis().temp_size_in_bytes
+            set_current_mesh(None)
+        assert temps[16] <= temps[4] * 1.25, temps
+
+    def test_distinct_activations_not_collapsed(self):
+        """F.relu vs F.gelu (and config-differing layers) must not be
+        treated as one periodic unit."""
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            _layer_signature)
+        import paddle_tpu.nn.functional as F
+        assert _layer_signature(F.relu) != _layer_signature(F.gelu)
+        assert (_layer_signature(nn.Dropout(0.1))
+                != _layer_signature(nn.Dropout(0.5)))
+
+    def test_shared_desc_forward_func_every_site(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            PipelineLayer, SharedLayerDesc)
+        calls = []
+
+        def fwd(layer, x):
+            calls.append(1)
+            return layer(x)
+        model = PipelineLayer(
+            layers=[SharedLayerDesc("e", nn.Linear, fwd, "weight", 4, 4),
+                    SharedLayerDesc("e", nn.Linear, fwd, "weight", 4, 4)],
+            num_stages=1)
+        # one parameter set (shared), applied twice through forward_func
+        assert len(model.parameters()) == 2  # weight + bias, once
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        model(x)
+        assert len(calls) == 2
+
+    def test_buffer_trunk_falls_back(self):
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            LayerDesc, PipelineLayer)
+
+        class BufBlock(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+                self.register_buffer("mu", paddle.to_tensor(
+                    np.zeros(4, "float32")))
+
+            def forward(self, x):
+                return self.fc(x) + self.mu
+        with pytest.warns(UserWarning, match="no periodic trunk"):
+            model = PipelineLayer(
+                layers=[LayerDesc(BufBlock) for _ in range(4)],
+                num_stages=2)
+        assert not model._pipelined
