@@ -18,7 +18,8 @@ from .mesh import (HybridCommunicateGroup, get_hybrid_communicate_group,
 from .auto_parallel_api import (ProcessMesh, shard_tensor, dtensor_from_fn,
                                 reshard, Shard, Replicate, Partial,
                                 Placement, shard_layer, shard_optimizer,
-                                to_static as dist_to_static, DistAttr)  # noqa
+                                to_static, DistAttr, Engine, DistModel)  # noqa
+dist_to_static = to_static  # back-compat alias
 from . import fleet                                               # noqa
 from . import checkpoint                                          # noqa
 from . import sharding                                            # noqa

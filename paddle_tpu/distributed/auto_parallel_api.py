@@ -22,7 +22,8 @@ from ..tensor import Tensor, Parameter
 
 __all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
            "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
-           "shard_optimizer", "to_static", "DistAttr"]
+           "shard_optimizer", "to_static", "DistAttr", "Engine",
+           "DistModel"]
 
 
 class Placement:
@@ -134,9 +135,12 @@ class DistAttr:
                f"placements={self.placements})"
 
 
-def _to_partition_spec(mesh: ProcessMesh, placements, ndim: int):
+def _to_partition_spec(mesh: ProcessMesh, placements, ndim: int,
+                       allow_partial: bool = False):
     """placements: one Placement per MESH dim (paddle convention) →
-    PartitionSpec over TENSOR dims."""
+    PartitionSpec over TENSOR dims. Partial placements are handled by
+    the caller (stacked contribution dims); reaching one here without
+    ``allow_partial`` is an error, never a silent drop."""
     spec = [None] * ndim
     for mesh_dim, p in enumerate(placements):
         if isinstance(p, Shard):
@@ -147,25 +151,69 @@ def _to_partition_spec(mesh: ProcessMesh, placements, ndim: int):
                 spec[p.dim] = spec[p.dim] + (axis_name,)
             else:
                 spec[p.dim] = (spec[p.dim], axis_name)
+        elif isinstance(p, Partial) and not allow_partial:
+            raise ValueError(
+                "Partial placement must go through shard_tensor/reshard "
+                "(stacked contribution representation); it cannot be "
+                "expressed as a plain PartitionSpec.")
     return PartitionSpec(*spec)
+
+
+def _partial_mesh_dims(placements):
+    return [i for i, p in enumerate(placements) if isinstance(p, Partial)]
+
+
+def _place_with_partial(value, mesh: ProcessMesh, placements):
+    """Build the on-device representation for ``placements`` from a
+    DENSE value.
+
+    Partial(axis) is represented as an explicit leading contribution dim
+    of size mesh[axis], sharded over that axis (TPU-native 'unreduced'
+    state: the global value is the sum over the dim — summing it lowers
+    to a psum over the axis, exactly the reference's p→r AllReduce
+    reshard). For a fresh partial tensor, slot 0 carries the full value
+    and the rest are zero, matching TensorDistAttr partial init."""
+    pdims = _partial_mesh_dims(placements)
+    base_spec = _to_partition_spec(mesh, placements, value.ndim,
+                                   allow_partial=True)
+    if not pdims:
+        return jax.device_put(value, NamedSharding(mesh.jax_mesh,
+                                                   base_spec)), []
+    axis_names = [mesh.dim_names[d] for d in pdims]
+    import jax.numpy as jnp
+    for d in reversed(pdims):
+        k = mesh.shape[d]
+        pad = jnp.zeros((k - 1,) + value.shape, value.dtype)
+        value = jnp.concatenate([value[None], pad], axis=0)
+    spec = PartitionSpec(*axis_names, *tuple(base_spec))
+    return jax.device_put(value, NamedSharding(mesh.jax_mesh, spec)), \
+        axis_names
 
 
 def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None,
                  stop_gradient=None):
     """Places `x` on the mesh with the given placements; ops consume it and
-    GSPMD propagates (reference: dist.shard_tensor creating DistTensor)."""
+    GSPMD propagates (reference: dist.shard_tensor creating DistTensor).
+    Partial placements produce an unreduced tensor resolved (psum) on
+    first consumption — see tensor._departial."""
     t = x if isinstance(x, Tensor) else Tensor(jax.numpy.asarray(x))
-    spec = _to_partition_spec(mesh, placements, t._value.ndim)
-    sharding = NamedSharding(mesh.jax_mesh, spec)
-    v = jax.device_put(t._value, sharding)
+    pdims = _partial_mesh_dims(placements)
+    if pdims and isinstance(t, Parameter):
+        raise ValueError("Partial placement on a Parameter is not "
+                         "supported (parameters are dense state)")
+    # a Partial source contributes its DENSE (summed) value
+    v, partial_axes = _place_with_partial(t._dense_value(), mesh,
+                                          placements)
     if isinstance(t, Parameter):
         t._update_value(v)
         out = t
+        out._sharding_spec = _to_partition_spec(mesh, placements,
+                                                t._value.ndim)
     else:
         out = Tensor(v, stop_gradient=t.stop_gradient
                      if stop_gradient is None else stop_gradient)
-    if isinstance(out, Parameter):
-        out._sharding_spec = spec
+        if partial_axes:
+            out._partial_axes = partial_axes
     out.dist_attr = DistAttr(mesh, placements)
     out.process_mesh = mesh
     out.placements = list(placements)
@@ -177,12 +225,20 @@ def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
 
 
 def reshard(x, mesh: ProcessMesh, placements):
-    """Move a dist tensor to new placements — the whole reshard function
-    family of the reference collapses to one device_put (XLA figures out
-    all_gather / slice / all-to-all)."""
-    spec = _to_partition_spec(mesh, placements, x._value.ndim)
-    v = jax.device_put(x._value, NamedSharding(mesh.jax_mesh, spec))
+    """Move a dist tensor to new placements — the s↔r reshard family of
+    the reference collapses to one device_put (XLA figures out
+    all_gather / slice / all-to-all). Partial transitions:
+
+    - p → r/s: sum the contribution dims (psum over the partial axes;
+      p→s additionally reshards, i.e. reduce-scatter under jit)
+    - r/s → p: slot 0 of the new contribution dim carries the value,
+      the rest are zero (reference TensorDistAttr partial init)
+    """
+    v, partial_axes = _place_with_partial(x._dense_value(), mesh,
+                                          placements)
     out = Tensor(v, stop_gradient=x.stop_gradient)
+    if partial_axes:
+        out._partial_axes = partial_axes
     out.dist_attr = DistAttr(mesh, placements)
     out.process_mesh = mesh
     out.placements = list(placements)
@@ -205,20 +261,228 @@ def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
 
 
 def shard_optimizer(optimizer, shard_fn=None):
-    """ZeRO-style optimizer-state sharding: slots inherit parameter
-    shardings automatically (they are created zeros_like on the sharded
-    param); a custom shard_fn can re-place them."""
+    """Re-place optimizer accumulator slots (reference:
+    dist.shard_optimizer / _ShardOptimizer — verify).
+
+    Default: every slot adopts its parameter's placements (so a Shard(0)
+    param gets Shard(0) moments — the semi-auto analogue of sharded
+    optimizer states). A custom ``shard_fn(accumulator_name, param)``
+    (reference signature: accumulator name like "m"/"v"/"master", then
+    the Parameter) may return a list of Placements (requires the param
+    to carry dist_attr) or ``None`` to keep the default.
+
+    Works through the optimizer's ``_slot_constrain`` hook so slots
+    created lazily inside a jitted TrainStep are placed identically."""
+    params = {n: p for n, p in zip(optimizer._param_names,
+                                   optimizer._param_list)}
+
+    def _constrain(slot_value, pname, slot_name=None):
+        p = params.get(pname)
+        if p is None:
+            return slot_value
+        placements = None
+        if shard_fn is not None:
+            placements = shard_fn(slot_name, p)
+        if placements is not None:
+            mesh = getattr(p, "process_mesh", None)
+            if mesh is None:
+                raise ValueError(
+                    f"shard_fn returned placements for '{pname}' but the "
+                    "param has no process_mesh (use dist.shard_tensor on "
+                    "it first)")
+            if slot_value.ndim == 0:   # beta powers etc. stay replicated
+                return slot_value
+            spec = _to_partition_spec(mesh, placements, slot_value.ndim)
+            return jax.lax.with_sharding_constraint(
+                slot_value, NamedSharding(mesh.jax_mesh, spec)) \
+                if _is_traced(slot_value) else jax.device_put(
+                    slot_value, NamedSharding(mesh.jax_mesh, spec))
+        # default: adopt the param's sharding
+        sharding = getattr(p._value, "sharding", None)
+        if sharding is None or slot_value.shape != p._value.shape:
+            return slot_value
+        return jax.lax.with_sharding_constraint(slot_value, sharding) \
+            if _is_traced(slot_value) else jax.device_put(slot_value,
+                                                          sharding)
+
+    optimizer._slot_constrain = _constrain
+    # re-place any slots that already exist
+    for pname, slots in optimizer._slots.items():
+        optimizer._slots[pname] = {k: _constrain(v, pname)
+                                   for k, v in slots.items()}
     return optimizer
 
 
-def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
-    """dist.to_static: returns a DistModel-like compiled trainer (the
-    static auto-parallel Engine path). First-cut: TrainStep with sharded
-    params already placed by shard_tensor/shard_layer."""
-    from ..jit import TrainStep
+def _is_traced(v):
+    import jax.core
+    return isinstance(v, jax.core.Tracer)
 
-    def loss_fn(model, batch):
+
+class Engine:
+    """Static auto-parallel engine (reference:
+    python/paddle/distributed/auto_parallel/static/engine.py — verify:
+    Engine.prepare → completion/partition/reshard pass pipeline;
+    Engine.fit/evaluate/predict drive the partitioned program).
+
+    TPU-native: `prepare` AOT-lowers ONE jitted SPMD train step (GSPMD is
+    the completion+partitioner+reshard pipeline); fit/evaluate/predict
+    drive it. ``cost()`` surfaces the compiled cost model the reference
+    exposes through its cost estimator."""
+
+    def __init__(self, model, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy
+        self._step = None
+        self._compiled = None
+        self.history = {"loss": []}
+
+    def _loss_fn(self, model, batch):
         x, y = batch
         out = model(x)
-        return loss(out, y)
-    return TrainStep(layer, loss_fn, optimizer)
+        return self._loss(out, y)
+
+    def _ensure_step(self):
+        if self._step is None:
+            from ..jit import TrainStep
+            if self._loss is None or self._optimizer is None:
+                raise ValueError("Engine.fit needs loss and optimizer")
+            self._step = TrainStep(self._model, self._loss_fn,
+                                   self._optimizer)
+        return self._step
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Build the jitted SPMD step; with specs (jax.ShapeDtypeStruct
+        or example Tensors) also AOT-compile it so `cost()` is
+        available. Returns self."""
+        step = self._ensure_step()
+        if inputs_spec is not None:
+            if labels_spec is None:
+                raise ValueError(
+                    "prepare(inputs_spec, labels_spec): labels_spec is "
+                    "required when inputs_spec is given (the step takes "
+                    "an (inputs, labels) batch)")
+            self._compiled = step.lower((inputs_spec, labels_spec)) \
+                .compile()
+        return self
+
+    def cost(self):
+        if self._compiled is None:
+            raise ValueError("call prepare(inputs_spec, labels_spec) first")
+        ca = self._compiled.cost_analysis()
+        ma = self._compiled.memory_analysis()
+        return {"flops": ca.get("flops", 0.0),
+                "bytes_accessed": ca.get("bytes accessed", 0.0),
+                "peak_temp_bytes": ma.temp_size_in_bytes,
+                "argument_bytes": ma.argument_size_in_bytes}
+
+    def dataloader(self, dataset, batch_size=32, shuffle=False,
+                   mode="train"):
+        from ..io import DataLoader, DistributedBatchSampler
+        sampler = DistributedBatchSampler(dataset, batch_size=batch_size,
+                                          shuffle=shuffle)
+        return DataLoader(dataset, batch_sampler=sampler)
+
+    def _resolve_loader(self, data, batch_size):
+        """Dataset → wrap in a distributed loader; anything else
+        (DataLoader, generator, list of pre-built batches) is iterated
+        as-is."""
+        from ..io import Dataset
+        if isinstance(data, Dataset):
+            return self.dataloader(data, batch_size=batch_size)
+        return data
+
+    def fit(self, train_data, epochs=1, batch_size=32, verbose=0,
+            log_freq=50):
+        step = self._ensure_step()
+        loader = self._resolve_loader(train_data, batch_size)
+        for epoch in range(epochs):
+            for it, batch in enumerate(loader):
+                loss = step(tuple(batch))
+                self.history["loss"].append(float(loss.item()))
+                if verbose and it % log_freq == 0:
+                    print(f"epoch {epoch} step {it}: "
+                          f"loss {self.history['loss'][-1]:.4f}")
+        return self.history
+
+    def evaluate(self, eval_data, batch_size=32):
+        losses = []
+        loader = self._resolve_loader(eval_data, batch_size)
+        from .. import framework
+        with framework.no_grad_guard():
+            for batch in loader:
+                x, y = batch
+                losses.append(float(self._loss(self._model(x), y).item()))
+        return {"loss": sum(losses) / max(len(losses), 1)}
+
+    def predict(self, test_data, batch_size=32):
+        outs = []
+        loader = self._resolve_loader(test_data, batch_size)
+        from .. import framework
+        with framework.no_grad_guard():
+            for batch in loader:
+                x = batch[0] if isinstance(batch, (tuple, list)) else batch
+                outs.append(self._model(x))
+        return outs
+
+    def state_dict(self):
+        return self._model.state_dict()
+
+    def save(self, path):
+        from .. import save
+        save(self._model.state_dict(), path)
+
+    def load(self, path):
+        from .. import load
+        self._model.set_state_dict(load(path))
+
+
+class DistModel:
+    """dist.to_static return type (reference: DistModel — verify): call
+    it with a batch to run one optimized step in train mode, or a
+    forward in eval/predict mode."""
+
+    def __init__(self, engine: Engine):
+        self._engine = engine
+        self._mode = "train"
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def predict(self):
+        self._mode = "predict"
+
+    @property
+    def mode(self):
+        return self._mode
+
+    def state_dict(self):
+        return self._engine.state_dict()
+
+    def __call__(self, *batch):
+        if len(batch) == 1 and isinstance(batch[0], (tuple, list)):
+            batch = tuple(batch[0])
+        if self._mode == "train":
+            return self._engine._ensure_step()(tuple(batch))
+        from .. import framework
+        model = self._engine._model
+        with framework.no_grad_guard():
+            if self._mode == "eval":
+                x, y = batch
+                return self._engine._loss(model(x), y)
+            return model(batch[0])
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """dist.to_static: wrap a (sharded) layer into a DistModel driven by
+    the static auto-parallel Engine (one jitted SPMD step; GSPMD plays
+    the reference's completion→partition→reshard pass pipeline)."""
+    engine = Engine(layer, loss=loss, optimizer=optimizer,
+                    strategy=strategy)
+    return DistModel(engine)

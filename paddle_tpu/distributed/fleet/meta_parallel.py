@@ -435,7 +435,7 @@ class PipelineLayer(Layer):
             h = Tensor(hv)
             with framework.functional_mode():
                 for proto in self._protos:
-                    h = proto(h) if isinstance(proto, Layer) else proto(h)
+                    h = proto(h)
             return h._value
         finally:
             for t, v in saved:

@@ -80,7 +80,7 @@ class ShardingConstrainer:
     def __init__(self, axis: str):
         self.axis = axis
 
-    def __call__(self, value, pname=None):
+    def __call__(self, value, pname=None, slot=None):
         mesh = get_current_mesh()
         if mesh is None or not hasattr(value, "ndim") or value.ndim == 0:
             return value

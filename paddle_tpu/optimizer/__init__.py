@@ -95,7 +95,7 @@ class Optimizer:
         self._step_count = 0
         # group-sharded (ZeRO) placement hooks, set by
         # paddle_tpu.distributed.sharding.group_sharded_parallel
-        self._slot_constrain = None   # (array, pname) -> sharded array
+        self._slot_constrain = None   # (array, pname, slot) -> sharded
         self._grad_constrain = None
         names, seen = [], set()
         for i, p in enumerate(self._param_list):
@@ -141,7 +141,7 @@ class Optimizer:
                     jnp.float16, jnp.bfloat16):
                 slots["master"] = p._value.astype(jnp.float32)
             if self._slot_constrain is not None:
-                slots = {k: self._slot_constrain(v, name)
+                slots = {k: self._slot_constrain(v, name, k)
                          for k, v in slots.items()}
             self._slots[name] = slots
         return self._slots[name]
@@ -241,7 +241,7 @@ class Optimizer:
                 new_params[n], new_slots[n] = self._apply(p, g, s, lr_value,
                                                           step)
         if self._slot_constrain is not None:
-            new_slots = {n: {k: self._slot_constrain(v, n)
+            new_slots = {n: {k: self._slot_constrain(v, n, k)
                              for k, v in s.items()}
                          for n, s in new_slots.items()}
         return new_params, {"slots": new_slots, "step": step}
@@ -268,7 +268,7 @@ class Optimizer:
             n, slot = k.rsplit(".", 1)
             val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
             if self._slot_constrain is not None:
-                val = self._slot_constrain(val, n)
+                val = self._slot_constrain(val, n, slot)
             self._slots.setdefault(n, {})[slot] = val
 
     def _wd(self, p, g):

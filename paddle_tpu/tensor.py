@@ -77,6 +77,11 @@ class Tensor:
                  "name", "persistable", "is_leaf", "trainable",
                  # semi-auto parallel metadata (set by dist.shard_tensor)
                  "dist_attr", "process_mesh", "placements",
+                 # Partial placement: names of mesh axes over which _value
+                 # carries an UNREDUCED leading contribution dim each (the
+                 # global value is the sum over those dims); resolved to a
+                 # dense value on first consumption (dist.reshard p→r)
+                 "_partial_axes",
                  # static-graph mode: producer record (paddle_tpu.static)
                  "_static_src", "__weakref__")
 
@@ -99,11 +104,13 @@ class Tensor:
 
     @property
     def shape(self):
-        return list(self._value.shape)
+        np_ = len(getattr(self, "_partial_axes", None) or ())
+        return list(self._value.shape[np_:])
 
     @property
     def ndim(self):
-        return self._value.ndim
+        np_ = len(getattr(self, "_partial_axes", None) or ())
+        return self._value.ndim - np_
 
     @property
     def dtype(self):
@@ -137,32 +144,38 @@ class Tensor:
         return jnp.dtype(self.dtype).itemsize
 
     # -- host interop -------------------------------------------------------
+    def _dense_value(self):
+        """Value with any Partial contribution dims summed out."""
+        np_ = len(getattr(self, "_partial_axes", None) or ())
+        return self._value.sum(axis=tuple(range(np_))) if np_ \
+            else self._value
+
     def numpy(self):
-        return np.asarray(self._value)
+        return np.asarray(self._dense_value())
 
     def item(self):
-        return self._value.item()
+        return self._dense_value().item()
 
     def tolist(self):
-        return np.asarray(self._value).tolist()
+        return np.asarray(self._dense_value()).tolist()
 
     def __array__(self, dtype=None):
-        a = np.asarray(self._value)
+        a = np.asarray(self._dense_value())
         return a.astype(dtype) if dtype is not None else a
 
     def __float__(self):
-        return float(self._value)
+        return float(self._dense_value())
 
     def __int__(self):
-        return int(self._value)
+        return int(self._dense_value())
 
     def __bool__(self):
-        return bool(self._value)
+        return bool(self._dense_value())
 
     def __len__(self):
         if self.ndim == 0:
             raise TypeError("len() of a 0-D tensor")
-        return self._value.shape[0]
+        return self.shape[0]
 
     def __hash__(self):
         return id(self)
@@ -170,7 +183,7 @@ class Tensor:
     def __repr__(self):
         grad_s = "" if self.stop_gradient else ", stop_gradient=False"
         return (f"Tensor(shape={self.shape}, dtype={jnp.dtype(self.dtype).name}"
-                f"{grad_s},\n       {np.asarray(self._value)!r})")
+                f"{grad_s},\n       {np.asarray(self._dense_value())!r})")
 
     # -- autograd -----------------------------------------------------------
     def backward(self, grad_tensor: Optional["Tensor"] = None,
@@ -431,6 +444,22 @@ def _apply_op_static(fn, args, kwargs, tensor_pos):
     return outs[0]
 
 
+def _departial(t: "Tensor") -> "Tensor":
+    """Resolve a Partial-placed tensor (leading unreduced contribution
+    dims, see dist.shard_tensor) into its dense global value — the
+    reference's implicit p→r reshard on consumption. The sum over the
+    stacked dim lowers to a psum over the partial mesh axis."""
+    axes = getattr(t, "_partial_axes", None)
+    if not axes:
+        return t
+    k = len(axes)
+    stripped = Tensor(t._value, stop_gradient=t.stop_gradient)
+    stripped._node = t._node
+    stripped._out_index = t._out_index
+    stripped.is_leaf = t.is_leaf
+    return apply_op(lambda v: v.sum(axis=tuple(range(k))), stripped)
+
+
 def apply_op(fn, *args, **kwargs):
     """Run pure-jax `fn` on Tensor/array args; record vjp on the tape when
     eager grad is enabled and any Tensor input requires grad.
@@ -439,6 +468,12 @@ def apply_op(fn, *args, **kwargs):
     statics. Returns Tensor or tuple/list of Tensors mirroring fn's output.
     """
     tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    if tensor_pos and any(
+            getattr(args[i], "_partial_axes", None) for i in tensor_pos):
+        args = list(args)
+        for i in tensor_pos:
+            args[i] = _departial(args[i])
+        args = tuple(args)
 
     if framework.in_static_mode() and not framework.in_functional_mode():
         return _apply_op_static(fn, args, kwargs, tensor_pos)
@@ -491,7 +526,7 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
     """paddle.to_tensor parity (reference: python/paddle/tensor/creation.py
     — verify)."""
     if isinstance(data, Tensor):
-        v = data._value
+        v = data._dense_value()  # Partial tensors copy as dense values
         if dtype is not None:
             v = v.astype(convert_dtype(dtype))
         return Tensor(v, stop_gradient=stop_gradient)
