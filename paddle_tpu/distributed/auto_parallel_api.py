@@ -308,7 +308,7 @@ def shard_optimizer(optimizer, shard_fn=None):
     optimizer._slot_constrain = _constrain
     # re-place any slots that already exist
     for pname, slots in optimizer._slots.items():
-        optimizer._slots[pname] = {k: _constrain(v, pname)
+        optimizer._slots[pname] = {k: _constrain(v, pname, k)
                                    for k, v in slots.items()}
     return optimizer
 

@@ -275,7 +275,9 @@ class TrainStep:
         key = framework.split_key()
         lr_value = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         batch_vals = jax.tree.map(
-            lambda x: x._value if isinstance(x, Tensor) else jnp.asarray(x),
+            lambda x: x._value if isinstance(x, Tensor)
+            else x if isinstance(x, jax.ShapeDtypeStruct)  # AOT specs
+            else jnp.asarray(x),
             batch, is_leaf=lambda x: isinstance(x, Tensor))
         return pvals, opt_state, bvals, fvals, key, lr_value, batch_vals
 
@@ -349,7 +351,9 @@ class EvalStep:
         bvals = {n: t._value for n, t in self._btensors.items()}
         key = framework.split_key()
         batch_vals = jax.tree.map(
-            lambda x: x._value if isinstance(x, Tensor) else jnp.asarray(x),
+            lambda x: x._value if isinstance(x, Tensor)
+            else x if isinstance(x, jax.ShapeDtypeStruct)  # AOT specs
+            else jnp.asarray(x),
             batch, is_leaf=lambda x: isinstance(x, Tensor))
         out = self._jitted(pvals, bvals, key, batch_vals)
         return jax.tree.map(Tensor, out)
