@@ -79,6 +79,27 @@ def _bench_train(model_cfg, batch, seq, steps, warmup, peak,
             "step_ms": round(dt / steps * 1000, 2)}
 
 
+def _bench_decode(model_cfg, batch, prompt, new_tokens):
+    """KV-cache autoregressive decode throughput (jitted decode step)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(model_cfg)
+    ids = paddle.to_tensor(np.random.randint(
+        0, model_cfg.vocab_size, (batch, prompt)).astype(np.int32))
+    # warmup with IDENTICAL shapes (same cache length) so the timed run
+    # reuses the compiled prefill + decode step
+    model.generate(ids, max_new_tokens=new_tokens)
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new_tokens)
+    assert out.shape[1] == prompt + new_tokens
+    dt = time.perf_counter() - t0
+    return {"decode_tokens_per_sec": round(batch * new_tokens / dt, 1),
+            "decode_batch": batch, "decode_prompt": prompt,
+            "decode_new_tokens": new_tokens}
+
+
 def _child_tpu():
     """Runs under the default (axon TPU) platform. Benches a 0.2B config
     and the largest Llama that fits one chip in bf16, reports the Pallas
@@ -115,6 +136,13 @@ def _child_tpu():
                              peak=peak)
         big = None
 
+    if on_tpu:
+        decode = _bench_decode(cfg_small, batch=8, prompt=128,
+                               new_tokens=128)
+    else:
+        decode = _bench_decode(llama_tiny_config(tensor_parallel=False),
+                               batch=2, prompt=16, new_tokens=16)
+
     from paddle_tpu.ops.pallas import flash_attention as fa
     head = big or small
     print("BENCH_JSON " + json.dumps({
@@ -127,6 +155,7 @@ def _child_tpu():
         "sdpa_dispatch": fa.sdpa_last_dispatch(),
         "config_small": small,
         "config_big": big,
+        **decode,
         **{k: head[k] for k in ("model_params", "batch", "seq",
                                 "final_loss", "step_ms")},
     }))

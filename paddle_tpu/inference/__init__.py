@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "export_model",
-           "convert_to_predictor", "PrecisionType"]
+           "convert_to_predictor", "PrecisionType", "export_decoder",
+           "GenerationPredictor"]
 
 
 class PrecisionType:
@@ -220,6 +221,116 @@ class Predictor:
             self._outputs[i].shape, self._outputs[i].dtype))
         h._value = self._outputs[i]
         return h
+
+
+def export_decoder(model, path: str, batch: int, prompt_len: int,
+                   max_len: int, temperature: float = 0.0,
+                   top_k: int = 0, top_p: float = 1.0):
+    """AOT-export the autoregressive serving path of a causal LM: TWO
+    StableHLO programs — prefill (prompt → first token + KV cache) and
+    decode step (token, cache, pos → next token, cache) — plus weights
+    (reference: AnalysisPredictor serving autoregressive models,
+    SURVEY §3.5; the decode loop then runs without Python tracing).
+
+    The model must implement ``init_kv_cache`` and a cached ``forward``
+    (see models/generation.GenerationMixin). The SAME pure step function
+    as GenerationMixin.generate is exported twice — once specialized to
+    the prompt block at pos=0 (prefill, cache zero-initialized inside),
+    once to a single token — so in-process and served decoding share one
+    implementation."""
+    from ..models.generation import build_decode_step
+    from ..tensor import Tensor
+
+    sample_kwargs = dict(temperature=temperature, top_k=top_k,
+                         top_p=top_p)
+    pvals = [p._value for _, p in model.named_parameters()]
+    bvals = [b._value for _, b in model.named_buffers()]
+    cache0 = model.init_kv_cache(batch, max_len)
+    flat0, tree = jax.tree.flatten(
+        cache0, is_leaf=lambda x: isinstance(x, Tensor))
+    cache_specs = tuple(jax.ShapeDtypeStruct(c._value.shape,
+                                             c._value.dtype)
+                        for c in flat0)
+    tree_holder = {"tree": tree}
+    step = build_decode_step(model, sample_kwargs, tree_holder)
+
+    def prefill(pv, bv, ids, key):
+        zero_cache = tuple(jnp.zeros(s.shape, s.dtype)
+                           for s in cache_specs)
+        return step(pv, bv, ids, zero_cache,
+                    jnp.asarray(0, jnp.int32), key)
+
+    pspecs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in pvals]
+    bspecs = [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in bvals]
+    ids_spec = jax.ShapeDtypeStruct((batch, prompt_len), jnp.int32)
+    tok_spec = jax.ShapeDtypeStruct((batch, 1), jnp.int32)
+    pos_spec = jax.ShapeDtypeStruct((), jnp.int32)
+    key_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    exp_prefill = jax.export.export(jax.jit(prefill))(
+        pspecs, bspecs, ids_spec, key_spec)
+    exp_step = jax.export.export(jax.jit(step))(
+        pspecs, bspecs, tok_spec, cache_specs, pos_spec, key_spec)
+    blob = {
+        "prefill": exp_prefill.serialize(),
+        "step": exp_step.serialize(),
+        "params": [np.asarray(v) for v in pvals],
+        "buffers": [np.asarray(v) for v in bvals],
+        "gen_config": {"batch": batch, "prompt_len": prompt_len,
+                       "max_len": max_len, **sample_kwargs},
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    out = path + ".pdgen"
+    with open(out, "wb") as f:
+        pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+    return out
+
+
+class GenerationPredictor:
+    """Serving-side decode loop over the AOT artifact of
+    :func:`export_decoder` — no model code or tracing needed."""
+
+    def __init__(self, path: str):
+        if not path.endswith(".pdgen"):
+            path = path + ".pdgen"
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self._prefill = jax.export.deserialize(blob["prefill"])
+        self._step = jax.export.deserialize(blob["step"])
+        self._params = [jnp.asarray(v) for v in blob["params"]]
+        self._buffers = [jnp.asarray(v) for v in blob["buffers"]]
+        self.gen_config = blob["gen_config"]
+
+    def generate(self, input_ids: np.ndarray, max_new_tokens: int = 20,
+                 seed: int = 0) -> np.ndarray:
+        cfg = self.gen_config
+        ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        b, s = ids.shape
+        if (b, s) != (cfg["batch"], cfg["prompt_len"]):
+            raise ValueError(
+                f"input shape {(b, s)} != exported "
+                f"({cfg['batch']}, {cfg['prompt_len']})")
+        if max_new_tokens <= 0:
+            return np.asarray(ids)
+        capacity = cfg["max_len"] - s
+        if max_new_tokens > capacity:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} exceeds the exported "
+                f"cache capacity ({capacity} = max_len {cfg['max_len']} "
+                f"- prompt {s}); re-export with a larger max_len")
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        tok, cache = self._prefill.call(self._params, self._buffers,
+                                        ids, sub)
+        toks = [tok]
+        for i in range(1, max_new_tokens):
+            key, sub = jax.random.split(key)
+            pos = jnp.asarray(s + i - 1, jnp.int32)
+            tok, cache = self._step.call(self._params, self._buffers,
+                                         tok[:, None], tuple(cache),
+                                         pos, sub)
+            toks.append(tok)
+        gen = jnp.stack(toks, axis=1)
+        return np.asarray(jnp.concatenate([ids, gen], axis=1))
 
 
 def create_predictor(config: Config) -> Predictor:

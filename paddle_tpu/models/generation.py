@@ -1,0 +1,168 @@
+"""Autoregressive generation: KV cache + jitted decode loop.
+
+Reference parity: PaddleNLP GenerationMixin (greedy/sampling decode with
+cache) and the reference inference engine's autoregressive path (SURVEY
+§2.1 Inference, §3.5 AnalysisPredictor) — verify.
+
+TPU-native design: the KV cache is a functional pytree of preallocated
+(b, max_len, kv_heads, head_dim) arrays updated with
+``lax.dynamic_update_slice`` (static shapes — no concat-growing cache,
+which would retrace every step). ONE pure step function serves both
+prefill (token block of length s, pos=0) and decode (length 1); it is
+jitted once per sampling config and cached on the model, so repeated
+``generate()`` calls reuse the compiled programs. Sampling
+(temperature / top-k / top-p) runs inside the program.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..tensor import Tensor
+
+__all__ = ["GenerationMixin", "sample_logits", "build_decode_step"]
+
+
+def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
+    """Sample token ids from (b, V) logits (pure jax; runs inside the
+    jitted decode step). temperature<=0 → greedy."""
+    if temperature is None or temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.asarray(temperature, logits.dtype)
+    v = logits.shape[-1]
+    if top_k and 0 < top_k < v:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set with cumulative prob >= top_p (always
+        # keep the best token)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def build_decode_step(model, sample_kwargs, tree_holder):
+    """The shared pure step: (params, bufs, token_block, cache_flat,
+    pos, key) → (next_token, new_cache_flat). Serves prefill (block of
+    length s at pos=0) and decode (length 1) — jit/retrace handles the
+    two shapes within one compiled-function cache. Used by both
+    GenerationMixin.generate and inference.export_decoder."""
+    ptensors = [p for _, p in model.named_parameters()]
+    btensors = [b for _, b in model.named_buffers()]
+
+    def pure(pv, bv, token, cache_flat, pos, key):
+        saved = [(t, t._value) for t in ptensors + btensors]
+        was_training = model.training
+        try:
+            for t, v in zip(ptensors, pv):
+                t._value = v
+            for t, v in zip(btensors, bv):
+                t._value = v
+            model.eval()   # no dropout inside the decode program
+            cache = jax.tree.unflatten(tree_holder["tree"], [
+                Tensor(c) for c in cache_flat])
+            with framework.functional_mode(), framework.no_grad_guard():
+                logits, new_cache = model.forward(
+                    Tensor(token), cache=cache, pos=Tensor(pos))
+            lv = logits._value[:, -1, :].astype(jnp.float32)
+            nt = sample_logits(lv, key, **sample_kwargs)
+            new_flat = [c._value for c in jax.tree.leaves(
+                new_cache, is_leaf=lambda x: isinstance(x, Tensor))]
+            return nt.astype(jnp.int32), tuple(new_flat)
+        finally:
+            for t, v in saved:
+                t._value = v
+            if was_training:
+                model.train()
+
+    return pure
+
+
+class GenerationMixin:
+    """Adds ``generate()`` to a causal LM whose forward supports
+    ``forward(input_ids, cache=cache, pos=pos) -> (logits, new_cache)``
+    and which implements ``init_kv_cache(batch, max_len, dtype)``."""
+
+    def _decode_fn(self, sample_kwargs):
+        """Jitted decode step, cached on the model per sampling config
+        (jax.jit caches by function identity — a fresh closure per call
+        would recompile every generate())."""
+        cache = self.__dict__.setdefault("_decode_fn_cache", {})
+        key = tuple(sorted(sample_kwargs.items()))
+        if key not in cache:
+            tree_holder = {"tree": None}
+            pure = build_decode_step(self, sample_kwargs, tree_holder)
+            cache[key] = (jax.jit(pure, donate_argnums=(3,)), tree_holder)
+        return cache[key]
+
+    def generate(self, input_ids, max_new_tokens: int = 20,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, do_sample: bool = False,
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 max_length: Optional[int] = None):
+        """Greedy (temperature<=0 / do_sample=False) or sampled decoding
+        with a preallocated KV cache and one jitted decode step.
+
+        Returns (b, s+new) int Tensor of prompt + generated ids (rows
+        that hit ``eos_token_id`` are padded with eos)."""
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(jnp.asarray(np.asarray(input_ids), jnp.int32))
+        b, s = ids.shape
+        total = max_length or (s + max_new_tokens)
+        max_new = total - s
+        if max_new <= 0:
+            return ids
+        limit = getattr(getattr(self, "config", None),
+                        "max_position_embeddings", None)
+        if limit is not None and total > limit:
+            raise ValueError(
+                f"prompt ({s}) + new tokens ({max_new}) = {total} exceeds "
+                f"max_position_embeddings={limit}; positions past the "
+                "RoPE/position table would silently clamp")
+        if not do_sample:
+            temperature = 0.0
+        sample_kwargs = dict(temperature=temperature, top_k=top_k,
+                             top_p=top_p)
+        cache = self.init_kv_cache(b, total)
+        flat, tree = jax.tree.flatten(
+            cache, is_leaf=lambda x: isinstance(x, Tensor))
+        decode, tree_holder = self._decode_fn(sample_kwargs)
+        tree_holder["tree"] = tree
+        cache_flat = tuple(c._value for c in flat)
+        ptensors = [p for _, p in self.named_parameters()]
+        btensors = [t for _, t in self.named_buffers()]
+        pv = [p._value for p in ptensors]
+        bv = [t._value for t in btensors]
+
+        key = jax.random.PRNGKey(seed)
+        key, sub = jax.random.split(key)
+        ids_arr = ids._value.astype(jnp.int32)
+        # prefill: the same compiled step with a length-s block at pos 0
+        tok, cache_flat = decode(pv, bv, ids_arr, cache_flat,
+                                 jnp.asarray(0, jnp.int32), sub)
+
+        out_tokens = [tok]
+        finished = jnp.zeros((b,), bool)
+        if eos_token_id is not None:
+            finished = finished | (tok == eos_token_id)
+        for i in range(1, max_new):
+            key, sub = jax.random.split(key)
+            pos = jnp.asarray(s + i - 1, jnp.int32)
+            tok, cache_flat = decode(pv, bv, tok[:, None], cache_flat,
+                                     pos, sub)
+            if eos_token_id is not None:
+                tok = jnp.where(finished, eos_token_id, tok)
+                finished = finished | (tok == eos_token_id)
+            out_tokens.append(tok)
+            if eos_token_id is not None and bool(finished.all()):
+                break
+        gen = jnp.stack(out_tokens, axis=1)
+        return Tensor(jnp.concatenate([ids_arr, gen], axis=1))

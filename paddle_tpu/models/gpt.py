@@ -14,12 +14,14 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .. import nn
 from ..nn import functional as F
 from ..ops.creation import arange
 from ..ops.manipulation import reshape
+from .generation import GenerationMixin
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny_config",
            "gpt2_small_config", "gpt2_medium_config", "gpt2_large_config"]
@@ -75,11 +77,27 @@ class GPTAttention(nn.Layer):
             self.qkv_proj.bias._sharding_spec = P("mp")
             self.out_proj.weight._sharding_spec = P("mp", None)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None, pos=None):
         b, s, h = x.shape
         qkv = reshape(self.qkv_proj(x), (b, s, 3, self.num_heads,
                                          self.head_dim))
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cache is not None:
+            import functools
+            import math as _math
+            from .llama import _cached_attention
+            from ..tensor import apply_op
+            ck, cv = cache
+            # identity "rope": cos=1, sin=0 (GPT has learned positions)
+            max_len = ck.shape[1]
+            ones = jnp.ones((max_len, self.head_dim), jnp.float32)
+            zeros_ = jnp.zeros((max_len, self.head_dim), jnp.float32)
+            out, nck, ncv = apply_op(
+                functools.partial(_cached_attention, cos=ones, sin=zeros_,
+                                  scale=1.0 / _math.sqrt(self.head_dim)),
+                q, k, v, ck, cv, pos)
+            out = reshape(out, (b, s, h))
+            return self.dropout(self.out_proj(out)), (nck, ncv)
         out = F.scaled_dot_product_attention(q, k, v, attn_mask,
                                              is_causal=attn_mask is None)
         out = reshape(out, (b, s, h))
@@ -113,7 +131,12 @@ class GPTBlock(nn.Layer):
                                  epsilon=config.layer_norm_epsilon)
         self.mlp = GPTMLP(config)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None, pos=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln_1(x), attn_mask,
+                                     cache=cache, pos=pos)
+            x = x + a
+            return x + self.mlp(self.ln_2(x)), new_cache
         x = x + self.attn(self.ln_1(x), attn_mask)
         return x + self.mlp(self.ln_2(x))
 
@@ -140,23 +163,47 @@ class GPTModel(nn.Layer):
         self.ln_f = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, cache=None, pos=None):
         b, s = input_ids.shape
-        pos = arange(0, s, dtype="int64")
-        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        positions = arange(0, s, dtype="int32")
+        if pos is not None:
+            positions = positions + pos   # decode offset
+        x = self.drop(self.wte(input_ids) + self.wpe(positions))
+        if cache is not None:
+            new_cache = []
+            for block, bc in zip(self.h, cache):
+                x, nc = block(x, attn_mask, cache=bc, pos=pos)
+                new_cache.append(nc)
+            return self.ln_f(x), new_cache
         for block in self.h:
             x = block(x, attn_mask)
         return self.ln_f(x)
 
 
-class GPTForCausalLM(nn.Layer):
+class GPTForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
         self.gpt = GPTModel(config)
 
-    def forward(self, input_ids, labels=None, attn_mask=None):
+    def init_kv_cache(self, batch: int, max_len: int, dtype=None):
+        from ..tensor import Tensor
+        import jax.numpy as jnp
+        c = self.config
+        head_dim = c.hidden_size // c.num_attention_heads
+        dt = jnp.dtype(dtype or "float32")
+        shape = (batch, max_len, c.num_attention_heads, head_dim)
+        return [(Tensor(jnp.zeros(shape, dt)), Tensor(jnp.zeros(shape, dt)))
+                for _ in range(c.num_hidden_layers)]
+
+    def forward(self, input_ids, labels=None, attn_mask=None, cache=None,
+                pos=None):
         from ..ops.math import matmul
+        if cache is not None:
+            h, new_cache = self.gpt(input_ids, attn_mask, cache=cache,
+                                    pos=pos)
+            return matmul(h, self.gpt.wte.weight, transpose_y=True), \
+                new_cache
         h = self.gpt(input_ids, attn_mask)
         # weight-tied head (GPT-2 convention)
         logits = matmul(h, self.gpt.wte.weight, transpose_y=True)

@@ -25,6 +25,7 @@ from ..nn import functional as F
 from ..ops.creation import arange, zeros
 from ..ops.manipulation import concat, reshape, transpose
 from ..tensor import Tensor, apply_op
+from .generation import GenerationMixin
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
            "LlamaDecoderStack", "llama_tiny_config", "llama_7b_config",
@@ -109,6 +110,37 @@ def _apply_rope(q, k, cos, sin, offset=0):
     return fused_rope(q, k, c, sn)
 
 
+def _cached_attention(qv, kv_, vv, ckv, cvv, posv, *, cos, sin, scale):
+    """KV-cache attention step (pure jax): RoPE at offset ``posv``,
+    write k/v into the preallocated cache with dynamic_update_slice,
+    attend causally over cache[:pos+s]. Static shapes — the same
+    compiled program serves every decode position."""
+    from ..ops.pallas.fused import fused_rope
+    b, s, h, d = qv.shape
+    c = jax.lax.dynamic_slice_in_dim(cos, posv, s, 0).astype(qv.dtype)
+    sn = jax.lax.dynamic_slice_in_dim(sin, posv, s, 0).astype(qv.dtype)
+    qv, kv_ = fused_rope(qv, kv_, c, sn)
+    ck = jax.lax.dynamic_update_slice(ckv, kv_.astype(ckv.dtype),
+                                      (0, posv, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
+                                      (0, posv, 0, 0))
+    kvh = ck.shape[2]
+    if kvh != h:                       # GQA: broadcast kv heads
+        ke = jnp.repeat(ck, h // kvh, axis=2)
+        ve = jnp.repeat(cv, h // kvh, axis=2)
+    else:
+        ke, ve = ck, cv
+    scores = jnp.einsum("bshd,bthd->bhst", qv.astype(jnp.float32),
+                        ke.astype(jnp.float32)) * scale
+    t_idx = jnp.arange(ck.shape[1])
+    q_idx = posv + jnp.arange(s)
+    mask = t_idx[None, :] <= q_idx[:, None]          # (s, T) causal
+    scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(ve.dtype)
+    out = jnp.einsum("bhst,bthd->bshd", probs, ve).astype(qv.dtype)
+    return out, ck, cv
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -127,11 +159,23 @@ class LlamaAttention(nn.Layer):
                 l.weight._sharding_spec = P(None, "mp")
             self.o_proj.weight._sharding_spec = P("mp", None)
 
-    def forward(self, x, cos, sin, attn_mask=None):
+    def forward(self, x, cos, sin, attn_mask=None, cache=None, pos=None):
+        """cache=(k_cache, v_cache) of (b, max_len, kv_heads, head_dim)
+        with ``pos`` the write offset → returns (out, new_cache): the
+        autoregressive decode path (reference: fused_multi_transformer's
+        cache_kv / PaddleNLP gen_cache — verify)."""
         b, s, _ = x.shape
         q = reshape(self.q_proj(x), (b, s, self.num_heads, self.head_dim))
         k = reshape(self.k_proj(x), (b, s, self.num_kv_heads, self.head_dim))
         v = reshape(self.v_proj(x), (b, s, self.num_kv_heads, self.head_dim))
+        if cache is not None:
+            ck, cv = cache
+            out, nck, ncv = apply_op(
+                functools.partial(_cached_attention, cos=cos, sin=sin,
+                                  scale=1.0 / math.sqrt(self.head_dim)),
+                q, k, v, ck, cv, pos)
+            out = reshape(out, (b, s, self.num_heads * self.head_dim))
+            return self.o_proj(out), (nck, ncv)
         q, k = apply_op(lambda qv, kv_: _apply_rope(qv, kv_, cos, sin), q, k)
         out = None
         cfg = self.config
@@ -183,7 +227,14 @@ class LlamaDecoderLayer(nn.Layer):
         self.mlp = LlamaMLP(config)
         self._seq_parallel = config.sequence_parallel
 
-    def forward(self, x, cos, sin, attn_mask=None):
+    def forward(self, x, cos, sin, attn_mask=None, cache=None, pos=None):
+        if cache is not None:
+            a, new_cache = self.self_attn(self.input_layernorm(x), cos,
+                                          sin, attn_mask, cache=cache,
+                                          pos=pos)
+            h = x + a
+            return h + self.mlp(self.post_attention_layernorm(h)), \
+                new_cache
         h = x + self.self_attn(self.input_layernorm(x), cos, sin, attn_mask)
         out = h + self.mlp(self.post_attention_layernorm(h))
         if self._seq_parallel:
@@ -322,9 +373,22 @@ class LlamaModel(nn.Layer):
         self.register_buffer("rope_cos", Tensor(cos), persistable=False)
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, cache=None, pos=None):
         x = self.embed_tokens(input_ids)
         cos, sin = self.rope_cos._value, self.rope_sin._value
+        if cache is not None:
+            if isinstance(self.layers, LlamaDecoderStack):
+                raise ValueError(
+                    "KV-cache decode is not supported with the stacked "
+                    "pipeline/scan trunk; build the model with "
+                    "pipeline_parallel=False, scan_layers=False for "
+                    "generation")
+            new_cache = []
+            for layer, layer_cache in zip(self.layers, cache):
+                x, nc = layer(x, cos, sin, attn_mask, cache=layer_cache,
+                              pos=pos)
+                new_cache.append(nc)
+            return self.norm(x), new_cache
         if isinstance(self.layers, LlamaDecoderStack):
             x = self.layers(x, cos, sin, attn_mask)
         else:
@@ -333,7 +397,7 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -346,14 +410,30 @@ class LlamaForCausalLM(nn.Layer):
             if config.tensor_parallel:
                 self.lm_head.weight._sharding_spec = P(None, "mp")
 
-    def forward(self, input_ids, labels=None, attn_mask=None):
-        h = self.llama(input_ids, attn_mask)
+    def init_kv_cache(self, batch: int, max_len: int, dtype=None):
+        """Preallocated per-layer (k, v) cache pytree for generate()."""
+        c = self.config
+        head_dim = c.hidden_size // c.num_attention_heads
+        dt = jnp.dtype(dtype or c.dtype)
+        shape = (batch, max_len, c.num_key_value_heads, head_dim)
+        return [(Tensor(jnp.zeros(shape, dt)), Tensor(jnp.zeros(shape, dt)))
+                for _ in range(c.num_hidden_layers)]
+
+    def forward(self, input_ids, labels=None, attn_mask=None, cache=None,
+                pos=None):
+        if cache is not None:
+            h, new_cache = self.llama(input_ids, attn_mask, cache=cache,
+                                      pos=pos)
+        else:
+            h = self.llama(input_ids, attn_mask)
         if self.lm_head is not None:
             logits = self.lm_head(h)
         else:
             from ..ops.math import matmul
             logits = matmul(h, self.llama.embed_tokens.weight,
                             transpose_y=True)
+        if cache is not None:
+            return logits, new_cache
         if labels is None:
             return logits
         loss = F.cross_entropy(logits, labels, reduction="mean")
