@@ -49,6 +49,39 @@ def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
     return jax.random.categorical(key, logits, axis=-1)
 
 
+def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
+                     sin=None):
+    """KV-cache attention step (pure jax), shared by every causal LM:
+    optional RoPE at offset ``posv`` (cos=None skips it — e.g. GPT's
+    learned positions), k/v written into the preallocated cache with
+    dynamic_update_slice, causal attention over cache[:pos+s]. GQA uses
+    grouped einsums — the kv cache is never materialized at q-head
+    count. Static shapes: one compiled program serves every position."""
+    b, s, h, d = qv.shape
+    if cos is not None:
+        from ..ops.pallas.fused import fused_rope
+        c = jax.lax.dynamic_slice_in_dim(cos, posv, s, 0).astype(qv.dtype)
+        sn = jax.lax.dynamic_slice_in_dim(sin, posv, s, 0).astype(qv.dtype)
+        qv, kv_ = fused_rope(qv, kv_, c, sn)
+    ck = jax.lax.dynamic_update_slice(ckv, kv_.astype(ckv.dtype),
+                                      (0, posv, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
+                                      (0, posv, 0, 0))
+    kvh = ck.shape[2]
+    g = h // kvh
+    qg = qv.reshape(b, s, kvh, g, d).astype(jnp.float32)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg,
+                        ck.astype(jnp.float32)) * scale
+    t_idx = jnp.arange(ck.shape[1])
+    q_idx = posv + jnp.arange(s)
+    mask = t_idx[None, :] <= q_idx[:, None]            # (s, T) causal
+    scores = jnp.where(mask[None, None, None], scores,
+                       jnp.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, cv)
+    return out.reshape(b, s, h, d).astype(qv.dtype), ck, cv
+
+
 def build_decode_step(model, sample_kwargs, tree_holder):
     """The shared pure step: (params, bufs, token_block, cache_flat,
     pos, key) → (next_token, new_cache_flat). Serves prefill (block of
@@ -120,6 +153,8 @@ class GenerationMixin:
         max_new = total - s
         if max_new <= 0:
             return ids
+        if do_sample and temperature <= 0.0:
+            temperature = 1.0   # PaddleNLP parity: do_sample defaults hot
         limit = getattr(getattr(self, "config", None),
                         "max_position_embeddings", None)
         if limit is not None and total > limit:

@@ -83,17 +83,18 @@ class GPTAttention(nn.Layer):
                                          self.head_dim))
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if cache is not None:
+            if attn_mask is not None:
+                raise ValueError(
+                    "attn_mask is not yet supported on the KV-cache "
+                    "decode path (it would be silently ignored); pad-"
+                    "free prompts only")
             import functools
             import math as _math
-            from .llama import _cached_attention
+            from .generation import cached_attention
             from ..tensor import apply_op
             ck, cv = cache
-            # identity "rope": cos=1, sin=0 (GPT has learned positions)
-            max_len = ck.shape[1]
-            ones = jnp.ones((max_len, self.head_dim), jnp.float32)
-            zeros_ = jnp.zeros((max_len, self.head_dim), jnp.float32)
-            out, nck, ncv = apply_op(
-                functools.partial(_cached_attention, cos=ones, sin=zeros_,
+            out, nck, ncv = apply_op(          # cos=None: no rope (wpe)
+                functools.partial(cached_attention,
                                   scale=1.0 / _math.sqrt(self.head_dim)),
                 q, k, v, ck, cv, pos)
             out = reshape(out, (b, s, h))
@@ -188,10 +189,9 @@ class GPTForCausalLM(nn.Layer, GenerationMixin):
 
     def init_kv_cache(self, batch: int, max_len: int, dtype=None):
         from ..tensor import Tensor
-        import jax.numpy as jnp
         c = self.config
         head_dim = c.hidden_size // c.num_attention_heads
-        dt = jnp.dtype(dtype or "float32")
+        dt = jnp.dtype(dtype or getattr(c, "dtype", None) or "float32")
         shape = (batch, max_len, c.num_attention_heads, head_dim)
         return [(Tensor(jnp.zeros(shape, dt)), Tensor(jnp.zeros(shape, dt)))
                 for _ in range(c.num_hidden_layers)]

@@ -110,37 +110,6 @@ def _apply_rope(q, k, cos, sin, offset=0):
     return fused_rope(q, k, c, sn)
 
 
-def _cached_attention(qv, kv_, vv, ckv, cvv, posv, *, cos, sin, scale):
-    """KV-cache attention step (pure jax): RoPE at offset ``posv``,
-    write k/v into the preallocated cache with dynamic_update_slice,
-    attend causally over cache[:pos+s]. Static shapes — the same
-    compiled program serves every decode position."""
-    from ..ops.pallas.fused import fused_rope
-    b, s, h, d = qv.shape
-    c = jax.lax.dynamic_slice_in_dim(cos, posv, s, 0).astype(qv.dtype)
-    sn = jax.lax.dynamic_slice_in_dim(sin, posv, s, 0).astype(qv.dtype)
-    qv, kv_ = fused_rope(qv, kv_, c, sn)
-    ck = jax.lax.dynamic_update_slice(ckv, kv_.astype(ckv.dtype),
-                                      (0, posv, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
-                                      (0, posv, 0, 0))
-    kvh = ck.shape[2]
-    if kvh != h:                       # GQA: broadcast kv heads
-        ke = jnp.repeat(ck, h // kvh, axis=2)
-        ve = jnp.repeat(cv, h // kvh, axis=2)
-    else:
-        ke, ve = ck, cv
-    scores = jnp.einsum("bshd,bthd->bhst", qv.astype(jnp.float32),
-                        ke.astype(jnp.float32)) * scale
-    t_idx = jnp.arange(ck.shape[1])
-    q_idx = posv + jnp.arange(s)
-    mask = t_idx[None, :] <= q_idx[:, None]          # (s, T) causal
-    scores = jnp.where(mask[None, None], scores, jnp.float32(-1e30))
-    probs = jax.nn.softmax(scores, axis=-1).astype(ve.dtype)
-    out = jnp.einsum("bhst,bthd->bshd", probs, ve).astype(qv.dtype)
-    return out, ck, cv
-
-
 class LlamaAttention(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -169,9 +138,15 @@ class LlamaAttention(nn.Layer):
         k = reshape(self.k_proj(x), (b, s, self.num_kv_heads, self.head_dim))
         v = reshape(self.v_proj(x), (b, s, self.num_kv_heads, self.head_dim))
         if cache is not None:
+            if attn_mask is not None:
+                raise ValueError(
+                    "attn_mask is not yet supported on the KV-cache "
+                    "decode path (it would be silently ignored); pad-"
+                    "free prompts only")
+            from .generation import cached_attention
             ck, cv = cache
             out, nck, ncv = apply_op(
-                functools.partial(_cached_attention, cos=cos, sin=sin,
+                functools.partial(cached_attention, cos=cos, sin=sin,
                                   scale=1.0 / math.sqrt(self.head_dim)),
                 q, k, v, ck, cv, pos)
             out = reshape(out, (b, s, self.num_heads * self.head_dim))
@@ -272,7 +247,14 @@ class LlamaDecoderStack(nn.Layer):
         lead = "pp" if config.pipeline_parallel else None
         for n in names:
             from ..tensor import Parameter
-            p = Parameter(jnp.stack(stacks[n]))
+            vals = stacks[n]
+            if isinstance(vals[0], jax.ShapeDtypeStruct):
+                # abstract construction (utils/scale.py AOT scale check)
+                stacked = jax.ShapeDtypeStruct(
+                    (len(vals), *vals[0].shape), vals[0].dtype)
+            else:
+                stacked = jnp.stack(vals)
+            p = Parameter(stacked)
             base = specs[n]
             if base is not None:
                 p._sharding_spec = P(lead, *tuple(base))

@@ -136,6 +136,16 @@ class Optimizer:
     # -- imperative step ----------------------------------------------------
     def _ensure_slots(self, name, p):
         if name not in self._slots:
+            if isinstance(p._value, jax.ShapeDtypeStruct):
+                # abstract (spec-only) params — AOT scale checks build
+                # slot SPECS without materializing zeros (utils/scale.py)
+                slots = dict(jax.eval_shape(self._init_slots, p._value))
+                if self._multi_precision and p._value.dtype in (
+                        jnp.float16, jnp.bfloat16):
+                    slots["master"] = jax.ShapeDtypeStruct(
+                        p._value.shape, jnp.float32)
+                self._slots[name] = slots
+                return self._slots[name]
             slots = self._init_slots(p._value)
             if self._multi_precision and p._value.dtype in (
                     jnp.float16, jnp.bfloat16):

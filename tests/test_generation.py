@@ -123,3 +123,28 @@ class TestExportedDecoder:
         pred = GenerationPredictor(p)
         out = pred.generate(ids, max_new_tokens=steps)
         np.testing.assert_array_equal(out, ref)
+
+    def test_do_sample_defaults_hot(self):
+        """do_sample=True without temperature must actually sample
+        (PaddleNLP parity: default temperature 1.0, not greedy)."""
+        paddle.seed(2)
+        cfg = llama_tiny_config(tensor_parallel=False)
+        model = LlamaForCausalLM(cfg)
+        rs = np.random.RandomState(5)
+        ids = rs.randint(0, cfg.vocab_size, (2, 4)).astype(np.int32)
+        a = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                           do_sample=True, seed=1)
+        b = model.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                           do_sample=True, seed=2)
+        assert not np.array_equal(a.numpy(), b.numpy())
+
+    def test_cache_path_rejects_attn_mask(self):
+        paddle.seed(0)
+        cfg = llama_tiny_config(tensor_parallel=False)
+        model = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int32))
+        cache = model.init_kv_cache(1, 8)
+        mask = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+        with pytest.raises(ValueError, match="attn_mask"):
+            model(ids, attn_mask=mask, cache=cache,
+                  pos=Tensor(jnp.asarray(0, jnp.int32)))
