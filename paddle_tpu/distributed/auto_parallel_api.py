@@ -280,6 +280,14 @@ def shard_optimizer(optimizer, shard_fn=None):
         p = params.get(pname)
         if p is None:
             return slot_value
+        if isinstance(slot_value, jax.ShapeDtypeStruct):
+            # abstract AOT scale check: carry placement on the spec
+            psh = getattr(p._value, "sharding", None)
+            if psh is not None and slot_value.shape == p._value.shape:
+                return jax.ShapeDtypeStruct(slot_value.shape,
+                                            slot_value.dtype,
+                                            sharding=psh)
+            return slot_value
         placements = None
         if shard_fn is not None:
             placements = shard_fn(slot_name, p)

@@ -82,7 +82,19 @@ class ShardingConstrainer:
 
     def __call__(self, value, pname=None, slot=None):
         mesh = get_current_mesh()
-        if mesh is None or not hasattr(value, "ndim") or value.ndim == 0:
+        if mesh is None:
+            return value
+        if isinstance(value, jax.ShapeDtypeStruct):
+            # abstract AOT scale check: attach the placement to the spec
+            if len(value.shape) == 0:
+                return value
+            spec = _sharded_spec(value.shape, self.axis, mesh)
+            if spec is None:
+                return value
+            return jax.ShapeDtypeStruct(
+                value.shape, value.dtype,
+                sharding=NamedSharding(mesh, spec))
+        if not hasattr(value, "ndim") or value.ndim == 0:
             return value
         spec = _sharded_spec(value.shape, self.axis, mesh)
         if spec is None:

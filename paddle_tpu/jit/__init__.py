@@ -364,30 +364,76 @@ class EvalStep:
 # ---------------------------------------------------------------------------
 
 def save(layer, path, input_spec=None, **configs):
-    """Serialize a Layer for inference: state_dict + a config blob. The
-    compiled program is rebuilt at load (XLA compile cache makes this fast);
-    StableHLO export for cross-process serving lives in
-    paddle_tpu.static.serving (round 2)."""
+    """Serialize a Layer for inference (reference: paddle.jit.save
+    writing program + params — verify).
+
+    Always writes ``path.pdparams`` (state_dict + class coordinates).
+    With ``input_spec``, ALSO AOT-exports the traced forward as
+    serialized StableHLO (``path.pdmodel``) — then ``jit.load`` returns
+    a TranslatedLayer that runs the compiled program without needing the
+    model class at all (the reference's program-based load)."""
     from ..serialization import save as _save
-    import pickle
-    import os
     state = layer.state_dict() if isinstance(layer, Layer) else {}
     _save({"state": state,
            "class_module": type(layer).__module__,
            "class_name": type(layer).__name__},
           path + ".pdparams")
+    if input_spec is not None:
+        from ..inference import export_model
+        export_model(layer, input_spec, path)
+
+
+class TranslatedLayer(Layer):
+    """jit.load result for a program-exported model (reference:
+    TranslatedLayer — verify): a Layer whose forward executes the saved
+    StableHLO program; parameters are frozen inside the artifact."""
+
+    def __init__(self, predictor, state):
+        super().__init__()
+        object.__setattr__(self, "_predictor", predictor)
+        object.__setattr__(self, "_saved_state", state)
+
+    def state_dict(self, *a, **k):
+        return dict(self._saved_state)
+
+    def forward(self, *inputs):
+        import numpy as np
+        arrs = [i._value if isinstance(i, Tensor) else np.asarray(i)
+                for i in inputs]
+        outs = self._predictor.run(arrs)
+        outs = [Tensor(jnp.asarray(o)) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 def load(path, **configs):
+    """Load a layer saved by jit.save. Resolution order:
+
+    1. ``path.pdmodel`` exists (saved with input_spec) → TranslatedLayer
+       running the exported StableHLO program — no model class needed.
+    2. Otherwise the saved class is imported and reconstructed (must be
+       constructible with no arguments) and the state_dict restored.
+    3. Anything else raises with the available options — never a silent
+       fallback to a bare state dict.
+    """
+    import os
     from ..serialization import load as _load
     blob = _load(path + ".pdparams")
+    if os.path.exists(path + ".pdmodel"):
+        from ..inference import Config, Predictor
+        return TranslatedLayer(Predictor(Config(path)), blob["state"])
     import importlib
     try:
         mod = importlib.import_module(blob["class_module"])
         cls = getattr(mod, blob["class_name"])
-        # best effort: class must be constructible without args
         layer = cls()
-        layer.set_state_dict(blob["state"])
-        return layer
-    except Exception:
-        return blob["state"]
+    except Exception as e:
+        raise RuntimeError(
+            f"jit.load({path!r}): no exported program "
+            f"('{path}.pdmodel') and the saved class "
+            f"{blob['class_module']}.{blob['class_name']} could not be "
+            f"reconstructed without arguments ({type(e).__name__}: {e}). "
+            "Either re-save with input_spec= (exports a runnable "
+            "program), or rebuild the model yourself and call "
+            "set_state_dict(paddle.load(path + '.pdparams')['state']).")
+    layer.set_state_dict(blob["state"])
+    return layer

@@ -153,7 +153,7 @@ class GenerationMixin:
         max_new = total - s
         if max_new <= 0:
             return ids
-        if do_sample and temperature <= 0.0:
+        if do_sample and (temperature is None or temperature <= 0.0):
             temperature = 1.0   # PaddleNLP parity: do_sample defaults hot
         limit = getattr(getattr(self, "config", None),
                         "max_position_embeddings", None)

@@ -144,6 +144,12 @@ class Optimizer:
                         jnp.float16, jnp.bfloat16):
                     slots["master"] = jax.ShapeDtypeStruct(
                         p._value.shape, jnp.float32)
+                if self._slot_constrain is not None:
+                    # constrainers attach shardings to specs (ZeRO/
+                    # shard_optimizer placement must show up in AOT
+                    # scale estimates too)
+                    slots = {k: self._slot_constrain(v, name, k)
+                             for k, v in slots.items()}
                 self._slots[name] = slots
                 return self._slots[name]
             slots = self._init_slots(p._value)

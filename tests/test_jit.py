@@ -164,3 +164,34 @@ def test_recompute_in_trainstep():
         last = float(step((paddle.to_tensor(x),
                            paddle.to_tensor(y))).item())
     assert last < l0
+
+
+class TestJitSaveLoad:
+    def test_save_with_input_spec_loads_translated(self, tmp_path):
+        import numpy as np
+        from paddle_tpu import jit
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.to_tensor(np.random.rand(3, 4).astype("float32"))
+        ref = m(x).numpy()
+        jit.save(m, str(tmp_path / "m"), input_spec=[x])
+        loaded = jit.load(str(tmp_path / "m"))
+        assert isinstance(loaded, jit.TranslatedLayer)
+        np.testing.assert_allclose(loaded(x).numpy(), ref, rtol=1e-6)
+        assert "0.weight" in loaded.state_dict()
+
+    def test_load_without_program_raises_actionably(self, tmp_path):
+        import pytest
+        from paddle_tpu import jit
+
+        class NeedsArgs(nn.Layer):
+            def __init__(self, dim):
+                super().__init__()
+                self.fc = nn.Linear(dim, dim)
+
+            def forward(self, x):
+                return self.fc(x)
+        m = NeedsArgs(4)
+        jit.save(m, str(tmp_path / "m2"))       # no input_spec
+        with pytest.raises(RuntimeError, match="input_spec"):
+            jit.load(str(tmp_path / "m2"))
