@@ -113,23 +113,40 @@ def _child_tpu():
 
     from paddle_tpu.models.llama import LlamaConfig, llama_tiny_config
 
+    def _isolated(fn, label):
+        """One config must not take down the others' results (a v5e HBM
+        OOM on the big config previously killed the whole child)."""
+        try:
+            return fn(), None
+        except Exception as e:
+            msg = f"{type(e).__name__}: {e}"
+            return None, f"{label}: {msg[:600]}"
+
+    errors = []
     if on_tpu:
         cfg_small = LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_hidden_layers=16, num_attention_heads=16,
             num_key_value_heads=16, max_position_embeddings=1024,
             tensor_parallel=False)
-        small = _bench_train(cfg_small, batch=8, seq=1024, steps=12,
-                             warmup=3, peak=peak)
+        small, err = _isolated(lambda: _bench_train(
+            cfg_small, batch=8, seq=1024, steps=12, warmup=3, peak=peak),
+            "small")
+        if err:
+            errors.append(err)
         # ~0.95B params; bf16 optimizer states (multi_precision off) +
-        # per-layer remat keep it inside a 16GB v5e HBM
+        # per-layer remat; batch 2 to stay inside 16GB v5e HBM (batch 4
+        # OOMed: 88MB bf16[4,2048,5632] remat temps)
         cfg_big = LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=16, num_attention_heads=16,
             num_key_value_heads=16, max_position_embeddings=2048,
             tensor_parallel=False, recompute=True)
-        big = _bench_train(cfg_big, batch=4, seq=2048, steps=8, warmup=2,
-                           peak=peak, multi_precision=False)
+        big, err = _isolated(lambda: _bench_train(
+            cfg_big, batch=2, seq=2048, steps=8, warmup=2, peak=peak,
+            multi_precision=False), "big")
+        if err:
+            errors.append(err)
     else:
         cfg = llama_tiny_config(tensor_parallel=False)
         small = _bench_train(cfg, batch=2, seq=64, steps=4, warmup=1,
@@ -137,14 +154,19 @@ def _child_tpu():
         big = None
 
     if on_tpu:
-        decode = _bench_decode(cfg_small, batch=8, prompt=128,
-                               new_tokens=128)
+        decode, err = _isolated(lambda: _bench_decode(
+            cfg_small, batch=8, prompt=128, new_tokens=128), "decode")
+        if err:
+            errors.append(err)
+        decode = decode or {}
     else:
         decode = _bench_decode(llama_tiny_config(tensor_parallel=False),
                                batch=2, prompt=16, new_tokens=16)
 
     from paddle_tpu.ops.pallas import flash_attention as fa
     head = big or small
+    if head is None:
+        raise RuntimeError("every config failed: " + "; ".join(errors))
     print("BENCH_JSON " + json.dumps({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": head["tokens_per_sec"],
@@ -155,6 +177,7 @@ def _child_tpu():
         "sdpa_dispatch": fa.sdpa_last_dispatch(),
         "config_small": small,
         "config_big": big,
+        **({"config_errors": errors} if errors else {}),
         **decode,
         **{k: head[k] for k in ("model_params", "batch", "seq",
                                 "final_loss", "step_ms")},

@@ -21,10 +21,18 @@ __all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
            "is_auto_cast_enabled", "get_amp_dtype", "white_cast",
            "black_cast"]
 
-# default op lists (reference: python/paddle/amp/amp_lists.py — verify)
-WHITE_LIST = {"matmul", "conv2d", "einsum", "bmm", "mm", "linear"}
-BLACK_LIST = {"softmax", "log_softmax", "cross_entropy", "exp", "log",
-              "mean", "sum", "norm", "layer_norm", "batch_norm"}
+# op lists come from the op-metadata registry (reference: amp_lists.py
+# keyed off the op YAML table — here ops/registry.py is that table).
+# Live views: ops registered after import still affect casting.
+from ..ops.registry import amp_black_list, amp_white_list
+
+
+def __getattr__(name):
+    if name == "WHITE_LIST":
+        return amp_white_list()
+    if name == "BLACK_LIST":
+        return amp_black_list()
+    raise AttributeError(name)
 
 
 def is_auto_cast_enabled() -> bool:
@@ -66,8 +74,8 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
     d = convert_dtype(dtype)
     framework.state().amp_stack.append(
         {"enable": enable, "dtype": d, "level": level,
-         "white": set(custom_white_list or ()) | WHITE_LIST,
-         "black": set(custom_black_list or ()) | BLACK_LIST})
+         "white": set(custom_white_list or ()) | amp_white_list(),
+         "black": set(custom_black_list or ()) | amp_black_list()})
     try:
         yield
     finally:

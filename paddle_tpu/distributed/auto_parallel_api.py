@@ -282,6 +282,18 @@ def shard_optimizer(optimizer, shard_fn=None):
             return slot_value
         if isinstance(slot_value, jax.ShapeDtypeStruct):
             # abstract AOT scale check: carry placement on the spec
+            # (custom shard_fn placements included — the per-device
+            # memory estimate must reflect them)
+            if shard_fn is not None:
+                placements = shard_fn(slot_name, p)
+                if placements is not None:
+                    mesh = getattr(p, "process_mesh", None)
+                    if mesh is not None and len(slot_value.shape) > 0:
+                        spec = _to_partition_spec(mesh, placements,
+                                                  len(slot_value.shape))
+                        return jax.ShapeDtypeStruct(
+                            slot_value.shape, slot_value.dtype,
+                            sharding=NamedSharding(mesh.jax_mesh, spec))
             psh = getattr(p._value, "sharding", None)
             if psh is not None and slot_value.shape == p._value.shape:
                 return jax.ShapeDtypeStruct(slot_value.shape,

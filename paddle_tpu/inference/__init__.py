@@ -105,9 +105,25 @@ def export_model(layer, input_spec: Sequence, path: str):
     from ..tensor import Tensor
     from .. import framework
 
+    _sym_count = [0]
+
+    def _shape(dims):
+        """-1/None dims (InputSpec dynamic axes) become jax.export
+        symbolic dimensions, so one exported program serves any size on
+        that axis — the reference's dynamic-shape ProgramDesc export."""
+        out = []
+        for d in dims:
+            if d is None or (isinstance(d, int) and d < 0):
+                _sym_count[0] += 1
+                out.append(jax.export.symbolic_shape(
+                    f"_dyn{_sym_count[0]}")[0])
+            else:
+                out.append(int(d))
+        return tuple(out)
+
     def to_sds(s):
         if isinstance(s, InputSpec):
-            return jax.ShapeDtypeStruct(tuple(s.shape),
+            return jax.ShapeDtypeStruct(_shape(s.shape),
                                         framework.convert_dtype(s.dtype))
         if isinstance(s, Tensor):
             return jax.ShapeDtypeStruct(tuple(s.shape),
@@ -154,7 +170,9 @@ def export_model(layer, input_spec: Sequence, path: str):
         "stablehlo": exported.serialize(),
         "params": {k: np.asarray(v) for k, v in pvals.items()},
         "buffers": {k: np.asarray(v) for k, v in bvals.items()},
-        "input_specs": [(tuple(s.shape), str(s.dtype)) for s in specs],
+        "input_specs": [(tuple(d if isinstance(d, int) else -1
+                               for d in s.shape), str(s.dtype))
+                        for s in specs],
         "input_names": [f"x{i}" for i in range(len(specs))],
     }
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -196,6 +214,15 @@ class Predictor:
     def get_input_handle(self, name) -> _IOHandle:
         return self._inputs[name]
 
+    def run_on_device(self, args: Sequence):
+        """Zero-copy path: device (or jnp-convertible) inputs in, device
+        arrays out — no host round trip (used by jit.TranslatedLayer)."""
+        out = self._exported.call(self._params, self._buffers,
+                                  *[jnp.asarray(a) for a in args])
+        self._outputs = list(out) if isinstance(out, (tuple, list)) \
+            else [out]
+        return self._outputs
+
     def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
         if inputs is not None:
             for n, arr in zip(self._input_names, inputs):
@@ -205,9 +232,7 @@ class Predictor:
             missing = [n for n in self._input_names
                        if self._inputs[n]._value is None]
             raise RuntimeError(f"inputs not set: {missing}")
-        out = self._exported.call(self._params, self._buffers, *args)
-        self._outputs = list(out) if isinstance(out, (tuple, list)) \
-            else [out]
+        self.run_on_device(args)
         if inputs is not None:
             return [np.asarray(o) for o in self._outputs]
         return None

@@ -157,6 +157,8 @@ class StaticFunction:
                       tuple(sorted(kwargs.items())) if kwargs else ())
         inputs = [a for a in args if isinstance(a, Tensor)]
         entry = self._cache.get(static_key)
+        if entry == "eager":
+            return self._fn(*args, **kwargs)
         if entry is None:
             entry = self._build(len(inputs), static_key)
             self._cache[static_key] = entry
@@ -165,7 +167,27 @@ class StaticFunction:
         key = framework.split_key()
         key_t = Tensor(key)  # ride through apply_op as a non-diff input
         flat_args = [key_t] + ptensors + btensors + inputs
-        out = apply_op(jitted, *flat_args)
+        try:
+            out = apply_op(jitted, *flat_args)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError) as e:
+            # the trace-based analogue of a SOT graph break (reference:
+            # python/paddle/jit/sot/ opcode-level breaks — verify):
+            # data-dependent Python control flow can't live in one XLA
+            # program, so this call signature permanently falls back to
+            # eager execution instead of crashing
+            import warnings
+            first_line = str(e).splitlines()[0] if str(e) else repr(e)
+            warnings.warn(
+                "to_static: forward has data-dependent Python control "
+                f"flow ({first_line}); falling back to EAGER execution "
+                "for this input signature (the reference's SOT inserts "
+                "a graph break here). Rewrite with lax.cond/where for a "
+                "fully compiled step.", stacklevel=2)
+            self._cache[static_key] = "eager"
+            return self._fn(*args, **kwargs)
         outs = list(out) if isinstance(out, (tuple, list)) else [out]
         n_out = holder["n_out"]
         out_leaves = outs[:n_out]
@@ -400,8 +422,8 @@ class TranslatedLayer(Layer):
         import numpy as np
         arrs = [i._value if isinstance(i, Tensor) else np.asarray(i)
                 for i in inputs]
-        outs = self._predictor.run(arrs)
-        outs = [Tensor(jnp.asarray(o)) for o in outs]
+        outs = self._predictor.run_on_device(arrs)  # no host round trip
+        outs = [Tensor(o) for o in outs]
         return outs[0] if len(outs) == 1 else tuple(outs)
 
 

@@ -1,0 +1,130 @@
+"""Op metadata registry — the single source of truth about ops.
+
+Reference parity: paddle/phi/ops/yaml/{ops,backward}.yaml + the
+generator pipeline (paddle/fluid/operators/generator/ — verify): one
+table drives API emission, AMP lists, inplace maps, and dtype rules.
+
+TPU-native design: no codegen is needed (jax.vjp derives backwards, XLA
+owns kernels), so the registry's job is METADATA: per-op AMP category
+(consulted by paddle_tpu.amp), differentiability, inplace variants, and
+integer support. Ops are auto-discovered from the ops modules and
+curated tags are overlaid; unknown ops default to amp-neutral, which is
+always numerically safe."""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Dict, Optional
+
+__all__ = ["OpMeta", "register_op", "get_op_meta", "ops_by_amp",
+           "all_ops", "amp_white_list", "amp_black_list"]
+
+
+@dataclasses.dataclass(frozen=True)
+class OpMeta:
+    name: str
+    module: str = ""
+    # AMP category (reference: python/paddle/amp/amp_lists.py — verify):
+    # "white" = compute-bound, run in bf16/fp16 (matmul/conv class);
+    # "black" = numerically sensitive, keep fp32 (softmax/norm/reduce);
+    # "neutral" = follow inputs
+    amp: str = "neutral"
+    differentiable: bool = True
+    inplace_variant: Optional[str] = None   # e.g. "add" -> "add_"
+    integer_ok: bool = True
+
+
+_REGISTRY: Dict[str, OpMeta] = {}
+
+# curated AMP tags (the reference's amp_lists, expressed as metadata)
+_AMP_WHITE = {"matmul", "mm", "bmm", "einsum", "linear", "conv1d",
+              "conv2d", "conv3d", "conv2d_transpose", "addmm", "dot",
+              "outer", "matmul_with_flatten"}
+_AMP_BLACK = {"softmax", "log_softmax", "cross_entropy", "exp", "expm1",
+              "log", "log2", "log10", "log1p", "mean", "sum", "prod",
+              "norm", "layer_norm", "batch_norm", "instance_norm",
+              "group_norm", "rms_norm", "softplus", "cumsum", "cumprod",
+              "logsumexp", "sigmoid", "log_sigmoid", "erf", "erfinv",
+              "var", "std", "nll_loss", "kl_div", "smooth_l1_loss",
+              "binary_cross_entropy", "binary_cross_entropy_with_logits",
+              "square_error_cost", "cosine_similarity", "pow", "rsqrt",
+              "acos", "asin", "atan", "cosh", "sinh", "tan", "renorm",
+              "dist", "pdist"}
+_NON_DIFF = {"argmax", "argmin", "argsort", "equal", "not_equal",
+             "greater_than", "greater_equal", "less_than", "less_equal",
+             "logical_and", "logical_or", "logical_not", "logical_xor",
+             "isnan", "isinf", "isfinite", "sign", "floor_divide",
+             "mod", "bitwise_and", "bitwise_or", "bitwise_xor",
+             "bitwise_not", "shape", "rank", "numel", "nonzero",
+             "unique", "bincount", "searchsorted", "count_nonzero"}
+_FLOAT_ONLY = {"softmax", "log_softmax", "exp", "log", "sqrt", "rsqrt",
+               "sigmoid", "tanh", "erf", "sin", "cos", "layer_norm",
+               "batch_norm", "rms_norm", "mean", "var", "std"}
+
+
+def register_op(name: str, **kw) -> OpMeta:
+    meta = OpMeta(name=name, **kw)
+    _REGISTRY[name] = meta
+    return meta
+
+
+def _categorize(name: str, module: str) -> OpMeta:
+    return OpMeta(
+        name=name, module=module,
+        amp=("white" if name in _AMP_WHITE
+             else "black" if name in _AMP_BLACK else "neutral"),
+        differentiable=name not in _NON_DIFF,
+        inplace_variant=name + "_" if name + "_" in _REGISTRY else None,
+        integer_ok=name not in _FLOAT_ONLY)
+
+
+def _bootstrap():
+    from . import creation, manipulation, math as math_ops
+    from ..nn import functional as F
+    for mod in (math_ops, manipulation, creation, F):
+        public = getattr(mod, "__all__", None) or [
+            n for n in vars(mod) if not n.startswith("_")]
+        for n in public:
+            fn = getattr(mod, n, None)
+            if not callable(fn) or inspect.isclass(fn):
+                continue
+            if n not in _REGISTRY:
+                _REGISTRY[n] = _categorize(n, mod.__name__)
+    # second pass: now that every name exists, link inplace variants
+    for n, meta in list(_REGISTRY.items()):
+        if not n.endswith("_") and n + "_" in _REGISTRY \
+                and meta.inplace_variant is None:
+            _REGISTRY[n] = dataclasses.replace(meta,
+                                               inplace_variant=n + "_")
+
+
+def _ensure():
+    if not _REGISTRY:
+        _bootstrap()
+
+
+def get_op_meta(name: str) -> Optional[OpMeta]:
+    _ensure()
+    return _REGISTRY.get(name)
+
+
+def all_ops() -> Dict[str, OpMeta]:
+    _ensure()
+    return dict(_REGISTRY)
+
+
+def ops_by_amp(category: str):
+    _ensure()
+    return {n for n, m in _REGISTRY.items() if m.amp == category}
+
+
+def amp_white_list():
+    """Names AMP runs in the low dtype — registry-derived, plus curated
+    names whose ops live outside the scanned modules."""
+    _ensure()
+    return ops_by_amp("white") | _AMP_WHITE
+
+
+def amp_black_list():
+    _ensure()
+    return ops_by_amp("black") | _AMP_BLACK

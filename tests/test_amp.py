@@ -82,3 +82,39 @@ def test_bf16_training_via_trainstep():
                            paddle.to_tensor(y).astype("bfloat16"))).item())
     assert last < first * 0.5
     assert str(m[0].weight.dtype) == "bfloat16"
+
+
+class TestOpRegistry:
+    """Op-metadata registry (reference: the op YAML single source of
+    truth, SURVEY §2.1) — AMP lists are derived from it."""
+
+    def test_registry_covers_op_surface(self):
+        from paddle_tpu.ops.registry import all_ops
+        ops = all_ops()
+        assert len(ops) > 200, len(ops)
+        for required in ("matmul", "softmax", "concat", "zeros", "relu"):
+            assert required in ops
+
+    def test_metadata_fields(self):
+        from paddle_tpu.ops.registry import get_op_meta
+        assert get_op_meta("matmul").amp == "white"
+        assert get_op_meta("softmax").amp == "black"
+        assert get_op_meta("softmax").integer_ok is False
+        assert get_op_meta("argmax").differentiable is False
+        add = get_op_meta("add")
+        if add is not None and add.inplace_variant:
+            assert add.inplace_variant == "add_"
+
+    def test_amp_lists_derive_from_registry(self):
+        from paddle_tpu import amp
+        from paddle_tpu.ops.registry import amp_white_list, amp_black_list
+        assert amp.WHITE_LIST == amp_white_list()
+        assert amp.BLACK_LIST == amp_black_list()
+        assert "matmul" in amp.WHITE_LIST
+        assert "layer_norm" in amp.BLACK_LIST
+
+    def test_registered_op_affects_casting_live(self):
+        from paddle_tpu import amp
+        from paddle_tpu.ops.registry import register_op
+        register_op("my_custom_matmul", amp="white")
+        assert "my_custom_matmul" in amp.WHITE_LIST

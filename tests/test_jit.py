@@ -195,3 +195,61 @@ class TestJitSaveLoad:
         jit.save(m, str(tmp_path / "m2"))       # no input_spec
         with pytest.raises(RuntimeError, match="input_spec"):
             jit.load(str(tmp_path / "m2"))
+
+
+class TestGraphBreakFallback:
+    """SOT-analog: data-dependent Python control flow in a to_static fn
+    falls back to eager with a warning instead of crashing (reference:
+    jit/sot graph breaks — SURVEY §2.2)."""
+
+    def test_data_dependent_branch_falls_back(self):
+        import warnings as w
+        import numpy as np
+        from paddle_tpu.jit import to_static
+
+        class Gated(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(4, 4)
+                self.b = nn.Linear(4, 4)
+
+            def forward(self, x):
+                if float(x.numpy().sum()) > 0:   # data-dependent branch
+                    return self.a(x)
+                return self.b(x)
+
+        m = Gated()
+        ref_pos = m(paddle.to_tensor(np.ones((2, 4), "float32"))).numpy()
+        to_static(m)
+        with w.catch_warnings(record=True) as rec:
+            w.simplefilter("always")
+            out = m(paddle.to_tensor(np.ones((2, 4), "float32")))
+            assert any("data-dependent" in str(r.message) for r in rec)
+        np.testing.assert_allclose(out.numpy(), ref_pos, rtol=1e-6)
+        # negative branch also works (eager fallback is cached)
+        out_neg = m(paddle.to_tensor(-np.ones((2, 4), "float32")))
+        assert out_neg.shape == [2, 4]
+
+    def test_compilable_fn_stays_compiled(self):
+        import numpy as np
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(x):
+            return x * 2 + 1
+        x = paddle.to_tensor(np.ones((3,), "float32"))
+        np.testing.assert_allclose(f(x).numpy(), np.full((3,), 3.0))
+        assert f._cache and "eager" not in f._cache.values()
+
+    def test_save_dynamic_batch_input_spec(self, tmp_path):
+        import numpy as np
+        from paddle_tpu import jit
+        from paddle_tpu.static import InputSpec
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        jit.save(m, str(tmp_path / "dyn"),
+                 input_spec=[InputSpec(shape=[None, 4], dtype="float32")])
+        loaded = jit.load(str(tmp_path / "dyn"))
+        for b in (2, 5):                    # one program, any batch
+            x = paddle.to_tensor(np.ones((b, 4), "float32"))
+            assert loaded(x).shape == [b, 2]
