@@ -8,7 +8,8 @@ from ..framework import set_device, get_device, Place
 
 __all__ = ["set_device", "get_device", "get_available_device",
            "get_available_custom_device", "device_count", "cuda",
-           "is_compiled_with_cuda", "synchronize"]
+           "is_compiled_with_cuda", "synchronize", "Stream", "Event",
+           "current_stream", "set_stream", "stream_guard"]
 
 
 def get_available_device():
@@ -68,24 +69,110 @@ class _CudaNamespace:
         stats = jax.devices()[0].memory_stats() or {}
         return stats.get("bytes_limit", 0)
 
-    class Event:
-        def __init__(self, enable_timing=False, **kw):
-            self._t = None
+    @staticmethod
+    def current_stream(device=None):
+        return _default_stream
 
-        def record(self, stream=None):
-            import time
-            synchronize()
-            self._t = time.perf_counter()
+    @staticmethod
+    def stream_guard(stream):
+        return stream_guard(stream)
 
-        def elapsed_time(self, end):
-            return (end._t - self._t) * 1000.0
+    Event = None  # assigned below (shared with paddle.device.Event)
+    Stream = None
 
-    class Stream:
-        def __init__(self, *a, **k):
-            pass
 
-        def synchronize(self):
-            synchronize()
+class Event:
+    """paddle.device.Event parity. XLA has no user events; ``record``
+    drains the async dispatch queue and timestamps — correct wall-clock
+    semantics for the profiling uses the reference API serves
+    (reference: paddle/phi/backends event APIs — verify)."""
+
+    def __init__(self, device=None, enable_timing=True, blocking=False,
+                 interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+        synchronize()
+        self._t = time.perf_counter()
+
+    def query(self):
+        return self._t is not None
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end):
+        if self._t is None or end._t is None:
+            raise RuntimeError("elapsed_time needs both events recorded")
+        return (end._t - self._t) * 1000.0
+
+
+class Stream:
+    """paddle.device.Stream parity. XLA owns real streams (async
+    dispatch + latency-hiding scheduler); this logical handle preserves
+    the reference API: per-stream sync, event recording, and
+    wait_event/wait_stream ordering (already guaranteed by XLA's
+    program order, so they are correct no-ops)."""
+
+    def __init__(self, device=None, priority=2, **kw):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+    def wait_event(self, event):
+        pass  # ordering is XLA program order
+
+    def wait_stream(self, stream):
+        pass
+
+    def query(self):
+        return True
+
+
+class CudaEvent(Event):
+    """paddle.device.cuda.Event signature parity: first positional is
+    enable_timing, not device."""
+
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        super().__init__(enable_timing=enable_timing, blocking=blocking,
+                         interprocess=interprocess)
+
+
+_default_stream = Stream()
+_CudaNamespace.Event = CudaEvent
+_CudaNamespace.Stream = Stream
+
+
+def current_stream(device=None):
+    return _default_stream
+
+
+def set_stream(stream):
+    global _default_stream
+    prev = _default_stream
+    _default_stream = stream
+    return prev
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self._stream = stream
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
 
 
 cuda = _CudaNamespace()

@@ -288,7 +288,14 @@ def shard_optimizer(optimizer, shard_fn=None):
                 placements = shard_fn(slot_name, p)
                 if placements is not None:
                     mesh = getattr(p, "process_mesh", None)
-                    if mesh is not None and len(slot_value.shape) > 0:
+                    if mesh is None:
+                        # same contract as the concrete path: a dry-run
+                        # must not validate a config that cannot run
+                        raise ValueError(
+                            f"shard_fn returned placements for '{pname}'"
+                            " but the param has no process_mesh (use "
+                            "dist.shard_tensor on it first)")
+                    if len(slot_value.shape) > 0:
                         spec = _to_partition_spec(mesh, placements,
                                                   len(slot_value.shape))
                         return jax.ShapeDtypeStruct(

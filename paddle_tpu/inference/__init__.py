@@ -106,6 +106,8 @@ def export_model(layer, input_spec: Sequence, path: str):
     from .. import framework
 
     _sym_count = [0]
+    _scope = [None]  # ONE scope for the whole export: symbolic dims from
+    #                  different scopes cannot be mixed in one program
 
     def _shape(dims):
         """-1/None dims (InputSpec dynamic axes) become jax.export
@@ -114,9 +116,11 @@ def export_model(layer, input_spec: Sequence, path: str):
         out = []
         for d in dims:
             if d is None or (isinstance(d, int) and d < 0):
+                if _scope[0] is None:
+                    _scope[0] = jax.export.SymbolicScope()
                 _sym_count[0] += 1
                 out.append(jax.export.symbolic_shape(
-                    f"_dyn{_sym_count[0]}")[0])
+                    f"_dyn{_sym_count[0]}", scope=_scope[0])[0])
             else:
                 out.append(int(d))
         return tuple(out)

@@ -983,3 +983,140 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         return (jnp.arange(m)[None, :] < v[..., None]).astype(
             convert_dtype(dtype))
     return apply_op(f, x)
+
+
+# ---------------------------------------------------------------------------
+# long-tail additions (round 2): vision layout ops
+# (reference: python/paddle/nn/functional/vision.py — verify)
+# ---------------------------------------------------------------------------
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = int(upscale_factor)
+
+    def f(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            oc = c // (r * r)
+            v = v.reshape(b, oc, r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(b, oc, h * r, w * r)
+        b, h, w, c = v.shape
+        oc = c // (r * r)
+        v = v.reshape(b, h, w, r, r, oc)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(b, h * r, w * r, oc)
+    return apply_op(f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def f(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v = v.reshape(b, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(b, c * r * r, h // r, w // r)
+        b, h, w, c = v.shape
+        v = v.reshape(b, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(b, h // r, w // r, c * r * r)
+    return apply_op(f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        if data_format == "NCHW":
+            b, c, h, w = v.shape
+            v = v.reshape(b, groups, c // groups, h, w)
+            return v.transpose(0, 2, 1, 3, 4).reshape(b, c, h, w)
+        b, h, w, c = v.shape
+        v = v.reshape(b, h, w, groups, c // groups)
+        return v.transpose(0, 1, 2, 4, 3).reshape(b, h, w, c)
+    return apply_op(f, x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM shift (reference: temporal_shift op): within each segment,
+    shift the first ``shift_ratio`` channels back one frame and the next
+    ``shift_ratio`` forward one frame."""
+    def f(v):
+        if data_format != "NCHW":
+            v = v.transpose(0, 3, 1, 2)
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [v5[:, 1:, :c1], jnp.zeros_like(v5[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, c1:c2]), v5[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, v5[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format != "NCHW":
+            out = out.transpose(0, 2, 3, 1)
+        return out
+    return apply_op(f, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1,
+           name=None):
+    """im2col (reference: F.unfold): (b, c, h, w) → (b, c*kh*kw, L)
+    column blocks."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def f(v):
+        b, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        lh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        lw = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        blocks = []
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                blocks.append(v[:, :, hi:hi + sh * lh:sh,
+                                wj:wj + sw * lw:sw])
+        cols = jnp.stack(blocks, axis=2)       # (b, c, kh*kw, lh, lw)
+        return cols.reshape(b, c * kh * kw, lh * lw)
+    return apply_op(f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1, name=None):
+    """col2im (reference: fold / col2im op): inverse of unfold —
+    overlapping column blocks summed back into the image."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def f(v):
+        b, ckk, L = v.shape
+        c = ckk // (kh * kw)
+        lh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        lw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        cols = v.reshape(b, c, kh, kw, lh, lw)
+        out = jnp.zeros((b, c, oh + 2 * ph, ow + 2 * pw), v.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                hi = i * dh
+                wj = j * dw
+                out = out.at[:, :, hi:hi + sh * lh:sh,
+                             wj:wj + sw * lw:sw].add(cols[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+    return apply_op(f, x)
+
+
+__all__ += ["pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+            "temporal_shift", "unfold", "fold"]

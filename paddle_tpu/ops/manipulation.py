@@ -633,3 +633,47 @@ def _unwrap_index(idx):
 def getitem(x, idx):
     uidx = _unwrap_index(idx)
     return apply_op(lambda v: v[uidx], x)
+
+
+# ---------------------------------------------------------------------------
+# long-tail additions (round 2): indexing/layout
+# (reference: python/paddle/tensor/manipulation.py — verify)
+# ---------------------------------------------------------------------------
+
+def index_fill(x, index, axis, value, name=None):
+    def f(v, idx):
+        moved = jnp.moveaxis(v, axis, 0)
+        filled = moved.at[idx].set(jnp.asarray(value, v.dtype))
+        return jnp.moveaxis(filled, 0, axis)
+    return apply_op(f, x, index)
+
+
+def index_fill_(x, index, axis, value, name=None):
+    out = index_fill(x, index, axis, value)
+    x._update_value(out._value)
+    return x
+
+
+def unflatten(x, axis, shape, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        tgt = list(v.shape[:ax]) + [int(s) for s in shape] \
+            + list(v.shape[ax + 1:])
+        return v.reshape(tgt)
+    return apply_op(f, x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view (reference: as_strided). XLA arrays have no user
+    strides; materialized via gather over the strided index map —
+    correct for every in-bounds (shape, stride, offset)."""
+    def f(v):
+        flat = v.reshape(-1)
+        idx = jnp.asarray(offset)
+        for s, st in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(s) * st
+        return flat[idx.reshape(-1)].reshape(tuple(shape))
+    return apply_op(f, x)
+
+
+__all__ += ["index_fill", "index_fill_", "unflatten", "as_strided"]

@@ -930,3 +930,135 @@ scale_ = _inplace(scale)
 clip_ = _inplace(clip)
 remainder_ = _inplace(remainder)
 softplus_op = _un(jax.nn.softplus)
+
+
+# ---------------------------------------------------------------------------
+# long-tail additions (round 2): special functions, integration, distance
+# (reference: python/paddle/tensor/math.py — verify)
+# ---------------------------------------------------------------------------
+
+def sinc(x, name=None):
+    return apply_op(jnp.sinc, x)
+
+
+def signbit(x, name=None):
+    return apply_op(jnp.signbit, x)
+
+
+def exp2(x, name=None):
+    return apply_op(jnp.exp2, x)
+
+
+def float_power(x, y, name=None):
+    return apply_op(
+        lambda a, b: jnp.float_power(a, b), x,
+        y if isinstance(y, Tensor) else jnp.asarray(y))
+
+
+def ldexp(x, y, name=None):
+    return apply_op(
+        lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x,
+        y if isinstance(y, Tensor) else jnp.asarray(y))
+
+
+def i0e(x, name=None):
+    return apply_op(jax.scipy.special.i0e, x)
+
+
+def i1e(x, name=None):
+    return apply_op(jax.scipy.special.i1e, x)
+
+
+def polygamma(x, n, name=None):
+    return apply_op(lambda v: jax.scipy.special.polygamma(n, v), x)
+
+
+def multigammaln(x, p, name=None):
+    return apply_op(lambda v: jax.scipy.special.multigammaln(v, p), x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply_op(lambda yy, xx: jax.scipy.integrate.trapezoid(
+            yy, xx, axis=axis), y, x)
+    return apply_op(lambda yy: jax.scipy.integrate.trapezoid(
+        yy, dx=1.0 if dx is None else dx, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(yy, xx=None):
+        yy_m = jnp.moveaxis(yy, axis, -1)
+        if xx is not None:
+            xx_m = jnp.moveaxis(xx, axis, -1) if xx.ndim == yy.ndim \
+                else xx
+            d = jnp.diff(xx_m, axis=-1)
+        else:
+            d = 1.0 if dx is None else dx
+        avg = (yy_m[..., 1:] + yy_m[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * d, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+    if x is not None:
+        return apply_op(f, y, x)
+    return apply_op(f, y)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op(lambda v: jnp.vander(v, N=n,
+                                         increasing=increasing), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.nanquantile(
+        v, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim), x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Per-slice p-norm clamp along ``axis`` (reference: renorm op)."""
+    def f(v):
+        dims = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v.astype(jnp.float32)) ** p,
+                        axis=dims, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm,
+                           max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return (v * factor.astype(v.dtype))
+    return apply_op(f, x)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Pairwise distance between row-vector batches (reference: cdist)."""
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(
+                jnp.sum(diff * diff, axis=-1), 0.0))
+        if p == 0.0:
+            return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
+        if jnp.isinf(p):
+            return jnp.max(jnp.abs(diff), axis=-1)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return apply_op(f, x, y)
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), input, x, y)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """Returns (hist Tensor, [edge Tensor per dim]) — the reference
+    contract; edges stay separate (possibly ragged across dims)."""
+    def f(v, w=None):
+        h, edges = jnp.histogramdd(v, bins=bins, range=ranges,
+                                   density=density, weights=w)
+        return (h, *edges)   # flat so apply_op wraps each separately
+    out = apply_op(f, x, weights) if weights is not None \
+        else apply_op(f, x)
+    return out[0], list(out[1:])
+
+
+__all__ += ["sinc", "signbit", "exp2", "float_power", "ldexp", "i0e",
+            "i1e", "polygamma", "multigammaln", "trapezoid",
+            "cumulative_trapezoid", "vander", "nanquantile", "renorm",
+            "cdist", "baddbmm", "histogramdd"]

@@ -62,7 +62,11 @@ _FLOAT_ONLY = {"softmax", "log_softmax", "exp", "log", "sqrt", "rsqrt",
                "batch_norm", "rms_norm", "mean", "var", "std"}
 
 
+_BOOTSTRAPPED = [False]
+
+
 def register_op(name: str, **kw) -> OpMeta:
+    _ensure()   # user registrations must not suppress auto-discovery
     meta = OpMeta(name=name, **kw)
     _REGISTRY[name] = meta
     return meta
@@ -99,7 +103,8 @@ def _bootstrap():
 
 
 def _ensure():
-    if not _REGISTRY:
+    if not _BOOTSTRAPPED[0]:
+        _BOOTSTRAPPED[0] = True
         _bootstrap()
 
 

@@ -4,30 +4,41 @@ multi-process Gloo-on-CPU; here one process with 8 XLA host devices).
 
 NOTE: this environment pre-imports jax at interpreter startup with
 JAX_PLATFORMS=axon (a real exclusive-access TPU tunnel), so we must flip
-the already-imported jax config to cpu — env vars alone are too late."""
+the already-imported jax config to cpu — env vars alone are too late.
+
+PT_TPU_TESTS=1 skips the CPU pinning so the on-hardware kernel tests
+(tests/test_pallas_tpu.py) run against the real chip:
+    PT_TPU_TESTS=1 python -m pytest tests/test_pallas_tpu.py -q"""
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-# Child processes spawned by launch/elastic/communication tests inherit
-# this env; without the pop each child's interpreter startup dials the
-# exclusive TPU tunnel (site hook keyed on this var) and pays seconds —
-# the whole launch test file then takes minutes (VERDICT r1 weak #7).
-for _var in ("PALLAS_AXON_POOL_IPS", "TPU_NAME", "TPU_WORKER_HOSTNAMES"):
-    os.environ.pop(_var, None)
+_ON_TPU = os.environ.get("PT_TPU_TESTS") == "1"
+
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # Child processes spawned by launch/elastic/communication tests
+    # inherit this env; without the pop each child's interpreter startup
+    # dials the exclusive TPU tunnel (site hook keyed on this var) and
+    # pays seconds — the whole launch test file then takes minutes
+    # (VERDICT r1 weak #7).
+    for _var in ("PALLAS_AXON_POOL_IPS", "TPU_NAME",
+                 "TPU_WORKER_HOSTNAMES"):
+        os.environ.pop(_var, None)
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
+if not _ON_TPU and "xla_force_host_platform_device_count" not in flags:
     flags += " --xla_force_host_platform_device_count=8"
 # Tests check numerics/parity, not codegen quality: skip expensive LLVM
 # passes so the big model-zoo graphs compile ~30% faster on CPU.
-if "xla_llvm_disable_expensive_passes" not in flags:
+if not _ON_TPU and "xla_llvm_disable_expensive_passes" not in flags:
     flags += (" --xla_llvm_disable_expensive_passes=true"
               " --xla_backend_optimization_level=0")
 os.environ["XLA_FLAGS"] = flags.strip()
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
-assert not jax.config.jax_platforms or jax.config.jax_platforms == "cpu"
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
+    assert not jax.config.jax_platforms or \
+        jax.config.jax_platforms == "cpu"
 
 # Persistent compile cache: repeat suite runs skip recompilation entirely.
 _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")

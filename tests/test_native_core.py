@@ -241,3 +241,41 @@ class TestDataLoaderMultiprocess:
                            use_shared_memory=True)
         ids = sorted(int(b.numpy()[0]) for b in dl)
         assert set(ids) <= {0, 1}
+
+
+class TestStreamEventSurface:
+    """L0 stream/event API parity (reference: paddle.device.cuda Stream/
+    Event — on TPU, XLA owns real streams; these preserve the API)."""
+
+    def test_event_timing(self):
+        import time
+        import paddle_tpu.device as device
+        e1, e2 = device.Event(), device.Event()
+        e1.record()
+        time.sleep(0.01)
+        e2.record()
+        assert e1.query() and e2.query()
+        assert e2.elapsed_time(e1) < 0 < e1.elapsed_time(e2)
+        e1.synchronize()
+
+    def test_stream_guard_and_events(self):
+        import paddle_tpu.device as device
+        s = device.Stream()
+        assert device.current_stream() is not s
+        with device.stream_guard(s):
+            assert device.current_stream() is s
+            ev = s.record_event()
+            assert ev.query()
+        assert device.current_stream() is not s
+        s.wait_event(ev)
+        s.wait_stream(device.current_stream())
+        assert s.query()
+        # cuda namespace aliases the same types
+        assert device.cuda.Stream is device.Stream
+        assert device.cuda.current_stream() is device.current_stream()
+
+    def test_unrecorded_elapsed_raises(self):
+        import pytest as _pytest
+        import paddle_tpu.device as device
+        with _pytest.raises(RuntimeError, match="recorded"):
+            device.Event().elapsed_time(device.Event())
