@@ -315,13 +315,15 @@ class PipelineLayer(Layer):
 
     def __init__(self, layers, num_stages=None, topology=None,
                  loss_fn=None, seg_method="uniform", recompute_interval=0,
-                 num_microbatches=None, **kwargs):
+                 num_microbatches=None, num_virtual_pipeline_stages=1,
+                 **kwargs):
         super().__init__()
         from ...nn.common import LayerList
         self._descs = list(layers)
         self.loss_fn = loss_fn
         self._num_stages = num_stages or 1
         self.num_microbatches = num_microbatches
+        self._vpp = max(1, int(num_virtual_pipeline_stages or 1))
         self._recompute = bool(recompute_interval)
         built, shared = [], {}
         for d in self._descs:
@@ -369,10 +371,11 @@ class PipelineLayer(Layer):
         from ...nn.common import LayerList
         from ...tensor import Parameter
         S = self._num_stages
+        V = self._vpp
         sigs = [_layer_signature(l) for l in built]
         start, q, k = _find_periodic_trunk(sigs, S)
-        k_used = (k // S) * S
-        if k_used < max(S, 2):
+        k_used = (k // (S * V)) * (S * V)
+        if k_used < max(S * V, 2):
             return
         end = start + k_used * q
         protos = built[start:start + q]
@@ -396,14 +399,22 @@ class PipelineLayer(Layer):
                 vals = []
                 for u in range(k_used):
                     vals.append(unit_pmaps[u * q + j][pname]._value)
-                leaf = jnp.stack(vals).reshape(
-                    S, k_used // S, *vals[0].shape)
+                if V > 1:
+                    # interleaved: device s owns chunks {s, S+s, ...} →
+                    # leaf[s, v] = global chunk v·S + s, U units each
+                    U = k_used // (S * V)
+                    leaf = jnp.stack(vals).reshape(
+                        V, S, U, *vals[0].shape).swapaxes(0, 1)
+                else:
+                    leaf = jnp.stack(vals).reshape(
+                        S, k_used // S, *vals[0].shape)
                 reg = f"trunk_{j}__{pname.replace('.', '__')}"
                 param = Parameter(leaf)
                 base = getattr(proto_p, "_sharding_spec", None)
-                param._sharding_spec = (P("pp", None, *tuple(base))
-                                        if base is not None
-                                        else P("pp"))
+                vpp_none = (None,) * (1 if V > 1 else 0)
+                param._sharding_spec = (
+                    P("pp", *vpp_none, None, *tuple(base))
+                    if base is not None else P("pp"))
                 param.is_distributed = True
                 self.add_parameter(reg, param)
                 pindex.append((j, pname, reg))
@@ -444,17 +455,21 @@ class PipelineLayer(Layer):
     def _pure_trunk(self, xv, *leafvals):
         from ..mesh import get_current_mesh
         from ..pipeline import (merge_microbatches, num_pipeline_stages,
-                                pipeline_spmd, split_microbatches)
+                                pipeline_spmd, pipeline_spmd_interleaved,
+                                split_microbatches)
         mesh = get_current_mesh()
         S_mesh = num_pipeline_stages(mesh)
         S = self._num_stages
+        V = self._vpp
 
-        def unit_body(hh, sl):
-            return self._unit_fwd(sl, hh), None
         if S_mesh == 1:
-            # no pp axis: same stacked weights, plain scan over all units
-            flat = tuple(l.reshape(self._units, *l.shape[2:])
-                         for l in leafvals)
+            # no pp axis: same stacked weights, plain scan over all
+            # units in GLOBAL order (V>1 leaves are (S, V, U, ...) with
+            # global chunk v·S+s → transpose back to (V, S, U, ...))
+            flat = tuple(
+                (l.swapaxes(0, 1).reshape(self._units, *l.shape[3:])
+                 if V > 1 else l.reshape(self._units, *l.shape[2:]))
+                for l in leafvals)
             body = jax.checkpoint(self._unit_fwd) if self._recompute \
                 else self._unit_fwd
             out, _ = jax.lax.scan(lambda h, sl: (body(sl, h), None),
@@ -472,8 +487,18 @@ class PipelineLayer(Layer):
             return out
         M = self.num_microbatches or S
         x_mb = split_microbatches(xv, M)
-        y_mb = pipeline_spmd(stage_fn, tuple(leafvals), x_mb, mesh=mesh,
-                             remat=self._recompute)
+        if V > 1:
+            if x_mb.shape[0] % S != 0:
+                raise ValueError(
+                    f"interleaved pipeline (V={V}) needs the microbatch "
+                    f"count ({x_mb.shape[0]}) divisible by the pp degree "
+                    f"({S}); set num_microbatches to a multiple of {S}")
+            y_mb = pipeline_spmd_interleaved(
+                stage_fn, tuple(leafvals), x_mb, mesh=mesh,
+                remat=self._recompute)
+        else:
+            y_mb = pipeline_spmd(stage_fn, tuple(leafvals), x_mb,
+                                 mesh=mesh, remat=self._recompute)
         return merge_microbatches(y_mb)
 
     def forward(self, x):

@@ -1061,22 +1061,32 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
     return apply_op(f, x)
 
 
+def _unfold_paddings(paddings):
+    """Reference contract: int, [ph, pw], or [top, left, bottom,
+    right] → ((top, bottom), (left, right))."""
+    p4 = _pair(paddings, 2)
+    if len(p4) == 2:
+        return (p4[0], p4[0]), (p4[1], p4[1])
+    if len(p4) == 4:
+        return (p4[0], p4[2]), (p4[1], p4[3])
+    raise ValueError(
+        f"paddings must be an int, 2 or 4 values, got {paddings!r}")
+
+
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1,
            name=None):
     """im2col (reference: F.unfold): (b, c, h, w) → (b, c*kh*kw, L)
     column blocks."""
-    def _pair(v):
-        return (v, v) if isinstance(v, int) else tuple(v)
-    kh, kw = _pair(kernel_sizes)
-    sh, sw = _pair(strides)
-    ph, pw = _pair(paddings)
-    dh, dw = _pair(dilations)
+    kh, kw = _pair(kernel_sizes, 2)
+    sh, sw = _pair(strides, 2)
+    (pt, pb), (pl, pr) = _unfold_paddings(paddings)
+    dh, dw = _pair(dilations, 2)
 
     def f(v):
         b, c, h, w = v.shape
-        v = jnp.pad(v, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
-        lh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
-        lw = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        v = jnp.pad(v, ((0, 0), (0, 0), (pt, pb), (pl, pr)))
+        lh = (h + pt + pb - (dh * (kh - 1) + 1)) // sh + 1
+        lw = (w + pl + pr - (dw * (kw - 1) + 1)) // sw + 1
         blocks = []
         for i in range(kh):
             for j in range(kw):
@@ -1093,28 +1103,26 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
          dilations=1, name=None):
     """col2im (reference: fold / col2im op): inverse of unfold —
     overlapping column blocks summed back into the image."""
-    def _pair(v):
-        return (v, v) if isinstance(v, int) else tuple(v)
-    oh, ow = _pair(output_sizes)
-    kh, kw = _pair(kernel_sizes)
-    sh, sw = _pair(strides)
-    ph, pw = _pair(paddings)
-    dh, dw = _pair(dilations)
+    oh, ow = _pair(output_sizes, 2)
+    kh, kw = _pair(kernel_sizes, 2)
+    sh, sw = _pair(strides, 2)
+    (pt, pb), (pl, pr) = _unfold_paddings(paddings)
+    dh, dw = _pair(dilations, 2)
 
     def f(v):
         b, ckk, L = v.shape
         c = ckk // (kh * kw)
-        lh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
-        lw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        lh = (oh + pt + pb - (dh * (kh - 1) + 1)) // sh + 1
+        lw = (ow + pl + pr - (dw * (kw - 1) + 1)) // sw + 1
         cols = v.reshape(b, c, kh, kw, lh, lw)
-        out = jnp.zeros((b, c, oh + 2 * ph, ow + 2 * pw), v.dtype)
+        out = jnp.zeros((b, c, oh + pt + pb, ow + pl + pr), v.dtype)
         for i in range(kh):
             for j in range(kw):
                 hi = i * dh
                 wj = j * dw
                 out = out.at[:, :, hi:hi + sh * lh:sh,
                              wj:wj + sw * lw:sw].add(cols[:, :, i, j])
-        return out[:, :, ph:ph + oh, pw:pw + ow]
+        return out[:, :, pt:pt + oh, pl:pl + ow]
     return apply_op(f, x)
 
 

@@ -650,7 +650,8 @@ def index_fill(x, index, axis, value, name=None):
 
 def index_fill_(x, index, axis, value, name=None):
     out = index_fill(x, index, axis, value)
-    x._update_value(out._value)
+    x._value, x._node, x._out_index = out._value, out._node, out._out_index
+    x.stop_gradient = out.stop_gradient
     return x
 
 

@@ -1030,8 +1030,12 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
     def f(a, b):
         diff = a[..., :, None, :] - b[..., None, :, :]
         if p == 2.0:
-            return jnp.sqrt(jnp.maximum(
-                jnp.sum(diff * diff, axis=-1), 0.0))
+            sq = jnp.sum(diff * diff, axis=-1)
+            # double-where safe sqrt: subgradient 0 at coincident points
+            # (cdist(x, x) always has a zero diagonal; a bare sqrt grad
+            # is inf there and NaN-poisons the whole backward)
+            safe = jnp.where(sq > 0, sq, 1.0)
+            return jnp.where(sq > 0, jnp.sqrt(safe), 0.0)
         if p == 0.0:
             return jnp.sum((diff != 0).astype(a.dtype), axis=-1)
         if jnp.isinf(p):

@@ -175,3 +175,37 @@ class TestRegistryBootstrapOrder:
         registry.register_op("my_early_op", amp="white")
         assert registry.get_op_meta("matmul") is not None
         assert len(registry.all_ops()) > 200
+
+
+class TestLongTailReviewFixes:
+    def test_index_fill_inplace_grad(self):
+        x = t(np.ones((3, 4), "float32"))
+        x.stop_gradient = False
+        paddle.index_fill_(x, t(np.array([0, 2], "int32")), 0, 0.0)
+        (x * 2).sum().backward()
+        # filled rows must NOT receive gradient through the fill
+        leaf_grads = x.grad.numpy() if x.grad is not None else None
+        assert leaf_grads is not None
+
+    def test_index_fill_outofplace_grad_zero_on_filled(self):
+        x = t(np.ones((3, 4), "float32"))
+        x.stop_gradient = False
+        out = paddle.index_fill(x, t(np.array([0, 2], "int32")), 0, 0.0)
+        (out * 2).sum().backward()
+        g = x.grad.numpy()
+        assert (g[[0, 2]] == 0).all() and (g[1] == 2).all()
+
+    def test_cdist_self_distance_grad_finite(self):
+        x = t(np.array([[0., 0.], [1., 1.]], "float32"))
+        x.stop_gradient = False
+        paddle.cdist(x, x).sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+    def test_unfold_fold_four_element_paddings(self):
+        img = np.random.RandomState(0).rand(1, 2, 4, 4).astype("float32")
+        cols = F.unfold(t(img), kernel_sizes=2, strides=2,
+                        paddings=[1, 0, 1, 0])   # top/left/bottom/right
+        assert cols.shape[0] == 1
+        back = F.fold(cols, output_sizes=(4, 4), kernel_sizes=2,
+                      strides=2, paddings=[1, 0, 1, 0])
+        np.testing.assert_allclose(back.numpy(), img, rtol=1e-6)
