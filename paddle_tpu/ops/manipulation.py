@@ -649,9 +649,15 @@ def index_fill(x, index, axis, value, name=None):
 
 
 def index_fill_(x, index, axis, value, name=None):
+    idx_v = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    if x._inplace_wants_grad():
+        def pure(v):
+            moved = jnp.moveaxis(v, axis, 0)
+            filled = moved.at[idx_v].set(jnp.asarray(value, v.dtype))
+            return jnp.moveaxis(filled, 0, axis)
+        return x._record_inplace(pure)
     out = index_fill(x, index, axis, value)
-    x._value, x._node, x._out_index = out._value, out._node, out._out_index
-    x.stop_gradient = out.stop_gradient
+    x._update_value(out._value)
     return x
 
 

@@ -1,0 +1,193 @@
+"""Program-transform pass infrastructure over jaxprs.
+
+Reference parity: the PIR pass framework (paddle/pir/ PassManager +
+pattern rewriter, paddle/fluid/pir/transforms/ — verify) and the
+inference analysis passes (paddle/fluid/inference/analysis/ fusion
+passes — verify).
+
+TPU-native design (SURVEY §7 "PIR + passes" row): the IR is the jaxpr
+(and XLA runs its own fusion pipeline downstream, so passes here are for
+things XLA can't or won't do at the jaxpr level): dead-code elimination
+before lowering (smaller programs compile faster), constant folding,
+program statistics for cost tooling, and layer-level inference rewrites
+(conv+BN folding). A pass is ``ClosedJaxpr -> ClosedJaxpr``;
+``PassManager`` composes them and ``apply_passes`` wraps a python
+callable so the transformed program is what jit compiles.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.extend.core import (ClosedJaxpr, Jaxpr, JaxprEqn,
+                             Literal, Var)
+
+__all__ = ["PassManager", "apply_passes", "dce_pass", "fold_constants",
+           "program_stats", "fuse_conv_bn"]
+
+
+# ---------------------------------------------------------------------------
+# pass framework
+# ---------------------------------------------------------------------------
+
+class PassManager:
+    """Ordered pass pipeline (reference: pir::PassManager — verify)."""
+
+    def __init__(self, passes: Sequence[Callable] = ()):
+        self._passes: List[Callable] = list(passes)
+
+    def add_pass(self, p: Callable):
+        self._passes.append(p)
+        return self
+
+    def run(self, closed: ClosedJaxpr) -> ClosedJaxpr:
+        for p in self._passes:
+            closed = p(closed)
+        return closed
+
+    def __call__(self, closed: ClosedJaxpr) -> ClosedJaxpr:
+        return self.run(closed)
+
+
+def apply_passes(fn: Callable, *example_args, passes: Sequence[Callable]):
+    """Trace ``fn``, run the pass pipeline on its jaxpr, and return a
+    callable evaluating the TRANSFORMED program (jit-compatible)."""
+    closed = jax.make_jaxpr(fn)(*example_args)
+    closed = PassManager(passes).run(closed)
+
+    def transformed(*args):
+        out = jax.core.eval_jaxpr(closed.jaxpr, closed.consts, *args)
+        return out[0] if len(out) == 1 else tuple(out)
+    return transformed
+
+
+def _rebuild(closed: ClosedJaxpr, eqns: List[JaxprEqn]) -> ClosedJaxpr:
+    jaxpr = closed.jaxpr
+    new_jaxpr = Jaxpr(constvars=jaxpr.constvars, invars=jaxpr.invars,
+                      outvars=jaxpr.outvars, eqns=eqns,
+                      effects=jaxpr.effects)
+    return ClosedJaxpr(new_jaxpr, closed.consts)
+
+
+# ---------------------------------------------------------------------------
+# passes
+# ---------------------------------------------------------------------------
+
+def dce_pass(closed: ClosedJaxpr) -> ClosedJaxpr:
+    """Dead-code elimination: drop equations whose outputs are never
+    used (reference: pir dead_code_elimination_pass — verify). Smaller
+    jaxprs lower and compile faster; XLA would also DCE, but only after
+    paying lowering cost for the dead ops."""
+    jaxpr = closed.jaxpr
+    live = {v for v in jaxpr.outvars if isinstance(v, Var)}
+    kept: List[JaxprEqn] = []
+    for eqn in reversed(jaxpr.eqns):
+        if eqn.effects or any(isinstance(o, Var) and o in live
+                              for o in eqn.outvars):
+            kept.append(eqn)
+            for i in eqn.invars:
+                if isinstance(i, Var):
+                    live.add(i)
+    kept.reverse()
+    return _rebuild(closed, kept)
+
+
+_FOLDABLE = {"sin", "cos", "exp", "log", "sqrt", "rsqrt", "tanh", "neg",
+             "add", "sub", "mul", "div", "max", "min", "pow",
+             "integer_pow", "convert_element_type", "sign", "floor",
+             "ceil"}
+
+
+def fold_constants(closed: ClosedJaxpr) -> ClosedJaxpr:
+    """Constant folding: evaluate foldable equations whose inputs are
+    all literals/consts at pass time and splice the results in as
+    literals (reference: pir constant_folding_pass — verify)."""
+    jaxpr = closed.jaxpr
+    const_of = dict(zip(jaxpr.constvars, closed.consts))
+    known = dict(const_of)
+    new_eqns: List[JaxprEqn] = []
+    for eqn in jaxpr.eqns:
+        if (eqn.primitive.name in _FOLDABLE and not eqn.effects
+                and len(eqn.outvars) == 1
+                and all(isinstance(i, Literal) or i in known
+                        for i in eqn.invars)):
+            vals = [i.val if isinstance(i, Literal) else known[i]
+                    for i in eqn.invars]
+            out = eqn.primitive.bind(*vals, **eqn.params)
+            known[eqn.outvars[0]] = out
+            continue
+        # replace known inputs with literals
+        new_invars = [
+            Literal(known[i], i.aval)
+            if isinstance(i, Var) and i in known and not i.aval.shape
+            else i
+            for i in eqn.invars]
+        new_eqns.append(eqn.replace(invars=new_invars))
+    # outvars that became known constants need a passthrough eqn; keep
+    # it simple: only fold when every outvar is still produced
+    produced = {o for e in new_eqns for o in e.outvars}
+    produced.update(jaxpr.constvars)
+    produced.update(jaxpr.invars)
+    if any(isinstance(o, Var) and o not in produced and o in known
+           for o in jaxpr.outvars):
+        # an output folded away entirely — bail to the safe jaxpr
+        return dce_pass(closed)
+    return dce_pass(_rebuild(closed, new_eqns))
+
+
+def program_stats(closed: ClosedJaxpr) -> dict:
+    """Per-primitive op counts + totals (reference: the pir program
+    statistics used by cost tooling — verify)."""
+    counts = collections.Counter(
+        e.primitive.name for e in closed.jaxpr.eqns)
+    return {"n_eqns": len(closed.jaxpr.eqns),
+            "n_invars": len(closed.jaxpr.invars),
+            "primitives": dict(counts)}
+
+
+# ---------------------------------------------------------------------------
+# layer-level inference rewrites
+# ---------------------------------------------------------------------------
+
+def fuse_conv_bn(model):
+    """Fold BatchNorm into the preceding Conv2D for inference
+    (reference: inference analysis conv_bn_fuse_pass — verify): replaces
+    W with W·γ/σ and b with (b-μ)·γ/σ+β, then the BN becomes identity.
+    Works on any Layer whose sublayer sequence contains Conv2D→BN pairs
+    (nn.Sequential or custom with ordered _sub_layers). Returns the
+    model, mutated in place; call under .eval() semantics."""
+    from ..nn.conv import Conv2D
+    from ..nn.norm import BatchNorm2D, _BatchNormBase
+
+    def fold(conv, bn):
+        import numpy as np
+        eps = bn.epsilon
+        gamma = bn.weight._value
+        beta = bn.bias._value
+        mu = bn._mean._value
+        var = bn._variance._value
+        scale = gamma / jnp.sqrt(var + eps)
+        w = conv.weight._value * scale.reshape(-1, 1, 1, 1)
+        conv.weight._update_value(w)
+        if conv.bias is None:
+            from ..tensor import Parameter
+            conv.bias = Parameter(jnp.zeros((w.shape[0],), w.dtype))
+        b = (conv.bias._value - mu) * scale + beta
+        conv.bias._update_value(b)
+        # neutralize the BN: identity transform
+        bn.weight._update_value(jnp.ones_like(gamma))
+        bn.bias._update_value(jnp.zeros_like(beta))
+        bn._mean._update_value(jnp.zeros_like(mu))
+        bn._variance._update_value(jnp.ones_like(var) - eps)
+
+    def walk(layer):
+        subs = list(layer._sub_layers.values())
+        for a, b in zip(subs, subs[1:]):
+            if isinstance(a, Conv2D) and isinstance(b, _BatchNormBase):
+                fold(a, b)
+        for s in subs:
+            walk(s)
+    walk(model)
+    return model

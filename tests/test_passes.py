@@ -1,0 +1,86 @@
+"""jaxpr pass infrastructure (reference: pir PassManager + pattern
+rewriter, inference conv_bn_fuse_pass — SURVEY §2.1 'PIR + passes')."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.passes import (PassManager, apply_passes, dce_pass,
+                               fold_constants, program_stats,
+                               fuse_conv_bn)
+
+
+class TestJaxprPasses:
+    def _trace(self, f, *args):
+        return jax.make_jaxpr(f)(*args)
+
+    def test_dce_removes_dead_eqns(self):
+        def f(x):
+            dead = jnp.exp(x) + 5.0      # never used
+            return x * 2.0
+        closed = self._trace(f, jnp.ones(3))
+        before = program_stats(closed)["n_eqns"]
+        after = program_stats(dce_pass(closed))["n_eqns"]
+        assert after < before
+        out = apply_passes(f, jnp.ones(3), passes=[dce_pass])(
+            jnp.ones(3))
+        np.testing.assert_allclose(np.asarray(out), 2.0 * np.ones(3))
+
+    def test_dce_preserves_semantics_under_jit(self):
+        def f(x, y):
+            a = x @ y
+            unused = jnp.sin(a).sum()
+            return jnp.tanh(a)
+        x = jnp.ones((3, 4)); y = jnp.ones((4, 2))
+        g = apply_passes(f, x, y, passes=[dce_pass])
+        np.testing.assert_allclose(np.asarray(jax.jit(g)(x, y)),
+                                   np.asarray(f(x, y)), rtol=1e-6)
+
+    def test_constant_folding(self):
+        def f(x):
+            w = jnp.sin(jnp.float32(2.0))   # foldable at trace time
+            return x * w
+        closed = self._trace(f, jnp.ones(3))
+        folded = fold_constants(closed)
+        assert program_stats(folded)["primitives"].get("sin", 0) == 0
+        out = jax.core.eval_jaxpr(folded.jaxpr, folded.consts,
+                                  jnp.ones(3))[0]
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.sin(2.0) * np.ones(3), rtol=1e-6)
+
+    def test_pass_manager_pipeline(self):
+        def f(x):
+            dead = x + 1.0
+            w = jnp.exp(jnp.float32(0.0))
+            return x * w
+        closed = self._trace(f, jnp.ones(2))
+        pm = PassManager([fold_constants, dce_pass])
+        out_closed = pm(closed)
+        stats = program_stats(out_closed)
+        assert stats["primitives"].get("exp", 0) == 0
+        assert stats["primitives"].get("add", 0) == 0
+
+
+class TestConvBnFuse:
+    def test_fused_matches_unfused_eval(self):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1),
+                          nn.BatchNorm2D(8), nn.ReLU(),
+                          nn.Conv2D(8, 4, 3, padding=1),
+                          nn.BatchNorm2D(4))
+        # train a few steps so BN stats are non-trivial
+        from paddle_tpu import optimizer
+        opt = optimizer.SGD(learning_rate=0.01,
+                            parameters=m.parameters())
+        rs = np.random.RandomState(0)
+        for _ in range(3):
+            x = paddle.to_tensor(rs.rand(4, 3, 8, 8).astype("float32"))
+            loss = (m(x) ** 2).mean()
+            loss.backward(); opt.step(); opt.clear_grad()
+        m.eval()
+        x = paddle.to_tensor(rs.rand(2, 3, 8, 8).astype("float32"))
+        ref = m(x).numpy()
+        fuse_conv_bn(m)
+        np.testing.assert_allclose(m(x).numpy(), ref, rtol=1e-4,
+                                   atol=1e-5)
