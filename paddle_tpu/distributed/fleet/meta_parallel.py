@@ -476,10 +476,11 @@ class PipelineLayer(Layer):
                                   xv, flat)
             return out
         if S_mesh != S:
-            raise ValueError(
+            from ...utils.enforce import InvalidArgumentError
+            raise InvalidArgumentError(
                 f"PipelineLayer was built with num_stages={S} but the "
-                f"active mesh has pp={S_mesh}; re-build the model or the "
-                "mesh so the degrees agree.")
+                f"active mesh has pp={S_mesh}",
+                "re-build the model or the mesh so the degrees agree")
 
         def stage_fn(local, h):
             out, _ = jax.lax.scan(lambda hh, sl: (self._unit_fwd(sl, hh),

@@ -487,3 +487,40 @@ class DataLoader:
                 next_emit += 1
         finally:
             stop.set()
+
+
+def device_prefetch(loader, size=2, sharding=None):
+    """Wrap a batch iterator so batches are transferred to device ``size``
+    steps ahead of consumption (reference: the DataLoader buffer reader /
+    pin-memory double buffering — python/paddle/io/dataloader — verify).
+
+    On TPU, jax device transfers are async: enqueueing the NEXT batch's
+    host->device copy before the current step finishes overlaps input IO
+    with compute. ``sharding`` (e.g. NamedSharding(mesh, P("dp"))) places
+    each leaf directly into its dp-sharded layout."""
+    import collections as _c
+
+    import jax as _jax
+
+    from ..tensor import Tensor as _T
+
+    def _put(x):
+        v = x._value if isinstance(x, _T) else x
+        v = _jax.device_put(v, sharding) if sharding is not None \
+            else _jax.device_put(v)
+        return _T(v) if isinstance(x, _T) else v
+
+    def _transfer(batch):
+        return _jax.tree.map(_put, batch,
+                             is_leaf=lambda x: isinstance(x, _T))
+
+    queue = _c.deque()
+    for batch in loader:
+        queue.append(_transfer(batch))
+        if len(queue) > size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()
+
+
+__all__.append("device_prefetch")

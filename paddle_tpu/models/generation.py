@@ -158,10 +158,13 @@ class GenerationMixin:
         limit = getattr(getattr(self, "config", None),
                         "max_position_embeddings", None)
         if limit is not None and total > limit:
-            raise ValueError(
+            from ..utils.enforce import OutOfRangeError
+            raise OutOfRangeError(
                 f"prompt ({s}) + new tokens ({max_new}) = {total} exceeds "
-                f"max_position_embeddings={limit}; positions past the "
-                "RoPE/position table would silently clamp")
+                f"max_position_embeddings={limit}",
+                "positions past the RoPE/position table would silently "
+                "clamp; raise max_position_embeddings or shorten the "
+                "request")
         if not do_sample:
             temperature = 0.0
         sample_kwargs = dict(temperature=temperature, top_k=top_k,

@@ -1,6 +1,12 @@
 """Utilities (reference: python/paddle/utils/ — verify)."""
 from . import flags        # noqa: F401
+from . import enforce      # noqa: F401
 from .run_check import run_check  # noqa: F401
+from .enforce import (EnforceNotMet, InvalidArgumentError,  # noqa: F401
+                      NotFoundError, OutOfRangeError,
+                      AlreadyExistsError, PermissionDeniedError,
+                      PreconditionNotMetError, UnimplementedError,
+                      UnavailableError, ExecutionTimeoutError)
 
 
 def try_import(module_name):

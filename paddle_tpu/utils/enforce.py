@@ -1,0 +1,147 @@
+"""Systematic error layer (reference: paddle/common/enforce.h
+PADDLE_ENFORCE_* macros + the typed error hierarchy surfaced to Python
+as paddle.base.core.EnforceNotMet subtypes — verify).
+
+TPU-native design: the reference's macro layer exists because C++ has
+no exceptions-with-context discipline; here the value is (a) ONE typed
+error hierarchy users can catch precisely, (b) enforce helpers that
+produce uniform, actionable messages (expected vs actual, a hint), and
+(c) shape/dtype checks that read well at call sites. XLA/jax errors are
+re-raised through `rethrow` with framework context attached."""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+__all__ = ["EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+           "OutOfRangeError", "AlreadyExistsError", "PermissionDeniedError",
+           "PreconditionNotMetError", "UnimplementedError",
+           "UnavailableError", "ExecutionTimeoutError", "enforce",
+           "enforce_eq", "enforce_gt", "enforce_ge", "enforce_in",
+           "enforce_shape", "enforce_dtype", "rethrow"]
+
+
+class EnforceNotMet(RuntimeError):
+    """Root of the framework error hierarchy (reference: EnforceNotMet)."""
+
+    def __init__(self, message: str, hint: Optional[str] = None):
+        self.hint = hint
+        full = message if hint is None else f"{message}\n  [Hint: {hint}]"
+        self._formatted = full
+        super().__init__(full)
+
+    def __str__(self):
+        # KeyError.__str__ would repr-quote the message and escape the
+        # hint newline; always render the formatted text
+        return self._formatted
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError, ValueError):
+    # also a ValueError: pre-enforce call sites raised ValueError for
+    # range violations, and callers catching it must keep working
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+def enforce(cond: Any, message: str, hint: Optional[str] = None,
+            error: type = PreconditionNotMetError):
+    """PADDLE_ENFORCE: raise ``error`` with a uniform message when the
+    condition is falsy."""
+    if not cond:
+        raise error(message, hint)
+    return cond
+
+
+def enforce_eq(actual, expected, what: str, hint: Optional[str] = None,
+               error: type = InvalidArgumentError):
+    if actual != expected:
+        raise error(f"{what}: expected {expected!r}, got {actual!r}", hint)
+    return actual
+
+
+def enforce_gt(actual, bound, what: str, hint: Optional[str] = None):
+    if not actual > bound:
+        raise InvalidArgumentError(
+            f"{what}: expected > {bound!r}, got {actual!r}", hint)
+    return actual
+
+
+def enforce_ge(actual, bound, what: str, hint: Optional[str] = None):
+    if not actual >= bound:
+        raise InvalidArgumentError(
+            f"{what}: expected >= {bound!r}, got {actual!r}", hint)
+    return actual
+
+
+def enforce_in(value, allowed: Sequence, what: str,
+               hint: Optional[str] = None):
+    if value not in allowed:
+        raise InvalidArgumentError(
+            f"{what}: expected one of {list(allowed)!r}, got {value!r}",
+            hint)
+    return value
+
+
+def enforce_shape(x, expected_shape: Sequence, what: str = "tensor",
+                  hint: Optional[str] = None):
+    """Shape check with wildcards: None/-1 entries match any size."""
+    shape = tuple(getattr(x, "shape", x))
+    exp = tuple(expected_shape)
+    ok = len(shape) == len(exp) and all(
+        e is None or e == -1 or int(e) == int(s)
+        for s, e in zip(shape, exp))
+    if not ok:
+        raise InvalidArgumentError(
+            f"{what}: expected shape {list(exp)!r}, got {list(shape)!r}",
+            hint)
+    return x
+
+
+def enforce_dtype(x, expected, what: str = "tensor",
+                  hint: Optional[str] = None):
+    import numpy as np
+    from ..framework import convert_dtype
+    exp = np.dtype(convert_dtype(expected))
+    actual = np.dtype(getattr(x, "dtype", x))
+    if actual != exp:
+        raise InvalidArgumentError(
+            f"{what}: expected dtype {exp}, got {actual}", hint)
+    return x
+
+
+def rethrow(exc: BaseException, context: str,
+            error: type = EnforceNotMet):
+    """Wrap a lower-level (jax/XLA) exception with framework context —
+    the reference's error-stack annotation (external error classes
+    decoded into EnforceNotMet — verify)."""
+    raise error(f"{context}: {type(exc).__name__}: {exc}") from exc

@@ -102,3 +102,34 @@ def test_batch_sampler_custom_sampler():
     assert [len(b) for b in bs] == [3, 3, 3, 1]
     rs = RandomSampler(Squares(10))
     assert sorted(iter(rs)) == list(range(10))
+
+
+class TestDevicePrefetch:
+    def test_prefetch_preserves_order_and_values(self):
+        import numpy as np
+        from paddle_tpu.io import DataLoader, Dataset, device_prefetch
+
+        class DS(Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                return np.full((3,), i, "float32"), np.int64(i)
+        dl = DataLoader(DS(), batch_size=4)
+        seen = []
+        for xb, yb in device_prefetch(dl, size=2):
+            assert hasattr(xb._value, "devices")   # already on device
+            seen.extend(int(v) for v in yb.numpy())
+        assert seen == list(range(12))
+
+    def test_prefetch_with_sharding(self):
+        import jax
+        import numpy as np
+        from jax.sharding import NamedSharding, Mesh, PartitionSpec as P
+        from paddle_tpu.io import device_prefetch
+        mesh = Mesh(np.array(jax.devices()), ("dp",))
+        sh = NamedSharding(mesh, P("dp"))
+        batches = [np.arange(16, dtype="float32") for _ in range(3)]
+        out = list(device_prefetch(iter(batches), size=1, sharding=sh))
+        assert len(out) == 3
+        assert out[0].sharding == sh

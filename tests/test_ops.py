@@ -226,3 +226,66 @@ def test_inplace_and_item():
     np.testing.assert_allclose(t.numpy(), [2.0, 3.0])
     assert paddle.to_tensor(3.5).item() == 3.5
     assert paddle.to_tensor([[1, 2]]).tolist() == [[1, 2]]
+
+
+class TestEnforceLayer:
+    """Systematic error layer (reference: PADDLE_ENFORCE_* + typed
+    EnforceNotMet hierarchy — SURVEY §2.1 'Enforce')."""
+
+    def test_typed_hierarchy_catchable_both_ways(self):
+        import pytest
+        from paddle_tpu.utils.enforce import (InvalidArgumentError,
+                                              EnforceNotMet, enforce_eq)
+        with pytest.raises(InvalidArgumentError):
+            enforce_eq(3, 4, "degree")
+        with pytest.raises(ValueError):     # stays ValueError-compatible
+            enforce_eq(3, 4, "degree")
+        with pytest.raises(EnforceNotMet, match="expected 4, got 3"):
+            enforce_eq(3, 4, "degree")
+
+    def test_helpers_and_hints(self):
+        import pytest
+        import numpy as np
+        from paddle_tpu.utils import enforce as E
+        E.enforce(True, "fine")
+        E.enforce_ge(5, 5, "n")
+        E.enforce_in("ring", ("ring", "ulysses"), "mode")
+        E.enforce_shape(np.zeros((2, 3)), [None, 3])
+        E.enforce_dtype(np.zeros((1,), "float32"), "float32")
+        with pytest.raises(E.InvalidArgumentError, match="Hint"):
+            E.enforce_shape(np.zeros((2, 3)), [4, 3], "w",
+                            hint="transpose your input")
+        with pytest.raises(E.PreconditionNotMetError):
+            E.enforce(False, "nope")
+
+    def test_rethrow_wraps_with_context(self):
+        import pytest
+        from paddle_tpu.utils.enforce import rethrow, EnforceNotMet
+        try:
+            raise KeyError("missing")
+        except KeyError as e:
+            with pytest.raises(EnforceNotMet, match="loading ckpt"):
+                rethrow(e, "loading ckpt")
+
+    def test_generation_uses_typed_error(self):
+        import pytest
+        import numpy as np
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        from paddle_tpu.utils.enforce import OutOfRangeError
+        paddle.seed(0)
+        m = LlamaForCausalLM(llama_tiny_config(tensor_parallel=False))
+        ids = paddle.to_tensor(np.zeros((1, 8), "int32"))
+        with pytest.raises(OutOfRangeError):
+            m.generate(ids, max_new_tokens=10_000)
+
+    def test_notfound_str_and_range_valueerror_compat(self):
+        import pytest
+        from paddle_tpu.utils.enforce import (NotFoundError,
+                                              OutOfRangeError)
+        e = NotFoundError("ckpt not found", "check the path")
+        assert str(e) == "ckpt not found\n  [Hint: check the path]"
+        with pytest.raises(ValueError):      # back-compat
+            raise OutOfRangeError("too long")
+        import paddle_tpu.utils as U
+        assert U.AlreadyExistsError and U.ExecutionTimeoutError
