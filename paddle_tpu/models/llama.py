@@ -55,6 +55,11 @@ class LlamaConfig:
     pp_num_microbatches: int = 4
     scan_layers: bool = False           # stacked trunk, scan over layers
     recompute: bool = False             # per-layer activation checkpointing
+    # "full": save only layer boundaries (min memory, recompute all);
+    # "selective": save matmul outputs, recompute elementwise (the
+    # standard MFU/memory trade — reference: selective recompute,
+    # fleet/recompute refined_recompute — verify)
+    recompute_granularity: str = "full"
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -64,6 +69,11 @@ class LlamaConfig:
                 f"unknown sequence_parallel_mode="
                 f"{self.sequence_parallel_mode!r}; expected 'megatron', "
                 f"'ring', or 'ulysses'")
+        if self.recompute_granularity not in ("full", "selective"):
+            raise ValueError(
+                f"recompute_granularity="
+                f"{self.recompute_granularity!r}; expected 'full' or "
+                "'selective'")
         if self.pipeline_parallel and \
                 self.sequence_parallel_mode in ("ring", "ulysses"):
             raise ValueError(
@@ -299,7 +309,12 @@ class LlamaDecoderStack(nn.Layer):
         proto_params = dict(self._proto.named_parameters())
         fwd = functools.partial(self._layer_fwd, proto_params)
         if cfg.recompute:
-            fwd = jax.checkpoint(fwd, static_argnums=())
+            if cfg.recompute_granularity == "selective":
+                policy = jax.checkpoint_policies \
+                    .dots_with_no_batch_dims_saveable
+                fwd = jax.checkpoint(fwd, policy=policy)
+            else:
+                fwd = jax.checkpoint(fwd)
 
         mesh = get_current_mesh()
         S = num_pipeline_stages(mesh) if cfg.pipeline_parallel else 1

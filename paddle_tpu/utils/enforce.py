@@ -130,8 +130,12 @@ def enforce_shape(x, expected_shape: Sequence, what: str = "tensor",
 def enforce_dtype(x, expected, what: str = "tensor",
                   hint: Optional[str] = None):
     import numpy as np
-    from ..framework import convert_dtype
-    exp = np.dtype(convert_dtype(expected))
+    try:
+        exp = np.dtype(expected)   # validate as-is: no 64->32 creation
+        #                            policy when CHECKING existing data
+    except TypeError:
+        from ..framework import convert_dtype
+        exp = np.dtype(convert_dtype(expected))
     actual = np.dtype(getattr(x, "dtype", x))
     if actual != exp:
         raise InvalidArgumentError(

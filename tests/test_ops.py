@@ -252,6 +252,8 @@ class TestEnforceLayer:
         E.enforce_in("ring", ("ring", "ulysses"), "mode")
         E.enforce_shape(np.zeros((2, 3)), [None, 3])
         E.enforce_dtype(np.zeros((1,), "float32"), "float32")
+        E.enforce_dtype(np.zeros((1,), "int64"), "int64")   # no 64->32
+        E.enforce_dtype(np.zeros((1,), "float64"), "float64")
         with pytest.raises(E.InvalidArgumentError, match="Hint"):
             E.enforce_shape(np.zeros((2, 3)), [4, 3], "w",
                             hint="transpose your input")

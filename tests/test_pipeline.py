@@ -593,3 +593,36 @@ class TestInterleavedPipeline:
                  paddle.to_tensor(rs.randn(8, 2).astype("float32")))
         losses = [float(step(batch).item()) for _ in range(8)]
         assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+class TestSelectiveRecompute:
+    def test_selective_trains_and_uses_more_memory_than_full(self):
+        """recompute_granularity='selective' keeps matmul outputs: it
+        must train identically and hold MORE residuals than 'full'."""
+        import paddle_tpu.optimizer as optimizer
+        temps, losses = {}, {}
+        for gran in ("full", "selective"):
+            paddle.seed(0)
+            cfg = llama_tiny_config(tensor_parallel=False,
+                                    scan_layers=True, recompute=True,
+                                    recompute_granularity=gran)
+            model = LlamaForCausalLM(cfg)
+            opt = optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=model.parameters())
+
+            def loss_fn(m, b):
+                loss, _ = m(b[0], b[1])
+                return loss
+            step = TrainStep(model, loss_fn, opt)
+            ids = paddle.to_tensor(np.random.RandomState(0).randint(
+                0, cfg.vocab_size, (2, 32)).astype(np.int32))
+            losses[gran] = float(step((ids, ids)).item())
+            assert np.isfinite(losses[gran])
+            c = step.lower((ids, ids)).compile()
+            temps[gran] = c.memory_analysis().temp_size_in_bytes
+        # identical numerics, strictly more saved residuals
+        assert losses["selective"] == losses["full"], losses
+        assert temps["selective"] > temps["full"], temps
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="recompute_granularity"):
+            llama_tiny_config(recompute_granularity="selectve")
