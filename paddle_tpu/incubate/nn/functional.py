@@ -128,3 +128,53 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, **kwargs):
     return F.flash_attention(query, key, value, dropout, causal,
                              return_softmax)
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU (reference: incubate/nn/functional/swiglu — verify):
+    silu(x) * y; with y=None, x is split in half along the last dim."""
+    from ...tensor import apply_op
+    import jax
+
+    if y is None:
+        def f(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return apply_op(f, x)
+    return apply_op(lambda a, b: jax.nn.silu(a) * b, x, y)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None):
+    """LayerNorm with optional pre-norm bias+residual add fused in
+    (reference: fused_layer_norm — verify); XLA fuses the chain.
+    Returns (out, residual_out) when ``residual`` is given — the
+    reference contract (the pre-norm sum feeds the next block)."""
+    if bias is not None:
+        x = x + bias
+    if residual is not None:
+        x = x + residual
+    axis = begin_norm_axis if begin_norm_axis >= 0 \
+        else len(x.shape) + begin_norm_axis
+    out = F.layer_norm(x, x.shape[axis:], norm_weight, norm_bias,
+                       epsilon)
+    if residual is not None:
+        return out, x
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """x+bias → dropout → +residual → LN (reference:
+    fused_bias_dropout_residual_layer_norm — verify)."""
+    if bias is not None:
+        x = x + bias
+    x = F.dropout(x, dropout_rate, training=training, mode=mode)
+    x = x + residual
+    return F.layer_norm(x, x.shape[-1:], ln_scale, ln_bias, ln_epsilon)
+
+
+__all__ += ["swiglu", "fused_layer_norm",
+            "fused_bias_dropout_residual_layer_norm"]

@@ -649,6 +649,9 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
     if not training or p == 0.0:
+        if mode == "downscale_in_infer" and p > 0.0 and not training:
+            # reference contract: this mode scales at INFERENCE by (1-p)
+            return apply_op(lambda v: (v * (1.0 - p)).astype(v.dtype), x)
         return x if isinstance(x, Tensor) else to_tensor(x)
     key = framework.split_key()
 

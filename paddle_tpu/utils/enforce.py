@@ -130,12 +130,16 @@ def enforce_shape(x, expected_shape: Sequence, what: str = "tensor",
 def enforce_dtype(x, expected, what: str = "tensor",
                   hint: Optional[str] = None):
     import numpy as np
-    try:
-        exp = np.dtype(expected)   # validate as-is: no 64->32 creation
-        #                            policy when CHECKING existing data
-    except TypeError:
-        from ..framework import convert_dtype
+    if isinstance(expected, type):      # float/int/bool follow the
+        from ..framework import convert_dtype   # framework policy
         exp = np.dtype(convert_dtype(expected))
+    else:
+        try:
+            exp = np.dtype(expected)   # validate as-is: no 64->32
+            #                            policy for explicit strings
+        except TypeError:
+            from ..framework import convert_dtype
+            exp = np.dtype(convert_dtype(expected))
     actual = np.dtype(getattr(x, "dtype", x))
     if actual != exp:
         raise InvalidArgumentError(

@@ -134,7 +134,9 @@ def main():
                  "target": "v5p-32 (virtual; CPU AOT)"},
         "config": {"batch": batch, "seq": seq,
                    "microbatches": cfg.pp_num_microbatches,
-                   "dtype": "bfloat16", "remat": "selective",
+                   "dtype": "bfloat16",
+                   "remat": cfg.recompute_granularity
+                   if cfg.recompute else "none",
                    "optimizer": "AdamW bf16 states, no master copies",
                    "donation": "params+opt_state donated"},
         "per_device": per_dev,

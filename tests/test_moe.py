@@ -194,3 +194,41 @@ def test_capacity_factor_from_gate():
     gate = GShardGate(16, 4, topk=2, capacity_factor=2.5)
     moe = MoELayer(d_model=16, num_expert=4, d_hidden=32, gate=gate)
     assert moe.capacity_factor == 2.5
+
+
+class TestIncubateFunctionalSurface:
+    def test_swiglu_both_forms(self):
+        import numpy as np
+        import jax
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn.functional import swiglu
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 8).astype("float32")
+        y = rs.rand(2, 8).astype("float32")
+        out = swiglu(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+        ref = np.asarray(jax.nn.silu(x)) * y
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+        xc = np.concatenate([x, y], axis=-1)
+        out2 = swiglu(paddle.to_tensor(xc)).numpy()
+        np.testing.assert_allclose(out2, ref, rtol=1e-6)
+
+    def test_fused_bias_dropout_residual_ln(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.incubate.nn.functional import (
+            fused_bias_dropout_residual_layer_norm, fused_layer_norm)
+        rs = np.random.RandomState(1)
+        x = paddle.to_tensor(rs.rand(2, 4, 8).astype("float32"))
+        res = paddle.to_tensor(rs.rand(2, 4, 8).astype("float32"))
+        w = paddle.to_tensor(np.ones(8, "float32"))
+        b = paddle.to_tensor(np.zeros(8, "float32"))
+        out = fused_bias_dropout_residual_layer_norm(
+            x, res, ln_scale=w, ln_bias=b, dropout_rate=0.0,
+            training=False)
+        assert out.shape == [2, 4, 8]
+        np.testing.assert_allclose(out.numpy().mean(axis=-1), 0.0,
+                                   atol=1e-5)
+        out2, res_out = fused_layer_norm(x, w, b, residual=res)
+        np.testing.assert_allclose(out2.numpy(), out.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(res_out.numpy(),
+                                   (x + res).numpy(), rtol=1e-6)

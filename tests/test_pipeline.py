@@ -620,8 +620,10 @@ class TestSelectiveRecompute:
             assert np.isfinite(losses[gran])
             c = step.lower((ids, ids)).compile()
             temps[gran] = c.memory_analysis().temp_size_in_bytes
-        # identical numerics, strictly more saved residuals
-        assert losses["selective"] == losses["full"], losses
+        # identical numerics (up to fusion reassociation), strictly
+        # more saved residuals
+        np.testing.assert_allclose(losses["selective"], losses["full"],
+                                   rtol=1e-5)
         assert temps["selective"] > temps["full"], temps
         import pytest as _pytest
         with _pytest.raises(ValueError, match="recompute_granularity"):
