@@ -50,7 +50,7 @@ def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
 
 
 def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
-                     sin=None):
+                     sin=None, window=None):
     """KV-cache attention step (pure jax), shared by every causal LM:
     optional RoPE at offset ``posv`` (cos=None skips it — e.g. GPT's
     learned positions), k/v written into the preallocated cache with
@@ -75,6 +75,8 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
     t_idx = jnp.arange(ck.shape[1])
     q_idx = posv + jnp.arange(s)
     mask = t_idx[None, :] <= q_idx[:, None]            # (s, T) causal
+    if window is not None:                     # sliding window: last W
+        mask = mask & (t_idx[None, :] > q_idx[:, None] - int(window))
     scores = jnp.where(mask[None, None, None], scores,
                        jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)

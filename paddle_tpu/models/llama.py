@@ -60,6 +60,8 @@ class LlamaConfig:
     # standard MFU/memory trade — reference: selective recompute,
     # fleet/recompute refined_recompute — verify)
     recompute_granularity: str = "full"
+    # Mistral-class sliding-window causal attention (None = full causal)
+    sliding_window: int | None = None
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -69,6 +71,17 @@ class LlamaConfig:
                 f"unknown sequence_parallel_mode="
                 f"{self.sequence_parallel_mode!r}; expected 'megatron', "
                 f"'ring', or 'ulysses'")
+        if self.sliding_window is not None and self.sliding_window <= 0:
+            raise ValueError(
+                f"sliding_window={self.sliding_window}; expected a "
+                "positive window size or None (disabled)")
+        if self.sliding_window is not None and self.sequence_parallel \
+                and self.sequence_parallel_mode in ("ring", "ulysses"):
+            raise ValueError(
+                "sliding_window is not yet supported with ring/ulysses "
+                "context parallelism (the CP kernels compute full causal "
+                "attention); use sequence_parallel_mode='megatron' or "
+                "disable the window")
         if self.recompute_granularity not in ("full", "selective"):
             raise ValueError(
                 f"recompute_granularity="
@@ -157,7 +170,8 @@ class LlamaAttention(nn.Layer):
             ck, cv = cache
             out, nck, ncv = apply_op(
                 functools.partial(cached_attention, cos=cos, sin=sin,
-                                  scale=1.0 / math.sqrt(self.head_dim)),
+                                  scale=1.0 / math.sqrt(self.head_dim),
+                                  window=self.config.sliding_window),
                 q, k, v, ck, cv, pos)
             out = reshape(out, (b, s, self.num_heads * self.head_dim))
             return self.o_proj(out), (nck, ncv)
@@ -179,8 +193,9 @@ class LlamaAttention(nn.Layer):
                     lambda qv, kv_, vv: fn(qv, kv_, vv, mesh=mesh,
                                            causal=True), q, k, v)
         if out is None:
-            out = F.scaled_dot_product_attention(q, k, v, attn_mask,
-                                                 is_causal=attn_mask is None)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask, is_causal=attn_mask is None,
+                sliding_window=cfg.sliding_window)
         out = reshape(out, (b, s, self.num_heads * self.head_dim))
         return self.o_proj(out)
 

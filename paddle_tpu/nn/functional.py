@@ -893,16 +893,19 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
-                                 training=True, name=None):
+                                 training=True, sliding_window=None,
+                                 name=None):
     """q/k/v: (batch, seq, heads, head_dim) — paddle convention. Delegates to
     the Pallas flash-attention kernel on TPU when shapes allow, else the
-    XLA-fused reference path."""
+    XLA-fused reference path. ``sliding_window``: Mistral-class banded
+    causal attention (each query sees at most the last W keys)."""
     from ..ops.pallas import flash_attention as fa
     args = [query, key, value] + ([attn_mask] if attn_mask is not None else [])
 
     def f(q, k, v, *m):
         return fa.sdpa(q, k, v, m[0] if m else None, is_causal=is_causal,
-                       dropout_p=dropout_p if training else 0.0)
+                       dropout_p=dropout_p if training else 0.0,
+                       window=sliding_window)
     return apply_op(f, *args)
 
 

@@ -20,8 +20,10 @@ import numpy as np
 
 
 def _xla_sdpa(q, k, v, mask=None, is_causal=False, dropout_p=0.0,
-              scale=None):
-    """Reference path: materializes scores; XLA fuses. bshd layout."""
+              scale=None, window=None):
+    """Reference path: materializes scores; XLA fuses. bshd layout.
+    ``window``: sliding-window (Mistral-class) attention — each query
+    attends to at most the last ``window`` keys."""
     *_, sq, hq, d = q.shape
     sk = k.shape[1]
     hk = k.shape[2]
@@ -33,8 +35,17 @@ def _xla_sdpa(q, k, v, mask=None, is_causal=False, dropout_p=0.0,
     # (b, h, sq, sk)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
-    if is_causal:
+    if is_causal or window is not None:
+        # sliding window implies causal banding even when the caller
+        # supplies its own (e.g. padding) mask with is_causal=False —
+        # otherwise training with masks and cached decode would silently
+        # apply different attention patterns
         causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        if window is not None:
+            # banded: q position p attends keys (p-window, p]
+            band = jnp.triu(jnp.ones((sq, sk), bool),
+                            k=sk - sq - int(window) + 1)
+            causal = causal & band
         scores = jnp.where(causal[None, None], scores, -jnp.inf)
     if mask is not None:
         if mask.dtype == jnp.bool_:
@@ -328,12 +339,18 @@ def sdpa_last_dispatch() -> str:
     return LAST_DISPATCH
 
 
-def sdpa(q, k, v, mask=None, is_causal=False, dropout_p=0.0, scale=None):
+def sdpa(q, k, v, mask=None, is_causal=False, dropout_p=0.0, scale=None,
+         window=None):
     """Scaled dot-product attention, bshd layout, fp32 accumulation.
     TPU dispatch order: jax's tuned flash kernel -> our fused flash
-    kernel -> XLA-fused reference (O(s^2) scores)."""
+    kernel -> XLA-fused reference (O(s^2) scores). ``window`` (sliding
+    window) currently runs the masked XLA path."""
     global LAST_DISPATCH, _FALLBACK_WARNED
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if window is not None:
+        LAST_DISPATCH = "xla"
+        return _xla_sdpa(q, k, v, mask, is_causal, dropout_p, scale,
+                         window=window)
     if (mask is None and dropout_p == 0.0 and _pallas_available()):
         # trace-time failures in either Pallas path fall back to XLA
         # (compile-time Mosaic errors surface later and are covered by
