@@ -16,7 +16,8 @@ from .framework import (set_default_dtype, get_default_dtype, seed,
 from .tensor import Tensor, Parameter, to_tensor
 from .ops import *                      # noqa: F401,F403 — op table
 from . import ops
-from .autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled
+from .autograd import (no_grad, enable_grad, set_grad_enabled,
+                       is_grad_enabled, grad)
 from . import autograd
 
 # subpackages (imported lazily-ish but eagerly fine; keep import light)

@@ -9,7 +9,8 @@ from ..framework import set_device, get_device, Place
 __all__ = ["set_device", "get_device", "get_available_device",
            "get_available_custom_device", "device_count", "cuda",
            "is_compiled_with_cuda", "synchronize", "Stream", "Event",
-           "current_stream", "set_stream", "stream_guard"]
+           "current_stream", "set_stream", "stream_guard",
+           "register_custom_device", "get_all_custom_device_type"]
 
 
 def get_available_device():
@@ -17,7 +18,13 @@ def get_available_device():
 
 
 def get_available_custom_device():
-    return []
+    out = []
+    for name in _CUSTOM_DEVICES:
+        try:
+            out.extend(f"{name}:{d.id}" for d in jax.devices(name))
+        except Exception:
+            pass   # registered but backend not (yet) loaded
+    return out
 
 
 def device_count():
@@ -176,3 +183,54 @@ class stream_guard:
 
 
 cuda = _CudaNamespace()
+
+
+# ---------------------------------------------------------------------------
+# custom-device plugin surface (reference: paddle/phi/backends custom
+# device C API + CustomDevice registration — verify)
+# ---------------------------------------------------------------------------
+
+_CUSTOM_DEVICES: dict = {}
+
+
+def register_custom_device(name: str, library_path: str):
+    """Register an out-of-tree accelerator plugin (reference: the custom
+    -device C API loading device_ext.so — verify). TPU-native analogue:
+    a PJRT plugin .so — jax discovers it through
+    ``PJRT_NAMES_AND_LIBRARY_PATHS``. Must be called BEFORE the first
+    backend use; raises if the backend already initialized or the
+    library does not exist."""
+    import os
+
+    from ..utils.enforce import (AlreadyExistsError, NotFoundError,
+                                 PreconditionNotMetError)
+    if name in _CUSTOM_DEVICES:
+        raise AlreadyExistsError(
+            f"custom device {name!r} already registered "
+            f"({_CUSTOM_DEVICES[name]})")
+    if not os.path.exists(library_path):
+        raise NotFoundError(
+            f"custom device plugin library not found: {library_path}",
+            "point at the PJRT plugin .so built for this accelerator")
+    try:
+        backends_initialized = bool(jax._src.xla_bridge._backends)
+    except Exception:
+        # fail CLOSED: if the (private) probe breaks on a jax upgrade,
+        # refusing registration is recoverable; silently setting env
+        # vars jax already consumed is not
+        backends_initialized = True
+    if backends_initialized:
+        raise PreconditionNotMetError(
+            "jax backends already initialized; register custom devices "
+            "before the first jax.devices()/computation",
+            "set PJRT_NAMES_AND_LIBRARY_PATHS in the environment before "
+            "process start for late registration")
+    entry = f"{name}:{library_path}"
+    cur = os.environ.get("PJRT_NAMES_AND_LIBRARY_PATHS", "")
+    os.environ["PJRT_NAMES_AND_LIBRARY_PATHS"] = \
+        f"{cur},{entry}" if cur else entry
+    _CUSTOM_DEVICES[name] = library_path
+
+
+def get_all_custom_device_type():
+    return list(_CUSTOM_DEVICES)

@@ -88,12 +88,14 @@ def build_decode_step(model, sample_kwargs, tree_holder):
     """The shared pure step: (params, bufs, token_block, cache_flat,
     pos, key) → (next_token, new_cache_flat). Serves prefill (block of
     length s at pos=0) and decode (length 1) — jit/retrace handles the
-    two shapes within one compiled-function cache. Used by both
-    GenerationMixin.generate and inference.export_decoder."""
+    two shapes within one compiled-function cache. Used by
+    GenerationMixin.generate, beam search (sample_kwargs=None → returns
+    next-token LOG-PROBS instead of a sampled token; the ``key`` arg is
+    accepted and ignored) and inference.export_decoder."""
     ptensors = [p for _, p in model.named_parameters()]
     btensors = [b for _, b in model.named_buffers()]
 
-    def pure(pv, bv, token, cache_flat, pos, key):
+    def pure(pv, bv, token, cache_flat, pos, key=None):
         saved = [(t, t._value) for t in ptensors + btensors]
         was_training = model.training
         try:
@@ -108,9 +110,11 @@ def build_decode_step(model, sample_kwargs, tree_holder):
                 logits, new_cache = model.forward(
                     Tensor(token), cache=cache, pos=Tensor(pos))
             lv = logits._value[:, -1, :].astype(jnp.float32)
-            nt = sample_logits(lv, key, **sample_kwargs)
             new_flat = [c._value for c in jax.tree.leaves(
                 new_cache, is_leaf=lambda x: isinstance(x, Tensor))]
+            if sample_kwargs is None:      # beam head: full log-probs
+                return jax.nn.log_softmax(lv, axis=-1), tuple(new_flat)
+            nt = sample_logits(lv, key, **sample_kwargs)
             return nt.astype(jnp.int32), tuple(new_flat)
         finally:
             for t, v in saved:
@@ -122,37 +126,8 @@ def build_decode_step(model, sample_kwargs, tree_holder):
 
 
 def build_logits_step(model, tree_holder):
-    """Like build_decode_step but returns full next-token LOG-PROBS
-    instead of a sampled token — the beam-search step."""
-    ptensors = [p for _, p in model.named_parameters()]
-    btensors = [b for _, b in model.named_buffers()]
-
-    def pure(pv, bv, token, cache_flat, pos):
-        saved = [(t, t._value) for t in ptensors + btensors]
-        was_training = model.training
-        try:
-            for t, v in zip(ptensors, pv):
-                t._value = v
-            for t, v in zip(btensors, bv):
-                t._value = v
-            model.eval()
-            cache = jax.tree.unflatten(tree_holder["tree"], [
-                Tensor(c) for c in cache_flat])
-            with framework.functional_mode(), framework.no_grad_guard():
-                logits, new_cache = model.forward(
-                    Tensor(token), cache=cache, pos=Tensor(pos))
-            lp = jax.nn.log_softmax(
-                logits._value[:, -1, :].astype(jnp.float32), axis=-1)
-            new_flat = [c._value for c in jax.tree.leaves(
-                new_cache, is_leaf=lambda x: isinstance(x, Tensor))]
-            return lp, tuple(new_flat)
-        finally:
-            for t, v in saved:
-                t._value = v
-            if was_training:
-                model.train()
-
-    return pure
+    """Beam-search head: build_decode_step with sample_kwargs=None."""
+    return build_decode_step(model, None, tree_holder)
 
 
 class GenerationMixin:
@@ -218,6 +193,8 @@ class GenerationMixin:
         tok = first.reshape(b * K)
 
         NEG = jnp.float32(-1e9)
+        if eos_token_id is not None:       # loop-invariant: hoisted
+            eos_only = jnp.full((V,), NEG).at[eos_token_id].set(0.0)
         for i in range(1, max_new):
             pos = jnp.asarray(s + i - 1, jnp.int32)
             lp, cache_flat = step_fn(pv, bv, tok[:, None].astype(
@@ -225,7 +202,6 @@ class GenerationMixin:
             lp = lp.reshape(b, K, V)
             if eos_token_id is not None:
                 # finished beams: only eos continues, at zero cost
-                eos_only = jnp.full((V,), NEG).at[eos_token_id].set(0.0)
                 lp = jnp.where(finished[..., None], eos_only[None, None],
                                lp)
             cand = beam_scores[..., None] + lp          # (b, K, V)

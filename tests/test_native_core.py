@@ -279,3 +279,20 @@ class TestStreamEventSurface:
         import paddle_tpu.device as device
         with _pytest.raises(RuntimeError, match="recorded"):
             device.Event().elapsed_time(device.Event())
+
+
+class TestCustomDevicePlugin:
+    def test_registration_contract(self, tmp_path):
+        import os
+        import pytest as _pytest
+        import paddle_tpu.device as device
+        from paddle_tpu.utils.enforce import (NotFoundError,
+                                              PreconditionNotMetError)
+        with _pytest.raises(NotFoundError):
+            device.register_custom_device("npu", "/nope/libfoo.so")
+        lib = tmp_path / "libplugin.so"
+        lib.write_bytes(b"\x7fELF")
+        # backend already initialized in the test process -> must refuse
+        with _pytest.raises(PreconditionNotMetError, match="initialized"):
+            device.register_custom_device("npu", str(lib))
+        assert device.get_all_custom_device_type() == []
