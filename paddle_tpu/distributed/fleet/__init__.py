@@ -17,7 +17,8 @@ import jax
 
 from ..mesh import HybridCommunicateGroup, get_hybrid_communicate_group
 from ..parallel import DataParallel
-from . import meta_parallel                                        # noqa
+from . import meta_parallel
+from . import utils                                        # noqa
 from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,
                             VocabParallelEmbedding, ParallelCrossEntropy,
                             PipelineLayer, LayerDesc, SharedLayerDesc)  # noqa

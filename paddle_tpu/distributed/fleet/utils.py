@@ -1,0 +1,44 @@
+"""Fleet training-loop utilities (reference:
+python/paddle/distributed/fleet/utils/hybrid_parallel_util.py — verify).
+
+TPU-native note: inside a jitted TrainStep, gradient synchronization is
+GSPMD's job (grads of replicated params are psum'd automatically). These
+helpers serve MANUAL eager loops ported from the reference, where the
+user calls fused_allreduce_gradients between backward() and opt.step().
+"""
+from __future__ import annotations
+
+from ...tensor import Tensor
+
+__all__ = ["fused_allreduce_gradients", "recompute", "recompute_sequential"]
+
+from .utils_recompute import recompute, recompute_sequential  # noqa: F401
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None, group=None):
+    """All-reduce (mean) every parameter's gradient across the data-
+    parallel group (reference: fused_allreduce_gradients — the bucketed
+    NCCL allreduce the C++ Reducer performs; here one host-level
+    all_reduce per grad — the jitted path needs none of this)."""
+    from .. import communication as C
+
+    if hcg is not None and group is None:
+        get = getattr(hcg, "get_data_parallel_group", None)
+        if callable(get):
+            try:
+                group = get()
+            except Exception:
+                group = None
+    n = None
+    for p in parameter_list:
+        if not isinstance(p, Tensor) or p.grad is None:
+            continue
+        C.all_reduce(p.grad, op=C.ReduceOp.SUM, group=group)
+        if n is None:
+            if group is not None and getattr(group, "nranks", 0):
+                n = group.nranks
+            else:
+                from ..parallel import ParallelEnv
+                n = max(ParallelEnv().world_size, 1)
+        if n > 1:
+            p.grad._update_value(p.grad._value / n)

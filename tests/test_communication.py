@@ -252,3 +252,31 @@ def test_group_broadcast_global_src_and_invalid():
             results[r] = "raised"
 
     assert _run_group_members(bad, gid=111) == ["raised", "raised"]
+
+
+class TestFusedAllreduceGradients:
+    def test_single_process_mean_noop(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet.utils import (
+            fused_allreduce_gradients)
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        x = paddle.to_tensor(np.ones((3, 4), "float32"))
+        (net(x) ** 2).mean().backward()
+        before = net.weight.grad.numpy().copy()
+        fused_allreduce_gradients(list(net.parameters()))
+        # world size 1: mean over one rank == identity
+        np.testing.assert_allclose(net.weight.grad.numpy(), before,
+                                   rtol=1e-6)
+
+    def test_skips_gradless_params(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet.utils import (
+            fused_allreduce_gradients)
+        net = nn.Linear(4, 2)
+        fused_allreduce_gradients(list(net.parameters()))  # no grads: ok
+        assert net.weight.grad is None
