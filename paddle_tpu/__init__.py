@@ -48,6 +48,7 @@ from . import hapi
 from .hapi import Model, summary
 
 # paddle API aliases
+from .linalg import inv as inverse  # paddle.inverse (top-level alias)
 from .serialization import save, load
 from .utils.run_check import run_check
 

@@ -575,3 +575,85 @@ class Maxout(Layer):
 
     def forward(self, x):
         return F.maxout(x, self.groups, self.axis)
+
+
+# ---- round-2 batch-2 layers (reference: python/paddle/nn/layer/{activation,
+# common,vision}.py — verify) ------------------------------------------------
+
+class RReLU(Layer):
+    def __init__(self, lower=1. / 8., upper=1. / 3., name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, value=0.0, name=None):
+        super().__init__()
+        self.threshold, self.value = threshold, value
+
+    def forward(self, x):
+        return F.thresholded_relu(x, self.threshold, self.value)
+
+
+class Softmax2D(Layer):
+    def forward(self, x):
+        return F.softmax2d(x)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.unflattened_shape = axis, shape
+
+    def forward(self, x):
+        from .. import ops
+        return ops.unflatten(x, self.axis, self.unflattened_shape)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding, self.data_format = padding, data_format
+
+    def forward(self, x):
+        return F.zeropad2d(x, self.padding, self.data_format)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.downscale_factor, self.data_format = downscale_factor, \
+            data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor, self.data_format)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.args = (output_sizes, kernel_sizes, strides, paddings,
+                     dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self.args)
+
+
+SiLU = Silu  # paddle keeps both spellings
+
+
+__all__ += ["RReLU", "ThresholdedReLU", "Softmax2D", "PairwiseDistance",
+            "Unflatten", "ZeroPad2D", "PixelUnshuffle", "Fold", "SiLU"]

@@ -126,3 +126,22 @@ class Conv1DTranspose(_ConvNd):
                                   self.padding, self.output_padding,
                                   self.groups, self.dilation,
                                   self.data_format)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(3, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True)
+        self.output_padding = output_padding
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.groups, self.dilation,
+                                  self.data_format, output_size)
+
+
+__all__ += ["Conv3DTranspose"]

@@ -564,6 +564,8 @@ def _pool(x, kernel, stride, padding, nd, op, include_pad=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        return max_pool2d_with_mask(x, kernel_size, stride, padding)
     return apply_op(_pool(x, kernel_size, stride, padding, 2, "max"), x)
 
 
@@ -1134,3 +1136,681 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
 
 __all__ += ["pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
             "temporal_shift", "unfold", "fold"]
+
+
+# ---------------------------------------------------------------------------
+# long-tail additions (round 2, batch 2): losses, unpool, vision sampling
+# (reference: python/paddle/nn/functional/{loss,pooling,vision,activation}.py
+# — verify)
+# ---------------------------------------------------------------------------
+
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=True, name=None):
+    """Randomized leaky ReLU: slope ~ U(lower, upper) in training, the
+    mean slope at inference."""
+    if training:
+        k = framework.split_key()
+
+        def f(v):
+            a = jax.random.uniform(k, v.shape, jnp.float32,
+                                   lower, upper).astype(v.dtype)
+            return jnp.where(v >= 0, v, a * v)
+        return apply_op(f, x)
+    mid = (lower + upper) / 2.0
+    return apply_op(lambda v: jnp.where(v >= 0, v, mid * v), x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v, jnp.asarray(value, v.dtype)),
+        x)
+
+
+def softmax2d(x, name=None):
+    """Softmax over the channel dim of an NCHW (or CHW) tensor."""
+    return softmax(x, axis=-3)
+
+
+# ---- losses ---------------------------------------------------------------
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        return _reduce(jnp.where(y == 1, 1.0 - cos,
+                                 jnp.maximum(0.0, cos - margin)), reduction)
+    return apply_op(f, input1, input2, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def f(x_, y):
+        return _reduce(
+            jnp.where(y == 1.0, x_, jnp.maximum(0.0, margin - x_)),
+            reduction)
+    return apply_op(f, input, label)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return apply_op(
+        lambda x_, y: _reduce(jnp.log1p(jnp.exp(-y * x_)), reduction),
+        input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def f(z, y, *w):
+        per = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        if w:
+            per = per * w[0]
+        return _reduce(jnp.mean(per, axis=-1), reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def f(z, y, *w):
+        n, c = z.shape
+        zy = jnp.take_along_axis(z, y[:, None].astype(jnp.int32), axis=1)
+        m = jnp.maximum(0.0, margin - zy + z) ** p
+        if w:
+            m = m * jnp.take(w[0], y.astype(jnp.int32))[:, None]
+        m = m * (1 - jax.nn.one_hot(y, c, dtype=z.dtype))
+        return _reduce(jnp.sum(m, axis=1) / c, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    def f(x_, y):
+        loss = jnp.exp(x_) - y * x_ if log_input \
+            else x_ - y * jnp.log(x_ + epsilon)
+        if full:
+            stir = y * jnp.log(y) - y + 0.5 * jnp.log(2 * np.pi * y)
+            loss = loss + jnp.where(y > 1, stir, 0.0)
+        return _reduce(loss, reduction)
+    return apply_op(f, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon)
+        - (1 - y) * jnp.log(1 - p + epsilon), input, label)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """input: (N, ..., C) class probabilities; label: (N, ..., 1) int."""
+    def f(p, y):
+        c = p.shape[-1]
+        oh = jax.nn.one_hot(y[..., 0], c, dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * oh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(oh, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op(f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    def f(a, p_, y):
+        sim = a @ p_.T
+        tgt = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = tgt / jnp.sum(tgt, axis=1, keepdims=True)
+        ce = jnp.mean(jnp.sum(
+            -tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        l2 = l2_reg * (jnp.sum(a * a) + jnp.sum(p_ * p_)) / (2 * a.shape[0])
+        return ce + l2
+    return apply_op(f, anchor, positive, labels)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def f(a, pos, neg):
+        def d(u, v):
+            return jnp.linalg.norm(u - v + epsilon, ord=p, axis=-1)
+        dp, dn = d(a, pos), d(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, d(pos, neg))
+        return _reduce(jnp.maximum(0.0, dp - dn + margin), reduction)
+    return apply_op(f, input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dpn = distance_function(positive, negative)
+        dn = apply_op(jnp.minimum, dn, dpn)
+    return apply_op(
+        lambda a, b: _reduce(jnp.maximum(0.0, a - b + margin), reduction),
+        dp, dn)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC forward (alpha recursion in log space, `lax.scan` over time —
+    reference: warpctc-backed ctc_loss; python/paddle/nn/functional/loss.py
+    — verify). ``log_probs``: (T, N, C) UNNORMALIZED logits (the reference
+    applies log_softmax internally); labels: (N, L) int padded."""
+    NEG = -1e30
+
+    def f(lp, lab, in_len, lab_len):
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        t_max, n, _ = lp.shape
+        l_max = lab.shape[1]
+        s_max = 2 * l_max + 1
+        # extended sequence: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((n, s_max), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        s_len = 2 * lab_len.astype(jnp.int32) + 1
+        pos = jnp.arange(s_max)[None, :]
+        valid_s = pos < s_len[:, None]
+        # can skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+        ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :s_max]
+        can_skip = (ext != blank) & (ext != ext_m2)
+
+        def emit(t):
+            return jnp.take_along_axis(lp[t], ext, axis=1)  # (N, S)
+
+        alpha0 = jnp.full((n, s_max), NEG)
+        alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(s_len > 1, emit(0)[:, 1], NEG))
+
+        def step(alpha, t):
+            prev1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                            constant_values=NEG)[:, :s_max]
+            prev2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                            constant_values=NEG)[:, :s_max]
+            prev2 = jnp.where(can_skip, prev2, NEG)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+            new = merged + emit(t)
+            new = jnp.where(valid_s, new, NEG)
+            # freeze once past this sample's input length
+            new = jnp.where((t < in_len)[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t_max))
+        last = jnp.take_along_axis(alpha, (s_len - 1)[:, None], axis=1)[:, 0]
+        last2 = jnp.take_along_axis(
+            alpha, jnp.maximum(s_len - 2, 0)[:, None], axis=1)[:, 0]
+        ll = jnp.logaddexp(last, jnp.where(s_len > 1, last2, NEG))
+        loss = -ll
+        if norm_by_times:
+            loss = loss / in_len.astype(loss.dtype)
+        return _reduce(loss, reduction)
+    return apply_op(f, log_probs, labels, input_lengths, label_lengths)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over a complete binary tree (default) or a
+    custom path table/code (reference: hsigmoid_loss op — verify).
+
+    Default tree: word2vec-style — internal node for step k is
+    ``((label + num_classes) >> (k+1)) - 1`` and the branch bit is
+    ``(label + num_classes) >> k & 1``; depth is ceil(log2(num_classes)).
+    """
+    if (path_table is None) != (path_code is None):
+        raise ValueError("path_table and path_code must be given together")
+
+    if path_table is None:
+        depth = max(1, int(np.ceil(np.log2(max(2, num_classes)))))
+
+        def f(x_, y, w, *b):
+            y = y.reshape(-1).astype(jnp.int32)
+            code = y + num_classes
+            ks = jnp.arange(depth)
+            nodes = ((code[:, None] >> (ks[None, :] + 1)) - 1)
+            bits = ((code[:, None] >> ks[None, :]) & 1).astype(x_.dtype)
+            mask = nodes >= 0
+            nodes = jnp.maximum(nodes, 0)
+            wn = w[nodes]                       # (N, depth, D)
+            z = jnp.einsum("nd,nkd->nk", x_, wn)
+            if b:
+                z = z + b[0].reshape(-1)[nodes]
+            # sign convention: bit 1 → sigmoid(-z); matches word2vec
+            per = -jax.nn.log_sigmoid(jnp.where(bits > 0, -z, z))
+            return jnp.sum(jnp.where(mask, per, 0.0), axis=1, keepdims=True)
+        args = [input, label, weight] + ([bias] if bias is not None else [])
+        return apply_op(f, *args)
+
+    def f(x_, y, tbl, cod, w, *b):
+        tbl = tbl.astype(jnp.int32)
+        mask = tbl >= 0
+        nodes = jnp.maximum(tbl, 0)
+        wn = w[nodes]
+        z = jnp.einsum("nd,nkd->nk", x_, wn)
+        if b:
+            z = z + b[0].reshape(-1)[nodes]
+        bits = cod.astype(x_.dtype)
+        per = -jax.nn.log_sigmoid(jnp.where(bits > 0, -z, z))
+        return jnp.sum(jnp.where(mask, per, 0.0), axis=1, keepdims=True)
+    args = [input, label, path_table, path_code, weight] + \
+        ([bias] if bias is not None else [])
+    return apply_op(f, *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace combined-margin softmax CE: the target-class cosine
+    becomes cos(m1*θ + m2) - m3 before scaling (reference:
+    margin_cross_entropy op — verify; single-shard path, logits assumed to
+    be cosines in [-1, 1])."""
+    def f(z, y):
+        n, c = z.shape
+        y = y.reshape(-1).astype(jnp.int32)
+        zy = jnp.take_along_axis(z, y[:, None], axis=1)[:, 0]
+        theta = jnp.arccos(jnp.clip(zy, -1.0 + 1e-7, 1.0 - 1e-7))
+        zy_m = jnp.cos(margin1 * theta + margin2) - margin3
+        z_adj = z.at[jnp.arange(n), y].set(zy_m) * scale
+        logp = jax.nn.log_softmax(z_adj, axis=1)
+        loss = _reduce(-jnp.take_along_axis(logp, y[:, None], axis=1),
+                       reduction)
+        return loss, jnp.exp(logp)
+    loss, sm = apply_op(f, logits, label)
+    return (loss, sm) if return_softmax else loss
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search ancestry backtrace (reference: gather_tree op): walk
+    parent pointers from the last step so each beam holds its full
+    predecessor sequence. ids/parents: (T, N, beam)."""
+    def f(idv, par):
+        t_max = idv.shape[0]
+
+        def step(beams, t):
+            # beams: (N, B) beam index each sequence currently follows
+            out = jnp.take_along_axis(idv[t], beams, axis=1)
+            nxt = jnp.take_along_axis(par[t], beams, axis=1)
+            return nxt.astype(jnp.int32), out
+
+        init = jnp.broadcast_to(
+            jnp.arange(idv.shape[2], dtype=jnp.int32),
+            idv.shape[1:]).astype(jnp.int32)
+        _, outs = jax.lax.scan(step, init, jnp.arange(t_max - 1, -1, -1))
+        return outs[::-1]
+    return apply_op(f, ids, parents)
+
+
+# ---- adaptive pools (3d / max variants) -----------------------------------
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    os_ = _pair(output_size, 3)
+
+    def f(v):
+        n, c, d, h, w = v.shape
+        od, oh, ow = os_
+        if d % od == 0 and h % oh == 0 and w % ow == 0:
+            v6 = v.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+            return jnp.mean(v6, axis=(3, 5, 7))
+        return jax.image.resize(v, (n, c, od, oh, ow), method="linear")
+    return apply_op(f, x)
+
+
+def _adaptive_windows(in_size, out_size):
+    """Per-output (start, end) — the standard floor/ceil split that also
+    covers non-divisible sizes."""
+    return [(i * in_size // out_size,
+             -(-((i + 1) * in_size) // out_size)) for i in range(out_size)]
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    wins = _adaptive_windows(int(x.shape[-1]), o)
+
+    def f(v):
+        outs, idxs = [], []
+        for s, e in wins:
+            w = v[..., s:e]
+            outs.append(jnp.max(w, axis=-1))
+            idxs.append(s + jnp.argmax(w, axis=-1))
+        out = jnp.stack(outs, axis=-1)
+        if return_mask:
+            return out, jnp.stack(idxs, axis=-1).astype(jnp.int32)
+        return out
+    if return_mask:
+        out = apply_op(f, x)
+        return out[0], out[1]
+    return apply_op(f, x)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    os_ = _pair(output_size, 3)
+    d_in, h_in, w_in = (int(s) for s in x.shape[2:])
+    dw = _adaptive_windows(d_in, os_[0])
+    hw = _adaptive_windows(h_in, os_[1])
+    ww = _adaptive_windows(w_in, os_[2])
+
+    def f(v):
+        outs = []
+        idxs = []
+        for ds, de in dw:
+            for hs, he in hw:
+                for ws, we in ww:
+                    win = v[:, :, ds:de, hs:he, ws:we]
+                    flat = win.reshape(win.shape[0], win.shape[1], -1)
+                    outs.append(jnp.max(flat, axis=-1))
+                    if return_mask:
+                        am = jnp.argmax(flat, axis=-1)
+                        wd, wh, ww_ = win.shape[2:]
+                        ld = am // (wh * ww_)
+                        lh = (am // ww_) % wh
+                        lw = am % ww_
+                        idxs.append(((ds + ld) * h_in + hs + lh) * w_in
+                                    + ws + lw)
+        shape = (v.shape[0], v.shape[1]) + tuple(os_)
+        out = jnp.stack(outs, axis=-1).reshape(shape)
+        if return_mask:
+            idx = jnp.stack(idxs, axis=-1).reshape(shape)
+            return out, idx.astype(jnp.int32)
+        return out
+    if return_mask:
+        out = apply_op(f, x)
+        return out[0], out[1]
+    return apply_op(f, x)
+
+
+# ---- max pooling with indices + unpooling ---------------------------------
+
+def _max_pool_with_mask(v, ks, st, pd, nd):
+    """Windowed max + argmax as flattened input-spatial indices (the
+    reference's return_mask contract). Padding must be explicit pairs."""
+    spatial = v.shape[2:]
+    padded = jnp.pad(
+        v, [(0, 0), (0, 0)] + [(p[0], p[1]) for p in pd],
+        constant_values=-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
+        else jnp.iinfo(v.dtype).min)
+    out_sp = [(padded.shape[2 + i] - ks[i]) // st[i] + 1 for i in range(nd)]
+    # flat index of every padded position within the ORIGINAL tensor
+    coords = jnp.meshgrid(*[jnp.arange(padded.shape[2 + i]) - pd[i][0]
+                            for i in range(nd)], indexing="ij")
+    inb = jnp.ones_like(coords[0], dtype=bool)
+    flat = jnp.zeros_like(coords[0])
+    for i in range(nd):
+        inb &= (coords[i] >= 0) & (coords[i] < spatial[i])
+        flat = flat * spatial[i] + jnp.clip(coords[i], 0, spatial[i] - 1)
+    blocks, idxs = [], []
+    for off in np.ndindex(*ks):
+        sl = tuple(slice(off[i], off[i] + st[i] * out_sp[i], st[i])
+                   for i in range(nd))
+        blocks.append(padded[(slice(None), slice(None)) + sl])
+        idxs.append(flat[sl])
+    stacked = jnp.stack(blocks, axis=2)          # (N, C, K, *out)
+    istacked = jnp.stack([jnp.broadcast_to(i, blocks[0].shape[2:])
+                          for i in idxs], axis=0)  # (K, *out)
+    am = jnp.argmax(stacked, axis=2)             # (N, C, *out)
+    out = jnp.max(stacked, axis=2)
+    mask = jnp.take_along_axis(
+        istacked[None, None], am[:, :, None], axis=2)[:, :, 0]
+    return out, mask.astype(jnp.int32)
+
+
+def max_pool2d_with_mask(x, kernel_size, stride=None, padding=0, name=None):
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride if stride is not None else kernel_size, 2)
+    pd = _conv_padding(padding, 2, st, ks, (1, 1))
+    if isinstance(pd, str):
+        pd = [(0, 0), (0, 0)] if pd == "VALID" else None
+    if pd is None:
+        raise ValueError("max_pool2d(return_mask=True) needs explicit "
+                         "padding")
+    out = apply_op(lambda v: _max_pool_with_mask(v, ks, st, pd, 2), x)
+    return out[0], out[1]
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Scatter pooled values back to the argmax positions recorded by
+    max_pool2d(return_mask=True)."""
+    ks = _pair(kernel_size, 2)
+    st = _pair(stride if stride is not None else kernel_size, 2)
+    pd = _pair(padding, 2)
+
+    def f(v, idx):
+        n, c, h, w = v.shape
+        if output_size is not None:
+            oh, ow = _pair(output_size, 2)
+        else:
+            oh = (h - 1) * st[0] - 2 * pd[0] + ks[0]
+            ow = (w - 1) * st[1] - 2 * pd[1] + ks[1]
+        flat = jnp.zeros((n, c, oh * ow), v.dtype)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1)].set(v.reshape(n, c, -1))
+        return flat.reshape(n, c, oh, ow)
+    return apply_op(f, x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    k = _pair(kernel_size, 1)[0]
+    s = _pair(stride if stride is not None else kernel_size, 1)[0]
+    p = _pair(padding, 1)[0]
+
+    def f(v, idx):
+        n, c, l = v.shape
+        ol = _pair(output_size, 1)[0] if output_size is not None \
+            else (l - 1) * s - 2 * p + k
+        flat = jnp.zeros((n, c, ol), v.dtype)
+        return flat.at[jnp.arange(n)[:, None, None],
+                       jnp.arange(c)[None, :, None], idx].set(v)
+    return apply_op(f, x, indices)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    ks = _pair(kernel_size, 3)
+    st = _pair(stride if stride is not None else kernel_size, 3)
+    pd = _pair(padding, 3)
+
+    def f(v, idx):
+        n, c, d, h, w = v.shape
+        if output_size is not None:
+            od, oh, ow = _pair(output_size, 3)
+        else:
+            od = (d - 1) * st[0] - 2 * pd[0] + ks[0]
+            oh = (h - 1) * st[1] - 2 * pd[1] + ks[1]
+            ow = (w - 1) * st[2] - 2 * pd[2] + ks[2]
+        flat = jnp.zeros((n, c, od * oh * ow), v.dtype)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1)].set(v.reshape(n, c, -1))
+        return flat.reshape(n, c, od, oh, ow)
+    return apply_op(f, x, indices)
+
+
+# ---- vision: sampling grids, 3-D transpose conv, LRN, padding -------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """(N, 2, 3) affine matrices → (N, H, W, 2) sampling grid in [-1, 1]
+    coordinates (reference: affine_grid op — verify)."""
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(s) for s in np.asarray(out_shape._value)]
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def lin(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    def f(th):
+        ys, xs = jnp.meshgrid(lin(h), lin(w), indexing="ij")
+        base = jnp.stack([xs, ys, jnp.ones_like(xs)], axis=-1)  # (H, W, 3)
+        return jnp.einsum("hwk,nck->nhwc", base, th)            # (N,H,W,2)
+    return apply_op(f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample NCHW input at (N, H', W', 2) normalized grid locations
+    (reference: grid_sample op — verify). Bilinear or nearest; zeros /
+    border / reflection padding."""
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1) * (size - 1) / 2
+        return ((g + 1) * size - 1) / 2
+
+    def reflect(p, size):
+        if align_corners:
+            if size <= 1:
+                return jnp.zeros_like(p)
+            span = 2 * (size - 1)
+            return span / 2 - jnp.abs(jnp.mod(p, span) - span / 2)
+        span = 2 * size
+        p = jnp.mod(p + 0.5, span)
+        return jnp.abs(span / 2 - jnp.abs(p - span / 2)) - 0.5
+
+    def f(v, g):
+        n, c, h, w = v.shape
+        gx = unnorm(g[..., 0], w)
+        gy = unnorm(g[..., 1], h)
+        if padding_mode == "reflection":
+            gx, gy = reflect(gx, w), reflect(gy, h)
+
+        def gather(iy, ix):
+            iyc = jnp.clip(iy, 0, h - 1)
+            ixc = jnp.clip(ix, 0, w - 1)
+            out = v[jnp.arange(n)[:, None, None], :, iyc, ixc]  # (N,H',W',C)
+            if padding_mode == "zeros":
+                ok = ((iy >= 0) & (iy <= h - 1) & (ix >= 0)
+                      & (ix <= w - 1))
+                out = out * ok[..., None].astype(out.dtype)
+            return out
+
+        if mode == "nearest":
+            out = gather(jnp.round(gy).astype(jnp.int32),
+                         jnp.round(gx).astype(jnp.int32))
+            return jnp.moveaxis(out, -1, 1)
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        wx = (gx - x0)[..., None]
+        wy = (gy - y0)[..., None]
+        x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+        out = (gather(y0i, x0i) * (1 - wx) * (1 - wy)
+               + gather(y0i, x0i + 1) * wx * (1 - wy)
+               + gather(y0i + 1, x0i) * (1 - wx) * wy
+               + gather(y0i + 1, x0i + 1) * wx * wy)
+        return jnp.moveaxis(out, -1, 1)
+    return apply_op(f, x, grid)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     data_format="NCDHW", output_size=None, name=None):
+    """3-D transposed conv via lhs-dilated forward conv (weight layout
+    (in, out/groups, kd, kh, kw) — reference conv3d_transpose — verify)."""
+    strides = _pair(stride, 3)
+    dils = _pair(dilation, 3)
+    opad = _pair(output_padding, 3)
+    pads = _conv_padding(padding, 3, strides, weight.shape[2:], dils)
+    if data_format != "NCDHW":
+        raise NotImplementedError("conv3d_transpose supports NCDHW only")
+
+    def f(v, w, *b):
+        kd, kh, kw = w.shape[2:]
+        if groups == 1:
+            w2 = jnp.swapaxes(w, 0, 1)
+        else:
+            ig = w.shape[0] // groups
+            wg = w.reshape(groups, ig, w.shape[1], kd, kh, kw)
+            w2 = jnp.swapaxes(wg, 1, 2).reshape(
+                groups * w.shape[1], ig, kd, kh, kw)
+        w2 = jnp.flip(w2, axis=(2, 3, 4))
+        keff = [(k - 1) * d + 1 for k, d in zip((kd, kh, kw), dils)]
+        if isinstance(pads, str):
+            p_list = [(0, 0)] * 3 if pads == "VALID" else [
+                ((keff[i] - strides[i]) // 2,) * 2 for i in range(3)]
+        else:
+            p_list = pads
+        opad_eff = list(opad)
+        if output_size is not None:
+            os_ = _pair(output_size, 3)
+            for i in range(3):
+                base = (v.shape[2 + i] - 1) * strides[i] - \
+                    (p_list[i][0] + p_list[i][1]) + keff[i]
+                opad_eff[i] = os_[i] - base
+        pad_arg = [(keff[i] - 1 - p_list[i][0],
+                    keff[i] - 1 - p_list[i][1] + opad_eff[i])
+                   for i in range(3)]
+        out = jax.lax.conv_general_dilated(
+            v, w2, window_strides=(1, 1, 1), padding=pad_arg,
+            lhs_dilation=strides, rhs_dilation=dils,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            feature_group_count=groups)
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1, 1)
+        return out
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op(f, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    """Across-channel LRN: x / (k + alpha/size * Σ_window x²)^beta."""
+    def f(v):
+        sq = v * v
+        if data_format.startswith("NC"):
+            ch_axis = 1
+        else:
+            ch_axis = v.ndim - 1
+        lo = (size - 1) // 2
+        hi = size - 1 - lo
+        pad = [(0, 0)] * v.ndim
+        pad[ch_axis] = (lo, hi)
+        window = [1] * v.ndim
+        window[ch_axis] = size
+        s = jax.lax.reduce_window(sq, 0.0, jax.lax.add, tuple(window),
+                                  (1,) * v.ndim, [tuple(p) for p in pad])
+        return v / (k + alpha / size * s) ** beta
+    return apply_op(f, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    p = _pair(padding, 2)
+    if len(p) == 2:
+        left, right, top, bottom = p[0], p[0], p[1], p[1]
+    else:
+        left, right, top, bottom = p
+
+    def f(v):
+        if data_format == "NCHW":
+            return jnp.pad(v, ((0, 0), (0, 0), (top, bottom), (left, right)))
+        return jnp.pad(v, ((0, 0), (top, bottom), (left, right), (0, 0)))
+    return apply_op(f, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """out[n, o] = x1[n] · W[o] · x2[n] + b (reference: bilinear op)."""
+    def f(a, b_, w, *bias_):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b_)
+        if bias_:
+            out = out + bias_[0].reshape(1, -1)
+        return out
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return apply_op(f, *args)
+
+
+__all__ += [
+    "rrelu", "thresholded_relu", "softmax2d", "cosine_embedding_loss",
+    "hinge_embedding_loss", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "multi_margin_loss", "poisson_nll_loss",
+    "log_loss", "dice_loss", "npair_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "ctc_loss", "hsigmoid_loss",
+    "margin_cross_entropy", "gather_tree", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool3d", "max_pool2d_with_mask",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d", "affine_grid",
+    "grid_sample", "conv3d_transpose", "local_response_norm", "zeropad2d",
+    "bilinear",
+]

@@ -684,3 +684,39 @@ def as_strided(x, shape, stride, offset=0, name=None):
 
 
 __all__ += ["index_fill", "index_fill_", "unflatten", "as_strided"]
+
+
+# ---- long-tail additions (reference: python/paddle/tensor/manipulation.py,
+# math.py multiplex — verify) ------------------------------------------------
+
+cat = concat  # torch-style alias kept by paddle
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select across candidate tensors: out[i] = inputs[index[i]][i].
+
+    ``inputs`` is a list of (N, ...) tensors, ``index`` an (N,) or (N, 1)
+    int tensor choosing the source tensor per row.
+    """
+    tensors = list(inputs)
+    def f(idx, *vs):
+        stacked = jnp.stack(vs, axis=0)            # (K, N, ...)
+        idx = idx.reshape(-1).astype(jnp.int32)    # (N,)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx, rows]
+    return apply_op(f, index, *tensors)
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-length combinations of a 1-D tensor, shape (C, r)."""
+    import itertools
+    n = int(x.shape[0])
+    picker = (itertools.combinations_with_replacement if with_replacement
+              else itertools.combinations)
+    idx = np.array(list(picker(range(n), int(r))), dtype=np.int64)
+    if idx.size == 0:
+        idx = idx.reshape(0, int(r))
+    return apply_op(lambda v: v[idx], x)
+
+
+__all__ += ["cat", "multiplex", "combinations"]

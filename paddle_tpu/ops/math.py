@@ -1066,3 +1066,75 @@ __all__ += ["sinc", "signbit", "exp2", "float_power", "ldexp", "i0e",
             "i1e", "polygamma", "multigammaln", "trapezoid",
             "cumulative_trapezoid", "vander", "nanquantile", "renorm",
             "cdist", "baddbmm", "histogramdd"]
+
+
+# ---- long-tail additions (reference: python/paddle/tensor/math.py,
+# creation.py, attribute.py — verify) ----------------------------------------
+
+def complex(real, imag, name=None):  # noqa: A001 — paddle API name
+    """Build a complex tensor from real and imaginary parts."""
+    return apply_op(jax.lax.complex, real, imag)
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    """Complex tensor from magnitude + phase: abs * exp(i*angle)."""
+    return apply_op(
+        lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+        abs, angle)
+
+
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| for complex, sign(x) for real."""
+    def f(v):
+        if jnp.iscomplexobj(v):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0. + 0.j, v / jnp.where(mag == 0, 1.,
+                                                               mag))
+        return jnp.sign(v)
+    return apply_op(f, x)
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distance of an (N, D) matrix: the upper-triangle
+    (i<j) of cdist(x, x, p), shape (N*(N-1)/2,)."""
+    n = int(x.shape[0])
+    iu, ju = np.triu_indices(n, k=1)
+    def f(v):
+        d = v[iu] - v[ju]
+        p_ = float(p)
+        if p_ == 0.0:
+            return jnp.sum((d != 0).astype(v.dtype), axis=-1)
+        if np.isinf(p_):
+            return jnp.max(jnp.abs(d), axis=-1)
+        return jnp.sum(jnp.abs(d) ** p_, axis=-1) ** (1.0 / p_)
+    return apply_op(f, x)
+
+
+def rank(x, name=None):
+    """Number of dimensions, as a 0-d int32 tensor (paddle.rank)."""
+    return to_tensor(np.int32(len(x.shape)))
+
+
+def is_complex(x):
+    return jnp.issubdtype(jnp.dtype(x.dtype), jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(jnp.dtype(x.dtype), jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(jnp.dtype(x.dtype), jnp.integer)
+
+
+def is_empty(x, name=None):
+    """0-d bool tensor: True when the tensor has zero elements."""
+    return to_tensor(np.bool_(0 in tuple(x.shape)))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+__all__ += ["complex", "polar", "sgn", "pdist", "rank", "is_complex",
+            "is_floating_point", "is_integer", "is_empty", "is_tensor"]

@@ -283,6 +283,40 @@ class Tensor:
         self._value = jnp.zeros_like(self._value)
         return self
 
+    def _random_overwrite_(self, sample):
+        """Shared body of the in-place random fills (uniform_/normal_/…):
+        like fill_, the overwrite cuts the gradient to the old value."""
+        new = sample(framework.split_key())
+        if self._inplace_wants_grad():
+            return self._record_inplace(
+                lambda x: jnp.broadcast_to(new, x.shape).astype(x.dtype))
+        self._value = new.astype(self._value.dtype)
+        return self
+
+    def uniform_(self, min=-1.0, max=1.0, seed=0, name=None):
+        shape, dt = self._value.shape, self._value.dtype
+        return self._random_overwrite_(lambda k: jax.random.uniform(
+            k if not seed else jax.random.PRNGKey(seed), shape,
+            jnp.float32, minval=min, maxval=max))
+
+    def normal_(self, mean=0.0, std=1.0, name=None):
+        shape = self._value.shape
+        return self._random_overwrite_(
+            lambda k: jax.random.normal(k, shape, jnp.float32) * std + mean)
+
+    def exponential_(self, lam=1.0, name=None):
+        shape = self._value.shape
+        return self._random_overwrite_(
+            lambda k: jax.random.exponential(k, shape, jnp.float32) / lam)
+
+    def geometric_(self, probs, name=None):
+        """Geometric(probs) fill: number of Bernoulli(p) trials to first
+        success, support {1, 2, ...} (the reference's convention)."""
+        shape = self._value.shape
+        return self._random_overwrite_(lambda k: jnp.ceil(
+            jnp.log1p(-jax.random.uniform(k, shape, jnp.float32))
+            / jnp.log1p(-jnp.asarray(probs, jnp.float32))))
+
     # -- dunder arithmetic (defined in ops/__init__.py monkey-attach) -------
     # __add__ etc. attached by paddle_tpu.ops at import time.
 

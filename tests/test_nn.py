@@ -268,3 +268,243 @@ def test_to_dtype():
     m = nn.Linear(2, 2)
     m.to(dtype="bfloat16")
     assert str(m.weight.dtype) == "bfloat16"
+
+
+class TestRound2Batch2Layers:
+    """Losses/pools/vision ops added in round-2 batch 2 (reference:
+    python/paddle/nn/functional/{loss,pooling,vision}.py — verify).
+    Numerics are cross-checked against torch in several cases."""
+
+    def test_ctc_loss_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(0)
+        T, N, C, L = 10, 3, 5, 4
+        logits = rng.randn(T, N, C).astype(np.float32)
+        labels = rng.randint(1, C, (N, L)).astype(np.int32)
+        in_len = np.array([10, 8, 6], np.int32)
+        lab_len = np.array([4, 3, 2], np.int32)
+        ours = F.ctc_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                          paddle.to_tensor(in_len),
+                          paddle.to_tensor(lab_len),
+                          blank=0, reduction="none").numpy()
+        want = TF.ctc_loss(
+            torch.log_softmax(torch.tensor(logits), -1),
+            torch.tensor(labels.astype(np.int64)),
+            torch.tensor(in_len.astype(np.int64)),
+            torch.tensor(lab_len.astype(np.int64)),
+            blank=0, reduction="none").numpy()
+        np.testing.assert_allclose(ours, want, rtol=1e-4, atol=1e-4)
+        # gradient exists and is finite
+        lt = paddle.to_tensor(logits)
+        lt.stop_gradient = False
+        F.ctc_loss(lt, paddle.to_tensor(labels), paddle.to_tensor(in_len),
+                   paddle.to_tensor(lab_len)).backward()
+        assert np.isfinite(lt.grad.numpy()).all()
+
+    def test_grid_sample_and_affine_grid_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 3, 5, 7).astype(np.float32)
+        grid = (rng.rand(2, 4, 6, 2) * 2 - 1).astype(np.float32)
+        for mode in ("bilinear", "nearest"):
+            for pm in ("zeros", "border"):
+                ours = F.grid_sample(paddle.to_tensor(x),
+                                     paddle.to_tensor(grid), mode=mode,
+                                     padding_mode=pm).numpy()
+                want = TF.grid_sample(torch.tensor(x), torch.tensor(grid),
+                                      mode=mode, padding_mode=pm,
+                                      align_corners=True).numpy()
+                np.testing.assert_allclose(ours, want, atol=1e-5)
+        theta = rng.randn(2, 2, 3).astype(np.float32)
+        np.testing.assert_allclose(
+            F.affine_grid(paddle.to_tensor(theta), (2, 3, 4, 5)).numpy(),
+            TF.affine_grid(torch.tensor(theta), (2, 3, 4, 5),
+                           align_corners=True).numpy(), atol=1e-5)
+
+    def test_max_pool_mask_and_unpool_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2, 0,
+                                 return_mask=True)
+        to, tm = TF.max_pool2d(torch.tensor(x), 2, 2, 0,
+                               return_indices=True)
+        np.testing.assert_allclose(out.numpy(), to.numpy())
+        assert (mask.numpy() == tm.numpy()).all()
+        np.testing.assert_allclose(
+            F.max_unpool2d(out, mask, 2, 2).numpy(),
+            TF.max_unpool2d(to, tm, 2, 2).numpy())
+        up = nn.MaxUnPool2D(2, 2)(out, mask)
+        np.testing.assert_allclose(up.numpy(),
+                                   TF.max_unpool2d(to, tm, 2, 2).numpy())
+
+    def test_conv3d_transpose_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 4, 3, 5, 5).astype(np.float32)
+        w = rng.randn(4, 6, 3, 3, 3).astype(np.float32)
+        ours = F.conv3d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                  stride=2, padding=1).numpy()
+        want = TF.conv_transpose3d(torch.tensor(x), torch.tensor(w),
+                                   stride=2, padding=1).numpy()
+        np.testing.assert_allclose(ours, want, atol=1e-3)
+        layer = nn.Conv3DTranspose(4, 6, 3, stride=2, padding=1)
+        assert list(layer(paddle.to_tensor(x)).shape) == list(want.shape)
+
+    def test_loss_zoo_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(4)
+        a = rng.randn(4, 6).astype(np.float32)
+        b = rng.randn(4, 6).astype(np.float32)
+        c = rng.randn(4, 6).astype(np.float32)
+        y1 = np.array([1, -1, 1, -1], np.float32)
+        cases = [
+            (F.cosine_embedding_loss(paddle.to_tensor(a),
+                                     paddle.to_tensor(b),
+                                     paddle.to_tensor(y1)),
+             TF.cosine_embedding_loss(torch.tensor(a), torch.tensor(b),
+                                      torch.tensor(y1))),
+            (nn.SoftMarginLoss()(paddle.to_tensor(a),
+                                 paddle.to_tensor(np.sign(b))),
+             TF.soft_margin_loss(torch.tensor(a),
+                                 torch.tensor(np.sign(b)))),
+            (nn.TripletMarginLoss(swap=True)(paddle.to_tensor(a),
+                                             paddle.to_tensor(b),
+                                             paddle.to_tensor(c)),
+             TF.triplet_margin_loss(torch.tensor(a), torch.tensor(b),
+                                    torch.tensor(c), swap=True)),
+            (nn.MultiMarginLoss()(paddle.to_tensor(a), paddle.to_tensor(
+                np.array([0, 2, 1, 5], np.int32))),
+             TF.multi_margin_loss(torch.tensor(a), torch.tensor(
+                 np.array([0, 2, 1, 5], np.int64)))),
+            (nn.PoissonNLLLoss()(paddle.to_tensor(a), paddle.to_tensor(
+                np.abs(b))),
+             TF.poisson_nll_loss(torch.tensor(a), torch.tensor(np.abs(b)))),
+            (nn.MultiLabelSoftMarginLoss()(
+                paddle.to_tensor(a),
+                paddle.to_tensor((b > 0).astype(np.float32))),
+             TF.multilabel_soft_margin_loss(
+                 torch.tensor(a), torch.tensor((b > 0).astype(np.float32)))),
+            (nn.HingeEmbeddingLoss()(paddle.to_tensor(a), paddle.to_tensor(
+                np.sign(c))),
+             TF.hinge_embedding_loss(torch.tensor(a),
+                                     torch.tensor(np.sign(c)))),
+        ]
+        for got, want in cases:
+            np.testing.assert_allclose(got.numpy(), want.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_hsigmoid_and_margin_ce(self):
+        rng = np.random.RandomState(5)
+        x = rng.randn(6, 8).astype(np.float32)
+        lbl = rng.randint(0, 10, (6, 1)).astype(np.int32)
+        layer = nn.HSigmoidLoss(8, 10)
+        out = layer(paddle.to_tensor(x), paddle.to_tensor(lbl))
+        assert list(out.shape) == [6, 1] and (out.numpy() > 0).all()
+        cos = np.clip(rng.randn(4, 10) * .3, -.99, .99).astype(np.float32)
+        loss, sm = F.margin_cross_entropy(
+            paddle.to_tensor(cos),
+            paddle.to_tensor(np.arange(4, dtype=np.int32)),
+            return_softmax=True)
+        assert float(loss.item()) > 0
+        np.testing.assert_allclose(sm.numpy().sum(1), np.ones(4), atol=1e-5)
+
+    def test_spectral_norm_and_misc_layers(self):
+        rng = np.random.RandomState(6)
+        w = rng.randn(6, 8).astype(np.float32)
+        sn = nn.SpectralNorm((6, 8), dim=0, power_iters=20)
+        wn = sn(paddle.to_tensor(w)).numpy()
+        assert abs(np.linalg.svd(wn)[1][0] - 1) < 1e-3
+        x = paddle.to_tensor(rng.randn(2, 4, 6, 6).astype(np.float32))
+        assert list(nn.ZeroPad2D(1)(x).shape) == [2, 4, 8, 8]
+        assert list(nn.PixelUnshuffle(2)(x).shape) == [2, 16, 3, 3]
+        assert list(nn.Softmax2D()(x).shape) == [2, 4, 6, 6]
+        assert list(nn.Unflatten(1, (2, 2))(x).shape) == [2, 2, 2, 6, 6]
+        pd = nn.PairwiseDistance()(x.flatten(2), x.flatten(2))
+        np.testing.assert_allclose(pd.numpy(), 0, atol=1e-5)
+        # Fold inverts Unfold for non-overlapping patches
+        u = F.unfold(x, 2, strides=2)
+        back = nn.Fold((6, 6), 2, strides=2)(u)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), atol=1e-6)
+        r = nn.RReLU()
+        r.eval()
+        v = paddle.to_tensor(np.array([-4.0, 4.0], np.float32))
+        slope = (1 / 8 + 1 / 3) / 2
+        np.testing.assert_allclose(r(v).numpy(), [-4 * slope, 4],
+                                   rtol=1e-6)
+        t = nn.ThresholdedReLU(1.0)
+        np.testing.assert_allclose(
+            t(paddle.to_tensor(np.array([0.5, 2.0], np.float32))).numpy(),
+            [0, 2])
+
+    def test_unpool_1d_3d(self):
+        rng = np.random.RandomState(7)
+        x1 = paddle.to_tensor(np.array(
+            [[[1., 5., 2., 8.]]], np.float32))
+        out, idx = F.adaptive_max_pool1d(x1, 2, return_mask=True)
+        np.testing.assert_allclose(out.numpy(), [[[5., 8.]]])
+        up = F.max_unpool1d(out, idx, 2, 2)
+        np.testing.assert_allclose(up.numpy(), [[[0, 5, 0, 8]]])
+        x3 = rng.randn(1, 2, 4, 4, 4).astype(np.float32)
+        o3 = F.adaptive_max_pool3d(paddle.to_tensor(x3), 2)
+        assert list(o3.shape) == [1, 2, 2, 2, 2]
+        a3 = F.adaptive_avg_pool3d(paddle.to_tensor(x3), 2)
+        np.testing.assert_allclose(
+            a3.numpy(),
+            x3.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean(axis=(3, 5, 7)),
+            rtol=1e-5)
+
+    def test_adaptive_max_pool_non_divisible_and_mask(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(8)
+        x1 = rng.randn(2, 3, 7).astype(np.float32)
+        o, m = F.adaptive_max_pool1d(paddle.to_tensor(x1), 3,
+                                     return_mask=True)
+        to, tm = TF.adaptive_max_pool1d(torch.tensor(x1), 3,
+                                        return_indices=True)
+        np.testing.assert_allclose(o.numpy(), to.numpy())
+        assert (m.numpy() == tm.numpy()).all()
+        x3 = rng.randn(1, 2, 5, 7, 6).astype(np.float32)
+        o, m = F.adaptive_max_pool3d(paddle.to_tensor(x3), (2, 3, 2),
+                                     return_mask=True)
+        to, tm = TF.adaptive_max_pool3d(torch.tensor(x3), (2, 3, 2),
+                                        return_indices=True)
+        np.testing.assert_allclose(o.numpy(), to.numpy())
+        assert (m.numpy() == tm.numpy()).all()
+
+    def test_grid_sample_reflection_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(9)
+        x = rng.randn(2, 3, 5, 7).astype(np.float32)
+        grid = (rng.rand(2, 4, 6, 2) * 4 - 2).astype(np.float32)
+        ours = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                             padding_mode="reflection").numpy()
+        want = TF.grid_sample(torch.tensor(x), torch.tensor(grid),
+                              padding_mode="reflection",
+                              align_corners=True).numpy()
+        np.testing.assert_allclose(ours, want, atol=1e-4)
+
+    def test_spectral_norm_grad_matches_torch(self):
+        import torch
+        import torch.nn.utils as TU
+        rng = np.random.RandomState(10)
+        w = rng.randn(6, 8).astype(np.float32)
+        sn = nn.SpectralNorm((6, 8), dim=0, power_iters=30)
+        wt = paddle.to_tensor(w)
+        wt.stop_gradient = False
+        sn(wt).sum().backward()
+        lin = torch.nn.Linear(8, 6, bias=False)
+        with torch.no_grad():
+            lin.weight.copy_(torch.tensor(w))
+        lin = TU.spectral_norm(lin, n_power_iterations=30)
+        lin(torch.zeros(1, 8))
+        lin.weight.sum().backward()
+        np.testing.assert_allclose(wt.grad.numpy(),
+                                   lin.weight_orig.grad.numpy(), atol=1e-3)

@@ -291,3 +291,75 @@ class TestEnforceLayer:
             raise OutOfRangeError("too long")
         import paddle_tpu.utils as U
         assert U.AlreadyExistsError and U.ExecutionTimeoutError
+
+
+class TestLongTailR2B:
+    """Round-2 second-batch long-tail ops (reference:
+    python/paddle/tensor/{math,manipulation,attribute}.py — verify)."""
+
+    def test_complex_polar_sgn(self):
+        c = paddle.complex(paddle.to_tensor([1., 2.]),
+                           paddle.to_tensor([3., 4.]))
+        np.testing.assert_allclose(c.numpy(), [1 + 3j, 2 + 4j])
+        p = paddle.polar(paddle.to_tensor([2.]),
+                         paddle.to_tensor([np.pi], "float32"))
+        np.testing.assert_allclose(p.numpy(), [-2 + 0j], atol=1e-6)
+        s = paddle.sgn(c)
+        np.testing.assert_allclose(np.abs(s.numpy()), [1., 1.], rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.sgn(paddle.to_tensor([-5., 0., 3.])).numpy(), [-1, 0, 1])
+
+    def test_pdist(self):
+        x = np.random.rand(5, 3).astype(np.float32)
+        got = paddle.pdist(paddle.to_tensor(x)).numpy()
+        want = np.array([np.linalg.norm(x[i] - x[j])
+                         for i in range(5) for j in range(i + 1, 5)])
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_predicates_and_rank(self):
+        x = paddle.to_tensor([[1., 2.]])
+        assert int(paddle.rank(x).item()) == 2
+        assert paddle.is_floating_point(x) and not paddle.is_complex(x)
+        assert paddle.is_tensor(x) and not paddle.is_tensor(x.numpy())
+        assert paddle.is_integer(paddle.to_tensor([1]))
+        assert bool(paddle.is_empty(paddle.zeros((0, 2))).item())
+        assert not bool(paddle.is_empty(x).item())
+
+    def test_multiplex_combinations_cat_inverse(self):
+        a = paddle.to_tensor([[1., 2.], [3., 4.]])
+        b = paddle.to_tensor([[10., 20.], [30., 40.]])
+        out = paddle.multiplex([a, b], paddle.to_tensor([[0], [1]]))
+        np.testing.assert_allclose(out.numpy(), [[1, 2], [30, 40]])
+        c = paddle.combinations(paddle.to_tensor([1, 2, 3]))
+        np.testing.assert_allclose(c.numpy(), [[1, 2], [1, 3], [2, 3]])
+        cr = paddle.combinations(paddle.to_tensor([1, 2]), r=2,
+                                 with_replacement=True)
+        np.testing.assert_allclose(cr.numpy(), [[1, 1], [1, 2], [2, 2]])
+        np.testing.assert_allclose(paddle.cat([a, b], axis=1).numpy(),
+                                   np.concatenate([a.numpy(), b.numpy()], 1))
+        m = paddle.to_tensor([[4., 0.], [0., 2.]])
+        np.testing.assert_allclose(paddle.inverse(m).numpy(),
+                                   [[.25, 0], [0, .5]])
+
+    def test_inplace_random_fills(self):
+        paddle.seed(7)
+        x = paddle.zeros((2000,))
+        x.uniform_(0., 4.)
+        v = x.numpy()
+        assert 0 <= v.min() and v.max() <= 4 and abs(v.mean() - 2) < .2
+        x.normal_(mean=1., std=3.)
+        v = x.numpy()
+        assert abs(v.mean() - 1) < .3 and abs(v.std() - 3) < .3
+        x.exponential_(4.)
+        assert abs(x.numpy().mean() - .25) < .05
+        x.geometric_(0.25)
+        v = x.numpy()
+        assert v.min() >= 1 and abs(v.mean() - 4) < .4
+
+    def test_inplace_random_cuts_grad(self):
+        w = paddle.to_tensor([1., 2.], stop_gradient=False)
+        z = w * 2
+        w.uniform_()
+        (z.sum() + (w * 5).sum()).backward()
+        # only the pre-overwrite read of w contributes
+        np.testing.assert_allclose(w.grad.numpy(), [2., 2.])
