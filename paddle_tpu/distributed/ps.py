@@ -1,0 +1,300 @@
+"""Parameter-server mode for sparse models (reference:
+python/paddle/distributed/ps/ + paddle/fluid/distributed/ps/ — the
+brpc+rocksdb service with MemorySparseTable/SSDSparseTable, GeoSGD — verify).
+
+TPU-native scope decision: the reference's PS is a ~150k-LoC CPU recsys
+stack. Here PS mode is a compact, working equivalent for the same API
+shape: in-memory sparse embedding tables sharded across server processes
+(row → server by ``id % num_servers``), pull/push over the
+:mod:`paddle_tpu.distributed.rpc` transport, server-side SGD/Adagrad, and
+a ``SparseEmbedding`` layer whose backward pushes gradients via the
+autograd grad-hook. Dense compute stays on the accelerator; only the
+sparse rows live host-side — which is exactly the reference's split.
+SSD/rocksdb spill and GeoSGD are out of scope (documented in README).
+
+Roles follow the launch contract: ``TRAINING_ROLE`` = ``PSERVER`` |
+``TRAINER``, ``PADDLE_PSERVER_NUM``, ``PADDLE_TRAINER_NUM``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from . import rpc
+
+__all__ = ["init_server", "run_server", "init_worker", "stop_worker",
+           "create_table", "pull_sparse", "push_sparse", "save_table",
+           "table_size", "SparseEmbedding", "is_server", "is_worker",
+           "server_num", "worker_num", "shutdown"]
+
+
+# ---------------------------------------------------------------------------
+# server side: tables live in this process-global registry
+# ---------------------------------------------------------------------------
+
+class _SparseTable:
+    """One shard of a sparse table: id → (row, per-row optimizer state).
+    Rows materialize on first touch (the reference's lazy sparse init)."""
+
+    def __init__(self, dim, init_range=0.01, optimizer="sgd", lr=0.1,
+                 seed=0):
+        self.dim = int(dim)
+        self.init_range = float(init_range)
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.rows: dict[int, np.ndarray] = {}
+        self.accum: dict[int, np.ndarray] = {}     # adagrad G
+        self._rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
+
+    def _row(self, i: int) -> np.ndarray:
+        r = self.rows.get(i)
+        if r is None:
+            r = self._rng.uniform(-self.init_range, self.init_range,
+                                  self.dim).astype(np.float32)
+            self.rows[i] = r
+        return r
+
+    def pull(self, ids) -> np.ndarray:
+        with self._lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push(self, ids, grads: np.ndarray):
+        with self._lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                row = self._row(i)
+                if self.optimizer == "adagrad":
+                    acc = self.accum.setdefault(
+                        i, np.zeros(self.dim, np.float32))
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + 1e-8)
+                else:                                   # sgd
+                    row -= self.lr * g
+
+    def state(self):
+        with self._lock:
+            return dict(self.rows)
+
+
+_TABLES: dict[str, _SparseTable] = {}
+_SERVER_STOP = threading.Event()
+
+
+# module-level so they are picklable rpc targets ----------------------------
+
+def _srv_create_table(name, dim, init_range, optimizer, lr, seed):
+    if name not in _TABLES:
+        _TABLES[name] = _SparseTable(dim, init_range, optimizer, lr, seed)
+    return True
+
+
+def _srv_pull(name, ids):
+    return _TABLES[name].pull(ids)
+
+
+def _srv_push(name, ids, grads):
+    _TABLES[name].push(ids, grads)
+    return True
+
+
+def _srv_size(name):
+    return len(_TABLES[name].rows)
+
+
+def _srv_save(name, path):
+    t = _TABLES[name]
+    rows = t.state()
+    ids = np.array(sorted(rows), np.int64)
+    np.savez(path, ids=ids,
+             rows=np.stack([rows[int(i)] for i in ids]) if len(ids)
+             else np.zeros((0, t.dim), np.float32))
+    return len(ids)
+
+
+def _srv_stop():
+    _SERVER_STOP.set()
+    return True
+
+
+# ---------------------------------------------------------------------------
+# role plumbing
+# ---------------------------------------------------------------------------
+
+def is_server() -> bool:
+    return os.environ.get("TRAINING_ROLE", "TRAINER").upper() == "PSERVER"
+
+
+def is_worker() -> bool:
+    return not is_server()
+
+
+def server_num() -> int:
+    return int(os.environ.get("PADDLE_PSERVER_NUM", 1))
+
+
+def worker_num() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_NUM", 1))
+
+
+def _rpc_world():
+    return server_num() + worker_num()
+
+
+def _server_name(i):
+    return f"ps:{i}"
+
+
+def _join(name, role_idx, as_server):
+    """Common join path: compute the global rpc rank from the role index
+    and align the store env (PADDLE_TRAINER_ID/NUM name the *rpc* world
+    from here on — PS processes do not use the collective path)."""
+    rank = role_idx if as_server else server_num() + role_idx
+    world = _rpc_world()
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(world)
+    rpc.init_rpc(name, rank=rank, world_size=world)
+
+
+def init_server(name: Optional[str] = None):
+    """Join the PS cluster as a server (reference fleet.init_server)."""
+    idx = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    _join(name or _server_name(idx), idx, as_server=True)
+
+
+def run_server(poll_s=0.1):
+    """Serve until a trainer calls :func:`shutdown` (fleet.run_server)."""
+    while not _SERVER_STOP.is_set():
+        time.sleep(poll_s)
+    rpc.shutdown()
+
+
+def init_worker(name: Optional[str] = None):
+    """Join the PS cluster as a trainer (reference fleet.init_worker)."""
+    idx = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    _join(name or f"trainer:{idx}", idx, as_server=False)
+
+
+def stop_worker():
+    rpc.shutdown()
+
+
+def shutdown():
+    """Trainer-side: stop every server, then leave the rpc world."""
+    for s in range(server_num()):
+        try:
+            rpc.rpc_sync(_server_name(s), _srv_stop, timeout=10)
+        except Exception:
+            pass
+    rpc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# client API
+# ---------------------------------------------------------------------------
+
+def _shard(ids: np.ndarray):
+    """Partition ids by owning server; returns {server_idx: positions}."""
+    owners = ids % server_num()
+    return {s: np.nonzero(owners == s)[0] for s in range(server_num())
+            if (owners == s).any()}
+
+
+def create_table(name, dim, init_range=0.01, optimizer="sgd", lr=0.1,
+                 seed=0):
+    """Create ``name`` on every server shard (idempotent)."""
+    futs = [rpc.rpc_async(_server_name(s), _srv_create_table,
+                          args=(name, dim, init_range, optimizer, lr,
+                                seed + s))
+            for s in range(server_num())]
+    for f in futs:
+        f.wait(60)
+
+
+def pull_sparse(name, ids) -> np.ndarray:
+    """Fetch rows for ``ids`` (any shape) → array of shape ids.shape+(dim,).
+    Fan-out to owning servers runs concurrently."""
+    ids = np.asarray(ids, np.int64)
+    flat = ids.reshape(-1)
+    out = None
+    shards = _shard(flat)
+    futs = {s: rpc.rpc_async(_server_name(s), _srv_pull,
+                             args=(name, flat[pos]))
+            for s, pos in shards.items()}
+    for s, fut in futs.items():
+        rows = fut.wait(60)
+        if out is None:
+            out = np.zeros((flat.size, rows.shape[-1]), np.float32)
+        out[shards[s]] = rows
+    if out is None:
+        raise ValueError("pull_sparse with empty ids")
+    return out.reshape(ids.shape + (out.shape[-1],))
+
+
+def push_sparse(name, ids, grads):
+    """Apply gradients to rows of ``ids``; duplicate ids within the batch
+    are pre-summed host-side (the reference merges by key in the worker)."""
+    ids = np.asarray(ids, np.int64).reshape(-1)
+    grads = np.asarray(grads, np.float32).reshape(ids.size, -1)
+    uniq, inv = np.unique(ids, return_inverse=True)
+    merged = np.zeros((uniq.size, grads.shape[1]), np.float32)
+    np.add.at(merged, inv, grads)
+    futs = [rpc.rpc_async(_server_name(s), _srv_push,
+                          args=(name, uniq[pos], merged[pos]))
+            for s, pos in _shard(uniq).items()]
+    for f in futs:
+        f.wait(60)
+
+
+def table_size(name) -> int:
+    return sum(rpc.rpc_sync(_server_name(s), _srv_size, args=(name,))
+               for s in range(server_num()))
+
+
+def save_table(name, dirname) -> int:
+    os.makedirs(dirname, exist_ok=True)
+    return sum(rpc.rpc_sync(_server_name(s), _srv_save,
+                            args=(name, os.path.join(
+                                dirname, f"{name}.shard{s}.npz")))
+               for s in range(server_num()))
+
+
+# ---------------------------------------------------------------------------
+# model-side layer
+# ---------------------------------------------------------------------------
+
+class SparseEmbedding:
+    """Embedding whose table lives on the parameter servers (reference:
+    paddle.static.nn.sparse_embedding / DistributedLookupTable — verify).
+
+    Forward pulls the touched rows into a leaf tensor; a grad hook on that
+    leaf pushes the gradient back — so a normal ``loss.backward()``
+    performs the PS update with no optimizer involvement (the server owns
+    the optimizer, as in the reference)."""
+
+    def __init__(self, name, num_embeddings, embedding_dim, optimizer="sgd",
+                 lr=0.1, init_range=0.01):
+        self.table_name = name
+        self.dim = int(embedding_dim)
+        create_table(name, embedding_dim, init_range, optimizer, lr)
+
+    def __call__(self, ids):
+        from ..tensor import Tensor, to_tensor
+        ids_np = np.asarray(
+            ids._value if isinstance(ids, Tensor) else ids, np.int64)
+        uniq, inv = np.unique(ids_np.reshape(-1), return_inverse=True)
+        rows = to_tensor(pull_sparse(self.table_name, uniq))
+        rows.stop_gradient = False
+        name = self.table_name
+
+        def push_hook(grad):
+            push_sparse(name, uniq, np.asarray(grad._value))
+            return grad
+        rows.register_hook(push_hook)
+        from .. import ops
+        flat = ops.gather(rows, to_tensor(inv.astype(np.int32)))
+        return ops.reshape(flat, list(ids_np.shape) + [self.dim])

@@ -1,0 +1,341 @@
+"""User-level RPC (reference: python/paddle/distributed/rpc/ +
+paddle/fluid/distributed/rpc/ RpcAgent over brpc — verify).
+
+TPU-native design: the reference ships a brpc C++ agent; here the agent is
+a length-prefixed-pickle protocol over raw TCP sockets — the same
+host-side control-plane transport class as the C++ TCPStore (which this
+module reuses for endpoint rendezvous). RPC is a coordination surface
+(parameter-server control, custom user plumbing), never the tensor perf
+path — bulk tensors move inside jitted XLA programs.
+
+Protocol: 8-byte big-endian length + pickle of (fn, args, kwargs);
+response is length + pickle of ("ok"|"err", payload). Functions must be
+picklable (importable top-level callables), as in the reference.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+    @property
+    def endpoint(self):
+        return f"{self.ip}:{self.port}"
+
+
+_AGENT = None
+_AGENT_LOCK = threading.Lock()
+
+
+def _send_msg(sock: socket.socket, payload: bytes):
+    sock.sendall(struct.pack(">Q", len(payload)) + payload)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rpc peer closed connection")
+        hdr += chunk
+    n = struct.unpack(">Q", hdr)[0]
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("rpc peer closed mid-message")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class _Agent:
+    """Per-process RPC agent: a serving thread + a client connection pool."""
+
+    def __init__(self, name: str, rank: int, world_size: int):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.workers: dict[str, WorkerInfo] = {}
+        self._by_rank: dict[int, WorkerInfo] = {}
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("0.0.0.0", 0))
+        self._srv.listen(64)
+        self.port = self._srv.getsockname()[1]
+        self.ip = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
+        self._stop = threading.Event()
+        self._conns: dict[str, socket.socket] = {}
+        self._locks: dict[str, threading.Lock] = {}
+        self._conn_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        t = threading.Thread(target=self._serve_loop, daemon=True,
+                             name=f"rpc-serve-{name}")
+        t.start()
+        self._serve_thread = t
+
+    # -- server side --------------------------------------------------------
+    def _serve_loop(self):
+        try:
+            self._srv.settimeout(0.2)
+        except OSError:
+            return
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _handle(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                try:
+                    req = _recv_msg(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    fn, args, kwargs = pickle.loads(req)
+                    out = ("ok", fn(*args, **(kwargs or {})))
+                except BaseException as e:  # delivered to the caller
+                    out = ("err", e)
+                try:
+                    _send_msg(conn, pickle.dumps(out))
+                except pickle.PicklingError:
+                    _send_msg(conn, pickle.dumps(
+                        ("err", RuntimeError(
+                            f"rpc result not picklable: {type(out[1])}"))))
+        finally:
+            conn.close()
+
+    # -- client side --------------------------------------------------------
+    def _conn_to(self, info: WorkerInfo):
+        with self._conn_lock:
+            s = self._conns.get(info.name)
+            if s is None:
+                s = socket.create_connection((info.ip, info.port),
+                                             timeout=60)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._conns[info.name] = s
+                self._locks[info.name] = threading.Lock()
+            return s, self._locks[info.name]
+
+    def _evict(self, name):
+        """Drop a connection whose request/response stream may be out of
+        sync (timeout or transport error mid-call): a late reply on a
+        reused socket would otherwise be read as the NEXT call's result."""
+        with self._conn_lock:
+            sock = self._conns.pop(name, None)
+            self._locks.pop(name, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def call(self, to, fn, args, kwargs, timeout=None):
+        info = self.resolve(to)
+        payload = pickle.dumps((fn, tuple(args or ()), dict(kwargs or {})))
+        # one in-flight request per connection: serialize on the socket
+        s, lock = self._conn_to(info)
+        with lock:
+            try:
+                if timeout is not None:
+                    s.settimeout(timeout)
+                _send_msg(s, payload)
+                status, result = pickle.loads(_recv_msg(s))
+            except (socket.timeout, ConnectionError, OSError):
+                self._evict(info.name)
+                raise
+            finally:
+                try:
+                    s.settimeout(None)
+                except OSError:
+                    pass
+        if status == "err":
+            raise result
+        return result
+
+    def resolve(self, to) -> WorkerInfo:
+        if isinstance(to, WorkerInfo):
+            return to
+        if isinstance(to, int):
+            if to not in self._by_rank:
+                raise ValueError(f"unknown rpc rank {to}")
+            return self._by_rank[to]
+        if to not in self.workers:
+            raise ValueError(
+                f"unknown rpc worker {to!r}; known: {sorted(self.workers)}")
+        return self.workers[to]
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            for s in self._conns.values():
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+            self._locks.clear()
+
+
+class FutureWrapper:
+    """rpc_async return value (paddle .wait() parity)."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._val: Any = None
+        self._exc: Optional[BaseException] = None
+
+    def _fulfill(self, val=None, exc=None):
+        self._val, self._exc = val, exc
+        self._ev.set()
+
+    def wait(self, timeout=None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("rpc_async result not ready")
+        if self._exc is not None:
+            raise self._exc
+        return self._val
+
+    def done(self):
+        return self._ev.is_set()
+
+
+def _store():
+    from . import communication
+    return communication._get_store()
+
+
+def init_rpc(name: str, rank: int = None, world_size: int = None,
+             master_endpoint: str = None):
+    """Start this process's RPC agent and rendezvous with peers.
+
+    ``master_endpoint`` (host:port) defaults to the launch contract's
+    PADDLE_MASTER; endpoint exchange rides the C++ TCPStore."""
+    global _AGENT
+    with _AGENT_LOCK:
+        if _AGENT is not None:
+            raise RuntimeError("init_rpc called twice (call shutdown first)")
+        if master_endpoint is not None:
+            os.environ.setdefault("PADDLE_MASTER", master_endpoint)
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+            else int(rank)
+        world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+            if world_size is None else int(world_size)
+        agent = _Agent(name, rank, world_size)
+        store = _store()
+        # generation = completed shutdown rounds; keys are scoped by it so
+        # a re-init never reads the previous round's (dead) endpoints.
+        # Wait for any in-flight shutdown round to complete first.
+        deadline = time.time() + 120
+        while True:
+            done = store.add("rpc/shutdown", 0)
+            if done % world_size == 0:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(
+                    "init_rpc: previous rpc round never finished shutdown")
+            time.sleep(0.05)
+        gen = done // world_size
+        agent.generation = gen
+        info = WorkerInfo(name, rank, agent.ip, agent.port)
+        store.set(f"rpc/{gen}/worker/{rank}", pickle.dumps(info))
+        for r in range(world_size):
+            key = f"rpc/{gen}/worker/{r}"
+            while True:
+                try:
+                    data = store.get(key)
+                    if data:
+                        break
+                except Exception:
+                    pass
+                if time.time() > deadline:
+                    raise TimeoutError(f"rpc rendezvous timed out on {key}")
+                time.sleep(0.05)
+            winfo = pickle.loads(data)
+            agent.workers[winfo.name] = winfo
+            agent._by_rank[winfo.rank] = winfo
+        _AGENT = agent
+        return agent
+
+
+def _agent() -> _Agent:
+    if _AGENT is None:
+        raise RuntimeError("rpc not initialized — call init_rpc first")
+    return _AGENT
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    """Call ``fn(*args, **kwargs)`` on worker ``to`` (name or rank) and
+    block for the result."""
+    return _agent().call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None):
+    """Like rpc_sync but returns a future with .wait()."""
+    fut = FutureWrapper()
+
+    def run():
+        try:
+            fut._fulfill(val=_agent().call(to, fn, args, kwargs, timeout))
+        except BaseException as e:
+            fut._fulfill(exc=e)
+    threading.Thread(target=run, daemon=True).start()
+    return fut
+
+
+def get_worker_info(name: str = None) -> WorkerInfo:
+    a = _agent()
+    if name is None:
+        return a.workers[a.name]
+    return a.resolve(name)
+
+
+def get_all_worker_infos():
+    return sorted(_agent().workers.values(), key=lambda w: w.rank)
+
+
+def shutdown():
+    """Graceful stop: barrier over the store so no peer is mid-call, then
+    close the agent."""
+    global _AGENT
+    with _AGENT_LOCK:
+        if _AGENT is None:
+            return
+        store = _store()
+        target = (_AGENT.generation + 1) * _AGENT.world_size
+        n = store.add("rpc/shutdown", 1)
+        deadline = time.time() + 60
+        while n < target:
+            if time.time() > deadline:
+                break  # shut down anyway; peers have their own deadline
+            time.sleep(0.05)
+            n = store.add("rpc/shutdown", 0)
+        _AGENT.stop()
+        _AGENT = None

@@ -10,7 +10,9 @@ from .pooling import *                        # noqa: F401,F403
 from .loss import *                           # noqa: F401,F403
 from .transformer import *                    # noqa: F401,F403
 from .rnn import *                            # noqa: F401,F403
+from .decode import *                         # noqa: F401,F403
 
 from ..param_attr import ParamAttr            # noqa: F401
 
 from . import common, conv, norm, pooling, loss, transformer, rnn  # noqa
+from . import decode  # noqa

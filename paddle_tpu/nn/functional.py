@@ -565,6 +565,11 @@ def _pool(x, kernel, stride, padding, nd, op, include_pad=False,
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
     if return_mask:
+        if ceil_mode or data_format != "NCHW":
+            raise ValueError(
+                "max_pool2d(return_mask=True) supports ceil_mode=False and "
+                f"NCHW only (got ceil_mode={ceil_mode}, "
+                f"data_format={data_format!r})")
         return max_pool2d_with_mask(x, kernel_size, stride, padding)
     return apply_op(_pool(x, kernel_size, stride, padding, 2, "max"), x)
 
@@ -611,25 +616,34 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     os = _pair(output_size, 2)
+    h_in, w_in = (int(s) for s in x.shape[2:])
 
     def f(v):
         n, c, h, w = v.shape
         oh, ow = os
-        v2 = v.reshape(n, c, oh, h // oh, ow, w // ow) if h % oh == 0 and \
-            w % ow == 0 else None
-        if v2 is not None:
+        if h % oh == 0 and w % ow == 0:
+            v2 = v.reshape(n, c, oh, h // oh, ow, w // ow)
             return jnp.mean(v2, axis=(3, 5))
-        return jax.image.resize(v, (n, c, oh, ow), method="linear")
+        hw = _adaptive_windows(h_in, oh)
+        ww = _adaptive_windows(w_in, ow)
+        rows = [jnp.stack([jnp.mean(v[:, :, hs:he, ws:we], axis=(2, 3))
+                           for ws, we in ww], axis=-1)
+                for hs, he in hw]
+        return jnp.stack(rows, axis=-2)
     return apply_op(f, x)
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
+    l_in = int(x.shape[-1])
+
     def f(v):
         n, c, l = v.shape
         o = output_size if isinstance(output_size, int) else output_size[0]
         if l % o == 0:
             return jnp.mean(v.reshape(n, c, o, l // o), axis=3)
-        return jax.image.resize(v, (n, c, o), method="linear")
+        return jnp.stack([jnp.mean(v[:, :, s_:e_], axis=-1)
+                          for s_, e_ in _adaptive_windows(l_in, o)],
+                         axis=-1)
     return apply_op(f, x)
 
 
@@ -1448,13 +1462,20 @@ def gather_tree(ids, parents, name=None):
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
     os_ = _pair(output_size, 3)
 
+    d_in, h_in, w_in = (int(s) for s in x.shape[2:])
+
     def f(v):
         n, c, d, h, w = v.shape
         od, oh, ow = os_
         if d % od == 0 and h % oh == 0 and w % ow == 0:
             v6 = v.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
             return jnp.mean(v6, axis=(3, 5, 7))
-        return jax.image.resize(v, (n, c, od, oh, ow), method="linear")
+        out = [jnp.mean(v[:, :, ds:de, hs:he, ws:we], axis=(2, 3, 4))
+               for ds, de in _adaptive_windows(d_in, od)
+               for hs, he in _adaptive_windows(h_in, oh)
+               for ws, we in _adaptive_windows(w_in, ow)]
+        return jnp.stack(out, axis=-1).reshape(
+            (n, c, od, oh, ow))
     return apply_op(f, x)
 
 

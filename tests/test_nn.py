@@ -508,3 +508,100 @@ class TestRound2Batch2Layers:
         lin.weight.sum().backward()
         np.testing.assert_allclose(wt.grad.numpy(),
                                    lin.weight_orig.grad.numpy(), atol=1e-3)
+
+
+class TestBeamSearchDecode:
+    """nn.BeamSearchDecoder + dynamic_decode (reference:
+    python/paddle/nn/decode.py — verify)."""
+
+    def _build(self, V=11, H=16, K=3):
+        paddle.seed(0)
+        emb = nn.Embedding(V, H)
+        cell = nn.GRUCell(H, H)
+        proj = nn.Linear(H, V)
+        return nn.BeamSearchDecoder(cell, start_token=1, end_token=2,
+                                    beam_size=K, embedding_fn=emb,
+                                    output_fn=proj), cell, emb, proj
+
+    def test_shapes_and_ranges(self):
+        dec, *_ = self._build()
+        ids, st, ln = nn.dynamic_decode(dec, inits=paddle.zeros((2, 16)),
+                                        max_step_num=12, return_length=True)
+        assert list(ids.shape) == [2, 12, 3] or ids.shape[1] <= 12
+        assert list(ln.shape) == [2, 3]
+        v = ids.numpy()
+        assert ((v >= 0) & (v < 11)).all()
+
+    def test_beam0_is_argmax_of_first_step(self):
+        # with beam scores initialized to [0, -inf, ...], after ONE step the
+        # top beam holds the argmax token of the start-token logits (over
+        # more steps an early-finished beam may legitimately overtake)
+        dec, cell, emb, proj = self._build()
+        ids, _ = nn.dynamic_decode(dec, inits=paddle.zeros((2, 16)),
+                                   max_step_num=1)
+        start = paddle.to_tensor(np.full((2,), 1, np.int64))
+        h = paddle.zeros((2, 16))
+        out, _ = cell(emb(start), h)
+        first = proj(out).numpy().argmax(-1)
+        np.testing.assert_array_equal(ids.numpy()[:, 0, 0], first)
+
+    def test_dynamic_decode_layer_and_time_major(self):
+        dec, *_ = self._build()
+        layer = nn.DynamicDecode(dec, max_step_num=6,
+                                 output_time_major=True)
+        ids, _ = layer(paddle.zeros((2, 16)))
+        assert ids.shape[1] == 2 and ids.shape[2] == 3
+
+    def test_adaptive_avg_pool_non_divisible_vs_torch(self):
+        import torch
+        import torch.nn.functional as TF
+        rng = np.random.RandomState(11)
+        x1 = rng.randn(2, 3, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool1d(paddle.to_tensor(x1), 3).numpy(),
+            TF.adaptive_avg_pool1d(torch.tensor(x1), 3).numpy(), atol=1e-5)
+        x2 = rng.randn(2, 3, 5, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool2d(paddle.to_tensor(x2), (2, 3)).numpy(),
+            TF.adaptive_avg_pool2d(torch.tensor(x2), (2, 3)).numpy(),
+            atol=1e-5)
+        x3 = rng.randn(1, 2, 5, 7, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            F.adaptive_avg_pool3d(paddle.to_tensor(x3), (2, 3, 4)).numpy(),
+            TF.adaptive_avg_pool3d(torch.tensor(x3), (2, 3, 4)).numpy(),
+            atol=1e-5)
+
+    def test_pool_mask_layer_flags(self):
+        rng = np.random.RandomState(12)
+        x3 = paddle.to_tensor(rng.randn(1, 2, 4, 4, 4).astype(np.float32))
+        out, mask = nn.AdaptiveMaxPool3D(2, return_mask=True)(x3)
+        assert list(out.shape) == [1, 2, 2, 2, 2]
+        assert list(mask.shape) == [1, 2, 2, 2, 2]
+        with pytest.raises(ValueError):
+            F.max_pool2d(paddle.to_tensor(
+                rng.randn(1, 1, 5, 5).astype(np.float32)), 2,
+                ceil_mode=True, return_mask=True)
+
+
+class TestBeamLengths:
+    def test_lengths_follow_reordered_beams(self):
+        # every traced beam's reported length == index of its first EOS
+        # (inclusive), or T when it never finished — robust to top-k
+        # slot reordering
+        paddle.seed(11)
+        emb = nn.Embedding(9, 8)
+        cell = nn.GRUCell(8, 8)
+        proj = nn.Linear(8, 9)
+        dec = nn.BeamSearchDecoder(cell, 1, 2, 3, embedding_fn=emb,
+                                   output_fn=proj)
+        ids, _, ln = nn.dynamic_decode(dec, inits=paddle.zeros((4, 8)),
+                                       max_step_num=10, return_length=True)
+        v, L = ids.numpy(), ln.numpy()
+        assert (L <= v.shape[1]).all()
+        for b in range(4):
+            for k in range(3):
+                seq = v[b, :, k].tolist()
+                if 2 in seq:
+                    assert L[b, k] == seq.index(2) + 1
+                else:
+                    assert L[b, k] == v.shape[1]

@@ -1,0 +1,145 @@
+"""RPC + parameter-server mode (reference: python/paddle/distributed/rpc/,
+python/paddle/distributed/ps/ — verify)."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu.distributed.rpc as rpc
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class TestRpcSingleWorld:
+    def test_sync_async_and_infos(self):
+        rpc.init_rpc("w0", rank=0, world_size=1)
+        try:
+            import operator
+            assert rpc.rpc_sync("w0", operator.add, args=(2, 3)) == 5
+            fut = rpc.rpc_async("w0", operator.mul, args=(4, 5))
+            assert fut.wait(10) == 20
+            # rank addressing + worker infos
+            assert rpc.rpc_sync(0, operator.add, args=(1, 1)) == 2
+            infos = rpc.get_all_worker_infos()
+            assert len(infos) == 1 and infos[0].name == "w0"
+            assert rpc.get_worker_info().rank == 0
+            # remote exceptions propagate
+            with pytest.raises(ZeroDivisionError):
+                rpc.rpc_sync("w0", operator.truediv, args=(1, 0))
+            with pytest.raises(ValueError):
+                rpc.rpc_sync("nope", operator.add, args=(1, 1))
+        finally:
+            rpc.shutdown()
+
+    def test_reinit_after_shutdown(self):
+        rpc.init_rpc("w0", rank=0, world_size=1)
+        rpc.shutdown()
+        rpc.init_rpc("w0", rank=0, world_size=1)
+        import operator
+        assert rpc.rpc_sync("w0", operator.add, args=(1, 2)) == 3
+        rpc.shutdown()
+
+
+SERVER_SCRIPT = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.distributed import ps
+    ps.init_server()
+    ps.run_server(poll_s=0.05)
+    print("SERVER_DONE")
+""")
+
+TRAINER_SCRIPT = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import ps
+
+    ps.init_worker()
+    ps.create_table("emb", 4, optimizer="sgd", lr=0.5, init_range=0.01)
+
+    ids = np.array([1, 2, 3])
+    before = ps.pull_sparse("emb", ids)
+    assert before.shape == (3, 4)
+    # pulls are stable (lazy rows persist server-side)
+    again = ps.pull_sparse("emb", ids)
+    assert np.allclose(before, again)
+
+    # manual push applies SGD: row -= lr * grad (duplicates pre-merged)
+    g = np.ones((4, 4), np.float32)
+    ps.push_sparse("emb", np.array([1, 2, 3, 1]), g)
+    after = ps.pull_sparse("emb", ids)
+    exp = before.copy()
+    exp[0] -= 0.5 * 2.0   # id 1 pushed twice
+    exp[1] -= 0.5
+    exp[2] -= 0.5
+    assert np.allclose(after, exp, atol=1e-6), (after, exp)
+
+    # SparseEmbedding: backward pushes through the grad hook
+    emb = ps.SparseEmbedding("emb2", 8, 4, lr=1.0)
+    x = paddle.to_tensor(np.array([[1, 2], [2, 5]], np.int64))
+    out = emb(x)
+    assert list(out.shape) == [2, 2, 4]
+    rows_before = ps.pull_sparse("emb2", np.array([1, 2, 5]))
+    out.sum().backward()
+    rows_after = ps.pull_sparse("emb2", np.array([1, 2, 5]))
+    # d(sum)/d(row): id1 once, id2 twice, id5 once; lr=1
+    assert np.allclose(rows_before[0] - 1.0, rows_after[0], atol=1e-6)
+    assert np.allclose(rows_before[1] - 2.0, rows_after[1], atol=1e-6)
+    assert np.allclose(rows_before[2] - 1.0, rows_after[2], atol=1e-6)
+
+    assert ps.table_size("emb") == 3
+    import tempfile
+    d = tempfile.mkdtemp()
+    assert ps.save_table("emb", d) == 3
+    ps.shutdown()
+    print("TRAINER_DONE")
+""")
+
+
+class TestPsCluster:
+    def test_one_server_one_trainer(self, tmp_path):
+        port = _free_port()
+        base_env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "PADDLE_PSERVER_NUM": "1",
+            "PADDLE_TRAINER_NUM": "1",
+            "PADDLE_TRAINER_ID": "0",
+        }
+        srv = subprocess.Popen(
+            [sys.executable, "-c", SERVER_SCRIPT],
+            env={**base_env, "TRAINING_ROLE": "PSERVER"},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        trn = subprocess.Popen(
+            [sys.executable, "-c", TRAINER_SCRIPT],
+            env={**base_env, "TRAINING_ROLE": "TRAINER"},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            t_out, _ = trn.communicate(timeout=180)
+            s_out, _ = srv.communicate(timeout=60)
+        finally:
+            for p in (srv, trn):
+                if p.poll() is None:
+                    p.kill()
+        assert trn.returncode == 0, t_out
+        assert "TRAINER_DONE" in t_out, t_out
+        assert srv.returncode == 0, s_out
+        assert "SERVER_DONE" in s_out, s_out
