@@ -77,8 +77,10 @@ class _SparseTable:
                     row -= self.lr * g
 
     def state(self):
+        # deep-copy under the lock: the row arrays are mutated in place by
+        # push, so sharing them would let a snapshot tear mid-update
         with self._lock:
-            return dict(self.rows)
+            return {k: v.copy() for k, v in self.rows.items()}
 
 
 _TABLES: dict[str, _SparseTable] = {}
@@ -209,10 +211,10 @@ def create_table(name, dim, init_range=0.01, optimizer="sgd", lr=0.1,
     """Create ``name`` on every server shard (idempotent)."""
     futs = [rpc.rpc_async(_server_name(s), _srv_create_table,
                           args=(name, dim, init_range, optimizer, lr,
-                                seed + s))
+                                seed + s), timeout=60)
             for s in range(server_num())]
     for f in futs:
-        f.wait(60)
+        f.wait(65)
 
 
 def pull_sparse(name, ids) -> np.ndarray:
@@ -223,10 +225,10 @@ def pull_sparse(name, ids) -> np.ndarray:
     out = None
     shards = _shard(flat)
     futs = {s: rpc.rpc_async(_server_name(s), _srv_pull,
-                             args=(name, flat[pos]))
+                             args=(name, flat[pos]), timeout=60)
             for s, pos in shards.items()}
     for s, fut in futs.items():
-        rows = fut.wait(60)
+        rows = fut.wait(65)
         if out is None:
             out = np.zeros((flat.size, rows.shape[-1]), np.float32)
         out[shards[s]] = rows
@@ -244,10 +246,10 @@ def push_sparse(name, ids, grads):
     merged = np.zeros((uniq.size, grads.shape[1]), np.float32)
     np.add.at(merged, inv, grads)
     futs = [rpc.rpc_async(_server_name(s), _srv_push,
-                          args=(name, uniq[pos], merged[pos]))
+                          args=(name, uniq[pos], merged[pos]), timeout=60)
             for s, pos in _shard(uniq).items()]
     for f in futs:
-        f.wait(60)
+        f.wait(65)
 
 
 def table_size(name) -> int:

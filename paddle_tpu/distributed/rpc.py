@@ -120,11 +120,13 @@ class _Agent:
                 except BaseException as e:  # delivered to the caller
                     out = ("err", e)
                 try:
-                    _send_msg(conn, pickle.dumps(out))
-                except pickle.PicklingError:
-                    _send_msg(conn, pickle.dumps(
+                    payload = pickle.dumps(out)
+                except Exception as e:  # TypeError for locks/sockets, etc.
+                    payload = pickle.dumps(
                         ("err", RuntimeError(
-                            f"rpc result not picklable: {type(out[1])}"))))
+                            "rpc result not picklable "
+                            f"({type(out[1]).__name__}): {e}")))
+                _send_msg(conn, payload)
         finally:
             conn.close()
 
@@ -243,7 +245,8 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
         if _AGENT is not None:
             raise RuntimeError("init_rpc called twice (call shutdown first)")
         if master_endpoint is not None:
-            os.environ.setdefault("PADDLE_MASTER", master_endpoint)
+            # explicit argument overrides any inherited env default
+            os.environ["PADDLE_MASTER"] = master_endpoint
         rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
             else int(rank)
         world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
@@ -334,7 +337,11 @@ def shutdown():
         deadline = time.time() + 60
         while n < target:
             if time.time() > deadline:
-                break  # shut down anyway; peers have their own deadline
+                # a peer died without calling shutdown: close the round on
+                # its behalf so a future init_rpc on this master can start
+                # (leaving the counter mid-round bricks rendezvous forever)
+                store.add("rpc/shutdown", target - n)
+                break
             time.sleep(0.05)
             n = store.add("rpc/shutdown", 0)
         _AGENT.stop()

@@ -799,3 +799,129 @@ def _kl_gamma(p, q):
             - gl(p.concentration) + gl(q.concentration)
             + q.concentration * (jnp.log(p.rate) - jnp.log(q.rate))
             + p.concentration * (q.rate / p.rate - 1))
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    """Continuous Bernoulli on [0, 1] (reference:
+    python/paddle/distribution/continuous_bernoulli.py — verify): density
+    C(λ) λ^x (1-λ)^(1-x) with the standard normalizing constant and its
+    λ→0.5 limit handled by a Taylor guard."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _arr(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _outside(self):
+        lo, hi = self._lims
+        return (self.probs < lo) | (self.probs > hi)
+
+    def _safe_probs(self):
+        # value used on the unstable branch only; keeps grads finite
+        return jnp.where(self._outside(), self.probs, 0.4)
+
+    def _log_norm(self):
+        p = self._safe_probs()
+        exact = jnp.log(jnp.abs(
+            2 * jnp.arctanh(1 - 2 * p) / (1 - 2 * p)))
+        x = self.probs - 0.5
+        taylor = jnp.log(2.0) + (4. / 3. + 104. / 45. * x * x) * x * x
+        return jnp.where(self._outside(), exact, taylor)
+
+    @property
+    def mean(self):
+        p = self._safe_probs()
+        exact = p / (2 * p - 1) + 1 / (2 * jnp.arctanh(1 - 2 * p))
+        x = self.probs - 0.5
+        taylor = 0.5 + (1. / 3. + 16. / 45. * x * x) * x
+        return Tensor(jnp.where(self._outside(), exact, taylor))
+
+    @property
+    def variance(self):
+        p = self._safe_probs()
+        exact = p * (p - 1) / (1 - 2 * p) ** 2 \
+            + 1 / (2 * jnp.arctanh(1 - 2 * p)) ** 2
+        x = self.probs - 0.5
+        taylor = 1. / 12. - (1. / 15. - 128. / 945. * x * x) * x * x
+        return Tensor(jnp.where(self._outside(), exact, taylor))
+
+    def _icdf(self, u):
+        p = self._safe_probs()
+        q = 1 - p
+        # inverse CDF: x = log((u*(2p-1) + (1-p)) / (1-p)) / log(p/(1-p))
+        exact = jnp.log((u * (2 * p - 1) + q) / q) / jnp.log(p / q)
+        return jnp.where(self._outside(), exact, u)
+
+    def _sample(self, shape, key):
+        u = jax.random.uniform(key, shape + self._batch_shape)
+        return self._icdf(u)
+
+    def _log_prob(self, v):
+        p = self.probs
+        return v * jnp.log(jnp.clip(p, 1e-12, 1.0)) \
+            + (1 - v) * jnp.log(jnp.clip(1 - p, 1e-12, 1.0)) \
+            + self._log_norm()
+
+    def _entropy(self):
+        # -E[log p(x)] via mean
+        m = np.asarray(self.mean._value)
+        p = self.probs
+        return -(m * jnp.log(jnp.clip(p, 1e-12, 1.0))
+                 + (1 - m) * jnp.log(jnp.clip(1 - p, 1e-12, 1.0))
+                 + self._log_norm())
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` batch dims of
+    a base distribution as event dims (reference:
+    python/paddle/distribution/independent.py — verify): log_prob sums
+    over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        if reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError(
+                f"reinterpreted_batch_rank {reinterpreted_batch_rank} "
+                f"exceeds base batch rank {len(base.batch_shape)}")
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        split = len(bs) - self._rank
+        super().__init__(bs[:split], bs[split:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def _sample(self, shape, key):
+        return self.base._sample(shape, key)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        axes = tuple(range(-self._rank, 0)) if self._rank else ()
+        from ..tensor import apply_op
+        return apply_op(lambda v: jnp.sum(v, axis=axes), lp) if axes \
+            else lp
+
+    def prob(self, value):
+        from ..tensor import apply_op
+        return apply_op(jnp.exp, self.log_prob(value))
+
+    def entropy(self):
+        ent = self.base.entropy()
+        axes = tuple(range(-self._rank, 0)) if self._rank else ()
+        from ..tensor import apply_op
+        return apply_op(lambda v: jnp.sum(v, axis=axes), ent) if axes \
+            else ent
+
+
+__all__ += ["ContinuousBernoulli", "Independent"]

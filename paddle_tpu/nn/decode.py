@@ -191,6 +191,11 @@ def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=
         prev_finished = finished
         outputs, states, inputs, finished = decoder.step(
             t, inputs, states, **kwargs)
+        if not decoder.tracks_own_finished:
+            # a per-step flag (token == eos this step) must not un-finish
+            # slots that already ended (reference: next_finished =
+            # step_finished | finished)
+            finished = apply_op(jnp.logical_or, prev_finished, finished)
         decoder._finished = finished
         out_steps.append(outputs if isinstance(outputs, tuple)
                          else (outputs,))

@@ -533,3 +533,139 @@ class LBFGS(Optimizer):
     def __init__(self, *a, **k):
         raise NotImplementedError(
             "LBFGS: planned (round 2) — use jax.scipy.optimize meanwhile")
+
+
+class NAdam(Optimizer):
+    """Nesterov-momentum Adam (reference: paddle.optimizer.NAdam;
+    python/paddle/optimizer/nadam.py — verify)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._psi = momentum_decay
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p, jnp.float32),
+                "moment2": jnp.zeros_like(p, jnp.float32),
+                "mu_product": jnp.ones((), jnp.float32)}
+
+    def _apply(self, p, g, slots, lr, step):
+        g = self._wd(p, g).astype(jnp.float32)
+        t = jnp.asarray(step, jnp.float32)
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod = slots["mu_product"] * mu_t
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * g * g
+        m_hat = mu_t1 * m / (1 - mu_prod * mu_t1) \
+            + (1 - mu_t) * g / (1 - mu_prod)
+        v_hat = v / (1 - self._beta2 ** t)
+        new_p = p.astype(jnp.float32) - lr * m_hat / \
+            (jnp.sqrt(v_hat) + self._eps)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v,
+                                       "mu_product": mu_prod}
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (reference: paddle.optimizer.RAdam — verify): warms
+    up the adaptive term only once its variance is tractable."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_slots(self, p):
+        return {"moment1": jnp.zeros_like(p, jnp.float32),
+                "moment2": jnp.zeros_like(p, jnp.float32)}
+
+    def _apply(self, p, g, slots, lr, step):
+        g = self._wd(p, g).astype(jnp.float32)
+        t = jnp.asarray(step, jnp.float32)
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * g * g
+        m_hat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2 * t * b2 ** t / (1 - b2 ** t)
+        # length of the approximated SMA; adaptive term only when
+        # rho_t > 5 (the torch/paddle threshold; the paper says 4)
+        r = jnp.sqrt(jnp.maximum(
+            ((rho_t - 4) * (rho_t - 2) * rho_inf)
+            / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-8),
+            0.0))
+        v_hat = jnp.sqrt(v / (1 - b2 ** t))
+        adaptive = lr * r * m_hat / (v_hat + self._eps)
+        sgd_like = lr * m_hat
+        new_p = p.astype(jnp.float32) - jnp.where(rho_t > 5.0, adaptive,
+                                                  sgd_like)
+        return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class Rprop(Optimizer):
+    """Resilient propagation (reference: paddle.optimizer.Rprop — verify):
+    sign-based per-weight step sizes, grown on agreement and shrunk on
+    sign flips; full-batch regimes only."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+        self._lr0 = learning_rate
+
+    def _init_slots(self, p):
+        return {"prev_grad": jnp.zeros_like(p, jnp.float32),
+                "step_size": jnp.full_like(p, self._lr0, jnp.float32)}
+
+    def _apply(self, p, g, slots, lr, step):
+        g = g.astype(jnp.float32)
+        sign = jnp.sign(g * slots["prev_grad"])
+        factor = jnp.where(sign > 0, self._eta_plus,
+                           jnp.where(sign < 0, self._eta_minus, 1.0))
+        step_size = jnp.clip(slots["step_size"] * factor, self._lr_min,
+                             self._lr_max)
+        # on sign flip the step is skipped and the stored grad zeroed
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p.astype(jnp.float32) - jnp.sign(g_eff) * step_size
+        return new_p.astype(p.dtype), {"prev_grad": g_eff,
+                                       "step_size": step_size}
+
+
+class ASGD(Optimizer):
+    """Averaged SGD over the last ``batch_num`` gradients (reference:
+    paddle.optimizer.ASGD, python/paddle/optimizer/asgd.py — verify):
+    keeps a ring buffer of the n most recent gradients and steps with
+    their running mean; batch_num=1 degenerates to plain SGD."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._batch_num = int(batch_num)
+
+    def _init_slots(self, p):
+        return {"d": jnp.zeros_like(p, jnp.float32),
+                "ys": jnp.zeros((self._batch_num,) + p.shape, jnp.float32)}
+
+    def _apply(self, p, g, slots, lr, step):
+        g = self._wd(p, g).astype(jnp.float32)
+        n = self._batch_num
+        idx = jnp.mod(jnp.asarray(step - 1, jnp.int32), n)
+        old = slots["ys"][idx]
+        d = slots["d"] - old / n + g / n
+        ys = slots["ys"].at[idx].set(g)
+        new_p = p.astype(jnp.float32) - lr * d
+        return new_p.astype(p.dtype), {"d": d, "ys": ys}
+
+
+__all__ += ["NAdam", "RAdam", "Rprop", "ASGD"]

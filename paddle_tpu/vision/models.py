@@ -3,8 +3,8 @@ python/paddle/vision/models/resnet.py — verify). NCHW layout; convs hit the
 MXU via lax.conv_general_dilated."""
 from __future__ import annotations
 
-from ..nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
-                  MaxPool2D, ReLU, Sequential)
+from ..nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Layer,
+                  Linear, MaxPool2D, ReLU, Sequential)
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnet152", "BasicBlock", "BottleneckBlock", "vgg16", "VGG",
@@ -331,3 +331,243 @@ def vit_l_16(pretrained=False, **kwargs):
 
 __all__ += ["MobileNetV2", "mobilenet_v2", "VisionTransformer", "vit_b_16",
             "vit_l_16"]
+
+
+# ---------------------------------------------------------------------------
+# round-2 zoo widening (reference: python/paddle/vision/models/{alexnet,
+# squeezenet,densenet,shufflenetv2,mobilenetv1,mobilenetv3,googlenet}.py
+# — verify)
+# ---------------------------------------------------------------------------
+
+class AlexNet(Layer):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        from ..nn import Dropout
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, stride=2))
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
+        self.classifier = Sequential(
+            Dropout(), Linear(256 * 6 * 6, 4096), ReLU(),
+            Dropout(), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        from ..ops.manipulation import flatten
+        return self.classifier(flatten(self.avgpool(self.features(x)), 1))
+
+
+def alexnet(pretrained=False, **kwargs):
+    return AlexNet(**kwargs)
+
+
+class _Fire(Layer):
+    def __init__(self, in_c, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Sequential(Conv2D(in_c, squeeze, 1), ReLU())
+        self.expand1 = Sequential(Conv2D(squeeze, e1, 1), ReLU())
+        self.expand3 = Sequential(Conv2D(squeeze, e3, 3, padding=1), ReLU())
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        s = self.squeeze(x)
+        return concat([self.expand1(s), self.expand3(s)], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.1", num_classes=1000):
+        super().__init__()
+        from ..nn import Dropout
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = Sequential(
+            Dropout(), Conv2D(512, num_classes, 1), ReLU(),
+            AdaptiveAvgPool2D((1, 1)))
+
+    def forward(self, x):
+        from ..ops.manipulation import flatten
+        return flatten(self.classifier(self.features(x)), 1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)
+
+
+class _DenseLayer(Layer):
+    def __init__(self, in_c, growth, bn_size):
+        super().__init__()
+        self.block = Sequential(
+            BatchNorm2D(in_c), ReLU(),
+            Conv2D(in_c, bn_size * growth, 1, bias_attr=False),
+            BatchNorm2D(bn_size * growth), ReLU(),
+            Conv2D(bn_size * growth, growth, 3, padding=1, bias_attr=False))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([x, self.block(x)], axis=1)
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000):
+        super().__init__()
+        cfgs = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+                169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+                264: (6, 12, 64, 48)}
+        block_cfg = cfgs[layers]
+        if layers == 161:
+            growth_rate = 48
+            init_c = 96
+        else:
+            init_c = 64
+        feats = [Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+                 BatchNorm2D(init_c), ReLU(), MaxPool2D(3, 2, padding=1)]
+        c = init_c
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth_rate, bn_size))
+                c += growth_rate
+            if i != len(block_cfg) - 1:
+                feats += [BatchNorm2D(c), ReLU(),
+                          Conv2D(c, c // 2, 1, bias_attr=False),
+                          AvgPool2D(2, 2)]
+                c //= 2
+        feats += [BatchNorm2D(c), ReLU()]
+        self.features = Sequential(*feats)
+        self.avgpool = AdaptiveAvgPool2D((1, 1))
+        self.fc = Linear(c, num_classes)
+
+    def forward(self, x):
+        from ..ops.manipulation import flatten
+        return self.fc(flatten(self.avgpool(self.features(x)), 1))
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(201, **kwargs)
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 2:
+            self.branch1 = Sequential(
+                Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
+                       bias_attr=False),
+                BatchNorm2D(in_c),
+                Conv2D(in_c, branch_c, 1, bias_attr=False),
+                BatchNorm2D(branch_c), ReLU())
+            in2 = in_c
+        else:
+            self.branch1 = None
+            in2 = branch_c
+        self.branch2 = Sequential(
+            Conv2D(in2, branch_c, 1, bias_attr=False),
+            BatchNorm2D(branch_c), ReLU(),
+            Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                   groups=branch_c, bias_attr=False),
+            BatchNorm2D(branch_c),
+            Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            BatchNorm2D(branch_c), ReLU())
+
+    def forward(self, x):
+        from ..nn.functional import channel_shuffle
+        from ..ops.manipulation import concat, split
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000):
+        super().__init__()
+        stage_c = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+                   1.5: (176, 352, 704, 1024),
+                   2.0: (244, 488, 976, 2048)}[scale]
+        self.conv1 = Sequential(
+            Conv2D(3, 24, 3, stride=2, padding=1, bias_attr=False),
+            BatchNorm2D(24), ReLU())
+        self.maxpool = MaxPool2D(3, 2, padding=1)
+        stages = []
+        in_c = 24
+        for i, (c, n) in enumerate(zip(stage_c[:3], (4, 8, 4))):
+            units = [_ShuffleUnit(in_c, c, 2)]
+            for _ in range(n - 1):
+                units.append(_ShuffleUnit(c, c, 1))
+            stages.append(Sequential(*units))
+            in_c = c
+        self.stages = Sequential(*stages)
+        self.conv5 = Sequential(
+            Conv2D(in_c, stage_c[3], 1, bias_attr=False),
+            BatchNorm2D(stage_c[3]), ReLU())
+        self.avgpool = AdaptiveAvgPool2D((1, 1))
+        self.fc = Linear(stage_c[3], num_classes)
+
+    def forward(self, x):
+        from ..ops.manipulation import flatten
+        x = self.maxpool(self.conv1(x))
+        x = self.conv5(self.stages(x))
+        return self.fc(flatten(self.avgpool(x), 1))
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(1.0, **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=128, **kwargs)
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=4, groups=32, **kwargs)
+
+
+def vgg11(pretrained=False, **kwargs):
+    return VGG(_vgg_features(
+        [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"]),
+        **kwargs)
+
+
+def vgg19(pretrained=False, **kwargs):
+    return VGG(_vgg_features(
+        [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"]), **kwargs)
+
+
+__all__ += ["AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0",
+            "squeezenet1_1", "DenseNet", "densenet121", "densenet201",
+            "ShuffleNetV2", "shufflenet_v2_x1_0", "wide_resnet50_2",
+            "resnext50_32x4d", "vgg11", "vgg19"]

@@ -392,3 +392,38 @@ class TestSignal:
                             window=paddle.to_tensor(win),
                             length=128).numpy()
         np.testing.assert_allclose(back, x, atol=1e-4)
+
+
+def test_continuous_bernoulli_vs_torch():
+    import torch
+    import paddle_tpu.distribution as D
+    for pv in (0.2, 0.5, 0.7):
+        ours = D.ContinuousBernoulli(np.array([pv], np.float32))
+        t = torch.distributions.ContinuousBernoulli(torch.tensor([pv]))
+        for x in (0.1, 0.5, 0.9):
+            np.testing.assert_allclose(
+                ours.log_prob(np.array([x], np.float32)).numpy(),
+                t.log_prob(torch.tensor([x])).numpy(), atol=1e-4)
+        np.testing.assert_allclose(ours.mean.numpy(), t.mean.numpy(),
+                                   atol=1e-4)
+        np.testing.assert_allclose(ours.variance.numpy(),
+                                   t.variance.numpy(), atol=1e-4)
+    paddle.seed(0)
+    s = D.ContinuousBernoulli(np.array([0.3], np.float32)).sample((4000,))
+    assert abs(float(s.numpy().mean()) - 0.4302) < 0.02
+
+
+def test_independent_vs_torch():
+    import torch
+    import paddle_tpu.distribution as D
+    base = D.Normal(np.zeros((3, 4), np.float32),
+                    np.ones((3, 4), np.float32))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    want = torch.distributions.Independent(
+        torch.distributions.Normal(torch.zeros(3, 4), torch.ones(3, 4)),
+        1).log_prob(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(ind.log_prob(x).numpy(), want, atol=1e-5)
+    with pytest.raises(ValueError):
+        D.Independent(base, 3)

@@ -113,3 +113,27 @@ def test_moe_routes_tokens():
     x = paddle.to_tensor(np.random.rand(1, 4, 8).astype(np.float32))
     out = moe(x).numpy()
     assert np.isfinite(out).all() and (np.abs(out) > 0).any()
+
+
+def test_round2_vision_zoo_param_parity():
+    """New zoo members must match the canonical architectures' parameter
+    counts (torchvision values, which equal the reference's)."""
+    from paddle_tpu.vision import models as M
+    known = {
+        "alexnet": 61_100_840, "squeezenet1_1": 1_235_496,
+        "densenet121": 7_978_856, "shufflenet_v2_x1_0": 2_278_604,
+        "wide_resnet50_2": 68_883_240, "resnext50_32x4d": 25_028_904,
+    }
+    for name, want in known.items():
+        m = getattr(M, name)()
+        n = sum(int(np.prod(p.shape)) for p in m.parameters())
+        assert n == want, (name, n, want)
+
+
+def test_round2_vision_zoo_forward():
+    from paddle_tpu.vision import models as M
+    x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))
+    for ctor in (M.squeezenet1_1, M.shufflenet_v2_x1_0):
+        m = ctor(num_classes=7)
+        m.eval()
+        assert list(m(x).shape) == [1, 7]
