@@ -122,6 +122,30 @@ def _child_tpu():
             msg = f"{type(e).__name__}: {e}"
             return None, f"{label}: {msg[:600]}"
 
+    def _emit(small, big, decode, errors):
+        """One BENCH_JSON line from whatever has finished so far; the
+        parent keeps the LAST line, so emitting after every stage means a
+        deadline kill mid-child can no longer lose the headline."""
+        from paddle_tpu.ops.pallas import flash_attention as fa
+        head = big or small
+        if head is None:
+            return
+        print("BENCH_JSON " + json.dumps({
+            "metric": "llama_pretrain_tokens_per_sec_per_chip",
+            "value": head["tokens_per_sec"],
+            "unit": "tokens/s",
+            "vs_baseline": round(head["mfu"] / 0.45, 4),
+            "mfu": head["mfu"],
+            "chip": gen,
+            "sdpa_dispatch": fa.sdpa_last_dispatch(),
+            "config_small": small,
+            "config_big": big,
+            **({"config_errors": errors} if errors else {}),
+            **(decode or {}),
+            **{k: head[k] for k in ("model_params", "batch", "seq",
+                                    "final_loss", "step_ms")},
+        }), flush=True)
+
     errors = []
     if on_tpu:
         cfg_small = LlamaConfig(
@@ -136,9 +160,17 @@ def _child_tpu():
             "small")
         if err:
             errors.append(err)
+        _emit(small, None, None, errors)
+        decode, err = _isolated(lambda: _bench_decode(
+            cfg_small, batch=8, prompt=128, new_tokens=128), "decode")
+        if err:
+            errors.append(err)
+        decode = decode or {}
+        _emit(small, None, decode, errors)
         # ~0.95B params; bf16 optimizer states (multi_precision off) +
         # per-layer remat; batch 2 to stay inside 16GB v5e HBM (batch 4
-        # OOMed: 88MB bf16[4,2048,5632] remat temps)
+        # OOMed: 88MB bf16[4,2048,5632] remat temps). Last: its compile
+        # has been killing the tunnel's compile helper.
         cfg_big = LlamaConfig(
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_hidden_layers=16, num_attention_heads=16,
@@ -149,41 +181,16 @@ def _child_tpu():
             multi_precision=False), "big")
         if err:
             errors.append(err)
+        _emit(small, big, decode, errors)
+        if small is None and big is None:
+            raise RuntimeError("every config failed: " + "; ".join(errors))
     else:
         cfg = llama_tiny_config(tensor_parallel=False)
         small = _bench_train(cfg, batch=2, seq=64, steps=4, warmup=1,
                              peak=peak)
-        big = None
-
-    if on_tpu:
-        decode, err = _isolated(lambda: _bench_decode(
-            cfg_small, batch=8, prompt=128, new_tokens=128), "decode")
-        if err:
-            errors.append(err)
-        decode = decode or {}
-    else:
         decode = _bench_decode(llama_tiny_config(tensor_parallel=False),
                                batch=2, prompt=16, new_tokens=16)
-
-    from paddle_tpu.ops.pallas import flash_attention as fa
-    head = big or small
-    if head is None:
-        raise RuntimeError("every config failed: " + "; ".join(errors))
-    print("BENCH_JSON " + json.dumps({
-        "metric": "llama_pretrain_tokens_per_sec_per_chip",
-        "value": head["tokens_per_sec"],
-        "unit": "tokens/s",
-        "vs_baseline": round(head["mfu"] / 0.45, 4),
-        "mfu": head["mfu"],
-        "chip": gen,
-        "sdpa_dispatch": fa.sdpa_last_dispatch(),
-        "config_small": small,
-        "config_big": big,
-        **({"config_errors": errors} if errors else {}),
-        **decode,
-        **{k: head[k] for k in ("model_params", "batch", "seq",
-                                "final_loss", "step_ms")},
-    }))
+        _emit(small, None, decode, errors)
 
 
 def _child_cpu():
@@ -235,21 +242,37 @@ def _child_cpu():
 
 
 def _run_child(mode: str, deadline: float):
-    """Run this script in child mode; returns parsed JSON dict or None."""
+    """Run this script in child mode; returns parsed JSON dict or None.
+    The child emits BENCH_JSON after every completed stage — the LAST
+    line wins, and a deadline kill still salvages the partial result."""
     env = dict(os.environ)
     if mode == "--child-cpu":
         env["JAX_PLATFORMS"] = "cpu"
+    stdout, stderr, rc = "", "", "killed"
     try:
         r = subprocess.run([sys.executable, os.path.abspath(__file__), mode],
                            env=env, timeout=deadline,
                            capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
-        return None, "deadline exceeded (backend init or compile hang)"
-    for line in r.stdout.splitlines():
+        stdout, stderr, rc = r.stdout, r.stderr or "", r.returncode
+    except subprocess.TimeoutExpired as e:
+        stdout = (e.stdout or b"").decode() if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+    result = None
+    for line in stdout.splitlines():
         if line.startswith("BENCH_JSON "):
-            return json.loads(line[len("BENCH_JSON "):]), None
-    tail = (r.stdout + r.stderr)[-2000:]
-    return None, f"rc={r.returncode}: {tail}"
+            try:
+                result = json.loads(line[len("BENCH_JSON "):])
+            except json.JSONDecodeError:
+                pass  # SIGKILL mid-flush truncated this line; keep the
+                      # last complete one
+    if result is not None:
+        if rc == "killed":
+            result["partial"] = "deadline killed the child mid-stage"
+        return result, None
+    if rc == "killed":
+        return None, "deadline exceeded (backend init or compile hang)"
+    tail = (stdout + stderr)[-2000:]
+    return None, f"rc={rc}: {tail}"
 
 
 def main():

@@ -253,22 +253,17 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
             if world_size is None else int(world_size)
         agent = _Agent(name, rank, world_size)
         store = _store()
-        # generation = completed shutdown rounds; keys are scoped by it so
-        # a re-init never reads the previous round's (dead) endpoints.
-        # Wait for any in-flight shutdown round to complete first.
-        deadline = time.time() + 120
-        while True:
-            done = store.add("rpc/shutdown", 0)
-            if done % world_size == 0:
-                break
-            if time.time() > deadline:
-                raise TimeoutError(
-                    "init_rpc: previous rpc round never finished shutdown")
-            time.sleep(0.05)
-        gen = done // world_size
+        # generation = joiner cohort: every round makes exactly world_size
+        # init_rpc calls, so a shared monotone joiner counter assigns each
+        # cohort a unique generation — endpoint keys are scoped by it, so a
+        # re-init can never read a previous (dead) round's endpoints, and
+        # no shutdown-counter arithmetic can race or brick rendezvous.
+        joiner = store.add("rpc/joiners", 1)
+        gen = (joiner - 1) // world_size
         agent.generation = gen
         info = WorkerInfo(name, rank, agent.ip, agent.port)
         store.set(f"rpc/{gen}/worker/{rank}", pickle.dumps(info))
+        deadline = time.time() + 120
         for r in range(world_size):
             key = f"rpc/{gen}/worker/{r}"
             while True:
@@ -332,17 +327,15 @@ def shutdown():
         if _AGENT is None:
             return
         store = _store()
-        target = (_AGENT.generation + 1) * _AGENT.world_size
-        n = store.add("rpc/shutdown", 1)
+        # per-generation barrier: isolated key, so a dead peer only means
+        # this round's barrier times out — future rounds are unaffected
+        key = f"rpc/{_AGENT.generation}/shutdown"
+        n = store.add(key, 1)
         deadline = time.time() + 60
-        while n < target:
+        while n < _AGENT.world_size:
             if time.time() > deadline:
-                # a peer died without calling shutdown: close the round on
-                # its behalf so a future init_rpc on this master can start
-                # (leaving the counter mid-round bricks rendezvous forever)
-                store.add("rpc/shutdown", target - n)
-                break
+                break  # peer died mid-round; nothing to repair
             time.sleep(0.05)
-            n = store.add("rpc/shutdown", 0)
+            n = store.add(key, 0)
         _AGENT.stop()
         _AGENT = None

@@ -863,8 +863,8 @@ class ContinuousBernoulli(ExponentialFamily):
             + self._log_norm()
 
     def _entropy(self):
-        # -E[log p(x)] via mean
-        m = np.asarray(self.mean._value)
+        # -E[log p(x)] via mean (stays in jnp: traceable + differentiable)
+        m = self.mean._value
         p = self.probs
         return -(m * jnp.log(jnp.clip(p, 1e-12, 1.0))
                  + (1 - m) * jnp.log(jnp.clip(1 - p, 1e-12, 1.0))

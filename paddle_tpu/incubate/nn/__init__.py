@@ -1,5 +1,6 @@
 """Fused layers (reference: python/paddle/incubate/nn/ — verify). On TPU
 "fused" means one jit region + Pallas attention; the layer API is kept."""
 from .functional import fused_multi_head_attention, fused_feedforward  # noqa
+from .functional import fused_linear_cross_entropy                     # noqa
 from .layers import FusedMultiHeadAttention, FusedFeedForward          # noqa
 from . import functional                                               # noqa

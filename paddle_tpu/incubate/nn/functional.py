@@ -178,3 +178,19 @@ def fused_bias_dropout_residual_layer_norm(
 
 __all__ += ["swiglu", "fused_layer_norm",
             "fused_bias_dropout_residual_layer_norm"]
+
+
+
+def fused_linear_cross_entropy(x, weight, labels, num_chunks=16,
+                               ignore_index=-100, name=None):
+    """Paddle-level wrapper of the chunked fused LM-head CE (see
+    paddle_tpu/incubate/nn/fused_ce.py): mean CE of softmax(x @ weight.T)
+    with the logits computed tile-by-tile. x: (..., D); weight: (V, D);
+    labels: (...,) int. Returns a scalar Tensor."""
+    from ...tensor import apply_op
+    from .fused_ce import fused_linear_cross_entropy as _kernel
+
+    def f(h, w, lab):
+        h2 = h.reshape(-1, h.shape[-1])
+        return _kernel(h2, w, lab.reshape(-1), num_chunks, ignore_index)
+    return apply_op(f, x, weight, labels)

@@ -62,6 +62,12 @@ class LlamaConfig:
     recompute_granularity: str = "full"
     # Mistral-class sliding-window causal attention (None = full causal)
     sliding_window: int | None = None
+    # chunked fused lm-head + CE for training (never materializes the
+    # (tokens, vocab) logits — see incubate/nn/fused_ce.py). Applied only
+    # on the labels-given path; TP mode keeps the GSPMD logits path where
+    # the vocab dim is mp-sharded.
+    fused_head_ce: bool = True
+    fused_head_ce_chunks: int = 16
     dtype: str = "float32"
 
     def __post_init__(self):
@@ -438,6 +444,21 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
                                       pos=pos)
         else:
             h = self.llama(input_ids, attn_mask)
+        c = self.config
+        if (cache is None and labels is not None and c.fused_head_ce
+                and not c.tensor_parallel):
+            # training fast path: chunked fused head+CE — the full
+            # (tokens, vocab) logits tensor never exists
+            from ..incubate.nn.functional import fused_linear_cross_entropy
+            w = self.lm_head.weight if self.lm_head is not None \
+                else self.llama.embed_tokens.weight
+            if self.lm_head is not None:
+                # nn.Linear stores (in, out); the kernel wants (V, D)
+                from ..ops.manipulation import transpose
+                w = transpose(w, (1, 0))
+            loss = fused_linear_cross_entropy(
+                h, w, labels, num_chunks=c.fused_head_ce_chunks)
+            return loss, None
         if self.lm_head is not None:
             logits = self.lm_head(h)
         else:

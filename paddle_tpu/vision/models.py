@@ -571,3 +571,161 @@ __all__ += ["AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0",
             "squeezenet1_1", "DenseNet", "densenet121", "densenet201",
             "ShuffleNetV2", "shufflenet_v2_x1_0", "wide_resnet50_2",
             "resnext50_32x4d", "vgg11", "vgg19"]
+
+
+class _SEModule(Layer):
+    def __init__(self, c, r=4):
+        super().__init__()
+        from ..nn import Hardsigmoid
+        squeeze = _make_divisible(c // r, 8)
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.fc1 = Conv2D(c, squeeze, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(squeeze, c, 1)
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBV3Block(Layer):
+    def __init__(self, in_c, exp_c, out_c, k, stride, se, act):
+        super().__init__()
+        from ..nn import Hardswish
+        Act = Hardswish if act == "hs" else ReLU
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if exp_c != in_c:
+            layers += [Conv2D(in_c, exp_c, 1, bias_attr=False),
+                       BatchNorm2D(exp_c), Act()]
+        layers += [Conv2D(exp_c, exp_c, k, stride=stride, padding=k // 2,
+                          groups=exp_c, bias_attr=False),
+                   BatchNorm2D(exp_c), Act()]
+        if se:
+            layers.append(_SEModule(exp_c))
+        layers += [Conv2D(exp_c, out_c, 1, bias_attr=False),
+                   BatchNorm2D(out_c)]
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return out + x if self.use_res else out
+
+
+_MBV3_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "re", 1), (3, 64, 24, False, "re", 2),
+    (3, 72, 24, False, "re", 1), (5, 72, 40, True, "re", 2),
+    (5, 120, 40, True, "re", 1), (5, 120, 40, True, "re", 1),
+    (3, 240, 80, False, "hs", 2), (3, 200, 80, False, "hs", 1),
+    (3, 184, 80, False, "hs", 1), (3, 184, 80, False, "hs", 1),
+    (3, 480, 112, True, "hs", 1), (3, 672, 112, True, "hs", 1),
+    (5, 672, 160, True, "hs", 2), (5, 960, 160, True, "hs", 1),
+    (5, 960, 160, True, "hs", 1)]
+_MBV3_SMALL = [
+    (3, 16, 16, True, "re", 2), (3, 72, 24, False, "re", 2),
+    (3, 88, 24, False, "re", 1), (5, 96, 40, True, "hs", 2),
+    (5, 240, 40, True, "hs", 1), (5, 240, 40, True, "hs", 1),
+    (5, 120, 48, True, "hs", 1), (5, 144, 48, True, "hs", 1),
+    (5, 288, 96, True, "hs", 2), (5, 576, 96, True, "hs", 1),
+    (5, 576, 96, True, "hs", 1)]
+
+
+class MobileNetV3(Layer):
+    """(reference: python/paddle/vision/models/mobilenetv3.py — verify)"""
+
+    def __init__(self, arch="large", num_classes=1000, scale=1.0):
+        super().__init__()
+        from ..nn import Dropout, Hardswish
+        cfg = _MBV3_LARGE if arch == "large" else _MBV3_SMALL
+        last_exp = 960 if arch == "large" else 576
+        last_c = 1280 if arch == "large" else 1024
+        sc = lambda c: _make_divisible(c * scale)
+        layers = [Conv2D(3, sc(16), 3, stride=2, padding=1,
+                         bias_attr=False),
+                  BatchNorm2D(sc(16)), Hardswish()]
+        in_c = sc(16)
+        for k, exp, out, se, act, stride in cfg:
+            layers.append(_MBV3Block(in_c, sc(exp), sc(out), k, stride, se,
+                                     act))
+            in_c = sc(out)
+        layers += [Conv2D(in_c, sc(last_exp), 1, bias_attr=False),
+                   BatchNorm2D(sc(last_exp)), Hardswish()]
+        self.features = Sequential(*layers)
+        self.avgpool = AdaptiveAvgPool2D((1, 1))
+        self.classifier = Sequential(
+            Linear(sc(last_exp), last_c), Hardswish(), Dropout(0.2),
+            Linear(last_c, num_classes))
+
+    def forward(self, x):
+        from ..ops.manipulation import flatten
+        return self.classifier(flatten(self.avgpool(self.features(x)), 1))
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3("large", scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3("small", scale=scale, **kwargs)
+
+
+class _Inception(Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = Sequential(Conv2D(in_c, c1, 1), ReLU())
+        self.b2 = Sequential(Conv2D(in_c, c3r, 1), ReLU(),
+                             Conv2D(c3r, c3, 3, padding=1), ReLU())
+        self.b3 = Sequential(Conv2D(in_c, c5r, 1), ReLU(),
+                             Conv2D(c5r, c5, 5, padding=2), ReLU())
+        self.b4 = Sequential(MaxPool2D(3, 1, padding=1),
+                             Conv2D(in_c, pp, 1), ReLU())
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(Layer):
+    """(reference: python/paddle/vision/models/googlenet.py — verify;
+    aux classifiers omitted as in inference-mode reference use)"""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        from ..nn import Dropout
+        self.stem = Sequential(
+            Conv2D(3, 64, 7, stride=2, padding=3), ReLU(),
+            MaxPool2D(3, 2, padding=1),
+            Conv2D(64, 64, 1), ReLU(),
+            Conv2D(64, 192, 3, padding=1), ReLU(),
+            MaxPool2D(3, 2, padding=1))
+        self.blocks = Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            MaxPool2D(3, 2, padding=1),
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            MaxPool2D(3, 2, padding=1),
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128))
+        self.avgpool = AdaptiveAvgPool2D((1, 1))
+        self.dropout = None
+        self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        from ..ops.manipulation import flatten
+        x = self.blocks(self.stem(x))
+        return self.fc(flatten(self.avgpool(x), 1))
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)
+
+
+__all__ += ["MobileNetV3", "mobilenet_v3_large", "mobilenet_v3_small",
+            "GoogLeNet", "googlenet"]

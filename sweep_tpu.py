@@ -1,0 +1,85 @@
+"""One-off MFU sweep on the real chip. Not part of the test suite."""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def bench(cfg_kw, batch, seq, steps=8, warmup=2, multi_precision=True):
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    peak = 197e12
+    paddle.seed(0)
+    cfg = LlamaConfig(**cfg_kw)
+    model = LlamaForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                          parameters=model.parameters(),
+                          multi_precision=multi_precision)
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def loss_fn(m, b):
+        ids, labels = b
+        loss, _ = m(ids, labels)
+        return loss
+
+    step = TrainStep(model, loss_fn, opt)
+    ids = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1).astype(np.int32)
+    batch_t = (paddle.to_tensor(ids), paddle.to_tensor(labels))
+    for _ in range(warmup):
+        loss = step(batch_t)
+    float(loss.item())
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(batch_t)
+    float(loss.item())
+    dt = time.perf_counter() - t0
+    tok = batch * seq * steps / dt
+    mfu = tok * model.flops_per_token(seq) / peak
+    return {"tok_s": round(tok, 1), "mfu": round(mfu, 4),
+            "step_ms": round(dt / steps * 1000, 1),
+            "params": int(model.num_params())}
+
+
+SMALL = dict(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+             num_hidden_layers=16, num_attention_heads=16,
+             num_key_value_heads=16, max_position_embeddings=4096,
+             tensor_parallel=False)
+BIG = dict(vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+           num_hidden_layers=16, num_attention_heads=16,
+           num_key_value_heads=16, max_position_embeddings=4096,
+           tensor_parallel=False, recompute=True)
+
+CONFIGS = {
+    "small_b16_s1024": (SMALL, 16, 1024, True),
+    "small_b32_s1024": (SMALL, 32, 1024, True),
+    "small_b8_s2048": (SMALL, 8, 2048, True),
+    "big_b2_s2048": (BIG, 2, 2048, False),
+    "big_b4_s2048": (BIG, 4, 2048, False),
+    "big_b8_s1024": (BIG, 8, 1024, False),
+}
+
+MED = dict(vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+           num_hidden_layers=16, num_attention_heads=16,
+           num_key_value_heads=16, max_position_embeddings=2048,
+           tensor_parallel=False)
+CONFIGS["med_b8_s1024"] = (MED, 8, 1024, True)
+CONFIGS["med_b16_s1024"] = (MED, 16, 1024, True)
+MEDR = dict(MED, recompute=True)
+CONFIGS["medr_b16_s1024"] = (MEDR, 16, 1024, False)
+
+
+if __name__ == "__main__":
+    name = sys.argv[1]
+    cfg, b, s, mp = CONFIGS[name]
+    try:
+        r = bench(cfg, b, s, multi_precision=mp)
+        print("SWEEP " + json.dumps({"name": name, **r}))
+    except Exception as e:
+        print("SWEEP " + json.dumps(
+            {"name": name, "error": f"{type(e).__name__}: {e}"[:300]}))

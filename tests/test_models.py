@@ -123,6 +123,7 @@ def test_round2_vision_zoo_param_parity():
         "alexnet": 61_100_840, "squeezenet1_1": 1_235_496,
         "densenet121": 7_978_856, "shufflenet_v2_x1_0": 2_278_604,
         "wide_resnet50_2": 68_883_240, "resnext50_32x4d": 25_028_904,
+        "mobilenet_v3_large": 5_483_032, "mobilenet_v3_small": 2_542_856,
     }
     for name, want in known.items():
         m = getattr(M, name)()
@@ -133,7 +134,8 @@ def test_round2_vision_zoo_param_parity():
 def test_round2_vision_zoo_forward():
     from paddle_tpu.vision import models as M
     x = paddle.to_tensor(np.random.rand(1, 3, 64, 64).astype(np.float32))
-    for ctor in (M.squeezenet1_1, M.shufflenet_v2_x1_0):
+    for ctor in (M.squeezenet1_1, M.shufflenet_v2_x1_0, M.googlenet,
+                 M.mobilenet_v3_small):
         m = ctor(num_classes=7)
         m.eval()
         assert list(m(x).shape) == [1, 7]

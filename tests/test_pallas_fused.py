@@ -268,3 +268,56 @@ def test_rope_gqa_pallas_path(interpret):
                                atol=1e-6)
     np.testing.assert_allclose(np.asarray(ok), np.asarray(rk), rtol=1e-5,
                                atol=1e-6)
+
+
+class TestFusedLinearCrossEntropy:
+    """Chunked fused lm-head CE (incubate/nn/fused_ce.py): forward and
+    both gradients must match the full-logits reference, including vocab
+    padding and ignore_index."""
+
+    def test_kernel_parity(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.nn.fused_ce import (
+            fused_linear_cross_entropy, linear_cross_entropy_jnp)
+        rng = np.random.RandomState(0)
+        N, D, V = 48, 24, 900          # 900 % 16 != 0 → padding path
+        h = jnp.asarray(rng.randn(N, D).astype(np.float32))
+        w = jnp.asarray(rng.randn(V, D).astype(np.float32) * .1)
+        labels = jnp.asarray(rng.randint(0, V, (N,)).astype(np.int32))
+        labels = labels.at[5].set(-100)
+        l1, (gh1, gw1) = jax.value_and_grad(
+            lambda a, b: fused_linear_cross_entropy(a, b, labels, 16),
+            (0, 1))(h, w)
+        l2, (gh2, gw2) = jax.value_and_grad(
+            lambda a, b: linear_cross_entropy_jnp(a, b, labels),
+            (0, 1))(h, w)
+        assert abs(float(l1) - float(l2)) < 1e-5
+        np.testing.assert_allclose(gh1, gh2, atol=1e-5)
+        np.testing.assert_allclose(gw1, gw2, atol=1e-5)
+
+    def test_llama_head_parity(self):
+        import dataclasses
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+
+        def run(fused):
+            paddle.seed(0)
+            cfg = llama_tiny_config(tensor_parallel=False)
+            m = LlamaForCausalLM(dataclasses.replace(
+                cfg, fused_head_ce=fused, fused_head_ce_chunks=8))
+            ids = paddle.to_tensor(np.random.RandomState(0).randint(
+                0, cfg.vocab_size, (2, 16)).astype(np.int32))
+            labels = paddle.to_tensor(
+                np.roll(ids.numpy(), -1, 1).astype(np.int32))
+            loss, _ = m(ids, labels)
+            loss.backward()
+            return (float(loss.item()),
+                    {n: p.grad.numpy() for n, p in m.named_parameters()})
+
+        l1, g1 = run(False)
+        l2, g2 = run(True)
+        assert abs(l1 - l2) < 1e-5
+        for n in g1:
+            np.testing.assert_allclose(g1[n], g2[n], atol=2e-4,
+                                       err_msg=n)
