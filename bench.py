@@ -268,6 +268,11 @@ def _run_child(mode: str, deadline: float):
     if result is not None:
         if rc == "killed":
             result["partial"] = "deadline killed the child mid-stage"
+        elif rc != 0:
+            # child crashed after emitting a stage result (e.g. the
+            # compile helper hard-killed it) — keep the salvage but say so
+            result["partial"] = f"child crashed rc={rc} after this stage"
+            result["crash_tail"] = (stdout + stderr)[-500:]
         return result, None
     if rc == "killed":
         return None, "deadline exceeded (backend init or compile hang)"

@@ -1,0 +1,55 @@
+"""Fine-tune a vision model with the high-level Model API (fit/evaluate,
+callbacks, checkpoint-resume).
+
+CPU smoke: python examples/finetune_vision.py --cpu --epochs 1
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--model", default="mobilenet_v3_small")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend")
+    args = ap.parse_args()
+
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.io import Dataset
+    from paddle_tpu.metric import Accuracy
+    from paddle_tpu.vision import models
+
+    class Synth(Dataset):
+        """Two-class toy set: label = brightness of the image."""
+        def __len__(self):
+            return 128
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            x = rng.rand(3, 32, 32).astype(np.float32)
+            return x, np.array([int(x.mean() > 0.5)], np.int64)
+
+    paddle.seed(0)
+    net = getattr(models, args.model)(num_classes=2)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer.Adam(learning_rate=1e-2, parameters=net.parameters()),
+        nn.CrossEntropyLoss(), Accuracy())
+    model.fit(Synth(), epochs=args.epochs, batch_size=16, verbose=1)
+    print(model.evaluate(Synth(), batch_size=16, verbose=0))
+
+
+if __name__ == "__main__":
+    main()

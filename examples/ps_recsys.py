@@ -1,0 +1,83 @@
+"""Parameter-server mode: a sparse+dense recommender where the embedding
+table lives on PS shards and loss.backward() pushes the sparse grads.
+
+Single-machine demo (spawns 2 servers + 1 trainer):
+    python examples/ps_recsys.py
+"""
+import os
+import socket
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+TRAINER = """
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import ps
+
+ps.init_worker()
+emb = ps.SparseEmbedding("user_emb", 10_000, 16, optimizer="adagrad",
+                         lr=0.1)
+dense = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 1))
+opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                            parameters=dense.parameters())
+rng = np.random.RandomState(0)
+for step in range(40):
+    user_ids = rng.randint(0, 10_000, (32, 1))
+    click = ((user_ids % 3) == 0).astype(np.float32)
+    e = emb(paddle.to_tensor(user_ids))          # pull from servers
+    logit = dense(e[:, 0])
+    loss = nn.functional.binary_cross_entropy_with_logits(
+        logit, paddle.to_tensor(click))
+    loss.backward()                              # pushes sparse grads
+    opt.step()
+    opt.clear_grad()
+    if step % 10 == 0:
+        print(f"step {step}: loss {float(loss.item()):.4f}", flush=True)
+print("rows touched:", ps.table_size("user_emb"))
+ps.shutdown()
+"""
+
+SERVER = """
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_platforms", "cpu")
+from paddle_tpu.distributed import ps
+ps.init_server()
+ps.run_server()
+"""
+
+
+def main():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": _REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", ""),
+           "PADDLE_MASTER": f"127.0.0.1:{port}",
+           "PADDLE_PSERVER_NUM": "2", "PADDLE_TRAINER_NUM": "1"}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", SERVER],
+        env={**env, "TRAINING_ROLE": "PSERVER",
+             "PADDLE_TRAINER_ID": str(i)}) for i in range(2)]
+    trainer = subprocess.Popen(
+        [sys.executable, "-c", TRAINER],
+        env={**env, "TRAINING_ROLE": "TRAINER", "PADDLE_TRAINER_ID": "0"})
+    trainer.wait(timeout=300)
+    for p in procs:
+        p.wait(timeout=60)
+    print("exit codes:", trainer.returncode, [p.returncode for p in procs])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,95 @@
+"""Pretrain a Llama-family model on synthetic data, single chip or a
+hybrid-parallel mesh.
+
+CPU smoke:   python examples/train_llama.py --cpu --tiny --steps 5
+One chip:    python examples/train_llama.py --steps 50
+Multi-chip:  python -m paddle_tpu.distributed.launch --nnodes 1 \
+                 examples/train_llama.py --dp 2 --mp 2 --pp 2
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true", help="CPU-sized model")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=512)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--mp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--save", type=str, default=None,
+                    help="checkpoint dir (tensorstore backend)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend")
+    args = ap.parse_args()
+
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_tiny_config)
+
+    parallel = args.dp * args.mp * args.pp > 1
+    if parallel:
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": args.dp,
+                                   "mp_degree": args.mp,
+                                   "pp_degree": args.pp}
+        fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(0)
+    if args.tiny:
+        cfg = llama_tiny_config(tensor_parallel=args.mp > 1)
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=args.seq,
+            tensor_parallel=args.mp > 1, pipeline_parallel=args.pp > 1)
+    model = LlamaForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=3e-4, weight_decay=0.01,
+                          parameters=model.parameters())
+    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def loss_fn(m, b):
+        ids, labels = b
+        loss, _ = m(ids, labels)
+        return loss
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    for i in range(args.steps):
+        ids = rng.randint(0, cfg.vocab_size,
+                          (args.batch, args.seq)).astype(np.int32)
+        batch = (paddle.to_tensor(ids),
+                 paddle.to_tensor(np.roll(ids, -1, 1).astype(np.int32)))
+        t0 = time.perf_counter()
+        loss = step(batch)
+        if i % 5 == 0 or i == args.steps - 1:
+            dt = time.perf_counter() - t0
+            print(f"step {i:4d}  loss {float(loss.item()):.4f}  "
+                  f"{args.batch * args.seq / dt:,.0f} tok/s")
+
+    if args.save:
+        from paddle_tpu.distributed import checkpoint
+        checkpoint.save_state_dict(model.state_dict(), args.save,
+                                   backend="tensorstore")
+        print("saved to", args.save)
+
+
+if __name__ == "__main__":
+    main()

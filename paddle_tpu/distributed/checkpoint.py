@@ -71,17 +71,43 @@ def wait_async_save():
         _ASYNC.pop().result()
 
 
+def _ts_spec(path, key):
+    return {"driver": "zarr",
+            "kvstore": {"driver": "file",
+                        "path": os.path.join(path, "ts", key)}}
+
+
+def _ts_open(path, key, dtype=None, shape=None, chunks=None, create=False,
+             delete_existing=False):
+    import tensorstore as ts
+    kw = {"open": not delete_existing}
+    if create:
+        kw.update(create=True, dtype=ts.dtype(_np_dtype(str(dtype))),
+                  shape=list(shape), delete_existing=delete_existing)
+        if chunks is not None:
+            kw["chunk_layout"] = ts.ChunkLayout(chunk_shape=list(chunks))
+    return ts.open(_ts_spec(path, key), **kw).result()
+
+
 def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None, async_save=False):
+                    coordinator_rank=0, unique_id=None, async_save=False,
+                    backend="npz"):
     """Write each tensor's addressable shards + global metadata.
 
     async_save=True: device→host transfer happens now (a consistent
-    snapshot), file IO on a background thread; returns AsyncSaveHandle."""
+    snapshot), file IO on a background thread; returns AsyncSaveHandle.
+
+    backend="tensorstore": shards go into one chunked zarr array per
+    tensor (chunk grid = the GSPMD shard grid, so concurrent multi-host
+    region writes never read-modify-write the same chunk); load reads
+    exactly the target region. backend="npz" keeps the self-contained
+    per-process file layout."""
     os.makedirs(path, exist_ok=True)
     pidx = jax.process_index()
     meta = {}
     shard_file = os.path.join(path, f"shard_{pidx}.npz")
     arrays = {}
+    ts_writes = []                       # (key, index ranges, host array)
     for key, v in _leaf_items(state_dict):
         # Partial tensors persist their DENSE (summed) value
         val = v._dense_value() if isinstance(v, Tensor) else v
@@ -112,6 +138,10 @@ def save_state_dict(state_dict, path, process_group=None,
                            "file": f"shard_{pidx}.npz"})
         meta[key] = {"kind": "tensor", "shape": gshape,
                      "dtype": str(val.dtype), "shards": shards}
+        if backend == "tensorstore":
+            meta[key]["storage"] = "tensorstore"
+            for sh in shards:
+                ts_writes.append((key, sh["index"], arrays[sh["array"]]))
 
     # the metadata all_gather is a COLLECTIVE — it must run on the main
     # thread in deterministic order with the training step's collectives
@@ -131,9 +161,41 @@ def save_state_dict(state_dict, path, process_group=None,
             elif info["kind"] == "tensor":
                 merged[k]["shards"].extend(info["shards"])
 
+    if backend == "tensorstore":
+        # (re)create the arrays on the MAIN thread with a collective
+        # barrier: the coordinator wipes any prior checkpoint whose
+        # shape/chunk grid changed (overwriting with merged constraints
+        # would raise), then every process opens the fresh arrays
+        if pidx == coordinator_rank:
+            for key, idx, _ in ts_writes:
+                info = merged[key]
+                _ts_open(path, key, dtype=info["dtype"],
+                         shape=info["shape"],
+                         chunks=[b - a for a, b in idx], create=True,
+                         delete_existing=True)
+        if jax.process_count() > 1:
+            from .communication import all_gather_object
+            token = []
+            all_gather_object(token, pidx)   # barrier: creation done
+
     def _write(handle=None):
         try:
-            np.savez(shard_file, **arrays)
+            if backend == "tensorstore":
+                futures = []
+                opened = {}
+                for key, idx, host in ts_writes:
+                    info = merged[key]
+                    if key not in opened:
+                        opened[key] = _ts_open(
+                            path, key, dtype=info["dtype"],
+                            shape=info["shape"],
+                            chunks=[b - a for a, b in idx], create=True)
+                    sl = tuple(slice(a, b) for a, b in idx)
+                    futures.append(opened[key][sl].write(host))
+                for f in futures:
+                    f.result()
+            else:
+                np.savez(shard_file, **arrays)
             if pidx == coordinator_rank:
                 with open(os.path.join(path, "metadata.json"), "w") as f:
                     json.dump(merged, f)
@@ -216,6 +278,23 @@ def load_state_dict(state_dict, path, process_group=None,
             cache[fname] = np.load(os.path.join(path, fname))
         return cache[fname]
 
+    ts_cache: dict = {}
+
+    def read_region(info, key, region, saved_dtype):
+        """One target region, from zarr (exact-region read) or npz
+        (piece assembly)."""
+        if info.get("storage") == "tensorstore":
+            if key not in ts_cache:
+                ts_cache[key] = _ts_open(path, key)
+            arr = ts_cache[key]
+            sl = tuple(slice(a, b) for a, b in region)
+            buf = np.asarray(arr[sl].read().result())
+            _last_load_stats["max_buffer_bytes"] = max(
+                _last_load_stats["max_buffer_bytes"], buf.nbytes)
+            return buf
+        return _assemble_region(region, info["shards"], shard_data,
+                                saved_dtype)
+
     for key, v in _leaf_items(state_dict):
         info = meta.get(key)
         if info is None or info["kind"] != "tensor":
@@ -237,15 +316,13 @@ def load_state_dict(state_dict, path, process_group=None,
             full_region = tuple((0, d) for d in gshape)
             if list(by_region) == [full_region]:
                 # fully replicated: one buffer, device_put broadcasts
-                buf = _assemble_region(full_region, info["shards"],
-                                       shard_data, saved_dtype)
+                buf = read_region(info, key, full_region, saved_dtype)
                 v._update_value(jax.device_put(
                     buf.astype(tgt_np_dtype, copy=False), sharding))
                 continue
             pieces = []
             for region, devices in by_region.items():
-                buf = _assemble_region(region, info["shards"],
-                                       shard_data, saved_dtype)
+                buf = read_region(info, key, region, saved_dtype)
                 buf = buf.astype(tgt_np_dtype, copy=False)
                 pieces.extend(jax.device_put(buf, d) for d in devices)
             arr = jax.make_array_from_single_device_arrays(
@@ -253,6 +330,6 @@ def load_state_dict(state_dict, path, process_group=None,
             v._update_value(arr)
             continue
         # unsharded target: assemble the (single-device) full value
-        full = _assemble_region(tuple((0, d) for d in gshape),
-                                info["shards"], shard_data, saved_dtype)
+        full = read_region(info, key, tuple((0, d) for d in gshape),
+                           saved_dtype)
         v._update_value(jnp.asarray(full).astype(tgt.dtype))

@@ -116,7 +116,10 @@ class _Agent:
                     return
                 try:
                     fn, args, kwargs = pickle.loads(req)
-                    out = ("ok", fn(*args, **(kwargs or {})))
+                    if fn == "__ping__":
+                        out = ("ok", self.name)
+                    else:
+                        out = ("ok", fn(*args, **(kwargs or {})))
                 except BaseException as e:  # delivered to the caller
                     out = ("err", e)
                 try:
@@ -177,6 +180,21 @@ class _Agent:
         if status == "err":
             raise result
         return result
+
+    def ping(self, info: WorkerInfo, timeout=5.0) -> bool:
+        try:
+            s, lock = self._conn_to(info)
+            with lock:
+                s.settimeout(timeout)
+                _send_msg(s, pickle.dumps(("__ping__", (), {})))
+                status, _ = pickle.loads(_recv_msg(s))
+                s.settimeout(None)
+            return status == "ok"
+        except Exception:
+            return False
+
+    def drop_conn(self, name):
+        self._evict(name)
 
     def resolve(self, to) -> WorkerInfo:
         if isinstance(to, WorkerInfo):
@@ -264,21 +282,37 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
         info = WorkerInfo(name, rank, agent.ip, agent.port)
         store.set(f"rpc/{gen}/worker/{rank}", pickle.dumps(info))
         deadline = time.time() + 120
-        for r in range(world_size):
-            key = f"rpc/{gen}/worker/{r}"
-            while True:
-                try:
-                    data = store.get(key)
+        try:
+            for r in range(world_size):
+                key = f"rpc/{gen}/worker/{r}"
+                while True:
+                    data = None
+                    try:
+                        data = store.get(key)
+                    except Exception:
+                        pass
                     if data:
-                        break
-                except Exception:
-                    pass
-                if time.time() > deadline:
-                    raise TimeoutError(f"rpc rendezvous timed out on {key}")
-                time.sleep(0.05)
-            winfo = pickle.loads(data)
-            agent.workers[winfo.name] = winfo
-            agent._by_rank[winfo.rank] = winfo
+                        winfo = pickle.loads(data)
+                        # liveness-validate: a partially-failed earlier
+                        # round can leave stale endpoints under this
+                        # generation; never rendezvous with a dead peer —
+                        # on ping failure re-read the key (a live cohort
+                        # member overwrites its slot) until the deadline
+                        if winfo.rank == rank or agent.ping(winfo):
+                            break
+                        agent.drop_conn(winfo.name)
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"rpc rendezvous timed out on {key} — if a "
+                            "previous init round failed part-way, restart "
+                            "the rendezvous master (stale store state "
+                            "fails loudly rather than joining dead peers)")
+                    time.sleep(0.05)
+                agent.workers[winfo.name] = winfo
+                agent._by_rank[winfo.rank] = winfo
+        except BaseException:
+            agent.stop()        # never leak a serving agent on failure
+            raise
         _AGENT = agent
         return agent
 

@@ -194,3 +194,6 @@ def fused_linear_cross_entropy(x, weight, labels, num_chunks=16,
         h2 = h.reshape(-1, h.shape[-1])
         return _kernel(h2, w, lab.reshape(-1), num_chunks, ignore_index)
     return apply_op(f, x, weight, labels)
+
+
+__all__ += ["fused_linear_cross_entropy"]

@@ -439,6 +439,11 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
 
     def forward(self, input_ids, labels=None, attn_mask=None, cache=None,
                 pos=None):
+        """Causal LM forward. labels given → (loss, logits); NOTE: with
+        ``config.fused_head_ce`` (default, non-TP) the logits slot is
+        ``None`` — the fused head never materializes them. Set
+        ``fused_head_ce=False`` if the training path must also return
+        logits. labels=None (eval/generate) always returns real logits."""
         if cache is not None:
             h, new_cache = self.llama(input_ids, attn_mask, cache=cache,
                                       pos=pos)
