@@ -53,8 +53,12 @@ def main():
         served = GenerationPredictor(args.export)
         out2 = served.generate(prompt.numpy(),
                                max_new_tokens=args.max_new)
-        assert np.array_equal(out.numpy(), out2), "served != in-process"
-        print("served decode matches in-process bit-exactly")
+        if not kwargs:   # the exported artifact decodes greedily
+            assert np.array_equal(out.numpy(), out2), "served != in-process"
+            print("served decode matches in-process bit-exactly")
+        else:
+            print("served (greedy) decode shape:", out2.shape,
+                  "— parity assert skipped for sampled/beam runs")
 
 
 if __name__ == "__main__":

@@ -73,9 +73,14 @@ def main():
     trainer = subprocess.Popen(
         [sys.executable, "-c", TRAINER],
         env={**env, "TRAINING_ROLE": "TRAINER", "PADDLE_TRAINER_ID": "0"})
-    trainer.wait(timeout=300)
-    for p in procs:
-        p.wait(timeout=60)
+    try:
+        trainer.wait(timeout=300)
+        for p in procs:
+            p.wait(timeout=60)
+    finally:
+        for p in procs + [trainer]:      # never orphan the servers
+            if p.poll() is None:
+                p.kill()
     print("exit codes:", trainer.returncode, [p.returncode for p in procs])
 
 

@@ -29,6 +29,7 @@ from . import jit
 from . import distributed
 from . import device
 from . import vision
+from . import geometric
 from . import metric
 from . import profiler
 from . import incubate

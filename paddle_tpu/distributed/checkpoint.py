@@ -77,6 +77,20 @@ def _ts_spec(path, key):
                         "path": os.path.join(path, "ts", key)}}
 
 
+def _tensor_chunks(info):
+    """One chunk shape per tensor: the max shard extent per dim. GSPMD
+    tiles are grid-aligned (shard i spans [i*tile, min((i+1)*tile, dim))),
+    so every shard covers exactly one chunk — or a prefix of the final
+    chunk that only that one writer touches. Ragged edge shards therefore
+    never share a chunk with another writer, and creation and open use
+    the SAME layout."""
+    chunks = [1] * len(info["shape"])
+    for sh in info["shards"]:
+        for d, (a, b) in enumerate(sh["index"]):
+            chunks[d] = max(chunks[d], b - a)
+    return chunks
+
+
 def _ts_open(path, key, dtype=None, shape=None, chunks=None, create=False,
              delete_existing=False):
     import tensorstore as ts
@@ -165,14 +179,17 @@ def save_state_dict(state_dict, path, process_group=None,
         # (re)create the arrays on the MAIN thread with a collective
         # barrier: the coordinator wipes any prior checkpoint whose
         # shape/chunk grid changed (overwriting with merged constraints
-        # would raise), then every process opens the fresh arrays
+        # would raise), then every process opens the fresh arrays. The
+        # wipe walks MERGED metadata (all tensors, once each) — not this
+        # process's shards — so tensors addressable only on other hosts
+        # are recreated too.
         if pidx == coordinator_rank:
-            for key, idx, _ in ts_writes:
-                info = merged[key]
+            for key, info in merged.items():
+                if info["kind"] != "tensor" or                         info.get("storage") != "tensorstore":
+                    continue
                 _ts_open(path, key, dtype=info["dtype"],
-                         shape=info["shape"],
-                         chunks=[b - a for a, b in idx], create=True,
-                         delete_existing=True)
+                         shape=info["shape"], chunks=_tensor_chunks(info),
+                         create=True, delete_existing=True)
         if jax.process_count() > 1:
             from .communication import all_gather_object
             token = []
@@ -189,7 +206,7 @@ def save_state_dict(state_dict, path, process_group=None,
                         opened[key] = _ts_open(
                             path, key, dtype=info["dtype"],
                             shape=info["shape"],
-                            chunks=[b - a for a, b in idx], create=True)
+                            chunks=_tensor_chunks(info), create=True)
                     sl = tuple(slice(a, b) for a, b in idx)
                     futures.append(opened[key][sl].write(host))
                 for f in futures:

@@ -1,0 +1,244 @@
+"""Detection ops (reference: python/paddle/vision/ops.py — nms,
+roi_align, roi_pool, box_coder, DeformConv2D, yolo_box … — verify).
+
+TPU-native design: everything is static-shaped so it compiles once.
+``nms`` returns a fixed-length index vector (padded with -1) driven by a
+`lax.fori_loop` greedy suppression — the reference returns a dynamic
+count, which cannot exist inside an XLA program; callers mask on >= 0.
+roi_align is gather+bilinear arithmetic (MXU-adjacent, fuses into the
+surrounding program) rather than a custom CUDA kernel.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply_op
+
+__all__ = ["box_iou", "nms", "roi_align", "roi_pool", "box_coder",
+           "RoIAlign", "RoIPool"]
+
+
+def _iou_matrix(boxes_a, boxes_b):
+    """(N, 4) x (M, 4) xyxy → (N, M) IoU (pure jnp)."""
+    area_a = (boxes_a[:, 2] - boxes_a[:, 0]) * (boxes_a[:, 3] - boxes_a[:, 1])
+    area_b = (boxes_b[:, 2] - boxes_b[:, 0]) * (boxes_b[:, 3] - boxes_b[:, 1])
+    lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])
+    rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-9)
+
+
+def box_iou(boxes1, boxes2, name=None):
+    """Pairwise IoU of two xyxy box sets: (N, 4), (M, 4) → (N, M)."""
+    return apply_op(_iou_matrix, boxes1, boxes2)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy NMS. Returns kept indices sorted by descending score,
+    fixed length (padded with -1 when fewer survive; sliced to ``top_k``
+    when given). With ``category_idxs``/``categories``, suppression is
+    per-category (batched NMS via the coordinate-offset trick)."""
+    n = int(boxes.shape[0])
+
+    def f(bx, *rest):
+        it = iter(rest)
+        sc = next(it) if scores is not None else jnp.zeros((n,))
+        if category_idxs is not None:
+            cat = next(it).astype(jnp.float32)
+            # disjoint coordinate islands per category: cross-category
+            # IoU becomes 0, one suppression pass handles all classes
+            # (shift to 0 first so negative coords can't overlap islands)
+            lo = jnp.min(bx)
+            span = jnp.max(bx) - lo + 1.0
+            bx = (bx - lo) + (cat * span)[:, None]
+        order = jnp.argsort(-sc)
+        bx_sorted = bx[order]
+        iou = _iou_matrix(bx_sorted, bx_sorted)
+
+        def body(i, keep):
+            # suppress j>i overlapping a KEPT i
+            sup = (iou[i] > iou_threshold) & keep[i] & \
+                (jnp.arange(n) > i)
+            return keep & ~sup
+        keep = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+        kept_sorted = jnp.where(keep, order, -1)
+        # stable-compact: kept first (in score order), -1 padding after
+        rank = jnp.where(keep, jnp.arange(n), n)
+        perm = jnp.argsort(rank)
+        return kept_sorted[perm]
+
+    args = [boxes]
+    if scores is not None:
+        args.append(scores)
+    if category_idxs is not None:
+        args.append(category_idxs)
+    out = apply_op(f, *args)
+    if top_k is not None:
+        out = apply_op(lambda v: v[:top_k], out)
+    return out
+
+
+def _roi_align_one(feat, roi, out_h, out_w, spatial_scale, sampling_ratio,
+                   aligned):
+    """feat: (C, H, W); roi: (4,) xyxy in input coords → (C, oh, ow)."""
+    c, h, w = feat.shape
+    off = 0.5 if aligned else 0.0
+    x0 = roi[0] * spatial_scale - off
+    y0 = roi[1] * spatial_scale - off
+    x1 = roi[2] * spatial_scale - off
+    y1 = roi[3] * spatial_scale - off
+    rw = jnp.maximum(x1 - x0, 1e-3 if aligned else 1.0)
+    rh = jnp.maximum(y1 - y0, 1e-3 if aligned else 1.0)
+    bin_h = rh / out_h
+    bin_w = rw / out_w
+    # reference semantics for sampling_ratio<=0 are ADAPTIVE
+    # (ceil(bin_size) samples per bin) — data-dependent shapes that XLA
+    # cannot compile; this TPU-native port uses a fixed grid instead
+    # (default 2, override via sampling_ratio for wide-RoI fidelity)
+    ns = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: (oh, ns) x (ow, ns) bilinear points, averaged per bin
+    iy = y0 + (jnp.arange(out_h)[:, None] + (jnp.arange(ns)[None, :] + .5)
+               / ns) * bin_h                       # (oh, ns)
+    ix = x0 + (jnp.arange(out_w)[:, None] + (jnp.arange(ns)[None, :] + .5)
+               / ns) * bin_w                       # (ow, ns)
+
+    def bilinear(yy, xx):
+        # reference contract: samples beyond [-1, size] contribute ZERO
+        # (not border replication)
+        ok_y = (yy > -1.0) & (yy < h)
+        ok_x = (xx > -1.0) & (xx < w)
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        yl = jnp.floor(yy).astype(jnp.int32)
+        xl = jnp.floor(xx).astype(jnp.int32)
+        yh_ = jnp.minimum(yl + 1, h - 1)
+        xh_ = jnp.minimum(xl + 1, w - 1)
+        wy = yy - yl
+        wx = xx - xl
+        v00 = feat[:, yl, :][:, :, xl]
+        v01 = feat[:, yl, :][:, :, xh_]
+        v10 = feat[:, yh_, :][:, :, xl]
+        v11 = feat[:, yh_, :][:, :, xh_]
+        out = (v00 * (1 - wy[None, :, None]) * (1 - wx[None, None, :])
+               + v01 * (1 - wy[None, :, None]) * wx[None, None, :]
+               + v10 * wy[None, :, None] * (1 - wx[None, None, :])
+               + v11 * wy[None, :, None] * wx[None, None, :])
+        return out * (ok_y[None, :, None] & ok_x[None, None, :])
+
+    ys = iy.reshape(-1)                 # (oh*ns,)
+    xs = ix.reshape(-1)                 # (ow*ns,)
+    vals = bilinear(ys, xs)             # (C, oh*ns, ow*ns)
+    vals = vals.reshape(c, out_h, ns, out_w, ns)
+    return jnp.mean(vals, axis=(2, 4))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign over NCHW features. boxes: (R, 4) xyxy; boxes_num: (B,)
+    rois per image (static routing via searchsorted)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, bx, bn):
+        csum = jnp.cumsum(bn)
+        img_of_roi = jnp.searchsorted(csum, jnp.arange(bx.shape[0]),
+                                      side="right")
+        feats = feat[img_of_roi]        # (R, C, H, W)
+        return jax.vmap(lambda fo, ro: _roi_align_one(
+            fo, ro, oh, ow, spatial_scale, sampling_ratio, aligned))(
+            feats, bx)
+    return apply_op(f, x, boxes, boxes_num)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """Max-pool RoI pooling (the older, quantized variant)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, bx, bn):
+        b, c, h, w = feat.shape
+        csum = jnp.cumsum(bn)
+        img_of_roi = jnp.searchsorted(csum, jnp.arange(bx.shape[0]),
+                                      side="right")
+        feats = feat[img_of_roi]
+
+        def one(fo, roi):
+            # classic Fast-R-CNN convention: rounded, INCLUSIVE ends
+            x0 = jnp.round(roi[0] * spatial_scale).astype(jnp.int32)
+            y0 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+            x1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+            rh = jnp.maximum(y1 - y0 + 1, 1)
+            rw = jnp.maximum(x1 - x0 + 1, 1)
+            ys = y0 + (jnp.arange(oh)[:, None] * rh) // oh
+            ye = y0 + ((jnp.arange(oh)[:, None] + 1) * rh + oh - 1) // oh
+            xs = x0 + (jnp.arange(ow)[None, :] * rw) // ow
+            xe = x0 + ((jnp.arange(ow)[None, :] + 1) * rw + ow - 1) // ow
+            # evaluate on a dense grid with -inf outside each bin
+            yy = jnp.arange(h)
+            xx = jnp.arange(w)
+            in_y = (yy[None, None, :] >= ys[..., None]) & \
+                (yy[None, None, :] < ye[..., None])      # (oh,1,H)
+            in_x = (xx[None, None, :] >= xs[..., None]) & \
+                (xx[None, None, :] < xe[..., None])      # (1,ow,W)
+            mask = in_y[:, :, :, None] & in_x[:, :, None, :]  # (oh,ow,H,W)
+            vals = jnp.where(mask[None], fo[:, None, None], -jnp.inf)
+            return jnp.max(vals, axis=(3, 4))
+        return jax.vmap(one)(feats, bx)
+    return apply_op(f, x, boxes, boxes_num)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              name=None):
+    """Encode/decode boxes against priors (reference box_coder op)."""
+    def f(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                             jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+            return out / pbv
+        d = tb * pbv
+        cx = d[:, 0] * pw + pcx
+        cy = d[:, 1] * ph + pcy
+        w_ = jnp.exp(d[:, 2]) * pw
+        h_ = jnp.exp(d[:, 3]) * ph
+        return jnp.stack([cx - w_ / 2, cy - h_ / 2,
+                          cx + w_ / 2 - norm, cy + h_ / 2 - norm], axis=1)
+    return apply_op(f, prior_box, prior_box_var, target_box)
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)

@@ -1,0 +1,180 @@
+"""Detection ops + graph ops (reference: python/paddle/vision/ops.py,
+python/paddle/geometric/ — verify)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.geometric as G
+from paddle_tpu.vision import ops as V
+
+
+def np_nms(b, s, thr):
+    order = np.argsort(-s)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        if order.size == 1:
+            break
+        xx1 = np.maximum(b[i, 0], b[order[1:], 0])
+        yy1 = np.maximum(b[i, 1], b[order[1:], 1])
+        xx2 = np.minimum(b[i, 2], b[order[1:], 2])
+        yy2 = np.minimum(b[i, 3], b[order[1:], 3])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        a_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        a_o = (b[order[1:], 2] - b[order[1:], 0]) * \
+            (b[order[1:], 3] - b[order[1:], 1])
+        iou = inter / (a_i + a_o - inter)
+        order = order[1:][iou <= thr]
+    return np.array(keep)
+
+
+def np_roi_align(feat, roi, out, scale, ns=2):
+    """Direct bilinear reference for one (C,H,W) map, aligned=True."""
+    c, h, w = feat.shape
+    x0, y0, x1, y1 = roi * scale - np.array([.5, .5, .5, .5])
+    rw = max(x1 - x0, 1e-3)
+    rh = max(y1 - y0, 1e-3)
+    res = np.zeros((c, out, out), np.float32)
+    for oy in range(out):
+        for ox in range(out):
+            acc = np.zeros(c, np.float32)
+            for sy in range(ns):
+                for sx in range(ns):
+                    yy = min(max(y0 + (oy + (sy + .5) / ns) * rh / out, 0),
+                             h - 1)
+                    xx = min(max(x0 + (ox + (sx + .5) / ns) * rw / out, 0),
+                             w - 1)
+                    yl, xl = int(np.floor(yy)), int(np.floor(xx))
+                    yh, xh = min(yl + 1, h - 1), min(xl + 1, w - 1)
+                    wy, wx = yy - yl, xx - xl
+                    acc += (feat[:, yl, xl] * (1 - wy) * (1 - wx)
+                            + feat[:, yl, xh] * (1 - wy) * wx
+                            + feat[:, yh, xl] * wy * (1 - wx)
+                            + feat[:, yh, xh] * wy * wx)
+            res[:, oy, ox] = acc / (ns * ns)
+    return res
+
+
+class TestDetectionOps:
+    def test_nms_matches_numpy_greedy(self):
+        rng = np.random.RandomState(0)
+        boxes = rng.rand(40, 4).astype(np.float32) * 50
+        boxes[:, 2:] = boxes[:, :2] + rng.rand(40, 2) * 30 + 1
+        scores = rng.rand(40).astype(np.float32)
+        got = V.nms(paddle.to_tensor(boxes), 0.3,
+                    paddle.to_tensor(scores)).numpy()
+        got = got[got >= 0]
+        np.testing.assert_array_equal(got, np_nms(boxes, scores, 0.3))
+
+    def test_batched_nms_per_category(self):
+        rng = np.random.RandomState(1)
+        boxes = rng.rand(30, 4).astype(np.float32) * 40
+        boxes[:, 2:] = boxes[:, :2] + rng.rand(30, 2) * 20 + 1
+        scores = rng.rand(30).astype(np.float32)
+        cats = (np.arange(30) % 3).astype(np.int32)
+        got = V.nms(paddle.to_tensor(boxes), 0.3, paddle.to_tensor(scores),
+                    paddle.to_tensor(cats)).numpy()
+        got = set(got[got >= 0].tolist())
+        want = set()
+        for c in range(3):
+            idx = np.nonzero(cats == c)[0]
+            want |= set(idx[np_nms(boxes[idx], scores[idx], 0.3)].tolist())
+        assert got == want
+
+    def test_roi_align_matches_numpy_bilinear(self):
+        rng = np.random.RandomState(2)
+        feat = rng.rand(1, 3, 12, 12).astype(np.float32)
+        rois = np.array([[2., 1., 9., 10.], [0., 0., 11., 11.]], np.float32)
+        bn = np.array([2], np.int32)
+        got = V.roi_align(paddle.to_tensor(feat), paddle.to_tensor(rois),
+                          paddle.to_tensor(bn), 3, spatial_scale=1.0,
+                          sampling_ratio=2, aligned=True).numpy()
+        for r in range(2):
+            want = np_roi_align(feat[0], rois[r], 3, 1.0)
+            np.testing.assert_allclose(got[r], want, atol=1e-4)
+
+    def test_roi_align_is_differentiable(self):
+        feat = paddle.to_tensor(
+            np.random.RandomState(3).rand(1, 2, 8, 8).astype(np.float32))
+        feat.stop_gradient = False
+        out = V.roi_align(feat, paddle.to_tensor(
+            np.array([[1., 1., 6., 6.]], np.float32)),
+            paddle.to_tensor(np.array([1], np.int32)), 2)
+        out.sum().backward()
+        g = feat.grad.numpy()
+        assert np.isfinite(g).all() and g.sum() > 0
+
+    def test_roi_pool_and_box_ops(self):
+        cf = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+        o = V.roi_pool(paddle.to_tensor(cf), paddle.to_tensor(
+            np.array([[0., 0., 7., 7.]], np.float32)),
+            paddle.to_tensor(np.array([1], np.int32)), 2)
+        # max pooling of quadrants of an arange grid
+        np.testing.assert_allclose(o.numpy()[0, 0],
+                                   [[27., 31.], [59., 63.]])
+        iou = V.box_iou(paddle.to_tensor(np.array(
+            [[0., 0., 2., 2.]], np.float32)), paddle.to_tensor(np.array(
+                [[1., 1., 3., 3.], [0., 0., 2., 2.]], np.float32)))
+        np.testing.assert_allclose(iou.numpy(), [[1. / 7., 1.]], atol=1e-6)
+        pb = np.array([[0., 0., 10., 10.]], np.float32)
+        pbv = np.full((1, 4), .5, np.float32)
+        tb = np.array([[1., 2., 8., 9.]], np.float32)
+        enc = V.box_coder(paddle.to_tensor(pb), paddle.to_tensor(pbv),
+                          paddle.to_tensor(tb))
+        dec = V.box_coder(paddle.to_tensor(pb), paddle.to_tensor(pbv), enc,
+                          code_type="decode_center_size")
+        np.testing.assert_allclose(dec.numpy(), tb, atol=1e-4)
+
+
+class TestGeometric:
+    def test_send_u_recv_reduces(self):
+        rng = np.random.RandomState(4)
+        x = rng.rand(5, 4).astype(np.float32)
+        src = np.array([0, 1, 2, 3, 4, 0], np.int32)
+        dst = np.array([1, 1, 0, 4, 4, 4], np.int32)
+        out = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                            paddle.to_tensor(dst), "sum").numpy()
+        want = np.zeros((5, 4), np.float32)
+        for s, d in zip(src, dst):
+            want[d] += x[s]
+        np.testing.assert_allclose(out, want, atol=1e-6)
+        # empty destination segments come back 0 (not -inf) under max
+        outm = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                             paddle.to_tensor(dst), "max").numpy()
+        np.testing.assert_allclose(outm[2], 0.0)
+        with pytest.raises(ValueError):
+            G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                          paddle.to_tensor(dst), "prod")
+
+    def test_send_ue_recv_and_segments(self):
+        rng = np.random.RandomState(5)
+        x = rng.rand(4, 3).astype(np.float32)
+        e = rng.rand(5, 3).astype(np.float32)
+        src = np.array([0, 1, 2, 3, 0], np.int32)
+        dst = np.array([1, 0, 3, 2, 2], np.int32)
+        out = G.send_ue_recv(paddle.to_tensor(x), paddle.to_tensor(e),
+                             paddle.to_tensor(src), paddle.to_tensor(dst),
+                             "mul", "sum").numpy()
+        want = np.zeros((4, 3), np.float32)
+        for i, (s, d) in enumerate(zip(src, dst)):
+            want[d] += x[s] * e[i]
+        np.testing.assert_allclose(out, want, atol=1e-6)
+        ids = np.array([0, 0, 1, 1, 2], np.int32)
+        data = rng.rand(5, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            G.segment_sum(paddle.to_tensor(data),
+                          paddle.to_tensor(ids)).numpy()[0],
+            data[:2].sum(0), atol=1e-6)
+        np.testing.assert_allclose(
+            G.segment_mean(paddle.to_tensor(data),
+                           paddle.to_tensor(ids)).numpy()[1],
+            data[2:4].mean(0), atol=1e-6)
+        np.testing.assert_allclose(
+            G.segment_max(paddle.to_tensor(data),
+                          paddle.to_tensor(ids)).numpy()[2], data[4],
+            atol=1e-6)
+        np.testing.assert_allclose(
+            G.segment_min(paddle.to_tensor(data),
+                          paddle.to_tensor(ids)).numpy()[0],
+            data[:2].min(0), atol=1e-6)
