@@ -36,11 +36,14 @@ def _segment(data, ids, num, pool):
     raise ValueError(f"reduce_op must be one of {_REDUCES}, got {pool!r}")
 
 
-def _finite(x, pool):
-    """segment_max/min fill empty segments with ∓inf; the reference
-    fills 0."""
+def _empty_to_zero(x, ids, num, pool):
+    """segment_max/min fill empty segments with the dtype's ∓extreme; the
+    reference fills 0. Count-based, so int dtypes are preserved."""
     if pool in ("max", "min"):
-        return jnp.where(jnp.isfinite(x), x, 0.0)
+        cnt = jax.ops.segment_sum(jnp.ones_like(ids, jnp.int32), ids, num)
+        shape = (-1,) + (1,) * (x.ndim - 1)
+        return jnp.where(cnt.reshape(shape) > 0, x,
+                         jnp.zeros((), x.dtype))
     return x
 
 
@@ -54,9 +57,10 @@ def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
     num = int(out_size) if out_size is not None else int(x.shape[0])
 
     def f(xv, si, di):
+        di = di.astype(jnp.int32)
         msgs = xv[si.astype(jnp.int32)]
-        return _finite(_segment(msgs, di.astype(jnp.int32), num,
-                                reduce_op), reduce_op)
+        return _empty_to_zero(_segment(msgs, di, num, reduce_op), di, num,
+                              reduce_op)
     return apply_op(f, x, src_index, dst_index)
 
 
@@ -75,9 +79,10 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     num = int(out_size) if out_size is not None else int(x.shape[0])
 
     def f(xv, yv, si, di):
+        di = di.astype(jnp.int32)
         msgs = ops[message_op](xv[si.astype(jnp.int32)], yv)
-        return _finite(_segment(msgs, di.astype(jnp.int32), num,
-                                reduce_op), reduce_op)
+        return _empty_to_zero(_segment(msgs, di, num, reduce_op), di, num,
+                              reduce_op)
     return apply_op(f, x, y, src_index, dst_index)
 
 
@@ -101,8 +106,9 @@ def _segment_api(pool):
                     "traced ids)") from e
 
         def f(d, ids):
-            return _finite(_segment(d, ids.astype(jnp.int32), num, pool),
-                           pool)
+            ids = ids.astype(jnp.int32)
+            return _empty_to_zero(_segment(d, ids, num, pool), ids, num,
+                                  pool)
         return apply_op(f, data, segment_ids)
     fn.__name__ = f"segment_{pool}"
     fn.__doc__ = (f"Segment {pool} over dim 0 (reference: "

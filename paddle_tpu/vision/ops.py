@@ -191,7 +191,10 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                 (xx[None, None, :] < xe[..., None])      # (1,ow,W)
             mask = in_y[:, :, :, None] & in_x[:, :, None, :]  # (oh,ow,H,W)
             vals = jnp.where(mask[None], fo[:, None, None], -jnp.inf)
-            return jnp.max(vals, axis=(3, 4))
+            out = jnp.max(vals, axis=(3, 4))
+            # bins entirely outside the map (roi past the image edge)
+            # pool to 0, matching the reference's clamped-bin behavior
+            return jnp.where(jnp.isfinite(out), out, 0.0)
         return jax.vmap(one)(feats, bx)
     return apply_op(f, x, boxes, boxes_num)
 
@@ -200,6 +203,10 @@ def box_coder(prior_box, prior_box_var, target_box,
               code_type="encode_center_size", box_normalized=True,
               name=None):
     """Encode/decode boxes against priors (reference box_coder op)."""
+    if code_type not in ("encode_center_size", "decode_center_size"):
+        raise ValueError(
+            f"code_type must be 'encode_center_size' or "
+            f"'decode_center_size', got {code_type!r}")
     def f(pb, pbv, tb):
         norm = 0.0 if box_normalized else 1.0
         pw = pb[:, 2] - pb[:, 0] + norm

@@ -1,0 +1,119 @@
+"""T5 encoder-decoder parity vs the HuggingFace torch implementation
+(weight-copied) + training-path checks (reference capability: PaddleNLP
+T5 — verify)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.t5 import (T5ForConditionalGeneration,
+                                  t5_tiny_config)
+
+
+def build_pair():
+    import torch
+    from transformers import T5Config as HFT5Config
+    from transformers import T5ForConditionalGeneration as HFT5
+    paddle.seed(0)
+    cfg = t5_tiny_config()
+    hf_cfg = HFT5Config(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model, d_kv=cfg.d_kv,
+        d_ff=cfg.d_ff, num_layers=cfg.num_layers,
+        num_decoder_layers=cfg.num_decoder_layers,
+        num_heads=cfg.num_heads,
+        relative_attention_num_buckets=cfg.relative_attention_num_buckets,
+        relative_attention_max_distance=cfg.relative_attention_max_distance,
+        feed_forward_proj="relu", tie_word_embeddings=True,
+        dropout_rate=0.0, decoder_start_token_id=0)
+    hf = HFT5(hf_cfg).eval()
+    ours = T5ForConditionalGeneration(cfg)
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+
+    def set_w(layer, arr, transpose=True):
+        layer.weight.set_value(
+            paddle.to_tensor(arr.T.copy() if transpose else arr.copy()))
+
+    set_w(ours.t5.shared, sd["shared.weight"], transpose=False)
+    for side, stack in (("encoder", ours.t5.encoder),
+                        ("decoder", ours.t5.decoder)):
+        for i, blk in enumerate(stack.block):
+            p = f"{side}.block.{i}.layer."
+            set_w(blk.attn.q, sd[p + "0.SelfAttention.q.weight"])
+            set_w(blk.attn.k, sd[p + "0.SelfAttention.k.weight"])
+            set_w(blk.attn.v, sd[p + "0.SelfAttention.v.weight"])
+            set_w(blk.attn.o, sd[p + "0.SelfAttention.o.weight"])
+            blk.ln1.weight.set_value(
+                paddle.to_tensor(sd[p + "0.layer_norm.weight"]))
+            if i == 0:
+                set_w(blk.attn.relative_attention_bias,
+                      sd[p + "0.SelfAttention.relative_attention_bias"
+                           ".weight"], transpose=False)
+            if side == "decoder":
+                set_w(blk.cross.q, sd[p + "1.EncDecAttention.q.weight"])
+                set_w(blk.cross.k, sd[p + "1.EncDecAttention.k.weight"])
+                set_w(blk.cross.v, sd[p + "1.EncDecAttention.v.weight"])
+                set_w(blk.cross.o, sd[p + "1.EncDecAttention.o.weight"])
+                blk.ln_cross.weight.set_value(
+                    paddle.to_tensor(sd[p + "1.layer_norm.weight"]))
+                ff = "2."
+            else:
+                ff = "1."
+            set_w(blk.ff.wi, sd[p + ff + "DenseReluDense.wi.weight"])
+            set_w(blk.ff.wo, sd[p + ff + "DenseReluDense.wo.weight"])
+            blk.ln2.weight.set_value(
+                paddle.to_tensor(sd[p + ff + "layer_norm.weight"]))
+        stack.final_layer_norm.weight.set_value(
+            paddle.to_tensor(sd[f"{side}.final_layer_norm.weight"]))
+    return cfg, hf, ours
+
+
+class TestT5:
+    def test_forward_matches_hf(self):
+        import torch
+        cfg, hf, ours = build_pair()
+        rng = np.random.RandomState(0)
+        inp = rng.randint(2, cfg.vocab_size, (2, 9)).astype(np.int64)
+        dec = rng.randint(2, cfg.vocab_size, (2, 5)).astype(np.int64)
+        with torch.no_grad():
+            want = hf(input_ids=torch.tensor(inp),
+                      decoder_input_ids=torch.tensor(dec)).logits.numpy()
+        got = ours(paddle.to_tensor(inp.astype(np.int32)),
+                   paddle.to_tensor(dec.astype(np.int32))).numpy()
+        np.testing.assert_allclose(got, want, atol=2e-4)
+
+    def test_cached_greedy_decode_matches_hf_generate(self):
+        import torch
+        cfg, hf, ours = build_pair()
+        rng = np.random.RandomState(1)
+        inp = rng.randint(2, cfg.vocab_size, (2, 7)).astype(np.int64)
+        out_hf = hf.generate(torch.tensor(inp), max_new_tokens=6,
+                             do_sample=False, num_beams=1).numpy()
+        out = ours.generate(paddle.to_tensor(inp.astype(np.int32)),
+                            max_new_tokens=6).numpy()
+        for b in range(2):
+            hf_seq = out_hf[b][1:]   # drop decoder_start
+            for t in range(min(len(hf_seq), out.shape[1])):
+                if hf_seq[t] == cfg.eos_token_id:
+                    break
+                assert hf_seq[t] == out[b][t]
+
+    def test_training_path(self):
+        paddle.seed(0)
+        cfg = t5_tiny_config()
+        m = T5ForConditionalGeneration(cfg)
+        opt = paddle.optimizer.Adam(learning_rate=3e-3,
+                                    parameters=m.parameters())
+        rng = np.random.RandomState(2)
+        inp = paddle.to_tensor(
+            rng.randint(2, cfg.vocab_size, (4, 8)).astype(np.int32))
+        dec = paddle.to_tensor(
+            rng.randint(2, cfg.vocab_size, (4, 6)).astype(np.int32))
+        labels = paddle.to_tensor(
+            rng.randint(2, cfg.vocab_size, (4, 6)).astype(np.int32))
+        losses = []
+        for _ in range(15):
+            loss, _ = m(inp, dec, labels=labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0] - 1.0, losses
