@@ -162,6 +162,23 @@ def functional_mode():
         _state.functional = prev
 
 
+def functional_wants_grad() -> bool:
+    """True when the functional trace in progress will be differentiated
+    by its surrounding vjp (set by the step compiler; consulted by
+    dy2static to refuse non-transposable control flow upfront)."""
+    return getattr(_state, "functional_wants_grad", False)
+
+
+@contextlib.contextmanager
+def functional_grad_hint(wants: bool):
+    prev = getattr(_state, "functional_wants_grad", False)
+    _state.functional_wants_grad = bool(wants)
+    try:
+        yield
+    finally:
+        _state.functional_wants_grad = prev
+
+
 # ---------------------------------------------------------------------------
 # RNG: stateful eager seed + pure threaded keys under jit
 # ---------------------------------------------------------------------------

@@ -146,19 +146,72 @@ class StaticFunction:
 
         return jax.jit(pure), holder
 
+    def _try_dy2static(self, static_key):
+        """AST-convert tensor control flow; on success, register the
+        converted runner for this signature. The conversion itself is
+        signature-independent, so it runs ONCE and later signatures reuse
+        the same converted StaticFunction."""
+        from . import dy2static
+        if getattr(self, "_dy2static_run", None) is not None:
+            self._cache[static_key] = ("dy2static", self._dy2static_run)
+            return self._dy2static_run
+        if getattr(self, "_dy2static_attempted", False):
+            return None
+        self._dy2static_attempted = True
+        new_fn = dy2static.convert_function(self._fn)
+        if new_fn is None:
+            return None
+        sub = StaticFunction(new_fn, layers=self._layers)
+
+        def run(*a, **k):
+            sig = self._sig_key(a, k)
+            try:
+                return sub(*a, **k)
+            except dy2static.ConversionError as ce:
+                import warnings
+                warnings.warn(
+                    f"to_static: dy2static conversion not lowerable "
+                    f"({ce}); falling back to eager for this signature",
+                    stacklevel=2)
+                self._cache[sig] = "eager"
+                return self._fn(*a, **k)
+            except ValueError as ve:
+                if "Reverse-mode differentiation" not in str(ve):
+                    raise
+                # a converted lax.while_loop cannot be transposed (XLA
+                # has no reverse-mode for dynamic trip counts); under
+                # grad, degrade to the eager Python loop, which unrolls
+                # per concrete values and differentiates fine
+                import warnings
+                warnings.warn(
+                    "to_static: converted while-loop is not reverse-"
+                    "differentiable (dynamic trip count); falling back "
+                    "to eager for this signature", stacklevel=2)
+                self._cache[sig] = "eager"
+                return self._fn(*a, **k)
+        self._dy2static_run = run
+        self._cache[static_key] = ("dy2static", run)
+        return run
+
+    @staticmethod
+    def _sig_key(args, kwargs):
+        arg_template = tuple(
+            (True, None) if isinstance(a, Tensor) else (False, a)
+            for a in args)
+        return (arg_template,
+                tuple(sorted(kwargs.items())) if kwargs else ())
+
     def __call__(self, *args, **kwargs):
         if not _TO_STATIC_ENABLED:
             return self._fn(*args, **kwargs)
         ptensors, btensors = self._state()
-        arg_template = tuple(
-            (True, None) if isinstance(a, Tensor) else (False, a)
-            for a in args)
-        static_key = (arg_template,
-                      tuple(sorted(kwargs.items())) if kwargs else ())
+        static_key = self._sig_key(args, kwargs)
         inputs = [a for a in args if isinstance(a, Tensor)]
         entry = self._cache.get(static_key)
         if entry == "eager":
             return self._fn(*args, **kwargs)
+        if isinstance(entry, tuple) and entry and entry[0] == "dy2static":
+            return entry[1](*args, **kwargs)
         if entry is None:
             entry = self._build(len(inputs), static_key)
             self._cache[static_key] = entry
@@ -167,17 +220,25 @@ class StaticFunction:
         key = framework.split_key()
         key_t = Tensor(key)  # ride through apply_op as a non-diff input
         flat_args = [key_t] + ptensors + btensors + inputs
+        wants_grad = framework.is_grad_enabled() and any(
+            not t.stop_gradient for t in flat_args)
         try:
-            out = apply_op(jitted, *flat_args)
+            with framework.functional_grad_hint(wants_grad):
+                out = apply_op(jitted, *flat_args)
         except (jax.errors.TracerBoolConversionError,
                 jax.errors.ConcretizationTypeError,
                 jax.errors.TracerArrayConversionError,
                 jax.errors.TracerIntegerConversionError) as e:
+            # data-dependent Python control flow leaked a tracer. Before
+            # giving up, try the dy2static AST conversion (reference:
+            # python/paddle/jit/dy2static/ IfElse/Loop transformers):
+            # tensor `if`/`while` become lax.cond / lax.while_loop and
+            # the signature stays fully compiled
+            converted = self._try_dy2static(static_key)
+            if converted is not None:
+                return converted(*args, **kwargs)
             # the trace-based analogue of a SOT graph break (reference:
-            # python/paddle/jit/sot/ opcode-level breaks — verify):
-            # data-dependent Python control flow can't live in one XLA
-            # program, so this call signature permanently falls back to
-            # eager execution instead of crashing
+            # python/paddle/jit/sot/ opcode-level breaks — verify)
             import warnings
             first_line = str(e).splitlines()[0] if str(e) else repr(e)
             warnings.warn(

@@ -1,0 +1,357 @@
+"""dy2static: AST conversion of data-dependent Python control flow into
+XLA control flow (reference: python/paddle/jit/dy2static/ — the
+IfElseTransformer / LoopTransformer AST passes behind @to_static; SOT's
+bytecode capture is the fallback layer there — verify).
+
+TPU-native design: ``if`` on a Tensor predicate becomes ``lax.cond`` and
+``while`` becomes ``lax.while_loop`` — both branches/bodies trace into
+the ONE compiled XLA program, which is exactly what the reference's
+ConditionalBlock/While ops compile to. The transform is conservative:
+any construct it cannot prove convertible (returns/breaks inside the
+branch, attribute/subscript stores, non-Tensor carried state under a
+Tensor predicate) raises :class:`ConversionError`, and StaticFunction
+falls back to eager for that signature (the SOT graph-break analogue).
+
+Pipeline inside ``to_static``: trace-compile the original function →
+on a tracer-leak error, retry with this AST-converted variant → only
+then fall back to eager.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["convert_function", "convert_ifelse", "convert_while",
+           "ConversionError", "ld", "UNDEF"]
+
+
+class ConversionError(RuntimeError):
+    """Raised at runtime when converted control flow cannot be lowered
+    (e.g. a branch-carried value is not a Tensor); callers treat it as a
+    graph break."""
+
+
+class _Undefined:
+    _singleton = None
+
+    def __new__(cls):
+        if cls._singleton is None:
+            cls._singleton = super().__new__(cls)
+        return cls._singleton
+
+    def __repr__(self):
+        return "<UNDEF>"
+
+
+UNDEF = _Undefined()
+
+
+def ld(frame_locals, name):
+    """Load ``name`` from the converted frame's locals, or UNDEF."""
+    return frame_locals.get(name, UNDEF)
+
+
+def _is_tensor_pred(pred):
+    return isinstance(pred, Tensor)
+
+
+def _check_tree(vals, names, where):
+    for v, n in zip(vals, names):
+        if isinstance(v, _Undefined):
+            raise ConversionError(
+                f"variable {n!r} may be undefined on one side of the "
+                f"converted {where}")
+        if not isinstance(v, Tensor):
+            raise ConversionError(
+                f"converted {where} carries non-Tensor variable {n!r} "
+                f"({type(v).__name__}); XLA control flow needs Tensor "
+                "state")
+
+
+def convert_ifelse(pred, true_fn, false_fn, inputs, names):
+    """Runtime dispatch for a converted ``if``: Python bool → plain
+    branch; Tensor predicate → lax.cond whose branch callables TRACE the
+    original statements, so only the selected branch executes at runtime
+    and the unselected branch's gradients cannot poison the result (the
+    classic double-where pitfall of select-after-compute)."""
+    if not _is_tensor_pred(pred):
+        return true_fn(*inputs) if pred else false_fn(*inputs)
+
+    # tensor inputs ride as cond operands; UNDEF / python values ride the
+    # closure (identical for both branches by construction)
+    tpos = [i for i, v in enumerate(inputs) if isinstance(v, Tensor)]
+    from ..tensor import apply_op
+
+    def f(p, *arrs):
+        def branch(branch_fn):
+            def run(op_arrs):
+                full = list(inputs)
+                for i, a in zip(tpos, op_arrs):
+                    full[i] = Tensor(a)
+                out = branch_fn(*full)
+                _check_tree(out, names, "if")
+                return tuple(t._value for t in out)
+            return run
+        try:
+            return jax.lax.cond(p.astype(bool).reshape(()),
+                                branch(true_fn), branch(false_fn), arrs)
+        except TypeError as e:
+            raise ConversionError(
+                f"if branches disagree in carried shapes/dtypes: {e}")
+    out = apply_op(f, pred, *[inputs[i] for i in tpos])
+    return out if isinstance(out, tuple) else (out,)
+
+
+def convert_while(cond_fn, body_fn, inputs, names):
+    """Runtime dispatch for a converted ``while``: Python predicate →
+    plain loop; Tensor predicate → lax.while_loop (state must be
+    shape/dtype-stable Tensors). NOTE: lax.while_loop has no reverse-mode
+    transpose — under grad, StaticFunction catches the transpose error
+    and degrades the signature to the eager Python loop."""
+    first = cond_fn(*inputs)
+    if not _is_tensor_pred(first):
+        vals = tuple(inputs)
+        while cond_fn(*vals):
+            vals = body_fn(*vals)
+        return vals
+
+    _check_tree(inputs, names, "while")
+    from .. import framework
+    wants_grad = (framework.is_grad_enabled() and any(
+        isinstance(t, Tensor) and not t.stop_gradient for t in inputs)) \
+        or (framework.in_functional_mode()
+            and framework.functional_wants_grad())
+    if wants_grad:
+        # lax.while_loop has no reverse-mode transpose; the error would
+        # only surface later at backward(), after the forward already
+        # compiled — so refuse NOW and let the signature fall back to the
+        # eager Python loop, which unrolls per concrete values and
+        # differentiates fine
+        raise ConversionError(
+            "while-loop over differentiable state (dynamic trip counts "
+            "have no reverse-mode)")
+    from ..tensor import apply_op
+
+    def f(*arrs):
+        def cond(state):
+            ts = tuple(Tensor(a) for a in state)
+            out = cond_fn(*ts)
+            return out._value.astype(bool).reshape(())
+
+        def body(state):
+            ts = tuple(Tensor(a) for a in state)
+            out = body_fn(*ts)
+            _check_tree(out, names, "while body")
+            new = tuple(t._value for t in out)
+            for n, a, b in zip(names, state, new):
+                if jnp.shape(a) != jnp.shape(b) or a.dtype != b.dtype:
+                    raise ConversionError(
+                        f"while-carried variable {n!r} changes "
+                        f"shape/dtype: {jnp.shape(a)}/{a.dtype} → "
+                        f"{jnp.shape(b)}/{b.dtype}")
+            return new
+        return jax.lax.while_loop(cond, body, arrs)
+    out = apply_op(f, *inputs)
+    return out if isinstance(out, tuple) else (out,)
+
+
+# ---------------------------------------------------------------------------
+# AST transform
+# ---------------------------------------------------------------------------
+
+_BAIL_NODES = (ast.Return, ast.Break, ast.Continue, ast.Yield,
+               ast.YieldFrom, ast.Global, ast.Nonlocal)
+
+
+def _contains_bail(stmts):
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, _BAIL_NODES):
+                return True
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # nested defs may legally contain returns — but we can't
+                # see through them; bail conservatively if they assign
+                return True
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, (ast.Attribute, ast.Subscript)):
+                            # side effects escape the branch closure
+                            return True
+    return False
+
+
+def _assigned_names(stmts):
+    names = []
+
+    def add_target(t):
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name) and sub.id not in names:
+                names.append(sub.id)
+
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    add_target(t)
+            elif isinstance(node, ast.For):
+                add_target(node.target)
+            elif isinstance(node, ast.NamedExpr):
+                add_target(node.target)
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                add_target(node.optional_vars)
+    return names
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.converted = 0
+
+    def _names_tuple(self, names, ctx):
+        return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                         ctx=ctx())
+
+    def _ld_inputs(self, names):
+        return ast.Tuple(elts=[
+            ast.Call(func=ast.Attribute(
+                value=ast.Name(id="_jst", ctx=ast.Load()), attr="ld",
+                ctx=ast.Load()),
+                args=[ast.Call(func=ast.Name(id="locals", ctx=ast.Load()),
+                               args=[], keywords=[]),
+                      ast.Constant(value=n)], keywords=[])
+            for n in names], ctx=ast.Load())
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _contains_bail(node.body) or _contains_bail(node.orelse):
+            return node
+        names = _assigned_names(node.body + node.orelse)
+        if not names:
+            return node
+        self.counter += 1
+        i = self.counter
+        ret = ast.Return(value=self._names_tuple(names, ast.Load))
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        tdef = ast.FunctionDef(name=f"_jst_true_{i}", args=args,
+                               body=list(node.body) + [ret],
+                               decorator_list=[])
+        fdef = ast.FunctionDef(name=f"_jst_false_{i}", args=args,
+                               body=(list(node.orelse) or [ast.Pass()])
+                               + [ast.Return(
+                                   value=self._names_tuple(names,
+                                                           ast.Load))],
+                               decorator_list=[])
+        call = ast.Assign(
+            targets=[self._names_tuple(names, ast.Store)],
+            value=ast.Call(
+                func=ast.Attribute(value=ast.Name(id="_jst",
+                                                  ctx=ast.Load()),
+                                   attr="convert_ifelse", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Name(id=f"_jst_true_{i}", ctx=ast.Load()),
+                      ast.Name(id=f"_jst_false_{i}", ctx=ast.Load()),
+                      self._ld_inputs(names),
+                      ast.Constant(value=tuple(names))],
+                keywords=[]))
+        self.converted += 1
+        return [tdef, fdef, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _contains_bail(node.body):
+            return node
+        carried = _assigned_names(node.body)
+        # names the condition reads that the body assigns must be carried;
+        # condition-only names ride the closure
+        names = [n for n in carried]
+        if not names:
+            return node
+        self.counter += 1
+        i = self.counter
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cdef = ast.FunctionDef(
+            name=f"_jst_wcond_{i}", args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        bdef = ast.FunctionDef(
+            name=f"_jst_wbody_{i}", args=args,
+            body=list(node.body) + [ast.Return(
+                value=self._names_tuple(names, ast.Load))],
+            decorator_list=[])
+        call = ast.Assign(
+            targets=[self._names_tuple(names, ast.Store)],
+            value=ast.Call(
+                func=ast.Attribute(value=ast.Name(id="_jst",
+                                                  ctx=ast.Load()),
+                                   attr="convert_while", ctx=ast.Load()),
+                args=[ast.Name(id=f"_jst_wcond_{i}", ctx=ast.Load()),
+                      ast.Name(id=f"_jst_wbody_{i}", ctx=ast.Load()),
+                      self._ld_inputs(names),
+                      ast.Constant(value=tuple(names))],
+                keywords=[]))
+        self.converted += 1
+        return [cdef, bdef, call]
+
+
+def convert_function(fn: Callable) -> Optional[Callable]:
+    """AST-rewrite ``fn``'s tensor control flow. Returns the rewritten
+    callable, or None when nothing was converted / source is
+    unavailable."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    for dec in fdef.decorator_list:
+        txt = ast.unparse(dec)
+        if "to_static" not in txt:
+            # some other decorator wraps the body; re-compiling without it
+            # would change behavior on exactly the converted signatures
+            return None
+    fdef.decorator_list = []          # don't re-apply @to_static
+    tr = _ControlFlowTransformer()
+    tr.visit(tree)
+    if tr.converted == 0:
+        return None
+    ast.fix_missing_locations(tree)
+    code = compile(tree, filename=f"<dy2static {fn.__name__}>",
+                   mode="exec")
+    import paddle_tpu.jit.dy2static as _jst_mod
+    glb = dict(getattr(fn, "__globals__", {}))
+    glb["_jst"] = _jst_mod
+    loc: dict = {}
+    exec(code, glb, loc)
+    new_fn = loc[fdef.name]
+    if getattr(fn, "__code__", None) is not None and \
+            fn.__code__.co_freevars:
+        return None                   # closures over free vars: too risky
+    if inspect.ismethod(fn):
+        # the recompiled def is unbound — rebind the original receiver
+        new_fn = functools.partial(new_fn, fn.__self__)
+        new_fn = functools.update_wrapper(new_fn, fn.__func__)
+        return new_fn
+    new_fn = functools.wraps(fn)(new_fn)
+    return new_fn

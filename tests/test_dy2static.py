@@ -1,0 +1,257 @@
+"""dy2static AST control-flow conversion (reference:
+python/paddle/jit/dy2static/ IfElse/Loop transformers — verify)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit import dy2static
+
+
+def t(arr):
+    return paddle.to_tensor(np.asarray(arr, np.float32))
+
+
+class TestConvertFunction:
+    def test_if_becomes_lax_cond(self):
+        def f(x):
+            if (x.sum() > 0):
+                y = x * 2
+            else:
+                y = x - 1
+            return y + 1
+
+        new = dy2static.convert_function(f)
+        assert new is not None
+        np.testing.assert_allclose(new(t([1., 2.])).numpy(), [3., 5.])
+        np.testing.assert_allclose(new(t([-5., 2.])).numpy(), [-5., 2.])
+
+    def test_while_becomes_lax_while(self):
+        def g(x):
+            while (x.sum() < 10):
+                x = x * 2
+            return x
+
+        new = dy2static.convert_function(g)
+        assert new is not None
+        np.testing.assert_allclose(new(t([1., 1.])).numpy(), [8., 8.])
+
+    def test_no_control_flow_returns_none(self):
+        def h(x):
+            return x + 1
+        assert dy2static.convert_function(h) is None
+
+
+class TestToStaticIntegration:
+    def test_tensor_if_stays_compiled(self):
+        @to_static
+        def f(x):
+            if (x.sum() > 0):
+                y = x * 2
+            else:
+                y = x - 1
+            return y + 1
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")   # graph-break warning = fail
+            np.testing.assert_allclose(f(t([1., 2.])).numpy(), [3., 5.])
+            np.testing.assert_allclose(f(t([-5., 2.])).numpy(), [-5., 2.])
+
+    def test_tensor_while_stays_compiled(self):
+        @to_static
+        def g(x):
+            while (x.sum() < 10):
+                x = x * 2
+            return x
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            np.testing.assert_allclose(g(t([1., 1.])).numpy(), [8., 8.])
+
+    def test_grad_through_converted_cond(self):
+        @to_static
+        def h(x):
+            if (x.sum() > 0):
+                y = x * 3
+            else:
+                y = x * 5
+            return y.sum()
+
+        a = t([1., 1.])
+        a.stop_gradient = False
+        h(a).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [3., 3.])
+        b = t([-1., -1.])
+        b.stop_gradient = False
+        h(b).backward()
+        np.testing.assert_allclose(b.grad.numpy(), [5., 5.])
+
+    def test_unsupported_falls_back_to_eager(self):
+        @to_static
+        def k(x):
+            if (x.sum() > 0):
+                return x * 2        # return inside branch: not converted
+            return x - 1
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            np.testing.assert_allclose(k(t([1.])).numpy(), [2.])
+            np.testing.assert_allclose(k(t([-1.])).numpy(), [-2.])
+        assert any("EAGER" in str(x.message) for x in w)
+
+    def test_python_bool_predicate_untouched(self):
+        @to_static
+        def m(x, flag=True):
+            if flag:
+                y = x + 1
+            else:
+                y = x
+            return y
+
+        np.testing.assert_allclose(m(t([1.])).numpy(), [2.])
+
+    def test_layer_forward_with_tensor_if(self):
+        from paddle_tpu import nn
+
+        class Gated(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if (h.mean() > 0):
+                    out = h * 2
+                else:
+                    out = -h
+                return out
+
+        paddle.seed(0)
+        layer = Gated()
+        fn = to_static(layer.forward)
+        x = t(np.random.RandomState(0).rand(2, 4))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            out = fn(x)
+        ref = layer.fc(x)
+        want = ref.numpy() * 2 if ref.numpy().mean() > 0 else -ref.numpy()
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5)
+
+
+class TestWhileGradSemantics:
+    def test_diff_while_degrades_to_eager(self):
+        from paddle_tpu import nn
+
+        class ClippedNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                while (h.abs().max() > 4.0):
+                    h = h * 0.5
+                if (h.mean() > 0):
+                    out = h * 2
+                else:
+                    out = -h
+                return out.sum()
+
+        paddle.seed(0)
+        net = ClippedNet()
+        fn = to_static(net.forward)
+        x = t(np.random.RandomState(0).rand(2, 4) * 20)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            got = float(fn(x).item())
+        # dynamic trip count over differentiable state has no
+        # reverse-mode: the signature must degrade loudly to eager
+        assert any("falling back to eager" in str(m.message) for m in w)
+        np.testing.assert_allclose(got, float(net.forward(x).item()),
+                                   rtol=1e-6)
+        # and training through the (eager) path works
+        opt = paddle.optimizer.SGD(learning_rate=0.01,
+                                   parameters=net.parameters())
+        loss = fn(x)
+        loss.backward()
+        opt.step()
+
+    def test_nograd_while_compiles(self):
+        from paddle_tpu import nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                while (h.abs().max() > 4.0):
+                    h = h * 0.5
+                return h.sum()
+
+        paddle.seed(1)
+        net = Net()
+        for p in net.parameters():
+            p.stop_gradient = True
+        fn = to_static(net.forward)
+        x = t(np.random.RandomState(1).rand(2, 4) * 20)
+        with paddle.no_grad():
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")   # must stay compiled
+                got = float(fn(x).item())
+        np.testing.assert_allclose(got, float(net.forward(x).item()),
+                                   rtol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_second_signature_reuses_conversion(self):
+        @to_static
+        def f(x):
+            if (x.sum() > 0):
+                y = x * 2
+            else:
+                y = x - 1
+            return y
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")   # NO graph-break anywhere
+            np.testing.assert_allclose(f(t([1., 2.])).numpy(), [2., 4.])
+            # different shape = different signature — must also convert
+            np.testing.assert_allclose(f(t([1., 1., 1.])).numpy(),
+                                       [2., 2., 2.])
+            np.testing.assert_allclose(f(t([[1., 1.]])).numpy(),
+                                       [[2., 2.]])
+
+    def test_untaken_branch_cannot_poison_gradients(self):
+        # the double-where pitfall: log(x) in the UNTAKEN branch at x=0
+        # must not leak NaN into the taken branch's gradient
+        @to_static
+        def f(x):
+            if (x.min() > 0):
+                y = x.log()
+            else:
+                y = x * 0.5
+            return y.sum()
+
+        a = t([0.0, 2.0])            # min == 0 → false branch taken
+        a.stop_gradient = False
+        f(a).backward()
+        assert np.isfinite(a.grad.numpy()).all(), a.grad.numpy()
+        np.testing.assert_allclose(a.grad.numpy(), [0.5, 0.5])
+
+    def test_for_target_carried_through_branch(self):
+        @to_static
+        def f(x):
+            acc = x * 0
+            if (x.sum() > 0):
+                for j in range(3):
+                    acc = acc + x * j
+            else:
+                acc = x
+            return acc
+
+        np.testing.assert_allclose(f(t([1.])).numpy(), [3.])
+        np.testing.assert_allclose(f(t([-1.])).numpy(), [-1.])
