@@ -47,6 +47,9 @@ from . import text
 from . import utils
 from . import hapi
 from .hapi import Model, summary
+from .hapi.flops import flops
+from . import hub
+from .framework import iinfo, finfo
 
 # paddle API aliases
 from .linalg import inv as inverse  # paddle.inverse (top-level alias)

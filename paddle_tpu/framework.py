@@ -261,3 +261,37 @@ def get_device() -> str:
 
 def default_backend_devices():
     return jax.devices()
+
+
+class _DtypeInfo:
+    def __init__(self, info, kind):
+        self._i = info
+        self.bits = info.bits
+        self.max = float(info.max) if kind == "f" else int(info.max)
+        self.min = float(info.min) if kind == "f" else int(info.min)
+        self.dtype = str(np.dtype(info.dtype).name) if hasattr(
+            info, "dtype") else ""
+        if kind == "f":
+            self.eps = float(info.eps)
+            self.tiny = float(info.tiny)
+            self.smallest_normal = float(info.tiny)
+            self.resolution = float(info.resolution)
+
+    def __repr__(self):
+        return repr(self._i)
+
+
+def iinfo(dtype):
+    """paddle.iinfo parity: integer dtype limits."""
+    return _DtypeInfo(np.iinfo(np.dtype(convert_dtype(dtype))), "i")
+
+
+def finfo(dtype):
+    """paddle.finfo parity: float dtype limits (bf16 via ml_dtypes)."""
+    dt = convert_dtype(dtype)
+    try:
+        info = np.finfo(np.dtype(dt))
+    except (TypeError, ValueError):
+        import ml_dtypes
+        info = ml_dtypes.finfo(dt)
+    return _DtypeInfo(info, "f")

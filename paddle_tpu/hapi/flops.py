@@ -1,0 +1,79 @@
+"""paddle.flops: per-layer FLOPs summary (reference:
+python/paddle/hapi/dynamic_flops.py — verify). Counts multiply-adds as
+2 FLOPs via forward hooks on the common layer types; custom layers can
+register through ``custom_ops``."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..tensor import Tensor
+
+__all__ = ["flops"]
+
+
+def _prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def _count(layer, x, y):
+    import paddle_tpu.nn as pnn
+    if isinstance(layer, pnn.Linear):
+        return 2 * _prod(x.shape) * layer.weight.shape[-1]
+    if isinstance(layer, tuple(c for c in (
+            getattr(pnn, "Conv1D", ()), getattr(pnn, "Conv2D", ()),
+            getattr(pnn, "Conv3D", ())) if c != ())):
+        kernel = _prod(layer.weight.shape[2:])
+        cin = layer.weight.shape[1]
+        return 2 * _prod(y.shape) * kernel * cin
+    if isinstance(layer, (pnn.BatchNorm, pnn.BatchNorm1D, pnn.BatchNorm2D,
+                          pnn.BatchNorm3D, pnn.LayerNorm, pnn.GroupNorm)):
+        return 2 * _prod(x.shape)
+    if isinstance(layer, pnn.Embedding):
+        return 0
+    return 0
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Run one dummy forward and return total FLOPs (int). input_size:
+    full input shape including batch."""
+    import paddle_tpu as paddle
+    total = [0]
+    rows = []
+    hooks = []
+    custom_ops = custom_ops or {}
+
+    def make_hook(layer):
+        def hook(lyr, inputs, output):
+            x = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+            y = output[0] if isinstance(output, (tuple, list)) else output
+            fn = custom_ops.get(type(lyr))
+            n = fn(lyr, x, y) if fn else _count(lyr, x, y)
+            if n:
+                total[0] += n
+                rows.append((type(lyr).__name__, list(x.shape),
+                             list(y.shape), n))
+        return hook
+
+    for sub in net.sublayers(include_self=True):
+        if not sub._sub_layers:          # leaves only
+            hooks.append(sub.register_forward_post_hook(make_hook(sub)))
+    was_training = net.training
+    net.eval()
+    try:
+        x = paddle.to_tensor(np.zeros(input_size, np.float32))
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+    if print_detail:
+        for name, si, so, n in rows:
+            print(f"{name:-20s} {str(si):>20s} -> {str(so):>20s} "
+                  f"{n/1e6:10.2f} MFLOPs")
+        print(f"Total: {total[0]/1e9:.3f} GFLOPs")
+    return total[0]

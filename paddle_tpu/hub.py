@@ -1,0 +1,62 @@
+"""paddle.hub parity (reference: python/paddle/hapi/hub.py — verify):
+load models from a hubconf.py. This environment has no network egress,
+so only ``source="local"`` is supported; github/gitee sources raise with
+that explanation (documented scope decision)."""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+_CACHE: dict = {}
+
+
+def _load_hubconf(repo_dir, force_reload=False):
+    path = os.path.realpath(os.path.join(repo_dir, _HUBCONF))
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir!r}")
+    if not force_reload and path in _CACHE:
+        return _CACHE[path]
+    # a unique, private module name: no sys.modules entry to clobber a
+    # real `hubconf` import, and side effects run once per repo
+    name = f"_paddle_tpu_hubconf_{abs(hash(path)):x}"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    _CACHE[path] = mod
+    return mod
+
+
+def _check_source(source):
+    if source != "local":
+        raise ValueError(
+            f"hub source {source!r} needs network access, which this "
+            "TPU environment does not have; only source='local' is "
+            "supported (point repo_dir at a checkout)")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoints exported by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir, force_reload)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir, force_reload)
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate ``model`` from the repo's hubconf entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir, force_reload)
+    if not hasattr(mod, model):
+        raise ValueError(
+            f"no entrypoint {model!r} in {repo_dir}/hubconf.py; "
+            f"available: {list(repo_dir)}")
+    return getattr(mod, model)(**kwargs)

@@ -3,3 +3,113 @@ fused transformer ops, MoE, flash attention wrappers."""
 from . import nn          # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp          # noqa: F401
+
+
+import builtins as _builtins
+
+
+class LookAhead:
+    """LookAhead optimizer wrapper (reference:
+    python/paddle/incubate/optimizer/lookahead.py — verify): every k
+    steps the slow weights move alpha of the way toward the fast
+    weights, and the fast weights restart from there."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        import numpy as np
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step = 0
+        self._slow = None
+
+    def _params(self):
+        return self.inner_optimizer._param_list
+
+    def step(self):
+        import jax.numpy as jnp
+        self.inner_optimizer.step()
+        self._step += 1
+        if self._slow is None:
+            self._slow = [p._value for p in self._params()]
+        if self._step % self.k == 0:
+            for i, p in enumerate(self._params()):
+                slow = self._slow[i] + self.alpha * (
+                    p._value - self._slow[i])
+                self._slow[i] = slow
+                p._update_value(slow.astype(p._value.dtype))
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["_lookahead_slow"] = self._slow
+        sd["_lookahead_step"] = self._step
+        return sd
+
+    def set_state_dict(self, sd):
+        self._slow = sd.pop("_lookahead_slow", None)
+        self._step = sd.pop("_lookahead_step", 0)
+        self.inner_optimizer.set_state_dict(sd)
+
+
+class ModelAverage:
+    """Polyak/EMA weight averaging (reference:
+    python/paddle/incubate/optimizer/modelaverage.py — verify):
+    maintains a running average of parameters; ``apply()`` swaps it in
+    for evaluation and ``restore()`` swaps back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = _builtins.list(parameters or [])
+        self._sum = None
+        self._count = 0
+        self._backup = None
+        self.rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+
+    def _window(self):
+        """Effective averaging window (reference semantics: grows with
+        the update count at ``average_window_rate``, clamped to
+        [min_average_window, max_average_window])."""
+        w = self._count * self.rate
+        return max(min(w, self.max_window), self.min_window, 1.0)
+
+    def step(self):
+        if self._sum is None:
+            self._sum = [p._value.astype("float32")
+                         for p in self._params]
+            self._count = 1
+            return
+        decay = max(1.0 / (self._count + 1), 1.0 / self._window())
+        self._sum = [s + (p._value.astype("float32") - s) * decay
+                     for s, p in zip(self._sum, self._params)]
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager style supported)."""
+        self._backup = [p._value for p in self._params]
+        for p, avg in zip(self._params, self._sum or self._backup):
+            p._update_value(avg.astype(p._value.dtype))
+        return self
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._update_value(b)
+        self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()
+

@@ -213,6 +213,13 @@ class StaticFunction:
         if isinstance(entry, tuple) and entry and entry[0] == "dy2static":
             return entry[1](*args, **kwargs)
         if entry is None:
+            if getattr(self, "_dy2static_run", None) is not None:
+                # the function provably contains tensor control flow;
+                # re-tracing the original would just re-raise — reuse the
+                # converted runner for this new signature directly
+                run = self._dy2static_run
+                self._cache[static_key] = ("dy2static", run)
+                return run(*args, **kwargs)
             entry = self._build(len(inputs), static_key)
             self._cache[static_key] = entry
         jitted, holder = entry

@@ -171,15 +171,30 @@ _BAIL_NODES = (ast.Return, ast.Break, ast.Continue, ast.Yield,
                ast.YieldFrom, ast.Global, ast.Nonlocal)
 
 
+def _walk_skip_generated(node):
+    """ast.walk that does NOT descend into the _jst_* defs this
+    transformer generated for already-converted inner control flow —
+    otherwise a converted inner `if` (whose defs legally contain Return)
+    would make the outer construct look unconvertible."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                n.name.startswith("_jst_"):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
 def _contains_bail(stmts):
     for stmt in stmts:
-        for node in ast.walk(stmt):
+        for node in _walk_skip_generated(stmt):
             if isinstance(node, _BAIL_NODES):
                 return True
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.Lambda)):
-                # nested defs may legally contain returns — but we can't
-                # see through them; bail conservatively if they assign
+                # nested USER defs may legally contain returns — but we
+                # can't see through them; bail conservatively
                 return True
             if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
                 targets = node.targets if isinstance(node, ast.Assign) \
@@ -201,7 +216,7 @@ def _assigned_names(stmts):
                 names.append(sub.id)
 
     for stmt in stmts:
-        for node in ast.walk(stmt):
+        for node in _walk_skip_generated(stmt):
             if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
                 targets = node.targets if isinstance(node, ast.Assign) \
                     else [node.target]

@@ -15,3 +15,5 @@ def try_import(module_name):
         return importlib.import_module(module_name)
     except ImportError:
         return None
+
+from . import dlpack  # noqa: F401

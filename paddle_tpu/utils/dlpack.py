@@ -1,0 +1,42 @@
+"""paddle.utils.dlpack parity: zero-copy tensor exchange via the DLPack
+protocol (reference: python/paddle/utils/dlpack.py — verify). jax arrays
+speak the modern __dlpack__ protocol; ``to_dlpack`` returns a small
+carrier exposing it (consumable by torch/numpy/jax ``from_dlpack``),
+which also makes the paddle round-trip from_dlpack(to_dlpack(t)) work —
+raw legacy capsules cannot be re-imported by jax 0.9."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+class _DLPackCarrier:
+    """Protocol object delegating to the underlying jax array."""
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __dlpack__(self, **kwargs):
+        return self._arr.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._arr.__dlpack_device__()
+
+
+def to_dlpack(x):
+    """Tensor → DLPack protocol object (torch/numpy/jax can consume)."""
+    val = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return _DLPackCarrier(val)
+
+
+def from_dlpack(obj):
+    """Any __dlpack__-capable object (incl. to_dlpack output) → Tensor."""
+    if not hasattr(obj, "__dlpack__"):
+        raise TypeError(
+            "from_dlpack needs an object implementing __dlpack__ / "
+            "__dlpack_device__ (a legacy raw PyCapsule cannot be "
+            f"re-imported by jax); got {type(obj).__name__}")
+    return Tensor(jnp.from_dlpack(obj))

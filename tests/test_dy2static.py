@@ -255,3 +255,21 @@ class TestReviewRegressions:
 
         np.testing.assert_allclose(f(t([1.])).numpy(), [3.])
         np.testing.assert_allclose(f(t([-1.])).numpy(), [-1.])
+
+    def test_nested_control_flow_converts(self):
+        # a converted inner `if` must not make the outer `while` look
+        # unconvertible (generated _jst_* defs are exempt from bail)
+        @to_static
+        def f(x):
+            while (x.sum() < 10):
+                if (x.min() > 0):
+                    x = x * 2
+                else:
+                    x = x + 3
+            return x
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            np.testing.assert_allclose(f(t([1., 1.])).numpy(), [8., 8.])
+            # [-1,1] → +3 → [2,4] (sum 6) → *2 → [4,8] (sum 12, exit)
+            np.testing.assert_allclose(f(t([-1., 1.])).numpy(), [4., 8.])

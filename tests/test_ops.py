@@ -363,3 +363,28 @@ class TestLongTailR2B:
         (z.sum() + (w * 5).sum()).backward()
         # only the pre-overwrite read of w contributes
         np.testing.assert_allclose(w.grad.numpy(), [2., 2.])
+
+
+def test_iinfo_finfo_dlpack_flops_hub(tmp_path):
+    import torch
+    import paddle_tpu.nn as nn
+    assert paddle.iinfo("int8").max == 127
+    assert paddle.finfo("bfloat16").bits == 16
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    tt = torch.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+    np.testing.assert_array_equal(tt.numpy(), t.numpy())
+    back = paddle.utils.dlpack.from_dlpack(torch.arange(4).float())
+    np.testing.assert_array_equal(back.numpy(), [0., 1., 2., 3.])
+    net = nn.Sequential(nn.Linear(10, 20), nn.ReLU(), nn.Linear(20, 5))
+    assert paddle.flops(net, (1, 10)) == 2 * (10 * 20 + 20 * 5)
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny(width=4):\n"
+        "    '''doc'''\n"
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(width, width)\n")
+    assert paddle.hub.list(str(tmp_path)) == ["tiny"]
+    assert paddle.hub.load(str(tmp_path), "tiny", width=3).weight.shape \
+        == [3, 3]
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        paddle.hub.load("x", "y", source="github")

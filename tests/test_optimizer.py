@@ -242,3 +242,42 @@ def test_asgd_gradient_averaging():
         w = w - 0.1 * d
         np.testing.assert_allclose(pp.numpy(), w.astype(np.float32),
                                    rtol=1e-5)
+
+
+def test_lookahead_slow_weights():
+    """k=2, alpha=0.5: slow weights interpolate halfway every 2 steps
+    (reference: incubate/optimizer/lookahead.py)."""
+    import jax.numpy as jnp
+    from paddle_tpu.incubate import LookAhead
+    from paddle_tpu.tensor import Parameter
+    p = Parameter(np.ones((2,), np.float32))
+    inner = optimizer.SGD(learning_rate=0.1, parameters=[p])
+    la = LookAhead(inner, alpha=0.5, k=2)
+    # manual reference
+    w = np.ones(2, np.float64)
+    slow = None
+    for step in range(1, 5):
+        (p * p).sum().backward()
+        la.step()
+        la.clear_grad()
+        w = w - 0.1 * 2 * w
+        if slow is None:
+            slow = w.copy()
+        if step % 2 == 0:
+            slow = slow + 0.5 * (w - slow)
+            w = slow.copy()
+    np.testing.assert_allclose(p.numpy(), w.astype(np.float32), rtol=1e-5)
+
+
+def test_model_average_apply_restore():
+    import jax.numpy as jnp
+    from paddle_tpu.incubate import ModelAverage
+    from paddle_tpu.tensor import Parameter
+    p = Parameter(np.zeros((2,), np.float32))
+    ma = ModelAverage(parameters=[p])
+    for v in (2.0, 4.0):
+        p._update_value(jnp.full((2,), v))
+        ma.step()
+    with ma.apply():
+        np.testing.assert_allclose(p.numpy(), 3.0)
+    np.testing.assert_allclose(p.numpy(), 4.0)   # restored
