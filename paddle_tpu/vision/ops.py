@@ -249,3 +249,133 @@ class RoIPool:
     def __call__(self, x, boxes, boxes_num):
         return roi_pool(x, boxes, boxes_num, self.output_size,
                         self.spatial_scale)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference: deform_conv2d op —
+    verify; v2 when ``mask`` is given). Implemented as bilinear sampling
+    at offset-shifted taps followed by a grouped 1x1 contraction — pure
+    gather+matmul, so XLA fuses it and the MXU does the contraction.
+
+    x: (N, Cin, H, W); offset: (N, 2*dg*kh*kw, Hout, Wout) in (dy, dx)
+    pairs; weight: (Cout, Cin/groups, kh, kw); mask: (N, dg*kh*kw,
+    Hout, Wout)."""
+    import jax
+    import jax.numpy as jnp
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    kh, kw = int(weight.shape[2]), int(weight.shape[3])
+    dg = deformable_groups
+
+    def f(v, off, w, *extra):
+        it = iter(extra)
+        b_ = next(it) if bias is not None else None
+        m_ = next(it) if mask is not None else None
+        n, cin, h, wd = v.shape
+        cout = w.shape[0]
+        hout = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        wout = (wd + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        # base sampling grid per tap: (kh*kw, hout, wout)
+        oy = jnp.arange(hout) * sh - ph
+        ox = jnp.arange(wout) * sw - pw
+        ky = jnp.arange(kh) * dh
+        kx = jnp.arange(kw) * dw
+        base_y = oy[None, :, None] + ky.repeat(kw)[:, None, None]
+        base_x = ox[None, None, :] + jnp.tile(kx, kh)[:, None, None]
+        off = off.reshape(n, dg, kh * kw, 2, hout, wout)
+        sy = base_y[None, None] + off[:, :, :, 0]     # (N, dg, K, Ho, Wo)
+        sx = base_x[None, None] + off[:, :, :, 1]
+
+        def bilinear(img, yy, xx):
+            """img: (N, dg, Cg, H, W); yy/xx: (N, dg, K, Ho, Wo).
+
+            Reference DCN border semantics (dmcn_im2col_bilinear): keep
+            FRACTIONAL corner weights and zero only the out-of-range
+            CORNERS — a clamp would overweight edge pixels and kill the
+            offset gradient at the border."""
+            y0f = jnp.floor(yy)
+            x0f = jnp.floor(xx)
+            wy = yy - y0f
+            wx = xx - x0f
+            y0 = y0f.astype(jnp.int32)
+            x0 = x0f.astype(jnp.int32)
+
+            def gat(yi, xi):
+                valid = ((yi >= 0) & (yi < h) & (xi >= 0)
+                         & (xi < wd))
+                yi = jnp.clip(yi, 0, h - 1)
+                xi = jnp.clip(xi, 0, wd - 1)
+
+                def per_ng(im, ys, xs):
+                    return im[:, ys, xs]       # (Cg, K, Ho, Wo)
+                vals = jax.vmap(jax.vmap(per_ng))(img, yi, xi)
+                return vals * valid[:, :, None]
+            return (gat(y0, x0) * ((1 - wy) * (1 - wx))[:, :, None]
+                    + gat(y0, x0 + 1) * ((1 - wy) * wx)[:, :, None]
+                    + gat(y0 + 1, x0) * (wy * (1 - wx))[:, :, None]
+                    + gat(y0 + 1, x0 + 1) * (wy * wx)[:, :, None])
+
+        img = v.reshape(n, dg, cin // dg, h, wd)
+        sampled = bilinear(img, sy, sx)        # (N, dg, Cg, K, Ho, Wo)
+        if m_ is not None:
+            mm = m_.reshape(n, dg, 1, kh * kw, hout, wout)
+            sampled = sampled * mm
+        cols = sampled.reshape(n, cin, kh * kw, hout, wout)
+        # grouped contraction with the (Cout, Cin/g, K) kernel
+        wg = w.reshape(groups, cout // groups, cin // groups, kh * kw)
+        cg = cols.reshape(n, groups, cin // groups, kh * kw, hout, wout)
+        out = jnp.einsum("ngckhw,gock->ngohw", cg, wg,
+                         preferred_element_type=jnp.float32
+                         ).reshape(n, cout, hout, wout).astype(v.dtype)
+        if b_ is not None:
+            out = out + b_.reshape(1, -1, 1, 1)
+        return out
+
+    args = [x, offset, weight]
+    if bias is not None:
+        args.append(bias)
+    if mask is not None:
+        args.append(mask)
+    return apply_op(f, *args)
+
+
+def _deform_layer_base():
+    from .. import nn
+    return nn.Layer
+
+
+class DeformConv2D(_deform_layer_base()):
+    """Layer owning the conv weight (offsets/mask come from a separate
+    conv branch, as in the reference API). A real nn.Layer: weight/bias
+    register as parameters (optimizers and state_dict see them) and
+    weight_attr/bias_attr are honored via create_parameter."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        import numpy as np
+        from ..nn import initializer as I
+        from ..param_attr import ParamAttr
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        fan_in = in_channels // groups * ks[0] * ks[1]
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, *ks),
+            attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Normal(0.0, np.sqrt(2.0 / fan_in)))
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr or None, is_bias=True)
+        self._cfg = (stride, padding, dilation, deformable_groups, groups)
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._cfg
+        return deform_conv2d(x, offset, self.weight, self.bias, s, p, d,
+                             dg, g, mask)
+
+
+__all__ += ["deform_conv2d", "DeformConv2D"]

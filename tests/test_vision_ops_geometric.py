@@ -178,3 +178,114 @@ class TestGeometric:
             G.segment_min(paddle.to_tensor(data),
                           paddle.to_tensor(ids)).numpy()[0],
             data[:2].min(0), atol=1e-6)
+
+
+class TestDeformConv2D:
+    def test_zero_offset_equals_conv(self):
+        import torch
+        import torch.nn.functional as TF
+        from paddle_tpu.vision.ops import deform_conv2d
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 6, 6).astype(np.float32)
+        w = rng.randn(5, 4, 3, 3).astype(np.float32) * .2
+        b = rng.randn(5).astype(np.float32) * .1
+        off = np.zeros((2, 18, 4, 4), np.float32)
+        ours = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                             paddle.to_tensor(w),
+                             paddle.to_tensor(b)).numpy()
+        want = TF.conv2d(torch.tensor(x), torch.tensor(w),
+                         torch.tensor(b)).numpy()
+        np.testing.assert_allclose(ours, want, atol=1e-4)
+
+    def test_integer_offset_shifts_and_mask_gates(self):
+        import torch
+        import torch.nn.functional as TF
+        from paddle_tpu.vision.ops import deform_conv2d
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 3, 6, 6).astype(np.float32)
+        w = rng.randn(2, 3, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 4, 4), np.float32)
+        off[:, 1::2] = 1.0               # dx=+1 every tap
+        ours = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                             paddle.to_tensor(w)).numpy()
+        want = TF.conv2d(torch.tensor(np.roll(x, -1, 3)),
+                         torch.tensor(w)).numpy()
+        np.testing.assert_allclose(ours[..., :-1], want[..., :-1],
+                                   atol=1e-4)
+        mask = np.zeros((1, 9, 4, 4), np.float32)
+        gated = deform_conv2d(paddle.to_tensor(x),
+                              paddle.to_tensor(np.zeros_like(off)),
+                              paddle.to_tensor(w),
+                              mask=paddle.to_tensor(mask)).numpy()
+        np.testing.assert_allclose(gated, 0.0, atol=1e-6)
+
+    def test_groups_and_offset_grad(self):
+        import torch
+        import torch.nn.functional as TF
+        from paddle_tpu.vision.ops import deform_conv2d
+        rng = np.random.RandomState(2)
+        x = rng.randn(2, 4, 6, 6).astype(np.float32)
+        w = rng.randn(4, 2, 3, 3).astype(np.float32)
+        off = np.zeros((2, 36, 4, 4), np.float32)
+        ours = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                             paddle.to_tensor(w), groups=2,
+                             deformable_groups=2).numpy()
+        want = TF.conv2d(torch.tensor(x), torch.tensor(w),
+                         groups=2).numpy()
+        np.testing.assert_allclose(ours, want, atol=1e-4)
+        ot = paddle.to_tensor(off + 0.3)
+        ot.stop_gradient = False
+        deform_conv2d(paddle.to_tensor(x), ot,
+                      paddle.to_tensor(w), groups=2,
+                      deformable_groups=2).sum().backward()
+        g = ot.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_fractional_border_offsets_match_reference_semantics(self):
+        # per-corner zeroing with kept fractional weights (NOT clamped):
+        # explicit numpy reference at dy = dx = -0.5
+        from paddle_tpu.vision.ops import deform_conv2d
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 2, 5, 5).astype(np.float32)
+        w = rng.randn(2, 2, 3, 3).astype(np.float32)
+        off = np.full((1, 18, 3, 3), -0.5, np.float32)
+        got = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(w)).numpy()
+
+        def ref(x, w, dy, dx):
+            N, Cin, H, W = x.shape
+            Cout, _, K, _ = w.shape
+            out = np.zeros((N, Cout, H - 2, W - 2), np.float32)
+            for n in range(N):
+                for oy in range(H - 2):
+                    for ox in range(W - 2):
+                        acc = np.zeros(Cout)
+                        for iy in range(K):
+                            for ix in range(K):
+                                yy, xx = oy + iy + dy, ox + ix + dx
+                                y0 = int(np.floor(yy))
+                                x0 = int(np.floor(xx))
+                                wy, wx = yy - y0, xx - x0
+                                v = np.zeros(Cin)
+                                for yi, xi, ww in (
+                                        (y0, x0, (1 - wy) * (1 - wx)),
+                                        (y0, x0 + 1, (1 - wy) * wx),
+                                        (y0 + 1, x0, wy * (1 - wx)),
+                                        (y0 + 1, x0 + 1, wy * wx)):
+                                    if 0 <= yi < H and 0 <= xi < W:
+                                        v += ww * x[n, :, yi, xi]
+                                acc += w[:, :, iy, ix] @ v
+                        out[n, :, oy, ox] = acc
+            return out
+        np.testing.assert_allclose(got, ref(x, w, -0.5, -0.5), atol=1e-4)
+
+    def test_layer_registers_parameters(self):
+        from paddle_tpu.vision.ops import DeformConv2D
+        paddle.seed(0)
+        dcn = DeformConv2D(3, 8, 3, padding=1)
+        assert len(dcn.parameters()) == 2
+        assert set(dcn.state_dict()) == {"weight", "bias"}
+        a = DeformConv2D(3, 8, 3, padding=1)
+        b = DeformConv2D(3, 8, 3, padding=1)
+        # distinct instances must NOT share identical init weights
+        assert not np.array_equal(a.weight.numpy(), b.weight.numpy())
