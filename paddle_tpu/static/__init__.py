@@ -252,3 +252,5 @@ class amp:
     @staticmethod
     def decorate(*a, **k):
         raise NotImplementedError("use paddle_tpu.amp.decorate")
+
+from . import nn  # noqa: F401  (static.nn helpers)

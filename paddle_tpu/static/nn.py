@@ -1,0 +1,231 @@
+"""paddle.static.nn: static-graph layer helpers (reference:
+python/paddle/static/nn/common.py — fc, conv2d, batch_norm, embedding,
+… — verify).
+
+TPU-native design and semantics:
+
+- **Static mode (inside ``program_guard``)**: every call creates a NEW
+  layer — build-once semantics, exactly the reference's (a static graph
+  is constructed a single time; re-entering ``program_guard`` builds
+  fresh parameters). Layers are attached to the CURRENT main Program, so
+  their parameters live and die with it; ``all_parameters()`` returns
+  the current program's.
+- **Dygraph mode**: an explicit unique ``name=`` is REQUIRED (there is
+  no graph to anchor identity to); repeated calls with the same name
+  reuse the layer, and a config mismatch under a reused name raises
+  instead of silently returning the wrong layer.
+- ``is_sparse`` is accepted for parity but has no effect: TPU gradients
+  are dense (documented scope decision).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import framework
+from .. import nn as _nn
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding", "sparse_embedding",
+           "prelu", "layer_norm", "sequence_expand", "all_parameters"]
+
+# dygraph-mode registry: (kind, name) -> (config, layer)
+_NAMED: dict = {}
+
+
+def _current_program():
+    from . import default_main_program
+    return default_main_program()
+
+
+def _get_layer(name, kind, config, build):
+    """Site-identity resolution per the module docstring."""
+    if framework.in_static_mode():
+        prog = _current_program()
+        reg = prog.__dict__.setdefault("_static_nn_layers", [])
+        layer = build()
+        reg.append((name or f"{kind}_{len(reg)}", layer))
+        return layer
+    if name is None:
+        raise ValueError(
+            f"static.nn.{kind} in dygraph mode needs an explicit unique "
+            "name= (outside a Program there is no graph site to anchor "
+            "parameter identity to)")
+    key = (kind, name)
+    if key in _NAMED:
+        old_config, layer = _NAMED[key]
+        if old_config != config:
+            raise ValueError(
+                f"static.nn.{kind} name {name!r} reused with a different "
+                f"configuration: {old_config} vs {config}")
+        return layer
+    layer = build()
+    _NAMED[key] = (config, layer)
+    return layer
+
+
+def all_parameters(prefix=None):
+    """Parameters of the current Program's helper-built layers (static
+    mode; reference: Program.all_parameters), or of dygraph-named
+    layers filtered by ``prefix``."""
+    out = []
+    if framework.in_static_mode():
+        for name, layer in getattr(_current_program(),
+                                   "_static_nn_layers", []):
+            if prefix is None or name.startswith(prefix):
+                out.extend(layer.parameters())
+        return out
+    for (kind, name), (_cfg, layer) in _NAMED.items():
+        if prefix is None or name.startswith(prefix):
+            out.extend(layer.parameters())
+    return out
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Fully connected over the trailing dims (reference fc semantics:
+    flatten from num_flatten_dims, then x @ W + b)."""
+    flat_in = 1
+    for d in x.shape[num_flatten_dims:]:
+        flat_in *= int(d)
+    layer = _get_layer(name, "fc", (flat_in, size), lambda: _nn.Linear(
+        flat_in, size, weight_attr=weight_attr, bias_attr=bias_attr))
+    from ..ops.manipulation import reshape
+    lead = [int(d) for d in x.shape[:num_flatten_dims]]
+    out = layer(reshape(x, lead + [flat_in]))
+    if activation:
+        out = getattr(_nn.functional, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    cin = int(input.shape[1])
+    cfg = (cin, num_filters, tuple(np.atleast_1d(filter_size).tolist()),
+           stride, padding, dilation, groups)
+    layer = _get_layer(name, "conv2d", cfg, lambda: _nn.Conv2D(
+        cin, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, groups=groups, weight_attr=param_attr,
+        bias_attr=bias_attr))
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-05,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None):
+    nch = int(input.shape[1] if data_layout == "NCHW"
+              else input.shape[-1])
+    cfg = (nch, momentum, epsilon, data_layout)
+    layer = _get_layer(name, "batch_norm", cfg, lambda: _nn.BatchNorm2D(
+        nch, momentum=momentum, epsilon=epsilon,
+        weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_layout))
+    # is_test is per-CALL, not a sticky mode flip on the shared layer
+    was_training = layer.training
+    if is_test:
+        layer.eval()
+    try:
+        out = layer(input)
+    finally:
+        if is_test and was_training:
+            layer.train()
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = tuple(int(d) for d in input.shape[begin_norm_axis:])
+    layer = _get_layer(name, "layer_norm", (shape, epsilon),
+                       lambda: _nn.LayerNorm(
+                           list(shape), epsilon=epsilon,
+                           weight_attr=param_attr if scale else False,
+                           bias_attr=bias_attr if shift else False))
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    if dtype not in ("float32", None):
+        raise ValueError(
+            f"static.nn.embedding dtype={dtype!r}: only float32 tables "
+            "are supported (bf16 comes from AMP casting at use sites)")
+    layer = _get_layer(name, "embedding", (tuple(size), padding_idx),
+                       lambda: _nn.Embedding(
+                           size[0], size[1], padding_idx=padding_idx,
+                           weight_attr=param_attr))
+    return layer(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, param_attr=None,
+                     dtype="float32", name=None):
+    """PS-mode sparse embedding (reference: static.nn.sparse_embedding
+    feeds the parameter-server table). Delegates to
+    distributed.ps.SparseEmbedding when a PS cluster is initialized,
+    else degrades to a dense embedding."""
+    from ..distributed import ps
+    try:
+        import paddle_tpu.distributed.rpc as _rpc
+        in_cluster = ps.server_num() >= 1 and _rpc._AGENT is not None
+    except Exception:
+        in_cluster = False
+    if in_cluster:
+        emb = _get_layer(name, "sparse_embedding", tuple(size),
+                         lambda: ps.SparseEmbedding(
+                             name or f"sparse_emb_{size[0]}x{size[1]}",
+                             size[0], size[1]))
+        return emb(input)
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype, name=name)
+
+
+class _ElemPrelu(_nn.Layer):
+    """Per-element slopes (prelu mode='element'): one parameter per
+    non-batch element, broadcast over the batch dim."""
+
+    def __init__(self, shape):
+        super().__init__()
+        from ..nn import initializer as I
+        self.weight = self.create_parameter(
+            shape, attr=None, default_initializer=I.Constant(0.25))
+
+    def forward(self, v):
+        import jax.numpy as jnp
+        from ..tensor import apply_op
+        return apply_op(lambda a, w: jnp.where(a > 0, a, w[None] * a),
+                        v, self.weight)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    if mode == "element":
+        shape = tuple(int(d) for d in x.shape[1:])
+        layer = _get_layer(name, "prelu", (mode, shape),
+                           lambda: _ElemPrelu(shape))
+        return layer(x)
+    if mode == "all":
+        n_params = 1
+    elif mode == "channel":
+        n_params = int(x.shape[1])
+    else:
+        raise ValueError(
+            f"prelu mode must be 'all', 'channel', or 'element', "
+            f"got {mode!r}")
+    layer = _get_layer(name, "prelu", (mode, n_params),
+                       lambda: _nn.PReLU(num_parameters=n_params,
+                                         weight_attr=param_attr))
+    return layer(x)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    raise NotImplementedError(
+        "sequence_expand operates on LoD tensors, a CPU-era ragged "
+        "format this TPU framework does not implement (documented scope "
+        "decision: ragged sequences are expressed with padding + "
+        "sequence_mask)")

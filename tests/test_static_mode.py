@@ -169,3 +169,74 @@ class TestASP:
             opt.clear_grad()
         assert asp.check_sparsity(net.weight)
         assert abs(asp.calculate_density(net.weight) - 0.5) < 0.01
+
+
+class TestStaticNN:
+    """static.nn helpers (reference: python/paddle/static/nn/common.py)."""
+
+    def test_fc_pipeline_static_mode(self):
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            startup = static.Program()
+            with static.program_guard(main, startup):
+                x = static.data("x", (4, 8), "float32")
+                h = static.nn.fc(x, 16, activation="relu", name="s_fc1")
+                out = static.nn.fc(h, 2, name="s_fc2")
+            exe = static.Executor()
+            exe.run(startup)
+            res = exe.run(main,
+                          feed={"x": np.random.rand(4, 8).astype(
+                              np.float32)},
+                          fetch_list=[out])
+            assert res[0].shape == (4, 2)
+        finally:
+            paddle.disable_static()
+
+    def test_helpers_dygraph_name_semantics(self):
+        img = paddle.to_tensor(
+            np.random.rand(2, 3, 8, 8).astype(np.float32))
+        # same name → same layer
+        a = static.nn.conv2d(img, 4, 3, padding=1, name="reuse_c")
+        b = static.nn.conv2d(img, 4, 3, padding=1, name="reuse_c")
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+        # unnamed in dygraph → loud error, never silent aliasing
+        with pytest.raises(ValueError):
+            static.nn.conv2d(img, 4, 3, padding=1)
+        # same name, different config → loud error
+        with pytest.raises(ValueError):
+            static.nn.conv2d(img, 8, 3, padding=1, name="reuse_c")
+        e = static.nn.embedding(
+            paddle.to_tensor(np.array([[1, 2]], np.int64)), (10, 4),
+            name="reuse_e")
+        assert list(e.shape) == [1, 2, 4]
+        with pytest.raises(NotImplementedError):
+            static.nn.sequence_expand(img, img)
+
+    def test_static_mode_builds_fresh_layers_per_program(self):
+        paddle.enable_static()
+        try:
+            p1 = static.Program()
+            s1 = static.Program()
+            with static.program_guard(p1, s1):
+                x = static.data("x", (2, 4), "float32")
+                static.nn.fc(x, 3)            # unnamed is fine here
+                static.nn.fc(x, 3)            # a SECOND distinct layer
+                params = static.nn.all_parameters()
+            assert len(params) == 4            # 2 × (weight, bias)
+            w0, w1 = params[0].numpy(), params[2].numpy()
+            assert not np.array_equal(w0, w1)  # independent inits
+        finally:
+            paddle.disable_static()
+
+    def test_batch_norm_is_test_not_sticky(self):
+        img = paddle.to_tensor(
+            np.random.rand(4, 3, 6, 6).astype(np.float32) + 2.0)
+        static.nn.batch_norm(img, is_test=True, name="bn_sticky")
+        # a later TRAIN call must update running stats again
+        before = static.nn._NAMED[("batch_norm",
+                                   "bn_sticky")][1]._mean.numpy().copy()
+        static.nn.batch_norm(img, is_test=False, name="bn_sticky")
+        after = static.nn._NAMED[("batch_norm",
+                                  "bn_sticky")][1]._mean.numpy()
+        assert not np.array_equal(before, after)
