@@ -24,18 +24,32 @@ __all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
            "is_grad_enabled", "PyLayer", "PyLayerContext"]
 
 
-_TENSOR_HOOKS: dict[int, list] = {}
+import weakref
+
+# id(tensor) -> (weakref to the tensor, [hooks]); the weakref guards
+# against CPython id reuse after the tensor dies
+_TENSOR_HOOKS: dict[int, tuple] = {}
 
 
 def _register_tensor_hook(t: Tensor, hook):
-    _TENSOR_HOOKS.setdefault(id(t), []).append(hook)
+    entry = _TENSOR_HOOKS.get(id(t))
+    if entry is None or entry[0]() is not t:
+        entry = (weakref.ref(t), [])
+        _TENSOR_HOOKS[id(t)] = entry
+    entry[1].append(hook)
 
     class _Handle:
         def remove(self):
-            lst = _TENSOR_HOOKS.get(id(t), [])
-            if hook in lst:
-                lst.remove(hook)
+            e = _TENSOR_HOOKS.get(id(t))
+            if e and e[0]() is t and hook in e[1]:
+                e[1].remove(hook)
     return _Handle()
+
+
+def _prune_dead_hooks():
+    dead = [k for k, (ref, _) in _TENSOR_HOOKS.items() if ref() is None]
+    for k in dead:
+        del _TENSOR_HOOKS[k]
 
 
 def _is_float0(x) -> bool:
@@ -44,16 +58,19 @@ def _is_float0(x) -> bool:
 
 def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
              retain_graph: bool = False):
-    """Accumulate gradients of `loss` into ``.grad`` of all leaf tensors with
-    ``stop_gradient=False`` that participated in its history."""
+    """Accumulate gradients of `loss` into ``.grad`` of all leaf tensors
+    with ``stop_gradient=False`` that participated in its history.
+
+    Only the SUBGRAPH reachable from ``loss`` is consumed: other live
+    graphs' nodes survive (reference eager semantics — e.g. GAN loops
+    backward two losses in sequence). Dead nodes (all outputs
+    garbage-collected) are pruned incrementally as the walk passes them."""
     tape = _tape()
     if loss._node is None:
         if not loss.stop_gradient:
             seed = (grad_tensor._value if grad_tensor is not None
                     else jnp.ones_like(loss._value))
             _deposit(loss, seed)
-        if not retain_graph:
-            tape.clear()
         return
 
     if grad_tensor is not None:
@@ -68,17 +85,26 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
     # cotangent store keyed by tensor identity
     cotangents: dict[int, jax.Array] = {id(loss): seed}
     keep = {id(loss): loss}
+    visited: set[int] = set()
+    dead: set[int] = set()
+    outs = out_cts = None
 
     for node in reversed(tape.nodes):
-        outs = node.outputs
-        if not any(id(o) in cotangents for o in outs):
+        outs = node.live_outputs()
+        hit = any(o is not None and id(o) in cotangents for o in outs)
+        if not hit:
+            if all(o is None for o in outs):
+                dead.add(id(node))     # fully dropped graph: prunable
             continue
+        visited.add(id(node))
         out_cts = []
-        for o in outs:
-            ct = cotangents.pop(id(o), None)
-            keep.pop(id(o), None)
+        for o, (shape, dtype) in zip(outs, node.out_meta):
+            ct = None
+            if o is not None:
+                ct = cotangents.pop(id(o), None)
+                keep.pop(id(o), None)
             if ct is None:
-                ct = jnp.zeros(o._value.shape, o._value.dtype)
+                ct = jnp.zeros(shape, dtype)
             out_cts.append(ct)
         # vjp_fn expects cotangent structure matching fn output
         arg = tuple(out_cts) if node.multi else out_cts[0]
@@ -95,23 +121,77 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
                 cotangents[tid] = ct
                 keep[tid] = t
 
+    node_ids = {id(n) for n in tape.nodes}
     for tid, ct in cotangents.items():
-        _deposit(keep[tid], ct)
+        t = keep[tid]
+        if not t.is_leaf and id(t._node) not in node_ids:
+            # this tensor's producing node is GONE from the tape: an
+            # earlier backward already freed the shared subgraph.
+            # (In-place termini keep their node on the tape this pass,
+            # so they deposit normally.)
+            if t is loss:
+                raise RuntimeError(
+                    "trying to run backward through the same graph a "
+                    "second time (its nodes were freed); use "
+                    "retain_graph=True")
+            raise RuntimeError(
+                "backward() reached a non-leaf tensor whose producing "
+                "nodes are gone — the shared trunk was freed by an "
+                "earlier backward; pass retain_graph=True to the first "
+                "backward when two losses share a trunk")
+        _deposit(t, ct)
 
-    if not retain_graph:
-        tape.clear()
-        _TENSOR_HOOKS.clear()
+    drop = dead if retain_graph else (dead | visited)
+    if drop:
+        tape.nodes = [n for n in tape.nodes if id(n) not in drop]
+    # release this frame's references before the sweep — the loop locals
+    # (outs/node/keep/cotangents) would otherwise pin dropped outputs
+    # alive through gc()
+    del outs, out_cts, keep, cotangents
+    node = o = t = None   # noqa: F841
+    tape.gc()
+    _prune_dead_hooks()
+
+
+# when non-empty, deposits are captured into the top dict instead of
+# mutating .grad (paddle.grad contract: .grad fields stay untouched)
+_CAPTURE: list = []
 
 
 def _deposit(t: Tensor, ct):
-    for hook in _TENSOR_HOOKS.get(id(t), []):
+    entry = _TENSOR_HOOKS.get(id(t))
+    hooks = entry[1] if entry and entry[0]() is t else []
+    for hook in hooks:
         res = hook(Tensor(ct))
         if res is not None:
             ct = res._value if isinstance(res, Tensor) else res
+    if _CAPTURE:
+        store = _CAPTURE[-1]
+        if id(t) in store:
+            store[id(t)] = (t, store[id(t)][1] + ct)
+        else:
+            store[id(t)] = (t, ct)
+        return
     if t.grad is None:
         t.grad = Tensor(ct)
     else:
         t.grad = Tensor(t.grad._value + ct)
+
+
+def _free_subgraph(roots):
+    """Remove from the tape every node reachable (reverse) from roots."""
+    tape = _tape()
+    reach = {id(r) for r in roots if isinstance(r, Tensor)}
+    drop = set()
+    for node in reversed(tape.nodes):
+        outs = node.live_outputs()
+        if any(o is not None and id(o) in reach for o in outs):
+            drop.add(id(node))
+            for t in node.inputs:
+                reach.add(id(t))
+    if drop:
+        tape.nodes = [n for n in tape.nodes if id(n) not in drop]
+    tape.gc()
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
@@ -121,31 +201,34 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     eager — use the jit path for higher order)."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    saved = [(t, t.grad) for t in inputs]
-    for t in inputs:
-        t.grad = None
+    capture: dict = {}
+    _CAPTURE.append(capture)
     try:
         for i, out in enumerate(outputs):
             go = None
             if grad_outputs is not None and grad_outputs[i] is not None:
                 go = grad_outputs[i]
             backward(out, go, retain_graph=True)
-        results = []
-        for t in inputs:
-            if t.grad is None:
-                if not allow_unused:
-                    raise RuntimeError(
-                        "one of the input tensors received no gradient "
-                        "(pass allow_unused=True to permit)")
-                results.append(None)
-            else:
-                results.append(t.grad)
-        return results
     finally:
+        _CAPTURE.pop()
         if not retain_graph:
-            _tape().clear()
-        for t, g in saved:
-            t.grad = g
+            # free the union subgraph of all outputs (each backward above
+            # ran with retain_graph=True so shared nodes stayed for later
+            # outputs); unrelated graphs survive — and NO .grad field was
+            # touched anywhere (deposits went into the capture dict)
+            _free_subgraph(outputs)
+    results = []
+    for t in inputs:
+        got = capture.get(id(t))
+        if got is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient "
+                    "(pass allow_unused=True to permit)")
+            results.append(None)
+        else:
+            results.append(Tensor(got[1]))
+    return results
 
 
 # ---------------------------------------------------------------------------
@@ -272,6 +355,7 @@ class PyLayer:
             t._out_index = i
             outputs_box.append(t)
             wrapped.append(t)
+        node.seal()
         return tuple(wrapped) if multi else wrapped[0]
 
 

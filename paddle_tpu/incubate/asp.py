@@ -25,7 +25,16 @@ __all__ = ["calculate_density", "check_sparsity", "create_mask",
            "set_excluded_layers"]
 
 _EXCLUDED: set = set()
-_MASKS: Dict[int, jnp.ndarray] = {}   # id(param) -> mask
+# id(param) -> (weakref to the param, mask); the weakref guards against
+# CPython id reuse after an unrelated tensor dies
+_MASKS: Dict[int, tuple] = {}
+
+
+def _mask_for(p):
+    entry = _MASKS.get(id(p))
+    if entry is not None and entry[0]() is p:
+        return entry[1]
+    return None
 
 
 def calculate_density(x) -> float:
@@ -93,7 +102,8 @@ def prune_model(model: Layer, n: int = 2, m: int = 4,
         mask = create_mask(p, mask_algo, n, m)
         p._value = p._value * mask._value
         if with_mask:
-            _MASKS[id(p)] = mask._value
+            import weakref
+            _MASKS[id(p)] = (weakref.ref(p), mask._value)
         masks[name] = mask
     return masks
 
@@ -111,14 +121,14 @@ class _ASPOptimizerWrapper:
     def step(self):
         self._inner.step()
         for p in self._inner._param_list:
-            mask = _MASKS.get(id(p))
+            mask = _mask_for(p)
             if mask is not None:
                 p._value = p._value * mask
 
     def minimize(self, loss, *a, **k):
         out = self._inner.minimize(loss, *a, **k)
         for p in self._inner._param_list:
-            mask = _MASKS.get(id(p))
+            mask = _mask_for(p)
             if mask is not None:
                 p._value = p._value * mask
         return out

@@ -12,8 +12,12 @@ TPU-native design and semantics:
   the current program's.
 - **Dygraph mode**: an explicit unique ``name=`` is REQUIRED (there is
   no graph to anchor identity to); repeated calls with the same name
-  reuse the layer, and a config mismatch under a reused name raises
-  instead of silently returning the wrong layer.
+  reuse the layer, and a STRUCTURAL config mismatch under a reused name
+  (shapes, strides, norm axes, scale/shift) raises instead of silently
+  returning the wrong layer. Parameter ATTRS (weight_attr/param_attr/
+  bias_attr) apply at first creation only — they alter initialization,
+  not the computation, so later calls reusing the name do not compare
+  them.
 - ``is_sparse`` is accepted for parity but has no effect: TPU gradients
   are dense (documented scope decision).
 """
@@ -140,7 +144,8 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
                epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
                name=None):
     shape = tuple(int(d) for d in input.shape[begin_norm_axis:])
-    layer = _get_layer(name, "layer_norm", (shape, epsilon),
+    layer = _get_layer(name, "layer_norm",
+                       (shape, epsilon, bool(scale), bool(shift)),
                        lambda: _nn.LayerNorm(
                            list(shape), epsilon=epsilon,
                            weight_attr=param_attr if scale else False,
@@ -190,11 +195,13 @@ class _ElemPrelu(_nn.Layer):
     """Per-element slopes (prelu mode='element'): one parameter per
     non-batch element, broadcast over the batch dim."""
 
-    def __init__(self, shape):
+    def __init__(self, shape, param_attr=None):
         super().__init__()
         from ..nn import initializer as I
+        from ..param_attr import ParamAttr
         self.weight = self.create_parameter(
-            shape, attr=None, default_initializer=I.Constant(0.25))
+            shape, attr=ParamAttr._to_attr(param_attr),
+            default_initializer=I.Constant(0.25))
 
     def forward(self, v):
         import jax.numpy as jnp
@@ -207,7 +214,7 @@ def prelu(x, mode="all", param_attr=None, name=None):
     if mode == "element":
         shape = tuple(int(d) for d in x.shape[1:])
         layer = _get_layer(name, "prelu", (mode, shape),
-                           lambda: _ElemPrelu(shape))
+                           lambda: _ElemPrelu(shape, param_attr))
         return layer(x)
     if mode == "all":
         n_params = 1

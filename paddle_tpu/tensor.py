@@ -33,15 +33,38 @@ __all__ = ["Tensor", "Parameter", "to_tensor", "apply_op", "reset_tape"]
 # ---------------------------------------------------------------------------
 
 class TapeNode:
-    """One recorded differentiable op application."""
-    __slots__ = ("vjp_fn", "inputs", "outputs", "idx", "multi")
+    """One recorded differentiable op application.
+
+    Outputs are held WEAKLY once sealed: backward walks only the
+    subgraph reachable from its loss (unrelated live graphs survive a
+    backward — reference eager semantics), and nodes whose every output
+    has been garbage-collected are pruned incrementally, so dropped
+    forward graphs don't pin memory."""
+    __slots__ = ("vjp_fn", "inputs", "outputs", "idx", "multi",
+                 "out_refs", "out_meta")
 
     def __init__(self, vjp_fn, inputs, outputs, idx, multi):
         self.vjp_fn = vjp_fn      # pullback: cotangents(out) -> cotangents(in)
         self.inputs = inputs      # list[Tensor] (diff inputs, tape order)
-        self.outputs = outputs    # list[Tensor]
+        self.outputs = outputs    # population box; dropped by seal()
         self.idx = idx
         self.multi = multi        # fn returned a tuple/list of arrays
+        self.out_refs = None
+        self.out_meta = None
+
+    def seal(self):
+        """Swap populated outputs for weakrefs + shape/dtype metadata
+        (the metadata builds zero-cotangents for dead sibling outputs)."""
+        import weakref
+        self.out_refs = [weakref.ref(o) for o in self.outputs]
+        self.out_meta = [(o._value.shape, o._value.dtype)
+                         for o in self.outputs]
+        self.outputs = None
+
+    def live_outputs(self):
+        if self.out_refs is None:      # unsealed (mid-apply_op)
+            return list(self.outputs)
+        return [r() for r in self.out_refs]
 
 
 class _Tape:
@@ -55,6 +78,19 @@ class _Tape:
 
     def clear(self):
         self.nodes.clear()
+
+    def gc(self):
+        """Drop nodes whose every output died, to a fixpoint: removing a
+        node releases its strong refs to upstream outputs (CPython
+        refcounting frees them immediately), which can kill the next
+        layer of nodes on the following sweep."""
+        while True:
+            live = [n for n in self.nodes
+                    if n.out_refs is None
+                    or any(r() is not None for r in n.out_refs)]
+            if len(live) == len(self.nodes):
+                return
+            self.nodes = live
 
 
 _TAPE = _Tape()
@@ -257,6 +293,7 @@ class Tensor:
                               *[t._value for t in in_tensors[1:]])
         node = _TAPE.record(vjp_fn, in_tensors, [self], multi=False)
         self._value = out
+        node.seal()
         self._node = node
         self._out_index = 0
         self.is_leaf = False
@@ -549,7 +586,9 @@ def apply_op(fn, *args, **kwargs):
         t._out_index = i
         outputs_box.append(t)
 
-    return _wrap_outputs(out, True, setter)
+    wrapped = _wrap_outputs(out, True, setter)
+    node.seal()
+    return wrapped
 
 
 # ---------------------------------------------------------------------------

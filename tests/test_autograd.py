@@ -217,3 +217,84 @@ def test_setitem_array_value_grad_path():
     np.testing.assert_allclose(x.numpy(), [5.0, 0.0, 0.0])
     # the constant write masks index 0's gradient w.r.t. the old value
     np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+class TestSubgraphBackward:
+    """backward() consumes only the loss's reachable subgraph (reference:
+    eager Backward walks the GradNode graph from the given root; other
+    live graphs are untouched)."""
+
+    def test_independent_graphs_survive_each_other(self):
+        a = paddle.to_tensor([2.0], stop_gradient=False)
+        b = paddle.to_tensor([3.0], stop_gradient=False)
+        la = (a * 5).sum()
+        lb = (b * 7).sum()
+        la.backward()
+        lb.backward()       # must still have its graph
+        np.testing.assert_allclose(a.grad.numpy(), [5.0])
+        np.testing.assert_allclose(b.grad.numpy(), [7.0])
+
+    def test_gan_style_two_losses(self):
+        from paddle_tpu import nn, optimizer
+        paddle.seed(0)
+        gen = nn.Linear(4, 4)
+        disc = nn.Linear(4, 1)
+        og = optimizer.SGD(learning_rate=0.01,
+                           parameters=gen.parameters())
+        od = optimizer.SGD(learning_rate=0.01,
+                           parameters=disc.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(8, 4).astype(np.float32))
+        fake = gen(x)
+        d_loss = (disc(fake.detach()) ** 2).mean()
+        g_loss = ((disc(fake) - 1) ** 2).mean()
+        d_loss.backward()
+        od.step()
+        od.clear_grad()
+        g_loss.backward()   # generator graph must survive d backward
+        assert gen.weight.grad is not None
+        og.step()
+
+    def test_dropped_graphs_are_pruned(self):
+        from paddle_tpu.tensor import _tape
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        for _ in range(5):
+            tmp = (x * 2).sum()
+        del tmp
+        (x * 3).sum().backward()
+        assert len(_tape().nodes) == 0
+
+    def test_hooks_survive_unrelated_backward(self):
+        calls = []
+        a = paddle.to_tensor([1.0], stop_gradient=False)
+        a.register_hook(lambda g: calls.append(1))
+        b = paddle.to_tensor([2.0], stop_gradient=False)
+        (b * 2).sum().backward()        # unrelated: must not wipe a's hook
+        (a * 3).sum().backward()
+        assert calls == [1]
+
+    def test_shared_trunk_second_backward_raises(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        trunk = x * 3
+        l1 = (trunk * 2).sum()
+        l2 = (trunk * 5).sum()
+        l1.backward()
+        with pytest.raises(RuntimeError):
+            l2.backward()   # trunk nodes were freed — loud, not wrong
+        # with retain_graph the shared pattern works
+        x2 = paddle.to_tensor([2.0], stop_gradient=False)
+        trunk2 = x2 * 3
+        (trunk2 * 2).sum().backward(retain_graph=True)
+        (trunk2 * 5).sum().backward()
+        np.testing.assert_allclose(x2.grad.numpy(), [6.0 + 15.0])
+
+    def test_grad_does_not_touch_grad_fields(self):
+        from paddle_tpu.tensor import Parameter
+        from paddle_tpu.autograd import grad as pgrad
+        w = Parameter(np.array([3.0], np.float32))
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        out = (x * w).sum()
+        g, = pgrad(out, [x])
+        np.testing.assert_allclose(g.numpy(), [3.0])
+        assert x.grad is None
+        assert w.grad is None     # leaf in graph but NOT in inputs
