@@ -227,3 +227,5 @@ class GradScaler:
         self._bad_steps = state.get("decr_count", 0)
 
     set_state_dict = load_state_dict
+
+from . import debugging  # noqa: F401

@@ -1,0 +1,145 @@
+"""paddle.amp.debugging parity (reference:
+python/paddle/amp/debugging.py — check_numerics, DebugMode,
+enable/disable_operator_stats_collection, collect_operator_stats —
+verify).
+
+TPU-native design: every eager op flows through ``tensor.apply_op``, so
+operator stats are one hook there (counting calls per op and per output
+dtype — the reference's per-kernel low-precision summary); check_numerics
+is a host-side nan/inf assertion on the materialized value.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+from collections import Counter
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["DebugMode", "check_numerics", "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats",
+           "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker"]
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+_STATS: Counter = Counter()
+_DTYPE_STATS: Counter = Counter()
+_COLLECTING = [False]
+_CHECKER = [None]   # active TensorCheckerConfig or None
+
+
+def _op_hook(fn, outputs):
+    """Called by tensor.apply_op for every dispatched op (when enabled)."""
+    if _COLLECTING[0]:
+        name = getattr(fn, "__qualname__", None) or repr(fn)
+        if name == "<lambda>":
+            name = f"{getattr(fn, '__module__', '?')}.<lambda>"
+        _STATS[name] += 1
+        for o in outputs:
+            try:
+                _DTYPE_STATS[str(jnp.dtype(o.dtype))] += 1
+            except Exception:
+                pass
+    cfg = _CHECKER[0]
+    if cfg is not None:
+        for o in outputs:
+            if jnp.issubdtype(jnp.dtype(o.dtype), jnp.floating):
+                check_numerics(o, op_type=getattr(fn, "__qualname__", "op"),
+                               debug_mode=cfg.debug_mode)
+
+
+def enable_operator_stats_collection():
+    _STATS.clear()
+    _DTYPE_STATS.clear()
+    _COLLECTING[0] = True
+    _install()
+
+
+def disable_operator_stats_collection():
+    _COLLECTING[0] = False
+    _print_stats()
+    _maybe_uninstall()
+
+
+def _print_stats():
+    print("<------------------- op list ------------------->")
+    for name, cnt in _STATS.most_common():
+        print(f"  {name:60s} {cnt}")
+    print("<----------------- dtype counts ----------------->")
+    for dt, cnt in sorted(_DTYPE_STATS.items()):
+        print(f"  {dt:12s} {cnt}")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Context manager: collect + print op/dtype stats for the block."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def check_numerics(tensor, op_type="", var_name="",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Raise (or warn) when the tensor contains NaN/Inf (reference:
+    check_numerics op). Host-side: forces materialization."""
+    v = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    if not np.issubdtype(v.dtype, np.floating):
+        return tensor
+    bad_nan = int(np.isnan(v).sum())
+    bad_inf = int(np.isinf(v).sum())
+    if bad_nan or bad_inf:
+        msg = (f"check_numerics: {op_type or 'tensor'} {var_name} has "
+               f"{bad_nan} NaN and {bad_inf} Inf values "
+               f"(shape {list(v.shape)})")
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise RuntimeError(msg)
+        import warnings
+        warnings.warn(msg, stacklevel=2)
+    return tensor
+
+
+class TensorCheckerConfig:
+    """reference parity: enable_tensor_checker(config) turns on per-op
+    output checking for ops matching the config."""
+
+    def __init__(self, enable=True,
+                 debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+
+
+def enable_tensor_checker(checker_config):
+    if getattr(checker_config, "enable", True):
+        _CHECKER[0] = checker_config
+        _install()
+
+
+def disable_tensor_checker():
+    _CHECKER[0] = None
+    _maybe_uninstall()
+
+
+def _install():
+    from .. import tensor as _t
+    _t._OP_HOOK[0] = _op_hook
+
+
+def _maybe_uninstall():
+    """Drop the hot-path hook entirely when both features are off —
+    eager dispatch must pay nothing for a one-off debug session."""
+    if not _COLLECTING[0] and _CHECKER[0] is None:
+        from .. import tensor as _t
+        _t._OP_HOOK[0] = None

@@ -121,8 +121,10 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
                 cotangents[tid] = ct
                 keep[tid] = t
 
+    # validate EVERY terminus before touching any .grad, so a freed-trunk
+    # error cannot leave gradient state half-updated
     node_ids = {id(n) for n in tape.nodes}
-    for tid, ct in cotangents.items():
+    for tid in cotangents:
         t = keep[tid]
         if not t.is_leaf and id(t._node) not in node_ids:
             # this tensor's producing node is GONE from the tape: an
@@ -139,10 +141,20 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
                 "nodes are gone — the shared trunk was freed by an "
                 "earlier backward; pass retain_graph=True to the first "
                 "backward when two losses share a trunk")
-        _deposit(t, ct)
+    for tid, ct in cotangents.items():
+        _deposit(keep[tid], ct)
 
     drop = dead if retain_graph else (dead | visited)
     if drop:
+        # an in-place op's surviving output becomes a LEAF again once its
+        # history is consumed (it continues life as a plain value; later
+        # fresh graphs through it must not see a freed-trunk tombstone)
+        for n in tape.nodes:
+            if id(n) in drop and n.inplace:
+                for o in n.live_outputs():
+                    if o is not None and o._node is n:
+                        o._node = None
+                        o.is_leaf = True
         tape.nodes = [n for n in tape.nodes if id(n) not in drop]
     # release this frame's references before the sweep — the loop locals
     # (outs/node/keep/cotangents) would otherwise pin dropped outputs

@@ -12,6 +12,7 @@ and export parity, not a speedup.
 """
 from __future__ import annotations
 
+import weakref
 from typing import Dict, Optional
 
 import jax.numpy as jnp
@@ -102,7 +103,6 @@ def prune_model(model: Layer, n: int = 2, m: int = 4,
         mask = create_mask(p, mask_algo, n, m)
         p._value = p._value * mask._value
         if with_mask:
-            import weakref
             _MASKS[id(p)] = (weakref.ref(p), mask._value)
         masks[name] = mask
     return masks

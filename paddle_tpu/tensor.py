@@ -41,7 +41,7 @@ class TapeNode:
     has been garbage-collected are pruned incrementally, so dropped
     forward graphs don't pin memory."""
     __slots__ = ("vjp_fn", "inputs", "outputs", "idx", "multi",
-                 "out_refs", "out_meta")
+                 "out_refs", "out_meta", "inplace")
 
     def __init__(self, vjp_fn, inputs, outputs, idx, multi):
         self.vjp_fn = vjp_fn      # pullback: cotangents(out) -> cotangents(in)
@@ -51,6 +51,7 @@ class TapeNode:
         self.multi = multi        # fn returned a tuple/list of arrays
         self.out_refs = None
         self.out_meta = None
+        self.inplace = False      # output IS an input (zero_/fill_/…)
 
     def seal(self):
         """Swap populated outputs for weakrefs + shape/dtype metadata
@@ -80,17 +81,21 @@ class _Tape:
         self.nodes.clear()
 
     def gc(self):
-        """Drop nodes whose every output died, to a fixpoint: removing a
-        node releases its strong refs to upstream outputs (CPython
-        refcounting frees them immediately), which can kill the next
-        layer of nodes on the following sweep."""
-        while True:
-            live = [n for n in self.nodes
-                    if n.out_refs is None
-                    or any(r() is not None for r in n.out_refs)]
-            if len(live) == len(self.nodes):
-                return
-            self.nodes = live
+        """Drop nodes whose every output died. A consumer is always newer
+        than its producers, so one NEWEST-FIRST pass reaches the fixpoint:
+        removing a dead consumer (the loop rebinding releases it) frees
+        its strong input refs before the pass reaches the producers."""
+        keep_rev = []
+        node = None
+        for node in reversed(self.nodes):
+            if node.out_refs is None or \
+                    any(r() is not None for r in node.out_refs):
+                keep_rev.append(node)
+            # else: drop — released when `node` rebinds next iteration
+        node = None
+        keep_rev.reverse()
+        if len(keep_rev) != len(self.nodes):
+            self.nodes = keep_rev
 
 
 _TAPE = _Tape()
@@ -292,6 +297,7 @@ class Tensor:
         out, vjp_fn = jax.vjp(pure, self._value,
                               *[t._value for t in in_tensors[1:]])
         node = _TAPE.record(vjp_fn, in_tensors, [self], multi=False)
+        node.inplace = True
         self._value = out
         node.seal()
         self._node = node
@@ -531,6 +537,19 @@ def _departial(t: "Tensor") -> "Tensor":
     return apply_op(lambda v: v.sum(axis=tuple(range(k))), stripped)
 
 
+# amp.debugging hook: when set, called as hook(fn, output_tensors) after
+# every eager dispatch (op stats / per-op nan checks)
+_OP_HOOK: list = [None]
+
+
+def _run_op_hook(fn, result):
+    hook = _OP_HOOK[0]
+    if hook is None:
+        return
+    outs = result if isinstance(result, (tuple, list)) else [result]
+    hook(fn, [o for o in outs if isinstance(o, Tensor)])
+
+
 def apply_op(fn, *args, **kwargs):
     """Run pure-jax `fn` on Tensor/array args; record vjp on the tape when
     eager grad is enabled and any Tensor input requires grad.
@@ -555,7 +574,10 @@ def apply_op(fn, *args, **kwargs):
     if not want_grad:
         vals = [a._value if isinstance(a, Tensor) else a for a in args]
         out = fn(*vals, **kwargs)
-        return _wrap_outputs(out, False, None)
+        result = _wrap_outputs(out, False, None)
+        if _OP_HOOK[0] is not None and not framework.in_functional_mode():
+            _run_op_hook(fn, result)
+        return result
 
     in_tensors = [args[i] for i in tensor_pos]
     in_vals = tuple(t._value for t in in_tensors)
@@ -588,6 +610,8 @@ def apply_op(fn, *args, **kwargs):
 
     wrapped = _wrap_outputs(out, True, setter)
     node.seal()
+    if _OP_HOOK[0] is not None and not framework.in_functional_mode():
+        _run_op_hook(fn, wrapped)
     return wrapped
 
 

@@ -1,5 +1,6 @@
 """AMP tests (reference pattern: test/amp/ — verify)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import amp, nn, optimizer
@@ -118,3 +119,42 @@ class TestOpRegistry:
         from paddle_tpu.ops.registry import register_op
         register_op("my_custom_matmul", amp="white")
         assert "my_custom_matmul" in amp.WHITE_LIST
+
+
+class TestAmpDebugging:
+    """paddle.amp.debugging (reference: python/paddle/amp/debugging.py)."""
+
+    def test_check_numerics_modes(self):
+        from paddle_tpu.amp import debugging as dbg
+        bad = paddle.to_tensor(np.array([1.0, np.nan, np.inf], np.float32))
+        with pytest.raises(RuntimeError):
+            dbg.check_numerics(bad)
+        import warnings
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            dbg.check_numerics(bad,
+                               debug_mode=dbg.DebugMode.CHECK_NAN_INF)
+        assert len(w) == 1 and "1 NaN and 1 Inf" in str(w[0].message)
+        ok = paddle.to_tensor(np.ones((3,), np.float32))
+        dbg.check_numerics(ok)     # clean tensor passes silently
+
+    def test_operator_stats_collection(self, capsys):
+        from paddle_tpu.amp import debugging as dbg
+        with dbg.collect_operator_stats():
+            x = paddle.to_tensor(np.ones((2, 2), np.float32))
+            _ = (x * 2) + 1
+        out = capsys.readouterr().out
+        assert "op list" in out and "float32" in out
+        # collection is OFF outside the context
+        assert not dbg._COLLECTING[0]
+
+    def test_tensor_checker_catches_nan_producing_op(self):
+        from paddle_tpu.amp import debugging as dbg
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig())
+        try:
+            with pytest.raises(RuntimeError):
+                paddle.log(paddle.to_tensor([-1.0]))
+        finally:
+            dbg.disable_tensor_checker()
+        # checker off: no raise
+        paddle.log(paddle.to_tensor([-1.0]))

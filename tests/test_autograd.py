@@ -298,3 +298,29 @@ class TestSubgraphBackward:
         np.testing.assert_allclose(g.numpy(), [3.0])
         assert x.grad is None
         assert w.grad is None     # leaf in graph but NOT in inputs
+
+    def test_inplace_terminus_then_fresh_graphs(self):
+        # zero_ on a requires-grad leaf: the first backward respects the
+        # overwrite cut (grad 0 w.r.t. the ORIGINAL value); consuming the
+        # in-place node restores leaf-ness, so later fresh graphs through
+        # x keep working and accumulate normally
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        x.zero_()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0])
+        assert x.is_leaf
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_freed_trunk_raise_mutates_no_grads(self):
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        trunk = x * 3
+        (trunk * 2).sum().backward()
+        l2 = (trunk + z * 2).sum()
+        with pytest.raises(RuntimeError):
+            l2.backward()
+        # termini are validated BEFORE any deposit: z untouched
+        assert z.grad is None
