@@ -52,6 +52,7 @@ from . import hub
 from .framework import iinfo, finfo
 
 # paddle API aliases
+from .param_attr import ParamAttr
 from .linalg import inv as inverse  # paddle.inverse (top-level alias)
 from .serialization import save, load
 from .utils.run_check import run_check

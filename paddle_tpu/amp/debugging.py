@@ -51,10 +51,12 @@ def _op_hook(fn, outputs):
                 pass
     cfg = _CHECKER[0]
     if cfg is not None:
-        for o in outputs:
-            if jnp.issubdtype(jnp.dtype(o.dtype), jnp.floating):
-                check_numerics(o, op_type=getattr(fn, "__qualname__", "op"),
-                               debug_mode=cfg.debug_mode)
+        name = getattr(fn, "__qualname__", "op")
+        if cfg._wants(name):
+            for o in outputs:
+                if jnp.issubdtype(jnp.dtype(o.dtype), jnp.floating):
+                    check_numerics(o, op_type=name,
+                                   debug_mode=cfg.debug_mode)
 
 
 def enable_operator_stats_collection():
@@ -93,9 +95,13 @@ def check_numerics(tensor, op_type="", var_name="",
                    debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
     """Raise (or warn) when the tensor contains NaN/Inf (reference:
     check_numerics op). Host-side: forces materialization."""
-    v = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
-    if not np.issubdtype(v.dtype, np.floating):
+    raw = tensor._value if isinstance(tensor, Tensor) else tensor
+    if not jnp.issubdtype(jnp.dtype(raw.dtype), jnp.floating):
         return tensor
+    v = np.asarray(raw)
+    if not np.issubdtype(v.dtype, np.floating):
+        # bfloat16/fp8 (ml_dtypes): lift to float32 for the host checks
+        v = v.astype(np.float32)
     bad_nan = int(np.isnan(v).sum())
     bad_inf = int(np.isinf(v).sum())
     if bad_nan or bad_inf:
@@ -111,7 +117,10 @@ def check_numerics(tensor, op_type="", var_name="",
 
 class TensorCheckerConfig:
     """reference parity: enable_tensor_checker(config) turns on per-op
-    output checking for ops matching the config."""
+    output checking. checked_op_list / skipped_op_list filter by
+    substring match on the dispatched op's qualified name; output_dir,
+    debug_step, and stack_height_limit are accepted for signature parity
+    but unsupported (a warning says so)."""
 
     def __init__(self, enable=True,
                  debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
@@ -119,6 +128,20 @@ class TensorCheckerConfig:
                  skipped_op_list=None, debug_step=None, stack_height_limit=1):
         self.enable = enable
         self.debug_mode = debug_mode
+        self.checked_op_list = list(checked_op_list or [])
+        self.skipped_op_list = list(skipped_op_list or [])
+        if output_dir or debug_step:
+            import warnings
+            warnings.warn(
+                "TensorCheckerConfig: output_dir/debug_step are not "
+                "supported here (checks raise/warn inline)", stacklevel=2)
+
+    def _wants(self, name):
+        if any(p in name for p in self.skipped_op_list):
+            return False
+        if self.checked_op_list:
+            return any(p in name for p in self.checked_op_list)
+        return True
 
 
 def enable_tensor_checker(checker_config):

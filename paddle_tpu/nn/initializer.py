@@ -185,3 +185,30 @@ def set_global_initializer(weight_init, bias_init=None):
     global _global_weight_init, _global_bias_init
     _global_weight_init = weight_init
     _global_bias_init = bias_init
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed convs (reference:
+    python/paddle/nn/initializer/Bilinear — verify). Weight layout
+    (C_in, C_out, kh, kw) or (C_out, C_in/g, kh, kw): every spatial
+    slice becomes the separable triangle kernel."""
+
+    def __call__(self, shape, dtype="float32"):
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer needs a 4-D conv weight, got "
+                f"{shape}")
+        kh, kw = shape[2], shape[3]
+
+        def tri(k):
+            f = (k + 1) // 2
+            center = f - 1 if k % 2 == 1 else f - 0.5
+            return (1 - np.abs(np.arange(k) - center) / f)
+        kernel = np.outer(tri(kh), tri(kw)).astype(dtype)
+        w = np.zeros(shape, dtype)
+        w[...] = kernel        # broadcast over the channel dims
+        return jnp.asarray(w)
+
+
+__all__ += ["Bilinear"]

@@ -82,20 +82,19 @@ class _Tape:
 
     def gc(self):
         """Drop nodes whose every output died. A consumer is always newer
-        than its producers, so one NEWEST-FIRST pass reaches the fixpoint:
-        removing a dead consumer (the loop rebinding releases it) frees
-        its strong input refs before the pass reaches the producers."""
-        keep_rev = []
-        node = None
-        for node in reversed(self.nodes):
-            if node.out_refs is None or \
-                    any(r() is not None for r in node.out_refs):
-                keep_rev.append(node)
-            # else: drop — released when `node` rebinds next iteration
-        node = None
-        keep_rev.reverse()
-        if len(keep_rev) != len(self.nodes):
-            self.nodes = keep_rev
+        than its producers, so one NEWEST-FIRST pass reaches the fixpoint
+        — PROVIDED each dead consumer is actually released (del from the
+        list + clear the loop variable) BEFORE its producers are tested,
+        so the refcount drop frees the producer outputs in time."""
+        i = len(self.nodes) - 1
+        while i >= 0:
+            n = self.nodes[i]
+            alive = n.out_refs is None or \
+                any(r() is not None for r in n.out_refs)
+            if not alive:
+                del self.nodes[i]
+            n = None            # release before testing the next (older)
+            i -= 1
 
 
 _TAPE = _Tape()
@@ -284,6 +283,14 @@ class Tensor:
         """Unchecked payload swap (step compiler / optimizers)."""
         self._value = v
 
+    def _notify_inplace_hook(self, name):
+        """amp.debugging visibility for in-place ops (they bypass
+        apply_op)."""
+        if _OP_HOOK[0] is not None and not framework.in_functional_mode():
+            class _Named:
+                __qualname__ = name
+            _run_op_hook(_Named, [self])
+
     def _record_inplace(self, pure, extra_inputs=()):
         """Tape-aware in-place update: record ``new = pure(old, *extras)``
         with self as both input and output (the eager engine's version-bump;
@@ -304,6 +311,9 @@ class Tensor:
         self._out_index = 0
         self.is_leaf = False
         self.stop_gradient = False
+        self._notify_inplace_hook(pure.__qualname__
+                                  if hasattr(pure, "__qualname__")
+                                  else "inplace")
         return self
 
     def _inplace_wants_grad(self, val=None) -> bool:
@@ -318,12 +328,14 @@ class Tensor:
             # recorded pullback encodes exactly that cut
             return self._record_inplace(lambda x: jnp.full_like(x, v))
         self._value = jnp.full_like(self._value, v)
+        self._notify_inplace_hook("fill_")
         return self
 
     def zero_(self):
         if self._inplace_wants_grad():
             return self._record_inplace(lambda x: jnp.zeros_like(x))
         self._value = jnp.zeros_like(self._value)
+        self._notify_inplace_hook("zero_")
         return self
 
     def _random_overwrite_(self, sample):
@@ -334,6 +346,7 @@ class Tensor:
             return self._record_inplace(
                 lambda x: jnp.broadcast_to(new, x.shape).astype(x.dtype))
         self._value = new.astype(self._value.dtype)
+        self._notify_inplace_hook("random_overwrite_")
         return self
 
     def uniform_(self, min=-1.0, max=1.0, seed=0, name=None):

@@ -158,3 +158,30 @@ class TestAmpDebugging:
             dbg.disable_tensor_checker()
         # checker off: no raise
         paddle.log(paddle.to_tensor([-1.0]))
+
+    def test_bf16_numerics_and_op_filters(self):
+        from paddle_tpu.amp import debugging as dbg
+        bad = paddle.to_tensor(
+            np.array([1.0, np.nan], np.float32)).astype("bfloat16")
+        with pytest.raises(RuntimeError):
+            dbg.check_numerics(bad)     # bf16 must not slip through
+        dbg.enable_tensor_checker(
+            dbg.TensorCheckerConfig(skipped_op_list=["log"]))
+        try:
+            paddle.log(paddle.to_tensor([-1.0]))    # skipped: no raise
+        finally:
+            dbg.disable_tensor_checker()
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig())
+        try:
+            with pytest.raises(RuntimeError):
+                paddle.to_tensor([1.0]).fill_(float("inf"))
+        finally:
+            dbg.disable_tensor_checker()
+
+    def test_tape_gc_single_call_cascade(self):
+        from paddle_tpu.tensor import _tape
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        t = ((x * 2) * 3) * 4
+        del t
+        _tape().gc()
+        assert len(_tape().nodes) == 0

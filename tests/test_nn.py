@@ -605,3 +605,23 @@ class TestBeamLengths:
                     assert L[b, k] == seq.index(2) + 1
                 else:
                     assert L[b, k] == v.shape[1]
+
+
+def test_bilinear_initializer_upsamples_smoothly():
+    """Bilinear init (reference: nn.initializer.Bilinear): the classic
+    separable triangle kernel; a stride-2 transposed conv initialized
+    with it interpolates — constant images stay constant (interior)."""
+    from paddle_tpu.nn import initializer as I
+    w = np.asarray(I.Bilinear()((1, 1, 4, 4), "float32"))
+    np.testing.assert_allclose(w[0, 0, 0],
+                               [0.0625, 0.1875, 0.1875, 0.0625],
+                               atol=1e-6)
+    x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+    ct = nn.Conv2DTranspose(
+        1, 1, 4, stride=2, padding=1,
+        weight_attr=paddle.ParamAttr(initializer=I.Bilinear()),
+        bias_attr=False)
+    y = ct(x).numpy()
+    assert np.allclose(y[0, 0, 2:-2, 2:-2], 1.0, atol=1e-5)
+    with pytest.raises(ValueError):
+        I.Bilinear()((4, 4), "float32")
