@@ -41,6 +41,26 @@ class Initializer:
         raise NotImplementedError
 
 
+def _draw(shape, dtype, host_fn, jax_fn):
+    """Sample an init value.
+
+    Eager path: draw on the HOST via numpy — sampling through jax.random
+    would jit-compile one tiny program per distinct parameter shape,
+    which made big model construction take tens of seconds (GoogLeNet:
+    ~100 shape-distinct params ≈ 35 s). Reproducibility is preserved:
+    the seed material comes from the same split_key() chain paddle.seed
+    controls, one split per parameter.
+
+    Traced path (functional mode / inside jit, where split_key returns a
+    tracer): fall back to the jax.random sampler — host numpy cannot
+    consume a traced key."""
+    k = framework.split_key()
+    if isinstance(k, jax.core.Tracer):
+        return jax_fn(k)
+    rng = np.random.default_rng(np.asarray(jax.random.key_data(k)))
+    return jnp.asarray(host_fn(rng), dtype)
+
+
 class Constant(Initializer):
     def __init__(self, value=0.0):
         self.value = value
@@ -54,8 +74,11 @@ class Normal(Initializer):
         self.mean, self.std = mean, std
 
     def __call__(self, shape, dtype):
-        k = framework.split_key()
-        return jax.random.normal(k, shape, dtype) * self.std + self.mean
+        return _draw(
+            shape, dtype,
+            lambda rng: rng.standard_normal(shape) * self.std + self.mean,
+            lambda k: jax.random.normal(k, shape, dtype) * self.std
+            + self.mean)
 
 
 class TruncatedNormal(Initializer):
@@ -63,9 +86,22 @@ class TruncatedNormal(Initializer):
         self.mean, self.std, self.a, self.b = mean, std, a, b
 
     def __call__(self, shape, dtype):
-        k = framework.split_key()
-        return jax.random.truncated_normal(
-            k, self.a, self.b, shape, dtype) * self.std + self.mean
+        if not self.a < self.b:
+            raise ValueError(
+                f"TruncatedNormal needs a < b, got ({self.a}, {self.b})")
+
+        def host(rng):
+            # inverse-CDF (scipy truncnorm): exact for arbitrary bounds,
+            # no rejection loop that could spin on far tails
+            from scipy.stats import truncnorm
+            r = truncnorm.rvs(self.a, self.b, size=shape,
+                              random_state=np.random.RandomState(
+                                  rng.integers(2 ** 31)))
+            return r * self.std + self.mean
+        return _draw(
+            shape, dtype, host,
+            lambda k: jax.random.truncated_normal(
+                k, self.a, self.b, shape, dtype) * self.std + self.mean)
 
 
 class Uniform(Initializer):
@@ -73,9 +109,12 @@ class Uniform(Initializer):
         self.low, self.high = low, high
 
     def __call__(self, shape, dtype):
-        k = framework.split_key()
-        return jax.random.uniform(k, shape, dtype,
-                                  minval=self.low, maxval=self.high)
+        return _draw(
+            shape, dtype,
+            lambda rng: rng.uniform(self.low, self.high, shape),
+            lambda k: jax.random.uniform(k, shape, dtype,
+                                         minval=self.low,
+                                         maxval=self.high))
 
 
 class XavierNormal(Initializer):
@@ -87,8 +126,9 @@ class XavierNormal(Initializer):
         fi = self.fan_in or fi
         fo = self.fan_out or fo
         std = self.gain * math.sqrt(2.0 / (fi + fo))
-        k = framework.split_key()
-        return jax.random.normal(k, shape, dtype) * std
+        return _draw(shape, dtype,
+                     lambda rng: rng.standard_normal(shape) * std,
+                     lambda k: jax.random.normal(k, shape, dtype) * std)
 
 
 class XavierUniform(Initializer):
@@ -100,9 +140,11 @@ class XavierUniform(Initializer):
         fi = self.fan_in or fi
         fo = self.fan_out or fo
         limit = self.gain * math.sqrt(6.0 / (fi + fo))
-        k = framework.split_key()
-        return jax.random.uniform(k, shape, dtype, minval=-limit,
-                                  maxval=limit)
+        return _draw(shape, dtype,
+                     lambda rng: rng.uniform(-limit, limit, shape),
+                     lambda k: jax.random.uniform(k, shape, dtype,
+                                                  minval=-limit,
+                                                  maxval=limit))
 
 
 class KaimingNormal(Initializer):
@@ -117,8 +159,9 @@ class KaimingNormal(Initializer):
         fi = self.fan_in or fi
         gain = calculate_gain(self.nonlinearity, self.negative_slope)
         std = gain / math.sqrt(fi)
-        k = framework.split_key()
-        return jax.random.normal(k, shape, dtype) * std
+        return _draw(shape, dtype,
+                     lambda rng: rng.standard_normal(shape) * std,
+                     lambda k: jax.random.normal(k, shape, dtype) * std)
 
 
 class KaimingUniform(Initializer):
@@ -133,9 +176,11 @@ class KaimingUniform(Initializer):
         fi = self.fan_in or fi
         gain = calculate_gain(self.nonlinearity, self.negative_slope)
         limit = gain * math.sqrt(3.0 / fi)
-        k = framework.split_key()
-        return jax.random.uniform(k, shape, dtype, minval=-limit,
-                                  maxval=limit)
+        return _draw(shape, dtype,
+                     lambda rng: rng.uniform(-limit, limit, shape),
+                     lambda k: jax.random.uniform(k, shape, dtype,
+                                                  minval=-limit,
+                                                  maxval=limit))
 
 
 class Assign(Initializer):

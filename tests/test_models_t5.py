@@ -9,6 +9,13 @@ from paddle_tpu.models.t5 import (T5ForConditionalGeneration,
                                   t5_tiny_config)
 
 
+@pytest.fixture(scope="module")
+def t5_pair():
+    """HF T5 + weight-copied paddle_tpu T5, built ONCE per module (the
+    triple rebuild was among the slowest things in the suite)."""
+    return build_pair()
+
+
 def build_pair():
     import torch
     from transformers import T5Config as HFT5Config
@@ -67,9 +74,9 @@ def build_pair():
 
 
 class TestT5:
-    def test_forward_matches_hf(self):
+    def test_forward_matches_hf(self, t5_pair):
         import torch
-        cfg, hf, ours = build_pair()
+        cfg, hf, ours = t5_pair
         rng = np.random.RandomState(0)
         inp = rng.randint(2, cfg.vocab_size, (2, 9)).astype(np.int64)
         dec = rng.randint(2, cfg.vocab_size, (2, 5)).astype(np.int64)
@@ -80,9 +87,9 @@ class TestT5:
                    paddle.to_tensor(dec.astype(np.int32))).numpy()
         np.testing.assert_allclose(got, want, atol=2e-4)
 
-    def test_cached_greedy_decode_matches_hf_generate(self):
+    def test_cached_greedy_decode_matches_hf_generate(self, t5_pair):
         import torch
-        cfg, hf, ours = build_pair()
+        cfg, hf, ours = t5_pair
         rng = np.random.RandomState(1)
         inp = rng.randint(2, cfg.vocab_size, (2, 7)).astype(np.int64)
         out_hf = hf.generate(torch.tensor(inp), max_new_tokens=6,
