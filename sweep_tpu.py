@@ -73,6 +73,13 @@ CONFIGS["med_b16_s1024"] = (MED, 16, 1024, True)
 MEDR = dict(MED, recompute=True)
 CONFIGS["medr_b16_s1024"] = (MEDR, 16, 1024, False)
 
+# fused-CE A/B at the headline config (run both on a healthy tunnel to
+# measure the chunked lm-head CE win on hardware)
+CONFIGS["small_b32_fusedce"] = (dict(SMALL, fused_head_ce=True), 32, 1024,
+                                True)
+CONFIGS["small_b32_nofuse"] = (dict(SMALL, fused_head_ce=False), 32, 1024,
+                               True)
+
 
 if __name__ == "__main__":
     name = sys.argv[1]
