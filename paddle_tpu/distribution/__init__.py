@@ -925,3 +925,201 @@ class Independent(Distribution):
 
 
 __all__ += ["ContinuousBernoulli", "Independent"]
+
+
+class AbsTransform(Transform):
+    """y = |x|. DEVIATION from paddle's AbsTransform (whose inverse
+    returns both branches (-y, y)): this inverse returns the positive
+    branch only, torch-style — a single tensor keeps the Transform
+    interface uniform."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-7, 1 - 1e-7))
+
+    def _fldj(self, x):
+        # log(1 - tanh^2) = 2(log2 - x - softplus(-2x)), the stable form
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class ChainTransform(Transform):
+    """Compose transforms left-to-right: y = tN(...t1(x))."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Reinterpret the rightmost ``reinterpreted_batch_rank`` dims as
+    event dims: the log-det sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ldj = self.base._fldj(x)
+        return jnp.sum(ldj, axis=tuple(range(-self._rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(int(s) for s in in_event_shape)
+        self.out_event_shape = tuple(int(s) for s in out_event_shape)
+        import numpy as _np
+        if int(_np.prod(self.in_event_shape)) != \
+                int(_np.prod(self.out_event_shape)):
+            raise ValueError(
+                f"reshape {self.in_event_shape} -> {self.out_event_shape} "
+                "changes the element count")
+
+    def _forward(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(lead + self.out_event_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(lead + self.in_event_shape)
+
+    def _fldj(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last dim (not bijective — the reference's
+    inverse maps back via log, defined up to an additive constant)."""
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not bijective; log-det is undefined "
+            "(reference raises here too)")
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, x, method):
+        n = len(self.transforms)
+        if int(x.shape[self.axis]) != n:
+            raise ValueError(
+                f"StackTransform has {n} transforms but the input has "
+                f"{x.shape[self.axis]} slices along axis {self.axis}")
+        pieces = []
+        for i, t in enumerate(self.transforms):
+            sl = jnp.take(x, i, axis=self.axis)
+            pieces.append(getattr(t, method)(sl))
+        return jnp.stack(pieces, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map(x, "_forward")
+
+    def _inverse(self, y):
+        return self._map(y, "_inverse")
+
+    def _fldj(self, x):
+        return self._map(x, "_fldj")
+
+
+class StickBreakingTransform(Transform):
+    """Unconstrained R^k -> (k+1)-simplex via stick breaking (the
+    reference's simplex bijector)."""
+
+    def _forward(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(k - jnp.arange(k, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones(x.shape[:-1] + (1,), x.dtype)],
+                               axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), 1 - z], axis=-1)
+        return zpad * jnp.cumprod(one_minus, axis=-1)
+
+    def _inverse(self, y):
+        k = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], axis=-1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), cum[..., :-1]],
+            axis=-1)
+        z = y[..., :-1] / jnp.maximum(rest, 1e-12)
+        offset = jnp.log(k - jnp.arange(k, dtype=y.dtype))
+        return jax.scipy.special.logit(jnp.clip(z, 1e-12, 1 - 1e-12)) \
+            + offset
+
+    def _fldj(self, x):
+        k = x.shape[-1]
+        offset = jnp.log(k - jnp.arange(k, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        one_minus = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), 1 - z[..., :-1]],
+            axis=-1)
+        rest = jnp.cumprod(one_minus, axis=-1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(rest),
+                       axis=-1)
+
+
+__all__ += ["AbsTransform", "PowerTransform", "TanhTransform",
+            "ChainTransform", "IndependentTransform", "ReshapeTransform",
+            "SoftmaxTransform", "StackTransform",
+            "StickBreakingTransform"]

@@ -16,3 +16,4 @@ from ..param_attr import ParamAttr            # noqa: F401
 
 from . import common, conv, norm, pooling, loss, transformer, rnn  # noqa
 from . import decode  # noqa
+from . import utils  # noqa

@@ -427,3 +427,58 @@ def test_independent_vs_torch():
     np.testing.assert_allclose(ind.log_prob(x).numpy(), want, atol=1e-5)
     with pytest.raises(ValueError):
         D.Independent(base, 3)
+
+
+class TestTransformsRound2:
+    """Transform long tail (reference: python/paddle/distribution/
+    transform.py) — tanh & stick-breaking checked against torch."""
+
+    def test_tanh_and_stickbreaking_vs_torch(self):
+        import torch
+        import paddle_tpu.distribution as D
+        x = np.random.RandomState(0).randn(5).astype(np.float32)
+        t = D.TanhTransform()
+        tt = torch.distributions.transforms.TanhTransform()
+        np.testing.assert_allclose(t.forward(x).numpy(),
+                                   tt(torch.tensor(x)).numpy(), atol=1e-6)
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(x).numpy(),
+            tt.log_abs_det_jacobian(torch.tensor(x),
+                                    tt(torch.tensor(x))).numpy(),
+            atol=1e-5)
+        s = D.StickBreakingTransform()
+        ts = torch.distributions.transforms.StickBreakingTransform()
+        y = s.forward(x).numpy()
+        np.testing.assert_allclose(y, ts(torch.tensor(x)).numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(s.inverse(y).numpy(), x, atol=1e-3)
+        np.testing.assert_allclose(
+            s.forward_log_det_jacobian(x).numpy(),
+            ts.log_abs_det_jacobian(torch.tensor(x),
+                                    torch.tensor(y)).numpy(), atol=1e-4)
+
+    def test_chain_stack_reshape_power_independent(self):
+        import paddle_tpu.distribution as D
+        x = np.random.RandomState(1).randn(5).astype(np.float32)
+        c = D.ChainTransform([D.AffineTransform(1.0, 2.0),
+                              D.ExpTransform()])
+        np.testing.assert_allclose(c.forward(x).numpy(),
+                                   np.exp(1 + 2 * x), rtol=1e-5)
+        np.testing.assert_allclose(c.inverse(c.forward(x).numpy()).numpy(),
+                                   x, atol=1e-4)
+        p = D.PowerTransform(2.0)
+        xx = np.abs(x) + 0.1
+        np.testing.assert_allclose(p.inverse(p.forward(xx).numpy()).numpy(),
+                                   xx, atol=1e-5)
+        r = D.ReshapeTransform((6,), (2, 3))
+        assert r.forward(np.arange(6, dtype=np.float32)).shape == [2, 3]
+        with pytest.raises(ValueError):
+            D.ReshapeTransform((6,), (2, 2))
+        st = D.StackTransform([D.ExpTransform(),
+                               D.AffineTransform(0.0, 2.0)])
+        out = st.forward(np.stack([x, x])).numpy()
+        np.testing.assert_allclose(out[0], np.exp(x), rtol=1e-5)
+        np.testing.assert_allclose(out[1], 2 * x, rtol=1e-5)
+        i = D.IndependentTransform(D.ExpTransform(), 1)
+        assert i.forward_log_det_jacobian(
+            np.ones((3, 4), np.float32)).shape == [3]

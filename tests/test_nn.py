@@ -625,3 +625,60 @@ def test_bilinear_initializer_upsamples_smoothly():
     assert np.allclose(y[0, 0, 2:-2, 2:-2], 1.0, atol=1e-5)
     with pytest.raises(ValueError):
         I.Bilinear()((4, 4), "float32")
+
+
+class TestNnUtils:
+    """nn.utils (reference: python/paddle/nn/utils/)."""
+
+    def test_weight_norm_roundtrip_and_training(self):
+        from paddle_tpu.nn import utils as U
+        paddle.seed(0)
+        lin = nn.Linear(4, 3)
+        w0 = lin.weight.numpy().copy()
+        U.weight_norm(lin, dim=0)
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5)
+        names = [n for n, _ in lin.named_parameters()]
+        assert "weight_v" in names and "weight_g" in names \
+            and "weight" not in names
+        x = paddle.to_tensor(rnd(2, 4))
+        lin(x).sum().backward()
+        assert lin.weight_g.grad is not None
+        assert lin.weight_v.grad is not None
+        U.remove_weight_norm(lin)
+        assert "weight" in [n for n, _ in lin.named_parameters()]
+        with pytest.raises(ValueError):
+            U.remove_weight_norm(lin)
+
+    def test_spectral_norm_wrapper(self):
+        from paddle_tpu.nn import utils as U
+        paddle.seed(1)
+        lin = nn.Linear(8, 6)
+        U.spectral_norm(lin, n_power_iterations=25)
+        lin(paddle.to_tensor(np.zeros((1, 8), np.float32)))
+        assert abs(np.linalg.svd(lin.weight.numpy())[1][0] - 1) < 1e-2
+
+    def test_grad_clipping(self):
+        from paddle_tpu.nn import utils as U
+        p = paddle.to_tensor(np.ones((3,), np.float32),
+                             stop_gradient=False)
+        (p * np.array([3., 4., 0.], np.float32)).sum().backward()
+        total = U.clip_grad_norm_([p], max_norm=1.0)
+        np.testing.assert_allclose(float(total.item()), 5.0, atol=1e-4)
+        np.testing.assert_allclose(np.linalg.norm(p.grad.numpy()), 1.0,
+                                   atol=1e-3)
+        p.grad = None
+        (p * 2).sum().backward()
+        U.clip_grad_value_([p], 0.5)
+        np.testing.assert_allclose(p.grad.numpy(), 0.5)
+
+    def test_parameter_vector_roundtrip(self):
+        from paddle_tpu.nn import utils as U
+        ps = nn.Linear(3, 2).parameters()
+        vec = U.parameters_to_vector(ps)
+        assert vec.shape == [8]
+        U.vector_to_parameters(vec * 0 + 1, ps)
+        for p in ps:
+            np.testing.assert_allclose(p.numpy(), 1.0)
+        with pytest.raises(ValueError):
+            U.vector_to_parameters(
+                paddle.to_tensor(np.zeros(5, np.float32)), ps)
