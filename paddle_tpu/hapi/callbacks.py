@@ -72,3 +72,91 @@ class LRScheduler(Callback):
     def __init__(self, by_step=True, by_epoch=False):
         self.by_step = by_step
         self.by_epoch = by_epoch
+
+
+class ReduceLROnPlateau(Callback):
+    """Shrink the optimizer lr when the monitored metric stalls
+    (reference: hapi callbacks.ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = patience
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.verbose = verbose
+        if mode not in ("auto", "min", "max"):
+            raise ValueError(f"mode must be auto/min/max, got {mode!r}")
+        if mode == "auto":
+            # the reference heuristic: accuracy-like metrics maximize
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self._cool = 0
+
+    def _improved(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self._cool > 0:
+            self._cool -= 1
+            return
+        if self._improved(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                old = opt.get_lr()
+                new = max(old * self.factor, self.min_lr)
+                if new < old:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {old:g} -> {new:g}")
+            self.wait = 0
+            self._cool = self.cooldown
+
+
+class VisualDL(Callback):
+    """Scalar logging callback. VisualDL itself is not in this
+    environment; scalars append to a JSONL file the dashboard (or any
+    tool) can tail — the callback surface matches the reference."""
+
+    def __init__(self, log_dir="./log"):
+        self.log_dir = log_dir
+        self._step = 0
+
+    def on_train_begin(self, logs=None):
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._f = open(f"{self.log_dir}/scalars.jsonl", "a")
+
+    def on_train_batch_end(self, step, logs=None):
+        import json
+        self._step += 1
+        rec = {"step": self._step}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(v)
+            except (TypeError, ValueError):
+                pass
+        self._f.write(json.dumps(rec) + "\n")
+
+    def on_train_end(self, logs=None):
+        if getattr(self, "_f", None):
+            self._f.close()
+
+
+__all__ += ["ReduceLROnPlateau", "VisualDL"]

@@ -72,29 +72,55 @@ class Model:
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
+        cbs = list(callbacks or [])
+        for cb in cbs:
+            cb.set_model(self)
+        for cb in cbs:
+            cb.on_train_begin({})
         history = []
         it = 0
-        for epoch in range(epochs):
-            t0 = time.time()
-            losses = []
-            for batch in loader:
-                x, y = batch[0], batch[1]
-                loss = self.train_batch(x, y)
-                losses.append(loss[0])
-                it += 1
-                if verbose and it % log_freq == 0:
-                    print(f"epoch {epoch} step {it}: "
-                          f"loss={np.mean(losses[-log_freq:]):.4f}")
+        stop = False
+        try:
+            for epoch in range(epochs):
+                t0 = time.time()
+                losses = []
+                for cb in cbs:
+                    cb.on_epoch_begin(epoch, {})
+                for batch in loader:
+                    x, y = batch[0], batch[1]
+                    step = it        # same index for begin AND end
+                    for cb in cbs:
+                        cb.on_train_batch_begin(step, {})
+                    loss = self.train_batch(x, y)
+                    losses.append(loss[0])
+                    it += 1
+                    batch_logs = {"loss": float(loss[0]), "step": step}
+                    for cb in cbs:
+                        cb.on_train_batch_end(step, batch_logs)
+                    if verbose and it % log_freq == 0:
+                        print(f"epoch {epoch} step {it}: "
+                              f"loss={np.mean(losses[-log_freq:]):.4f}")
+                    if num_iters is not None and it >= num_iters:
+                        break
+                history.append(float(np.mean(losses)))
+                epoch_logs = {"loss": history[-1], "epoch": epoch}
+                for cb in cbs:
+                    cb.on_epoch_end(epoch, epoch_logs)
+                if verbose:
+                    print(f"epoch {epoch}: loss={history[-1]:.4f} "
+                          f"({time.time() - t0:.1f}s)")
+                if save_dir and (epoch + 1) % save_freq == 0:
+                    self.save(os.path.join(save_dir, f"epoch_{epoch}"))
                 if num_iters is not None and it >= num_iters:
                     break
-            history.append(float(np.mean(losses)))
-            if verbose:
-                print(f"epoch {epoch}: loss={history[-1]:.4f} "
-                      f"({time.time() - t0:.1f}s)")
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(os.path.join(save_dir, f"epoch_{epoch}"))
-            if num_iters is not None and it >= num_iters:
-                break
+                if any(getattr(cb, "stopped", False) for cb in cbs):
+                    stop = True
+                    break
+        finally:
+            # a crash mid-training must still flush/close logging
+            # callbacks (that's exactly when their records matter)
+            for cb in cbs:
+                cb.on_train_end({"history": history, "stopped": stop})
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,

@@ -524,3 +524,50 @@ def device_prefetch(loader, size=2, sharding=None):
 
 
 __all__.append("device_prefetch")
+
+
+class ConcatDataset(Dataset):
+    """Concatenate map-style datasets (reference: io.ConcatDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        self._cum = np.cumsum([len(d) for d in self.datasets]).tolist()
+
+    def __len__(self):
+        return self._cum[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        if not 0 <= idx < len(self):
+            raise IndexError(
+                f"index {idx} out of range for ConcatDataset of length "
+                f"{len(self)}")
+        di = int(np.searchsorted(self._cum, idx, side="right"))
+        prev = self._cum[di - 1] if di else 0
+        return self.datasets[di][idx - prev]
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample the given indices in random order (reference parity).
+    ``generator`` may be a numpy Generator/RandomState or an int seed;
+    None draws from the global stream."""
+
+    def __init__(self, indices, generator=None):
+        self.indices = list(indices)
+        if isinstance(generator, (int, np.integer)):
+            generator = np.random.default_rng(int(generator))
+        self.generator = generator
+
+    def __iter__(self):
+        rng = self.generator if self.generator is not None else np.random
+        order = rng.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)
+
+
+__all__ += ["ConcatDataset", "SubsetRandomSampler"]

@@ -133,3 +133,50 @@ class TestDevicePrefetch:
         out = list(device_prefetch(iter(batches), size=1, sharding=sh))
         assert len(out) == 3
         assert out[0].sharding == sh
+
+
+class TestRound2IoAndCallbacks:
+    def test_concat_dataset_and_subset_random_sampler(self):
+        from paddle_tpu.io import ConcatDataset, SubsetRandomSampler
+        cd = ConcatDataset([list(range(3)), [100, 101]])
+        assert len(cd) == 5
+        assert cd[2] == 2 and cd[3] == 100 and cd[4] == 101
+        with pytest.raises(IndexError):
+            cd[5]
+        with pytest.raises(ValueError):
+            ConcatDataset([])
+        s = SubsetRandomSampler([1, 3, 4])
+        assert sorted(s) == [1, 3, 4] and len(s) == 3
+
+    def test_fit_dispatches_callbacks(self, tmp_path):
+        import json
+        from paddle_tpu.hapi.callbacks import (EarlyStopping,
+                                               ReduceLROnPlateau,
+                                               VisualDL)
+
+        class XY(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                rng = np.random.RandomState(i)
+                x = rng.rand(4).astype(np.float32)
+                return x, np.array([x.sum()], np.float32)
+
+        from paddle_tpu import nn, optimizer
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        m = paddle.Model(net)
+        opt = optimizer.Adam(learning_rate=0.1,
+                             parameters=net.parameters())
+        m.prepare(opt, nn.MSELoss())
+        m.fit(XY(), epochs=2, batch_size=8, verbose=0,
+              callbacks=[ReduceLROnPlateau(patience=1, verbose=0),
+                         VisualDL(log_dir=str(tmp_path))])
+        lines = (tmp_path / "scalars.jsonl").read_text().strip()
+        recs = [json.loads(x) for x in lines.splitlines()]
+        assert len(recs) == 4 and all("loss" in r for r in recs)
+        # EarlyStopping(patience=0) halts as soon as loss stops improving
+        h = m.fit(XY(), epochs=50, batch_size=8, verbose=0,
+                  callbacks=[EarlyStopping(monitor="loss", patience=0)])
+        assert len(h) < 50
