@@ -53,6 +53,13 @@ from .framework import iinfo, finfo
 
 # paddle API aliases
 from .param_attr import ParamAttr
+from .distributed.parallel import DataParallel
+from . import version
+
+
+def CUDAPlace(index=0):
+    """Parity alias: the accelerator place (TPU in this build)."""
+    return framework.Place("tpu", index)
 from .linalg import inv as inverse  # paddle.inverse (top-level alias)
 from .serialization import save, load
 from .utils.run_check import run_check

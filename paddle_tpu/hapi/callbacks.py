@@ -108,11 +108,14 @@ class ReduceLROnPlateau(Callback):
         cur = (logs or {}).get(self.monitor)
         if cur is None:
             return
+        improved = self._improved(cur)
+        if improved:
+            self.best = cur       # track best EVEN during cooldown
         if self._cool > 0:
             self._cool -= 1
+            self.wait = 0
             return
-        if self._improved(cur):
-            self.best = cur
+        if improved:
             self.wait = 0
             return
         self.wait += 1
@@ -136,7 +139,6 @@ class VisualDL(Callback):
 
     def __init__(self, log_dir="./log"):
         self.log_dir = log_dir
-        self._step = 0
 
     def on_train_begin(self, logs=None):
         import os
@@ -145,8 +147,7 @@ class VisualDL(Callback):
 
     def on_train_batch_end(self, step, logs=None):
         import json
-        self._step += 1
-        rec = {"step": self._step}
+        rec = {"step": int(step)}
         for k, v in (logs or {}).items():
             try:
                 rec[k] = float(v)

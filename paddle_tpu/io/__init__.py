@@ -545,7 +545,7 @@ class ConcatDataset(Dataset):
             raise IndexError(
                 f"index {idx} out of range for ConcatDataset of length "
                 f"{len(self)}")
-        di = int(np.searchsorted(self._cum, idx, side="right"))
+        di = bisect.bisect_right(self._cum, idx)
         prev = self._cum[di - 1] if di else 0
         return self.datasets[di][idx - prev]
 

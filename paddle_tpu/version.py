@@ -1,0 +1,24 @@
+"""paddle.version parity (reference: generated python/paddle/version.py).
+The framework's own version; `full_version`/`commit` mirror the
+reference's fields."""
+full_version = "0.2.0"
+major = "0"
+minor = "2"
+patch = "0"
+commit = "tpu-native"
+cuda_version = "False"      # no CUDA: TPU-native build
+cudnn_version = "False"
+tensorrt_version = "False"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit}; TPU-native, "
+          "no CUDA)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
