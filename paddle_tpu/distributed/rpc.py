@@ -83,7 +83,6 @@ class _Agent:
         self._conns: dict[str, socket.socket] = {}
         self._locks: dict[str, threading.Lock] = {}
         self._conn_lock = threading.Lock()
-        self._threads: list[threading.Thread] = []
         t = threading.Thread(target=self._serve_loop, daemon=True,
                              name=f"rpc-serve-{name}")
         t.start()
@@ -102,10 +101,10 @@ class _Agent:
                 continue
             except OSError:
                 break
-            t = threading.Thread(target=self._handle, args=(conn,),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
+            # daemon handler threads are fire-and-forget: retaining them
+            # would leak one Thread object per client connection
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
 
     def _handle(self, conn: socket.socket):
         try:
@@ -191,6 +190,10 @@ class _Agent:
                 s.settimeout(None)
             return status == "ok"
         except Exception:
+            # the socket may hold a late ping reply; a reused connection
+            # would read it as the NEXT call's result — evict at the
+            # source instead of relying on callers to drop_conn
+            self._evict(info.name)
             return False
 
     def drop_conn(self, name):

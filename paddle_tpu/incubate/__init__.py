@@ -27,10 +27,13 @@ class LookAhead:
 
     def step(self):
         import jax.numpy as jnp
-        self.inner_optimizer.step()
-        self._step += 1
+        # slow weights start from w0 (the params BEFORE the first inner
+        # step), matching the reference's copy-at-wrap-time semantics —
+        # snapshotting after inner step would interpolate from w1
         if self._slow is None:
             self._slow = [p._value for p in self._params()]
+        self.inner_optimizer.step()
+        self._step += 1
         if self._step % self.k == 0:
             for i, p in enumerate(self._params()):
                 slow = self._slow[i] + self.alpha * (

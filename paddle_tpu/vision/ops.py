@@ -178,20 +178,28 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             y1 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
             rh = jnp.maximum(y1 - y0 + 1, 1)
             rw = jnp.maximum(x1 - x0 + 1, 1)
-            ys = y0 + (jnp.arange(oh)[:, None] * rh) // oh
-            ye = y0 + ((jnp.arange(oh)[:, None] + 1) * rh + oh - 1) // oh
-            xs = x0 + (jnp.arange(ow)[None, :] * rw) // ow
-            xe = x0 + ((jnp.arange(ow)[None, :] + 1) * rw + ow - 1) // ow
-            # evaluate on a dense grid with -inf outside each bin
+            ys = y0 + (jnp.arange(oh) * rh) // oh
+            ye = y0 + ((jnp.arange(oh) + 1) * rh + oh - 1) // oh
+            xs = x0 + (jnp.arange(ow) * rw) // ow
+            xe = x0 + ((jnp.arange(ow) + 1) * rw + ow - 1) // ow
+            # max over a rectangle is separable: rows first, then cols —
+            # peak temp is one (C, H, W) masked copy per sequential bin
+            # instead of the (C, oh, ow, H, W) bin-mask outer product
             yy = jnp.arange(h)
             xx = jnp.arange(w)
-            in_y = (yy[None, None, :] >= ys[..., None]) & \
-                (yy[None, None, :] < ye[..., None])      # (oh,1,H)
-            in_x = (xx[None, None, :] >= xs[..., None]) & \
-                (xx[None, None, :] < xe[..., None])      # (1,ow,W)
-            mask = in_y[:, :, :, None] & in_x[:, :, None, :]  # (oh,ow,H,W)
-            vals = jnp.where(mask[None], fo[:, None, None], -jnp.inf)
-            out = jnp.max(vals, axis=(3, 4))
+
+            def row_bin(i):
+                m = (yy >= ys[i]) & (yy < ye[i])
+                return jnp.max(jnp.where(m[None, :, None], fo, -jnp.inf),
+                               axis=1)                      # (C, W)
+            rows = jax.lax.map(row_bin, jnp.arange(oh))     # (oh, C, W)
+
+            def col_bin(j):
+                m = (xx >= xs[j]) & (xx < xe[j])
+                return jnp.max(jnp.where(m[None, None, :], rows, -jnp.inf),
+                               axis=2)                      # (ow->, oh, C)
+            cols = jax.lax.map(col_bin, jnp.arange(ow))     # (ow, oh, C)
+            out = jnp.transpose(cols, (2, 1, 0))            # (C, oh, ow)
             # bins entirely outside the map (roi past the image edge)
             # pool to 0, matching the reference's clamped-bin behavior
             return jnp.where(jnp.isfinite(out), out, 0.0)

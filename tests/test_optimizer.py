@@ -253,16 +253,15 @@ def test_lookahead_slow_weights():
     p = Parameter(np.ones((2,), np.float32))
     inner = optimizer.SGD(learning_rate=0.1, parameters=[p])
     la = LookAhead(inner, alpha=0.5, k=2)
-    # manual reference
+    # manual reference: slow weights start as a copy of w0 (wrap-time
+    # snapshot, reference lookahead.py semantics)
     w = np.ones(2, np.float64)
-    slow = None
+    slow = w.copy()
     for step in range(1, 5):
         (p * p).sum().backward()
         la.step()
         la.clear_grad()
         w = w - 0.1 * 2 * w
-        if slow is None:
-            slow = w.copy()
         if step % 2 == 0:
             slow = slow + 0.5 * (w - slow)
             w = slow.copy()
