@@ -426,13 +426,24 @@ class Engine:
             log_freq=50):
         step = self._ensure_step()
         loader = self._resolve_loader(train_data, batch_size)
+        import jax
         for epoch in range(epochs):
-            for it, batch in enumerate(loader):
-                loss = step(tuple(batch))
-                self.history["loss"].append(float(loss.item()))
-                if verbose and it % log_freq == 0:
-                    print(f"epoch {epoch} step {it}: "
-                          f"loss {self.history['loss'][-1]:.4f}")
+            # losses stay on device inside the epoch: a per-step
+            # float(loss.item()) forces a device→host sync each step and
+            # defeats XLA async dispatch (reference logs on log_freq)
+            pend = []
+            try:
+                for it, batch in enumerate(loader):
+                    loss = step(tuple(batch))
+                    pend.append(loss._value)
+                    if verbose and it % log_freq == 0:
+                        print(f"epoch {epoch} step {it}: "
+                              f"loss {float(pend[-1]):.4f}")
+            finally:
+                # a mid-epoch crash/interrupt must not lose the completed
+                # steps' losses from history
+                self.history["loss"].extend(
+                    float(v) for v in jax.device_get(pend))
         return self.history
 
     def evaluate(self, eval_data, batch_size=32):

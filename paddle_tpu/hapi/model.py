@@ -77,13 +77,19 @@ class Model:
             cb.set_model(self)
         for cb in cbs:
             cb.on_train_begin({})
+        if self._train_step is None:
+            self._make_step()
+        # subclasses overriding train_batch (the documented customization
+        # point) keep their hook — only the base implementation is safe
+        # to bypass with the no-sync fast path
+        custom_step = type(self).train_batch is not Model.train_batch
         history = []
         it = 0
         stop = False
         try:
             for epoch in range(epochs):
                 t0 = time.time()
-                losses = []
+                losses = []      # device scalars — fetched once per epoch
                 for cb in cbs:
                     cb.on_epoch_begin(epoch, {})
                 for batch in loader:
@@ -91,18 +97,31 @@ class Model:
                     step = it        # same index for begin AND end
                     for cb in cbs:
                         cb.on_train_batch_begin(step, {})
-                    loss = self.train_batch(x, y)
-                    losses.append(loss[0])
+                    if custom_step or cbs:
+                        # callbacks' contract is a per-batch float loss
+                        # (the sync is the price of attaching them)
+                        lossf = self.train_batch(x, y)[0]
+                        losses.append(lossf)
+                        batch_logs = {"loss": float(lossf), "step": step}
+                    else:
+                        # fast path: keep the loss on device — a
+                        # per-step float() would force a device→host
+                        # sync and defeat XLA async dispatch (the
+                        # reference logs on log_freq only)
+                        xv = x[0] if isinstance(x, (list, tuple)) else x
+                        yv = y[0] if isinstance(y, (list, tuple)) else y
+                        losses.append(self._train_step((xv, yv))._value)
+                        batch_logs = None
                     it += 1
-                    batch_logs = {"loss": float(loss[0]), "step": step}
                     for cb in cbs:
                         cb.on_train_batch_end(step, batch_logs)
                     if verbose and it % log_freq == 0:
                         print(f"epoch {epoch} step {it}: "
-                              f"loss={np.mean(losses[-log_freq:]):.4f}")
+                              f"loss={float(losses[-1]):.4f}")
                     if num_iters is not None and it >= num_iters:
                         break
-                history.append(float(np.mean(losses)))
+                import jax
+                history.append(float(np.mean(jax.device_get(losses))))
                 epoch_logs = {"loss": history[-1], "epoch": epoch}
                 for cb in cbs:
                     cb.on_epoch_end(epoch, epoch_logs)
