@@ -196,4 +196,28 @@ def fused_linear_cross_entropy(x, weight, labels, num_chunks=16,
     return apply_op(f, x, weight, labels)
 
 
-__all__ += ["fused_linear_cross_entropy"]
+def parallel_fused_linear_cross_entropy(x, weight, labels, mesh=None,
+                                        axis="mp", num_chunks=8,
+                                        ignore_index=-100, name=None):
+    """TP-composable chunked fused CE (reference ParallelCrossEntropy,
+    fleet/layers/mpu/mp_layers.py — verify, fused with the chunked
+    lm-head): ``weight`` (V, D) vocab-sharded over the mesh ``axis``.
+    Falls back to the single-shard kernel when the mesh has no such
+    axis or its degree is 1."""
+    from ...tensor import apply_op
+    from ...distributed.mesh import get_current_mesh
+    mesh = mesh or get_current_mesh()
+    if mesh is None or axis not in mesh.axis_names \
+            or int(mesh.shape[axis]) == 1:
+        return fused_linear_cross_entropy(x, weight, labels,
+                                          num_chunks, ignore_index)
+    from .fused_ce import parallel_fused_linear_cross_entropy as _kernel
+
+    def f(h, w, lab):
+        return _kernel(h, w, lab, mesh=mesh, axis=axis,
+                       num_chunks=num_chunks, ignore_index=ignore_index)
+    return apply_op(f, x, weight, labels)
+
+
+__all__ += ["fused_linear_cross_entropy",
+            "parallel_fused_linear_cross_entropy"]

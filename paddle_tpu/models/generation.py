@@ -50,19 +50,40 @@ def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
 
 
 def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
-                     sin=None, window=None):
+                     sin=None, window=None, pad=None):
     """KV-cache attention step (pure jax), shared by every causal LM:
     optional RoPE at offset ``posv`` (cos=None skips it — e.g. GPT's
     learned positions), k/v written into the preallocated cache with
     dynamic_update_slice, causal attention over cache[:pos+s]. GQA uses
     grouped einsums — the kv cache is never materialized at q-head
-    count. Static shapes: one compiled program serves every position."""
+    count. Static shapes: one compiled program serves every position.
+
+    ``pad`` (b,) int32: per-row LEFT-padding counts for ragged batches
+    (reference decoding handles padded batches — SURVEY §3.5). Rows'
+    RoPE positions are shifted back by their pad count and cache slots
+    below ``pad`` are masked out of every later attention."""
     b, s, h, d = qv.shape
     if cos is not None:
-        from ..ops.pallas.fused import fused_rope
-        c = jax.lax.dynamic_slice_in_dim(cos, posv, s, 0).astype(qv.dtype)
-        sn = jax.lax.dynamic_slice_in_dim(sin, posv, s, 0).astype(qv.dtype)
-        qv, kv_ = fused_rope(qv, kv_, c, sn)
+        if pad is None:
+            from ..ops.pallas.fused import fused_rope
+            c = jax.lax.dynamic_slice_in_dim(cos, posv, s,
+                                             0).astype(qv.dtype)
+            sn = jax.lax.dynamic_slice_in_dim(sin, posv, s,
+                                              0).astype(qv.dtype)
+            qv, kv_ = fused_rope(qv, kv_, c, sn)
+        else:
+            # per-row positions: real-token index = slot - pad  (left
+            # padding keeps real tokens contiguous at the end)
+            positions = jnp.clip(
+                posv + jnp.arange(s)[None, :] - pad[:, None], 0, None)
+            c = cos[positions].astype(qv.dtype)      # (b, s, d)
+            sn = sin[positions].astype(qv.dtype)
+
+            def rope(x):
+                x1, x2 = jnp.split(x, 2, axis=-1)
+                rot = jnp.concatenate([-x2, x1], axis=-1)
+                return x * c[:, :, None, :] + rot * sn[:, :, None, :]
+            qv, kv_ = rope(qv), rope(kv_)
     ck = jax.lax.dynamic_update_slice(ckv, kv_.astype(ckv.dtype),
                                       (0, posv, 0, 0))
     cv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
@@ -77,7 +98,10 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
     mask = t_idx[None, :] <= q_idx[:, None]            # (s, T) causal
     if window is not None:                     # sliding window: last W
         mask = mask & (t_idx[None, :] > q_idx[:, None] - int(window))
-    scores = jnp.where(mask[None, None, None], scores,
+    mask = mask[None]                                  # (1|b, s, T)
+    if pad is not None:                        # padded slots never attend
+        mask = mask & (t_idx[None, None, :] >= pad[:, None, None])
+    scores = jnp.where(mask[:, None, None], scores,
                        jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, cv)
@@ -95,7 +119,7 @@ def build_decode_step(model, sample_kwargs, tree_holder):
     ptensors = [p for _, p in model.named_parameters()]
     btensors = [b for _, b in model.named_buffers()]
 
-    def pure(pv, bv, token, cache_flat, pos, key=None):
+    def pure(pv, bv, token, cache_flat, pos, key=None, pad=None):
         saved = [(t, t._value) for t in ptensors + btensors]
         was_training = model.training
         try:
@@ -106,9 +130,10 @@ def build_decode_step(model, sample_kwargs, tree_holder):
             model.eval()   # no dropout inside the decode program
             cache = jax.tree.unflatten(tree_holder["tree"], [
                 Tensor(c) for c in cache_flat])
+            kw = {} if pad is None else {"pad": Tensor(pad)}
             with framework.functional_mode(), framework.no_grad_guard():
                 logits, new_cache = model.forward(
-                    Tensor(token), cache=cache, pos=Tensor(pos))
+                    Tensor(token), cache=cache, pos=Tensor(pos), **kw)
             lv = logits._value[:, -1, :].astype(jnp.float32)
             new_flat = [c._value for c in jax.tree.leaves(
                 new_cache, is_leaf=lambda x: isinstance(x, Tensor))]
@@ -238,16 +263,43 @@ class GenerationMixin:
                  top_p: float = 1.0, do_sample: bool = False,
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  max_length: Optional[int] = None, num_beams: int = 1,
-                 length_penalty: float = 0.0):
+                 length_penalty: float = 0.0, attention_mask=None):
         """Greedy (temperature<=0 / do_sample=False), sampled, or
         beam-search (num_beams>1) decoding with a preallocated KV cache
         and one jitted decode step.
+
+        ``attention_mask`` (b, s) 0/1: LEFT-padded ragged prompts
+        (zeros first, HF convention) — per-row RoPE offsets and key
+        masking make batched ragged decode match per-sequence decode
+        exactly (reference: PaddleNLP padded-batch decoding — verify).
 
         Returns (b, s+new) int Tensor of prompt + generated ids (rows
         that hit ``eos_token_id`` are padded with eos)."""
         ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(jnp.asarray(np.asarray(input_ids), jnp.int32))
         b, s = ids.shape
+        pad = None
+        if attention_mask is not None:
+            import inspect
+            if "pad" not in inspect.signature(
+                    type(self).forward).parameters:
+                raise ValueError(
+                    f"{type(self).__name__}.forward does not accept "
+                    "per-row pad counts — ragged (attention_mask) "
+                    "decoding is unsupported for this model; decode "
+                    "unpadded batches instead")
+            am = attention_mask.numpy() if isinstance(
+                attention_mask, Tensor) else np.asarray(attention_mask)
+            if am.shape != (b, s):
+                raise ValueError(f"attention_mask shape {am.shape} != "
+                                 f"prompt shape {(b, s)}")
+            if not (np.sort(am, axis=1) == am).all():
+                raise ValueError(
+                    "attention_mask must be LEFT-padded (all zeros "
+                    "before ones in every row)")
+            pad = jnp.asarray(s - am.sum(axis=1), jnp.int32)   # (b,)
+            if not bool((pad < s).all()):
+                raise ValueError("attention_mask has an all-pad row")
         total = max_length or (s + max_new_tokens)
         max_new = total - s
         if max_new <= 0:
@@ -269,6 +321,10 @@ class GenerationMixin:
                 raise ValueError("num_beams>1 with do_sample=True is not "
                                  "supported (beam sampling); use one or "
                                  "the other")
+            if pad is not None:
+                raise ValueError("attention_mask with num_beams>1 is not "
+                                 "yet supported; decode ragged batches "
+                                 "with greedy/sampled generate")
             return self._beam_search(ids, max_new, total, num_beams,
                                      eos_token_id, length_penalty)
         if not do_sample:
@@ -291,7 +347,7 @@ class GenerationMixin:
         ids_arr = ids._value.astype(jnp.int32)
         # prefill: the same compiled step with a length-s block at pos 0
         tok, cache_flat = decode(pv, bv, ids_arr, cache_flat,
-                                 jnp.asarray(0, jnp.int32), sub)
+                                 jnp.asarray(0, jnp.int32), sub, pad)
 
         out_tokens = [tok]
         finished = jnp.zeros((b,), bool)
@@ -301,7 +357,7 @@ class GenerationMixin:
             key, sub = jax.random.split(key)
             pos = jnp.asarray(s + i - 1, jnp.int32)
             tok, cache_flat = decode(pv, bv, tok[:, None], cache_flat,
-                                     pos, sub)
+                                     pos, sub, pad)
             if eos_token_id is not None:
                 tok = jnp.where(finished, eos_token_id, tok)
                 finished = finished | (tok == eos_token_id)

