@@ -281,6 +281,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     """Host-level point-to-point send over the TCPStore transport.
     ``dst`` is the GLOBAL rank (reference semantics, same convention as
     broadcast/scatter); ``group`` only namespaces the exchange."""
+    _warn_if_bulk(_val(tensor), "send")
     store = _get_store()
     src = _my_rank()
     gid = group.id if group else 0
@@ -376,9 +377,33 @@ def _coll_round(group, op_name, me) -> int:
         return seq
 
 
+_BULK_WARNED = False
+
+
+def _warn_if_bulk(value, op_name):
+    """The store path is a CONTROL-PLANE transport (pickle over the TCP
+    store, O(world) per member) — bulk tensor exchange belongs inside
+    jit where XLA collectives ride ICI. Warn once instead of silently
+    delivering NCCL-class expectations at store speed."""
+    global _BULK_WARNED
+    try:
+        nbytes = int(np.asarray(value).nbytes)
+    except Exception:
+        return
+    if nbytes > (1 << 20) and not _BULK_WARNED:
+        _BULK_WARNED = True
+        import warnings
+        warnings.warn(
+            f"eager {op_name} of {nbytes / 1e6:.1f} MB rides the host "
+            "TCP store (control-plane transport, O(world) per member); "
+            "for bulk data use collectives inside jit/shard_map where "
+            "XLA lowers them to ICI", RuntimeWarning)
+
+
 def _store_gather(value, group, op_name):
     """All group members contribute `value`; returns the list of all
     members' values ordered by group.ranks. Last reader cleans up."""
+    _warn_if_bulk(value, op_name)
     store = _get_store()
     me = group.rank
     rnd = _coll_round(group, op_name, me)

@@ -36,9 +36,13 @@ class HookRemoveHelper:
 
 
 class Layer:
-    def __init__(self, name_scope=None, dtype="float32"):
+    def __init__(self, name_scope=None, dtype=None):
+        # dtype=None defers to paddle.set_default_dtype at parameter
+        # creation time (reference: set_default_dtype governs parameter
+        # creation; a hard "float32" here would pin bf16-built models'
+        # params to f32 — 2x the HBM for weights AND optimizer moments)
         self.training = True
-        self._dtype = convert_dtype(dtype)
+        self._dtype = convert_dtype(dtype) if dtype is not None else None
         self._parameters = collections.OrderedDict()
         self._sub_layers = collections.OrderedDict()
         self._buffers = collections.OrderedDict()

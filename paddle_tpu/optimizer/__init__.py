@@ -162,6 +162,17 @@ class Optimizer:
             self._slots[name] = slots
         return self._slots[name]
 
+    @staticmethod
+    def _keep_slot_dtypes(old, new):
+        """_apply math runs in fp32; slots must come back in their
+        DECLARED dtype (bf16 states silently promoting to fp32 would
+        retrace the train step with different avals AND double the
+        optimizer-state memory the bf16 budget depends on)."""
+        return {k: (v.astype(old[k].dtype)
+                    if k in old and hasattr(v, "astype")
+                    and v.dtype != old[k].dtype else v)
+                for k, v in new.items()}
+
     def step(self):
         named = list(zip(self._param_names, self._param_list))
         grads = {n: p.grad._value for n, p in named
@@ -185,12 +196,14 @@ class Optimizer:
                     master, g.astype(jnp.float32),
                     {k: v for k, v in slots.items() if k != "master"},
                     plr, self._step_count)
+                new_slots = self._keep_slot_dtypes(slots, new_slots)
                 new_slots["master"] = new_master
                 p._update_value(new_master.astype(p._value.dtype))
             else:
                 new_p, new_slots = self._apply(p._value, g, slots, plr,
                                                self._step_count)
-                p._update_value(new_p)
+                new_slots = self._keep_slot_dtypes(slots, new_slots)
+                p._update_value(new_p.astype(p._value.dtype))
             self._slots[n] = new_slots
 
     def clear_grad(self, set_to_zero=False):
@@ -250,12 +263,15 @@ class Optimizer:
                                              if k != "master"}
                 new_master, ns = self._apply(master, g.astype(jnp.float32),
                                              rest, lr_value, step)
+                ns = self._keep_slot_dtypes(s, ns)
                 ns["master"] = new_master
                 new_params[n] = new_master.astype(p.dtype)
                 new_slots[n] = ns
             else:
-                new_params[n], new_slots[n] = self._apply(p, g, s, lr_value,
-                                                          step)
+                new_p, ns = self._apply(p, g, s, lr_value, step)
+                new_params[n] = new_p.astype(p.dtype) \
+                    if hasattr(new_p, "astype") else new_p
+                new_slots[n] = self._keep_slot_dtypes(s, ns)
         if self._slot_constrain is not None:
             new_slots = {n: {k: self._slot_constrain(v, n, k)
                              for k, v in s.items()}
@@ -346,10 +362,19 @@ class Adam(Optimizer):
         self._decoupled = False
 
     def _init_slots(self, p):
-        s = {"moment1": jnp.zeros_like(p, jnp.float32),
-             "moment2": jnp.zeros_like(p, jnp.float32)}
+        # paddle semantics: moments live in the PARAM dtype unless
+        # multi_precision keeps an fp32 master (then fp32 moments). A
+        # bf16-built model with multi_precision=False therefore carries
+        # bf16 states — 2 bytes/param/moment, the "bf16 states" memory
+        # budget the ~1B single-chip config depends on. fp32 moments
+        # (multi_precision=True) remain the accuracy-safe default for
+        # mixed-precision training via amp.decorate.
+        mdt = jnp.float32 if (self._multi_precision
+                              or p.dtype == jnp.float32) else p.dtype
+        s = {"moment1": jnp.zeros_like(p, mdt),
+             "moment2": jnp.zeros_like(p, mdt)}
         if self._amsgrad:
-            s["moment2_max"] = jnp.zeros_like(p, jnp.float32)
+            s["moment2_max"] = jnp.zeros_like(p, mdt)
         return s
 
     def _apply(self, p, g, slots, lr, step):

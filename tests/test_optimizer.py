@@ -280,3 +280,81 @@ def test_model_average_apply_restore():
     with ma.apply():
         np.testing.assert_allclose(p.numpy(), 3.0)
     np.testing.assert_allclose(p.numpy(), 4.0)   # restored
+
+
+def test_moment_dtype_follows_param():
+    """paddle semantics: moments live in the param dtype unless
+    multi_precision keeps an fp32 master (the bf16-states budget the
+    ~1B single-chip config depends on) — and they must STAY that dtype
+    across steps (fp32 _apply math casting back), or the train step
+    retraces with different avals and state memory doubles."""
+    import jax.numpy as jnp
+    from paddle_tpu.tensor import Parameter
+    p_bf = Parameter(jnp.zeros((4,), jnp.bfloat16))
+    p_f32 = Parameter(jnp.zeros((4,), jnp.float32))
+    opt = optimizer.AdamW(learning_rate=0.1, parameters=[p_bf, p_f32])
+    s_bf = opt._init_slots(p_bf._value)
+    s_f32 = opt._init_slots(p_f32._value)
+    assert s_bf["moment1"].dtype == jnp.bfloat16
+    assert s_bf["moment2"].dtype == jnp.bfloat16
+    assert s_f32["moment1"].dtype == jnp.float32
+    opt_mp = optimizer.AdamW(learning_rate=0.1, parameters=[p_bf],
+                             multi_precision=True)
+    assert opt_mp._init_slots(p_bf._value)["moment1"].dtype == jnp.float32
+    # two eager steps: slots + param keep bf16 (incl. the fused-AdamW
+    # path exercised on step 2 when slots already exist)
+    for _ in range(2):
+        p_bf.grad = paddle.to_tensor(
+            np.ones((4,), np.float32)).astype("bfloat16")
+        opt.step()
+    name = opt._param_names[0]
+    assert opt._slots[name]["moment1"].dtype == jnp.bfloat16
+    assert opt._slots[name]["moment2"].dtype == jnp.bfloat16
+    assert p_bf._value.dtype == jnp.bfloat16
+
+
+def test_bf16_states_stable_through_train_step():
+    """TrainStep (functional path): bf16 params + bf16 moments must not
+    change avals between step 1 and step 2 (a promotion would force a
+    full retrace/recompile of the train program)."""
+    import jax.numpy as jnp
+    from paddle_tpu.jit import TrainStep
+    paddle.set_default_dtype("bfloat16")
+    try:
+        paddle.seed(0)
+        net = nn.Linear(8, 8, bias_attr=False)
+    finally:
+        paddle.set_default_dtype("float32")
+    assert net.weight.dtype == jnp.bfloat16
+    opt = optimizer.AdamW(learning_rate=0.01,
+                          parameters=net.parameters(),
+                          multi_precision=False)
+
+    def loss_fn(m, b):
+        return (m(b) ** 2).mean()
+
+    step = TrainStep(net, loss_fn, opt)
+    x = paddle.to_tensor(np.ones((2, 8), np.float32)).astype("bfloat16")
+    name = opt._param_names[0]
+    for _ in range(2):      # step 2 runs with step-1's returned slots
+        step(x)
+        for k in ("moment1", "moment2"):
+            got = opt._slots[name][k].dtype
+            assert got == jnp.bfloat16, (k, got)
+        assert net.weight.dtype == jnp.bfloat16
+
+
+def test_default_dtype_governs_parameter_creation():
+    """set_default_dtype must reach Layer parameter creation
+    (reference: paddle.set_default_dtype governs parameter creation)."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    paddle.set_default_dtype("bfloat16")
+    try:
+        l = nn.Linear(4, 4)
+        assert l.weight.dtype == jnp.bfloat16, l.weight.dtype
+    finally:
+        paddle.set_default_dtype("float32")
+    l2 = nn.Linear(4, 4)
+    assert l2.weight.dtype == jnp.float32
