@@ -51,10 +51,14 @@ def _bench_train(model_cfg, batch, seq, steps, warmup, peak,
     if getattr(model_cfg, "dtype", "float32") == "bfloat16":
         # pure-bf16 build: params AND Adam moments in bf16
         # (2 bytes x 3 per param) — the memory budget that fits ~1B on
-        # one 16 GB v5e chip; no AMP wrapper needed
+        # one 16 GB v5e chip; no AMP wrapper needed. finally: a failed
+        # build (e.g. OOM) must not leak the bf16 default into later
+        # stages of this child
         paddle.set_default_dtype("bfloat16")
-        model = LlamaForCausalLM(model_cfg)
-        paddle.set_default_dtype("float32")
+        try:
+            model = LlamaForCausalLM(model_cfg)
+        finally:
+            paddle.set_default_dtype("float32")
         opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
                               parameters=model.parameters(),
                               multi_precision=False)

@@ -77,7 +77,7 @@ _METHODS = [
     "remainder", "mod", "floor_divide", "floor_mod", "multiply_", "add_",
     "subtract_", "scale_", "clip_", "remainder_", "zero_", "stack",
     "unstack", "diagonal", "tril", "triu", "moveaxis", "flip",
-    "count_nonzero", "nan_to_num", "neg", "atan2",
+    "count_nonzero", "nan_to_num", "neg", "atan2", "frexp", "ldexp",
 ]
 
 for m in _METHODS:

@@ -961,6 +961,16 @@ def ldexp(x, y, name=None):
         y if isinstance(y, Tensor) else jnp.asarray(y))
 
 
+def frexp(x, name=None):
+    """Decompose into mantissa in [0.5, 1) and integer exponent with
+    x = mantissa * 2**exponent (reference: paddle.frexp,
+    python/paddle/tensor/math.py — verify). Zeros yield (0, 0)."""
+    def f(v):
+        m, e = jnp.frexp(v)
+        return m, e.astype(v.dtype)       # paddle returns same-dtype exp
+    return apply_op(f, x)
+
+
 def i0e(x, name=None):
     return apply_op(jax.scipy.special.i0e, x)
 
@@ -1062,8 +1072,8 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
     return out[0], list(out[1:])
 
 
-__all__ += ["sinc", "signbit", "exp2", "float_power", "ldexp", "i0e",
-            "i1e", "polygamma", "multigammaln", "trapezoid",
+__all__ += ["sinc", "signbit", "exp2", "float_power", "ldexp", "frexp",
+            "i0e", "i1e", "polygamma", "multigammaln", "trapezoid",
             "cumulative_trapezoid", "vander", "nanquantile", "renorm",
             "cdist", "baddbmm", "histogramdd"]
 

@@ -232,3 +232,8 @@ def transpose(x, perm, name=None):
     if was_csr:
         return SparseCsrTensor(jsparse.BCSR.from_bcoo(out.sum_duplicates()))
     return SparseCooTensor(out)
+
+
+# sparse.nn must import after the containers above (it depends on them)
+from . import nn                                            # noqa: E402
+__all__ += ["nn"]

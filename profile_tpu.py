@@ -39,6 +39,7 @@ def _op_breakdown(trace_dir):
             data = data.decode()
         return json.loads(data) if isinstance(data, str) else data
 
+    err1 = None
     try:
         tree = load("op_profile")
         root = tree.get("byCategory") or {}
@@ -54,10 +55,10 @@ def _op_breakdown(trace_dir):
         if cats:
             return {"source": "op_profile", "device_type":
                     tree.get("deviceType"), "categories": cats[:15]}, None
-    except Exception as e:
-        return None, f"op_profile: {type(e).__name__}: {e}"
+    except Exception as e:  # degraded trace: fall through to op stats
+        err1 = f"op_profile: {type(e).__name__}: {e}"
 
-    try:  # host-only trace (CPU machinery test): per-op stats table
+    try:  # host-only / degraded trace: per-op stats table
         tables = load("framework_op_stats")
         table = tables[0] if isinstance(tables, list) else tables
         idx = {c["id"]: i for i, c in enumerate(table.get("cols", []))}
@@ -69,14 +70,28 @@ def _op_breakdown(trace_dir):
                 i = idx.get(key)
                 return c[i].get("v") if i is not None and i < len(c) \
                     else None
-            rows.append({"type": val("type"),
-                         "op": val("operation"),
-                         "self_time_frac": val("total_self_time_percent")
-                         or val("selfTimePercent")})
+
+            def first_num(*keys):
+                for k in keys:
+                    v = val(k)
+                    if v is not None:
+                        return v
+                return None
+            rows.append({
+                "where": val("host_or_device"),
+                "type": val("type"),
+                "op": val("operation"),
+                "total_self_time": val("total_self_time"),
+                "self_time_pct": first_num(
+                    "device_total_self_time_percent",
+                    "host_total_self_time_percent"),
+                "bound_by": val("bound_by"),
+            })
         rows = [r for r in rows if r["op"]]
-        return {"source": "framework_op_stats", "rows": rows}, None
+        return {"source": "framework_op_stats", "rows": rows}, err1
     except Exception as e:
-        return None, f"framework_op_stats: {type(e).__name__}: {e}"
+        return None, f"{err1 + '; ' if err1 else ''}" \
+            f"framework_op_stats: {type(e).__name__}: {e}"
 
 
 def main():

@@ -1,0 +1,140 @@
+"""sparse.nn tests: Conv3D/SubmConv3D/BatchNorm/attention vs dense
+references."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+class TestSparseNN:
+    """sparse.nn Conv3D/SubmConv3D/BatchNorm/attention vs dense
+    references (VERDICT r2: SURVEY §2.2 sparse row was partial — no
+    sparse conv3d / attention ops)."""
+
+    def _voxels(self, N=2, D=6, H=5, W=7, C=3, nnz=25, seed=0):
+        rs = np.random.RandomState(seed)
+        coords = set()
+        while len(coords) < nnz:
+            coords.add((rs.randint(N), rs.randint(D), rs.randint(H),
+                        rs.randint(W)))
+        idx = np.array(sorted(coords)).T                 # (4, nnz)
+        vals = rs.randn(idx.shape[1], C).astype(np.float32)
+        x = paddle.sparse.sparse_coo_tensor(
+            idx, vals, shape=(N, D, H, W, C))
+        dense = np.zeros((N, D, H, W, C), np.float32)
+        dense[tuple(idx)] = vals
+        return x, dense
+
+    def _dense_conv(self, dense, w, stride, padding):
+        import jax.numpy as jnp
+        out = jax.lax.conv_general_dilated(
+            jnp.asarray(dense), jnp.asarray(w),
+            window_strides=(stride,) * 3,
+            padding=[(padding,) * 2] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+        return np.asarray(out)
+
+    @pytest.mark.parametrize("stride,padding", [(1, 1), (2, 1), (1, 0)])
+    def test_conv3d_matches_dense(self, stride, padding):
+        from paddle_tpu.sparse.nn import Conv3D
+        x, dense = self._voxels()
+        conv = Conv3D(3, 4, kernel_size=3, stride=stride, padding=padding)
+        out = conv(x)
+        ref = self._dense_conv(dense, np.asarray(conv.weight._value),
+                               stride, padding)
+        ref = ref + np.asarray(conv.bias._value)
+        got = np.asarray(out.to_dense().numpy())
+        # sparse conv only materializes sites reachable from an active
+        # input voxel; compare on those sites and assert the rest of ref
+        # is bias-only
+        oi = np.asarray(out.indices())
+        np.testing.assert_allclose(got[tuple(oi)], ref[tuple(oi)],
+                                   rtol=1e-4, atol=1e-4)
+        mask = np.zeros(ref.shape[:4], bool)
+        mask[tuple(oi)] = True
+        np.testing.assert_allclose(
+            ref[~mask], np.broadcast_to(np.asarray(conv.bias._value),
+                                        ref.shape)[~mask],
+            rtol=1e-4, atol=1e-4)
+
+    def test_subm_conv3d_matches_dense_on_input_sites(self):
+        from paddle_tpu.sparse.nn import SubmConv3D
+        x, dense = self._voxels()
+        conv = SubmConv3D(3, 4, kernel_size=3, padding=1, bias_attr=False)
+        out = conv(x)
+        ii = np.asarray(x.indices())
+        oi = np.asarray(out.indices())
+        np.testing.assert_array_equal(ii, oi)   # pattern preserved
+        ref = self._dense_conv(dense, np.asarray(conv.weight._value),
+                               1, 1)
+        got = np.asarray(out.to_dense().numpy())
+        np.testing.assert_allclose(got[tuple(oi)], ref[tuple(oi)],
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_batchnorm_relu(self):
+        from paddle_tpu.sparse.nn import BatchNorm, ReLU
+        x, _ = self._voxels()
+        bn = BatchNorm(3)
+        out = bn(x)
+        v = np.asarray(out.values().numpy())
+        np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_allclose(v.std(0), 1.0, atol=1e-2)
+        r = ReLU()(out)
+        assert (np.asarray(r.values().numpy()) >= 0).all()
+
+    def test_sparse_attention_matches_masked_dense(self):
+        from paddle_tpu.sparse.nn import functional as F
+        rs = np.random.RandomState(1)
+        b, h, s, d = 1, 2, 8, 4
+        q, k, v = (rs.randn(b, h, s, d).astype(np.float32)
+                   for _ in range(3))
+        # random causal-ish pattern, SHARED across batch heads (jax's
+        # batched BCSR requires uniform nse per batch)
+        pat = np.tril(rs.rand(s, s) < 0.6)
+        np.fill_diagonal(pat, True)
+        allow = np.broadcast_to(pat, (b * h, s, s)).copy()
+        rptr = [0]
+        cols1 = []
+        for r in range(s):
+            cs = np.nonzero(pat[r])[0]
+            cols1.extend(cs)
+            rptr.append(rptr[-1] + len(cs))
+        nse = len(cols1)
+        crows = np.broadcast_to(np.asarray(rptr), (b * h, s + 1))
+        cols = np.broadcast_to(np.asarray(cols1), (b * h, nse))
+        vals = np.ones((b * h, nse), np.float32)
+        mask = paddle.sparse.sparse_csr_tensor(
+            crows, cols, vals, shape=(b * h, s, s))
+        out = F.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                          paddle.to_tensor(v), mask).numpy()
+        # dense reference
+        scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(d)
+        scores = np.where(allow.reshape(b, h, s, s), scores, -1e30)
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = p @ v
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_sparse_layers_register_in_parent(self):
+        """sparse.nn modules are nn.Layer subclasses: their params reach
+        an enclosing model's parameters()/state_dict (else they would
+        silently never train)."""
+        from paddle_tpu import nn
+        from paddle_tpu.sparse.nn import SubmConv3D, BatchNorm
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = SubmConv3D(3, 4, 3, padding=1)
+                self.bn = BatchNorm(4)
+                self.fc = nn.Linear(4, 2)
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert any("conv.weight" in n for n in names), names
+        assert any("bn.weight" in n for n in names), names
+        sd = net.state_dict()
+        assert any("_mean" in k for k in sd), list(sd)[:8]
+        # sparse forward flows through the composed model
+        x, _ = self._voxels(C=3)
+        out = net.bn(net.conv(x))
+        assert out.to_dense().shape[-1] == 4
