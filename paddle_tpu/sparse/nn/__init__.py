@@ -1,0 +1,117 @@
+"""paddle.sparse.nn — sparse layers (reference: python/paddle/sparse/nn/
+layer/ — verify). Layers are nn.Layer subclasses (params register in an
+enclosing model's parameters()/state_dict) built on the coordinate-
+sparse kernels in :mod:`.functional`."""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import functional
+from .functional import _conv3d_coo, _triple
+from .. import SparseCooTensor, sparse_coo_tensor
+from ...nn.layer import Layer
+from ...tensor import Tensor
+
+__all__ = ["Conv3D", "SubmConv3D", "BatchNorm", "ReLU", "functional"]
+
+
+class _ConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        if groups != 1:
+            raise NotImplementedError("sparse conv groups > 1")
+        if data_format != "NDHWC":
+            raise ValueError("sparse conv3d supports NDHWC only "
+                             "(reference layout)")
+        self._subm = subm
+        self.stride = _triple(stride)
+        self.padding = _triple(padding)
+        self.dilation = _triple(dilation)
+        k = _triple(kernel_size)
+        fan_in = in_channels * k[0] * k[1] * k[2]
+        bound = 1.0 / _math.sqrt(fan_in)
+        from ...nn import initializer as I
+        self.weight = self.create_parameter(
+            (*k, in_channels, out_channels), attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return _conv3d_coo(x, self.weight, self.bias, self.stride,
+                           self.padding, self.dilation, subm=self._subm)
+
+
+class Conv3D(_ConvBase):
+    """Sparse 3D convolution: output sites are every stride-aligned
+    position reachable from an active input voxel (the sparse pattern
+    DILATES — reference sparse/nn/layer/conv.py Conv3D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, False, weight_attr,
+                         bias_attr, data_format)
+
+
+class SubmConv3D(_ConvBase):
+    """Submanifold sparse conv: output sites == input sites (no pattern
+    dilation — the point-cloud workhorse; reference SubmConv3D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, True, weight_attr,
+                         bias_attr, data_format)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel dim of ACTIVE voxels only (inactive
+    sites don't dilute the statistics — reference routes sparse BN
+    through the same batch_norm kernel). Delegates to F.batch_norm on
+    the (nnz, C) values, so momentum/unbiased-variance semantics and
+    running-stat buffers match the dense layer exactly."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        from ...nn import initializer as I
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True)
+        dt = self.weight._value.dtype
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros((num_features,), dt)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones((num_features,), dt)))
+        self.momentum = momentum
+        self.eps = epsilon
+
+    def forward(self, x: SparseCooTensor):
+        from ...nn import functional as F
+        v = x.values()
+        vt = v if isinstance(v, Tensor) else Tensor(jnp.asarray(v))
+        out = F.batch_norm(vt, self._mean, self._variance, self.weight,
+                           self.bias, training=self.training,
+                           momentum=self.momentum, epsilon=self.eps,
+                           data_format="NLC")
+        return sparse_coo_tensor(np.asarray(x.indices()), out,
+                                 shape=tuple(x.shape))
+
+
+class ReLU(Layer):
+    def forward(self, x: SparseCooTensor):
+        return functional.relu(x)

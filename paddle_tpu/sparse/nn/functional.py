@@ -1,0 +1,221 @@
+"""paddle.sparse.nn.functional — sparse conv / activation / attention.
+
+Reference parity: python/paddle/sparse/nn/functional/ (conv3d,
+subm_conv3d, relu, attention — verify). The reference backs these with
+hand-written COO kernels (paddle/phi/kernels/sparse/); the TPU-native
+design keeps COORDINATES on the host as numpy (the output structure of
+a sparse conv is data-dependent — inherently eager, the reference is
+too) and runs all VALUE math as jnp gathers + matmuls, which XLA maps
+onto the MXU: one (nnz_out, Cin) x (Cin, Cout) matmul per kernel
+offset. Coordinate lookup is a sorted-key binary search (O(nnz)
+memory) — never a dense voxel grid.
+
+Layout convention is paddle's: SparseCooTensor of shape
+(N, D, H, W, C) with indices (4, nnz) over (n, d, h, w) and dense
+values (nnz, C). Weight layout (kd, kh, kw, Cin, Cout).
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import SparseCooTensor, SparseCsrTensor, sparse_coo_tensor
+from ...tensor import Tensor
+
+__all__ = ["relu", "conv3d", "subm_conv3d", "attention"]
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        if len(v) != 3:
+            raise ValueError(f"expected 3 values, got {v!r}")
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _linearize(nidx, coords, dims):
+    """(n, d, h, w) -> single sortable int64 key."""
+    return ((nidx * dims[0] + coords[:, 0]) * dims[1]
+            + coords[:, 1]) * dims[2] + coords[:, 2]
+
+
+def _conv3d_coo(x: SparseCooTensor, weight, bias=None, stride=1,
+                padding=0, dilation=1, subm=False):
+    """Core sparse 3D convolution. Returns a SparseCooTensor."""
+    stride, padding, dilation = (_triple(stride), _triple(padding),
+                                 _triple(dilation))
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"expected SparseCooTensor, got {type(x)}")
+    idx = np.asarray(x.indices())              # (4, nnz)
+    vals = jnp.asarray(x.values()._value if isinstance(
+        x.values(), Tensor) else x.values())   # (nnz, Cin)
+    w = jnp.asarray(weight._value if isinstance(weight, Tensor)
+                    else weight)
+    N, D, H, W, cin = (int(s) for s in x.shape)
+    kd, kh, kw, wcin, cout = (int(s) for s in w.shape)
+    if wcin != cin:
+        raise ValueError(f"weight Cin {wcin} != input channels {cin}")
+    dims = np.array([D, H, W])
+    if subm:
+        if stride != (1, 1, 1):
+            raise ValueError("subm_conv3d requires stride 1")
+        out_spatial = (D, H, W)
+        out_idx = idx
+    else:
+        out_spatial = tuple(
+            (dims[i] + 2 * padding[i]
+             - dilation[i] * ([kd, kh, kw][i] - 1) - 1) // stride[i] + 1
+            for i in range(3))
+        # candidate outputs: every (input voxel, kernel offset) pair that
+        # lands on a stride-aligned, in-bounds output coordinate
+        cands = []
+        for od in range(kd):
+            for oh in range(kh):
+                for ow in range(kw):
+                    off = np.array([od, oh, ow]) * np.array(dilation)
+                    num = idx[1:].T + np.array(padding) - off
+                    ok = (num % np.array(stride) == 0).all(1)
+                    oc = num // np.array(stride)
+                    ok &= ((oc >= 0) & (oc < np.array(out_spatial))) \
+                        .all(1)
+                    if ok.any():
+                        cands.append(np.concatenate(
+                            [idx[0][ok, None], oc[ok]], axis=1))
+        if cands:
+            allc = np.unique(np.concatenate(cands, axis=0), axis=0)
+        else:
+            allc = np.zeros((0, 4), np.int64)
+        out_idx = allc.T                       # (4, nnz_out)
+
+    Do, Ho, Wo = out_spatial
+    # sorted-key lookup table over active INPUT voxels: O(nnz) memory
+    # (a dense (N,D,H,W) grid would be ~720 MB for a detection-scale
+    # 41x1600x1408 grid, rebuilt per conv call)
+    in_keys = _linearize(idx[0].astype(np.int64), idx[1:].T.astype(
+        np.int64), dims)
+    order = np.argsort(in_keys)
+    keys_sorted = in_keys[order]
+
+    def lookup(nidx, coords, valid):
+        q = _linearize(nidx.astype(np.int64), coords.astype(np.int64),
+                       dims)
+        pos = np.searchsorted(keys_sorted, q)
+        pos_c = np.minimum(pos, len(keys_sorted) - 1)
+        hit = valid & (len(keys_sorted) > 0)
+        if len(keys_sorted):
+            hit = hit & (keys_sorted[pos_c] == q)
+        rows = np.where(hit, order[pos_c], -1)
+        return rows
+
+    vals_pad = jnp.concatenate(
+        [vals, jnp.zeros((1, cin), vals.dtype)], axis=0)  # row -1 -> 0
+
+    nnz_out = out_idx.shape[1]
+    out = jnp.zeros((nnz_out, cout),
+                    jnp.promote_types(vals.dtype, w.dtype))
+    oc = out_idx[1:].T                         # (nnz_out, 3)
+    on = out_idx[0]
+    for od in range(kd):
+        for oh in range(kh):
+            for ow in range(kw):
+                off = np.array([od, oh, ow]) * np.array(dilation)
+                ic = oc * np.array(stride) - np.array(padding) + off
+                inb = ((ic >= 0) & (ic < dims)).all(1)
+                icc = np.clip(ic, 0, dims - 1)
+                rows = lookup(on, icc, inb)
+                g = vals_pad[jnp.asarray(rows)]          # (nnz_out, Cin)
+                out = out + g @ w[od, oh, ow]
+    if bias is not None:
+        b = jnp.asarray(bias._value if isinstance(bias, Tensor) else bias)
+        out = out + b
+    return sparse_coo_tensor(
+        out_idx, Tensor(out.astype(vals.dtype)),
+        shape=(N, Do, Ho, Wo, cout))
+
+
+def relu(x, name=None):
+    v = x.values()
+    v = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+    return sparse_coo_tensor(np.asarray(x.indices()),
+                             Tensor(jnp.maximum(v, 0)),
+                             shape=tuple(x.shape))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC", name=None):
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups > 1")
+    return _conv3d_coo(x, weight, bias, stride, padding, dilation,
+                       subm=False)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0,
+                dilation=1, groups=1, data_format="NDHWC", name=None):
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups > 1")
+    return _conv3d_coo(x, weight, bias, stride, padding, dilation,
+                       subm=True)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse attention: softmax runs over ONLY the positions named by
+    ``sparse_mask`` (a SparseCsrTensor of shape (b*h, s, s) — reference
+    sparse/nn/functional/transformer.py — verify). query/key/value:
+    dense (b, h, s, d). Additive masks ``key_padding_mask`` (b, s) /
+    ``attn_mask`` (s, s) follow the reference's semantics (−inf entries
+    drop keys). A row whose every participating key is masked out
+    yields exact zeros (never probability mass outside the pattern).
+
+    TPU-native: the CSR pattern becomes a boolean score mask and XLA
+    fuses the masked softmax; the pattern is static per call site, so
+    the MXU still sees the full (s, s) matmul tiles (a gather-per-row
+    formulation would defeat tiling for the moderate sparsities these
+    masks carry)."""
+    if not isinstance(sparse_mask, SparseCsrTensor):
+        raise TypeError("sparse_mask must be a SparseCsrTensor")
+    qv = query._value if isinstance(query, Tensor) \
+        else jnp.asarray(query)
+    kv = key._value if isinstance(key, Tensor) else jnp.asarray(key)
+    vv = value._value if isinstance(value, Tensor) \
+        else jnp.asarray(value)
+    b, h, s, d = qv.shape
+    # CSR pattern -> dense bool (b*h, s, s), vectorized: row ids repeat
+    # by per-row counts from np.diff(crows)
+    crows = np.asarray(sparse_mask.crows()).reshape(b * h, s + 1)
+    cols = np.asarray(sparse_mask.cols()).reshape(b * h, -1)
+    counts = np.diff(crows, axis=1)                  # (bh, s)
+    allow = np.zeros((b * h, s, s), bool)
+    bh_ids = np.repeat(np.arange(b * h), counts.sum(axis=1))
+    row_ids = np.concatenate(
+        [np.repeat(np.arange(s), c) for c in counts])
+    col_ids = np.concatenate(
+        [cols[i, :counts[i].sum()] for i in range(b * h)])
+    allow[bh_ids, row_ids, col_ids] = True
+    allow = jnp.asarray(allow.reshape(b, h, s, s))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qv, kv,
+                        preferred_element_type=jnp.float32) \
+        / _math.sqrt(d)
+    # additive masks apply FIRST (on allowed positions), then the
+    # pattern mask sets disallowed to -inf — so a -inf padding mask can
+    # never rank an allowed key BELOW a disallowed one
+    if attn_mask is not None:
+        am = attn_mask._value if isinstance(attn_mask, Tensor) \
+            else jnp.asarray(attn_mask)
+        scores = scores + am.astype(scores.dtype)
+    if key_padding_mask is not None:
+        kp = key_padding_mask._value if isinstance(
+            key_padding_mask, Tensor) else jnp.asarray(key_padding_mask)
+        scores = scores + kp.astype(scores.dtype)[:, None, None, :]
+    scores = jnp.where(allow, scores, -jnp.inf)
+    # -inf-safe softmax: fully-masked rows output exact zeros
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m_safe = jnp.where(jnp.isneginf(m), 0.0, m)
+    e = jnp.where(jnp.isneginf(scores), 0.0, jnp.exp(scores - m_safe))
+    denom = jnp.sum(e, axis=-1, keepdims=True)
+    probs = e / jnp.maximum(denom, 1e-30)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(vv.dtype), vv)
+    return Tensor(out)

@@ -138,3 +138,55 @@ class TestSparseNN:
         x, _ = self._voxels(C=3)
         out = net.bn(net.conv(x))
         assert out.to_dense().shape[-1] == 4
+
+    def test_attention_padding_never_leaks_outside_pattern(self):
+        """-inf key_padding_mask on every allowed key of a row must give
+        exact zeros — never probability mass on DISALLOWED keys."""
+        from paddle_tpu.sparse.nn import functional as F
+        rs = np.random.RandomState(2)
+        b, h, s, d = 1, 1, 4, 4
+        q, k, v = (rs.randn(b, h, s, d).astype(np.float32)
+                   for _ in range(3))
+        # row 0 allows only key 0; rows 1..3 allow keys {0..r}
+        pat = np.tril(np.ones((s, s), bool))
+        rptr = np.cumsum([0] + [pat[r].sum() for r in range(s)])
+        cols1 = np.concatenate([np.nonzero(pat[r])[0] for r in range(s)])
+        mask = paddle.sparse.sparse_csr_tensor(
+            rptr[None], cols1[None], np.ones((1, len(cols1)), np.float32),
+            shape=(b * h, s, s))
+        # pad key 0 out entirely: row 0's only allowed key is dead
+        kp = np.zeros((b, s), np.float32)
+        kp[0, 0] = -np.inf
+        out = F.attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                          paddle.to_tensor(v), mask,
+                          key_padding_mask=paddle.to_tensor(kp)).numpy()
+        np.testing.assert_array_equal(out[0, 0, 0], np.zeros(d))
+        # other rows: reference = softmax over allowed keys minus key 0
+        for r in range(1, s):
+            sc = (q[0, 0, r] @ k[0, 0, :r + 1].T) / np.sqrt(d)
+            sc[0] = -np.inf
+            e = np.exp(sc - sc[1:].max())
+            e[0] = 0.0
+            p = e / e.sum()
+            np.testing.assert_allclose(out[0, 0, r],
+                                       p @ v[0, 0, :r + 1],
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_running_stats_unbiased(self):
+        """Running variance uses the unbiased (n/(n-1)) correction —
+        same semantics as the dense BatchNorm."""
+        from paddle_tpu.sparse.nn import BatchNorm
+        x, _ = self._voxels(nnz=10)
+        bn = BatchNorm(3, momentum=0.0)     # running := batch stats
+        bn(x)
+        vals = np.asarray(x.values().numpy())
+        n = vals.shape[0]
+        expect = vals.var(0) * n / (n - 1)
+        np.testing.assert_allclose(np.asarray(bn._variance.numpy()),
+                                   expect, rtol=1e-5)
+
+    def test_functional_is_importable_module(self):
+        """paddle parity: sparse.nn.functional is a real module."""
+        import importlib
+        m = importlib.import_module("paddle_tpu.sparse.nn.functional")
+        assert hasattr(m, "attention") and hasattr(m, "subm_conv3d")
