@@ -37,9 +37,14 @@ class _ConvBase(Layer):
         fan_in = in_channels * k[0] * k[1] * k[2]
         bound = 1.0 / _math.sqrt(fan_in)
         from ...nn import initializer as I
+        from ...param_attr import ParamAttr
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
         self.weight = self.create_parameter(
             (*k, in_channels, out_channels), attr=weight_attr,
-            default_initializer=I.Uniform(-bound, bound))
+            default_initializer=None if (
+                weight_attr and weight_attr.initializer) else
+            I.Uniform(-bound, bound))
         if bias_attr is not False:
             self.bias = self.create_parameter(
                 (out_channels,), attr=bias_attr, is_bias=True)
@@ -87,9 +92,14 @@ class BatchNorm(Layer):
                  weight_attr=None, bias_attr=None, data_format="NDHWC"):
         super().__init__()
         from ...nn import initializer as I
+        from ...param_attr import ParamAttr
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
         self.weight = self.create_parameter(
             (num_features,), attr=weight_attr,
-            default_initializer=I.Constant(1.0))
+            default_initializer=None if (
+                weight_attr and weight_attr.initializer) else
+            I.Constant(1.0))
         self.bias = self.create_parameter(
             (num_features,), attr=bias_attr, is_bias=True)
         dt = self.weight._value.dtype
