@@ -270,9 +270,6 @@ class _EarlyReturnTransformer:
     # UNDEF on the other side (which would force the eager fallback)
     RET = "__jst_ret"
 
-    def __init__(self):
-        self.changed = False
-
     def _ret_value(self, ret):
         return ret.value if ret.value is not None \
             else ast.Constant(value=None)
@@ -310,7 +307,6 @@ class _EarlyReturnTransformer:
             new_else[-1] = ast.Assign(
                 targets=[ast.Name(id=rn, ctx=ast.Store())],
                 value=self._ret_value(new_else[-1]))
-            self.changed = True
             return stmts[:i] + [
                 ast.If(test=st.test, body=new_body, orelse=new_else),
                 ast.Return(value=ast.Name(id=rn, ctx=ast.Load()))]

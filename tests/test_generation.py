@@ -246,6 +246,7 @@ class _FixedLogitModel:
     _beam_search = _GM._beam_search
     _decode_fn = _GM._decode_fn
     _logits_fn = _GM._logits_fn
+    _scan_decode_fn = _GM._scan_decode_fn
 
     @property
     def __dict__(self):
@@ -422,3 +423,64 @@ class TestRaggedBatchDecode:
         with pytest.raises(ValueError, match="ragged"):
             gpt.generate(paddle.to_tensor(ids), max_new_tokens=2,
                          attention_mask=mask)
+
+
+class TestScanDecode:
+    """In-graph lax.scan decode: one compiled program for the whole
+    tail must produce EXACTLY the Python loop's tokens (greedy and
+    sampled — identical key-split sequence)."""
+
+    def _model(self, **kw):
+        paddle.seed(0)
+        cfg = llama_tiny_config(tensor_parallel=False, **kw)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m, cfg
+
+    def test_scan_matches_loop_greedy(self):
+        model, cfg = self._model()
+        rs = np.random.RandomState(0)
+        ids = rs.randint(1, cfg.vocab_size, (2, 6)).astype(np.int32)
+        a = model.generate(paddle.to_tensor(ids), max_new_tokens=7,
+                           use_scan_decode=True).numpy()
+        b = model.generate(paddle.to_tensor(ids), max_new_tokens=7,
+                           use_scan_decode=False).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_scan_matches_loop_sampled(self):
+        model, cfg = self._model()
+        rs = np.random.RandomState(1)
+        ids = rs.randint(1, cfg.vocab_size, (2, 5)).astype(np.int32)
+        kw = dict(max_new_tokens=6, do_sample=True, temperature=0.8,
+                  top_k=20, seed=7)
+        a = model.generate(paddle.to_tensor(ids),
+                           use_scan_decode=True, **kw).numpy()
+        b = model.generate(paddle.to_tensor(ids),
+                           use_scan_decode=False, **kw).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_scan_with_ragged_padding(self):
+        model, cfg = self._model()
+        rs = np.random.RandomState(2)
+        lens, s = [6, 3], 6
+        rows, mask = [], []
+        for ln in lens:
+            real = rs.randint(1, cfg.vocab_size, (ln,)).astype(np.int32)
+            rows.append(np.concatenate([np.zeros(s - ln, np.int32), real]))
+            mask.append(np.concatenate([np.zeros(s - ln, np.int32),
+                                        np.ones(ln, np.int32)]))
+        ids, am = np.stack(rows), np.stack(mask)
+        a = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                           attention_mask=am,
+                           use_scan_decode=True).numpy()
+        b = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                           attention_mask=am,
+                           use_scan_decode=False).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_scan_rejects_eos(self):
+        model, cfg = self._model()
+        ids = np.ones((1, 3), np.int32)
+        with pytest.raises(ValueError, match="early-exit"):
+            model.generate(paddle.to_tensor(ids), max_new_tokens=3,
+                           eos_token_id=1, use_scan_decode=True)
