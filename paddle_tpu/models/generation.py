@@ -211,11 +211,12 @@ class GenerationMixin:
         return cache[key]
 
     def _beam_search(self, ids, max_new, total, num_beams,
-                     eos_token_id, length_penalty):
+                     eos_token_id, length_penalty, pad=None):
         """Beam search over the cached decode step (reference: PaddleNLP
         BeamSearchScorer path — verify). Beams ride the batch dim: the
         cache is built at b·K rows and REORDERED (gather on dim 0)
-        after each step's beam selection."""
+        after each step's beam selection. ``pad`` (b,): per-row left-pad
+        counts (ragged prompts) — replicated K× alongside the cache."""
         b, s = ids.shape
         K = num_beams
         ids_arr = ids._value.astype(jnp.int32)
@@ -234,8 +235,10 @@ class GenerationMixin:
         bv = [t._value for t in btensors]
 
         lp, cache_flat = step_fn(pv, bv, ids_arr,
-                                 cache_flat, jnp.asarray(0, jnp.int32))
+                                 cache_flat, jnp.asarray(0, jnp.int32),
+                                 None, pad)
         cache_flat = tuple(jnp.repeat(c, K, axis=0) for c in cache_flat)
+        pad_rep = None if pad is None else jnp.repeat(pad, K, axis=0)
         V = lp.shape[-1]
         scores, first = jax.lax.top_k(lp, K)    # (b, K)
         beam_scores = scores                    # (b, K)
@@ -252,7 +255,7 @@ class GenerationMixin:
         for i in range(1, max_new):
             pos = jnp.asarray(s + i - 1, jnp.int32)
             lp, cache_flat = step_fn(pv, bv, tok[:, None].astype(
-                jnp.int32), cache_flat, pos)
+                jnp.int32), cache_flat, pos, None, pad_rep)
             lp = lp.reshape(b, K, V)
             if eos_token_id is not None:
                 # finished beams: only eos continues, at zero cost
@@ -354,16 +357,13 @@ class GenerationMixin:
                 raise ValueError("num_beams>1 with do_sample=True is not "
                                  "supported (beam sampling); use one or "
                                  "the other")
-            if pad is not None:
-                raise ValueError("attention_mask with num_beams>1 is not "
-                                 "yet supported; decode ragged batches "
-                                 "with greedy/sampled generate")
             if use_scan_decode:
                 raise ValueError("use_scan_decode=True with num_beams>1 "
                                  "is not supported (beam reordering is "
                                  "a per-token host decision)")
             return self._beam_search(ids, max_new, total, num_beams,
-                                     eos_token_id, length_penalty)
+                                     eos_token_id, length_penalty,
+                                     pad=pad)
         if not do_sample:
             temperature = 0.0
         sample_kwargs = dict(temperature=temperature, top_k=top_k,
