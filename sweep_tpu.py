@@ -1,49 +1,21 @@
 """One-off MFU sweep on the real chip. Not part of the test suite."""
 import json
-import os
 import sys
-import time
-
-import numpy as np
 
 
 def bench(cfg_kw, batch, seq, steps=8, warmup=2, multi_precision=True):
-    import paddle_tpu as paddle
-    from paddle_tpu import amp, optimizer
-    from paddle_tpu.jit import TrainStep
-    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    """One sweep point, measured by bench.py's _bench_train — ONE build
+    recipe (incl. the pure-bf16 path and the AOT memory precheck that
+    keeps oversized configs from OOM-crashing the tunnel)."""
+    from bench import _bench_train
+    from paddle_tpu.models.llama import LlamaConfig
 
-    peak = 197e12
-    paddle.seed(0)
     cfg = LlamaConfig(**cfg_kw)
-    model = LlamaForCausalLM(cfg)
-    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
-                          parameters=model.parameters(),
-                          multi_precision=multi_precision)
-    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
-
-    def loss_fn(m, b):
-        ids, labels = b
-        loss, _ = m(ids, labels)
-        return loss
-
-    step = TrainStep(model, loss_fn, opt)
-    ids = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
-    labels = np.roll(ids, -1, axis=1).astype(np.int32)
-    batch_t = (paddle.to_tensor(ids), paddle.to_tensor(labels))
-    for _ in range(warmup):
-        loss = step(batch_t)
-    float(loss.item())
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(batch_t)
-    float(loss.item())
-    dt = time.perf_counter() - t0
-    tok = batch * seq * steps / dt
-    mfu = tok * model.flops_per_token(seq) / peak
-    return {"tok_s": round(tok, 1), "mfu": round(mfu, 4),
-            "step_ms": round(dt / steps * 1000, 1),
-            "params": int(model.num_params())}
+    r = _bench_train(cfg, batch, seq, steps=steps, warmup=warmup,
+                     peak=197e12, multi_precision=multi_precision,
+                     hbm_limit=15.2e9)
+    return {"tok_s": r["tokens_per_sec"], "mfu": r["mfu"],
+            "step_ms": r["step_ms"], "params": r["model_params"]}
 
 
 SMALL = dict(vocab_size=32000, hidden_size=1024, intermediate_size=2816,
@@ -72,6 +44,14 @@ CONFIGS["med_b8_s1024"] = (MED, 8, 1024, True)
 CONFIGS["med_b16_s1024"] = (MED, 16, 1024, True)
 MEDR = dict(MED, recompute=True)
 CONFIGS["medr_b16_s1024"] = (MEDR, 16, 1024, False)
+
+# ~0.95B pure-bf16 build (5.7 GB params+moments): the r3 single-chip
+# scaling configs — scan_layers keeps the compile helper's program small
+BIG16 = dict(BIG, dtype="bfloat16", scan_layers=True,
+             max_position_embeddings=2048)
+CONFIGS["big16_b8_s2048"] = (BIG16, 8, 2048, False)
+CONFIGS["big16_b4_s2048"] = (BIG16, 4, 2048, False)
+CONFIGS["big16_b16_s1024"] = (BIG16, 16, 1024, False)
 
 # fused-CE A/B at the headline config (run both on a healthy tunnel to
 # measure the chunked lm-head CE win on hardware)
