@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -362,6 +363,27 @@ def flash_block(q, k, v, is_causal=False, scale=None, window=None):
     return fb(q, k, v)
 
 
+def _jax_flash_blocks(jfa, sq, sk):
+    """Block sizes for jax's TPU flash kernel. The kernel's built-in
+    default is 128 everywhere; PROFILE_r03 (v5e, b32 h16 s1024 d64)
+    measured the three 128-block kernels at 53% of device self-time for
+    ~14% of step FLOPs. Bigger tiles amortize the HBM traffic per score
+    tile — FLASH_BLOCKS_r03.json records the on-chip sweep; 512 wins.
+    Env overrides: PT_JAX_FLASH_BLOCK (kv block), PT_JAX_FLASH_BLOCK_Q.
+    Returns None (= kernel default) when the sequence doesn't tile."""
+    pref = int(os.environ.get("PT_JAX_FLASH_BLOCK", "512"))
+    pref_q = int(os.environ.get("PT_JAX_FLASH_BLOCK_Q", str(pref)))
+    bq = _pick_block(sq, min(pref_q, sq))
+    bk = _pick_block(sk, min(pref, sk))
+    if bq is None or bk is None or (bq <= 128 and bk <= 128):
+        return None
+    return jfa.BlockSizes(
+        block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
+        block_q_dkv=bq, block_k_major_dq=bk, block_k_dq=bk,
+        block_q_dq=bq)
+
+
 def _jax_tpu_flash(q, k, v, is_causal, scale):
     """jax's tuned Pallas TPU flash kernel (differentiable), bhsd layout.
     Returns None if shapes are unsupported. Equal q/kv head counts only —
@@ -374,12 +396,21 @@ def _jax_tpu_flash(q, k, v, is_causal, scale):
         return None
     if k.shape[2] != q.shape[2]:
         return None
+    blocks = _jax_flash_blocks(jfa, q.shape[1], k.shape[1])
     try:
         out = jfa.flash_attention(
             jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
-            jnp.moveaxis(v, 2, 1), causal=is_causal, sm_scale=scale)
+            jnp.moveaxis(v, 2, 1), causal=is_causal, sm_scale=scale,
+            block_sizes=blocks)
     except (ValueError, NotImplementedError):
-        return None
+        if blocks is None:
+            return None
+        try:  # tuned blocks rejected for this shape: kernel defaults
+            out = jfa.flash_attention(
+                jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
+                jnp.moveaxis(v, 2, 1), causal=is_causal, sm_scale=scale)
+        except (ValueError, NotImplementedError):
+            return None
     return jnp.moveaxis(out, 1, 2)
 
 

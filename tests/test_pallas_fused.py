@@ -2,6 +2,8 @@
 the actual kernel bodies are exercised (reference pattern: fused-op
 tests in test/legacy_test/test_fused_* compare against the unfused
 composition — verify)."""
+import os
+
 import numpy as np
 import pytest
 import jax
@@ -254,6 +256,31 @@ class TestFlashAttention:
                            1.0 / np.sqrt(q.shape[-1]))
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=1e-5)
+
+    def test_jax_flash_block_heuristic(self):
+        # PROFILE_r03: the kernel's 128-block default was the MFU
+        # bottleneck; the heuristic must hand 512-class tiles to
+        # tileable sequences and kernel defaults (None) to short ones
+        from paddle_tpu.ops.pallas import flash_attention as fa
+        from jax.experimental.pallas.ops.tpu import flash_attention as jfa
+        b = fa._jax_flash_blocks(jfa, 1024, 1024)
+        assert b.block_q == 512 and b.block_k == 512
+        assert b.block_q_dkv == 512 and b.block_k_major_dq == 512
+        b = fa._jax_flash_blocks(jfa, 2048, 2048)
+        assert b.block_k == 512
+        # short sequences: nothing bigger than the default tiles
+        assert fa._jax_flash_blocks(jfa, 128, 128) is None
+        assert fa._jax_flash_blocks(jfa, 64, 64) is None
+        # non-power-of-two seq still tiles to the largest divisor
+        b = fa._jax_flash_blocks(jfa, 1536, 1536)
+        assert b is not None and 1536 % b.block_q == 0
+        # env override
+        os.environ["PT_JAX_FLASH_BLOCK"] = "1024"
+        try:
+            b = fa._jax_flash_blocks(jfa, 1024, 1024)
+            assert b.block_k == 1024
+        finally:
+            del os.environ["PT_JAX_FLASH_BLOCK"]
 
 
 def test_rope_gqa_pallas_path(interpret):
