@@ -10,7 +10,22 @@ shape: in-memory sparse embedding tables sharded across server processes
 a ``SparseEmbedding`` layer whose backward pushes gradients via the
 autograd grad-hook. Dense compute stays on the accelerator; only the
 sparse rows live host-side — which is exactly the reference's split.
-SSD/rocksdb spill and GeoSGD are out of scope (documented in README).
+SSD/rocksdb spill is out of scope (documented in README).
+
+Training modes (reference: the ``Communicator`` family in
+paddle/fluid/distributed/ps/service/communicator/ — verify):
+
+- **sync** (default): every push blocks until the servers applied it.
+- **async**: pushes are merged by id into a per-table pending buffer and
+  flushed to the servers by a background thread — the trainer never
+  blocks on the send (the reference's AsyncCommunicator merge+send
+  queue). ``barrier_worker()`` drains the buffer.
+- **geo** (GeoSGD): the trainer trains against a *local* copy of the
+  touched rows (local SGD applied immediately), accumulating the delta
+  vs the server copy; every ``geo_step`` pushes the accumulated deltas
+  are shipped (servers *add* deltas — multi-trainer updates merge) and
+  the local cache refreshes from the merged server state (the
+  reference's GeoCommunicator).
 
 Roles follow the launch contract: ``TRAINING_ROLE`` = ``PSERVER`` |
 ``TRAINER``, ``PADDLE_PSERVER_NUM``, ``PADDLE_TRAINER_NUM``.
@@ -29,7 +44,8 @@ from . import rpc
 __all__ = ["init_server", "run_server", "init_worker", "stop_worker",
            "create_table", "pull_sparse", "push_sparse", "save_table",
            "table_size", "SparseEmbedding", "is_server", "is_worker",
-           "server_num", "worker_num", "shutdown"]
+           "server_num", "worker_num", "shutdown", "barrier_worker",
+           "training_mode", "set_training_mode"]
 
 
 # ---------------------------------------------------------------------------
@@ -104,6 +120,21 @@ def _srv_push(name, ids, grads):
     return True
 
 
+def _srv_push_delta(name, ids, deltas):
+    """GeoSGD merge: server ADDS the trainer's accumulated local delta
+    (no server-side optimizer — the trainer already applied its lr) and
+    returns the merged rows so the trainer can refresh its cache in the
+    same round trip."""
+    t = _TABLES[name]
+    with t._lock:
+        out = np.empty((len(ids), t.dim), np.float32)
+        for j, (i, d) in enumerate(zip(ids, deltas)):
+            row = t._row(int(i))
+            row += d
+            out[j] = row
+    return out
+
+
 def _srv_size(name):
     return len(_TABLES[name].rows)
 
@@ -121,6 +152,162 @@ def _srv_save(name, path):
 def _srv_stop():
     _SERVER_STOP.set()
     return True
+
+
+# ---------------------------------------------------------------------------
+# worker-side communicators (async / geo modes)
+# ---------------------------------------------------------------------------
+
+class _AsyncCommunicator:
+    """Merge-and-send queue: pushes accumulate by id in a pending buffer;
+    a daemon thread flushes it to the servers every ``interval`` seconds
+    (reference AsyncCommunicator: merge_sparse_grad + send thread)."""
+
+    def __init__(self, interval=0.02):
+        self._pending: dict[str, dict[int, np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._interval = interval
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def push(self, name, ids, grads):
+        with self._lock:
+            tab = self._pending.setdefault(name, {})
+            for i, g in zip(ids, grads):
+                i = int(i)
+                cur = tab.get(i)
+                tab[i] = g.copy() if cur is None else cur + g
+
+    def _drain(self):
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        for name, tab in pending.items():
+            if not tab:
+                continue
+            ids = np.fromiter(tab, np.int64, len(tab))
+            grads = np.stack([tab[int(i)] for i in ids])
+            _push_sparse_sync(name, ids, grads)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._stop.wait(self._interval)
+            try:
+                self._drain()
+            except Exception:
+                if self._stop.is_set():   # rpc torn down mid-flush
+                    break
+                raise
+
+    def flush(self):
+        self._drain()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._drain()
+
+
+class _GeoCommunicator:
+    """GeoSGD: local rows + accumulated deltas, periodic merge.
+
+    ``pull`` serves from the local cache (filling misses from the
+    servers), ``push`` applies plain SGD *locally* and records the delta;
+    every ``geo_step`` pushes the deltas ship to the servers (which add
+    them) and the touched rows refresh to the merged global state."""
+
+    def __init__(self, geo_step=100):
+        self.geo_step = int(geo_step)
+        self._cache: dict[str, dict[int, np.ndarray]] = {}
+        self._delta: dict[str, dict[int, np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self._pushes = 0
+
+    def pull(self, name, flat_ids):
+        cache = self._cache.setdefault(name, {})
+        with self._lock:
+            missing = np.array(
+                [i for i in dict.fromkeys(int(x) for x in flat_ids)
+                 if i not in cache], np.int64)
+        if missing.size:
+            rows = _pull_sparse_sync(name, missing)
+            with self._lock:
+                for i, r in zip(missing, rows):
+                    cache.setdefault(int(i), r.copy())
+        with self._lock:
+            return np.stack([cache[int(i)] for i in flat_ids])
+
+    def push(self, name, ids, grads):
+        lr = _TABLE_META.get(name, {}).get("lr", 0.1)
+        cache = self._cache.setdefault(name, {})
+        delta = self._delta.setdefault(name, {})
+        with self._lock:
+            for i, g in zip(ids, grads):
+                i = int(i)
+                upd = (-lr * g).astype(np.float32)
+                row = cache.get(i)
+                if row is None:       # pushed before ever pulled
+                    row = _pull_sparse_sync(name, np.array([i]))[0]
+                    cache[i] = row
+                row += upd
+                cur = delta.get(i)
+                delta[i] = upd if cur is None else cur + upd
+            self._pushes += 1
+            due = self._pushes % self.geo_step == 0
+        if due:
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            deltas, self._delta = self._delta, {}
+        for name, tab in deltas.items():
+            if not tab:
+                continue
+            ids = np.fromiter(tab, np.int64, len(tab))
+            ds = np.stack([tab[int(i)] for i in ids])
+            merged = _push_delta_sync(name, ids, ds)
+            with self._lock:
+                cache = self._cache.setdefault(name, {})
+                for i, r in zip(ids, merged):
+                    cache[int(i)] = r.copy()
+
+    def stop(self):
+        self.flush()
+
+
+_MODE = "sync"
+_COMM: Optional[object] = None
+_TABLE_META: dict[str, dict] = {}
+
+
+def training_mode() -> str:
+    """The worker's active PS mode: "sync" | "async" | "geo"."""
+    return _MODE
+
+
+def set_training_mode(mode: str, geo_step: int = 100,
+                      async_interval: float = 0.02):
+    """Switch the worker's communicator (drains the old one first).
+    Normally chosen once via :func:`init_worker`; exposed so a trainer
+    can e.g. fall back to sync pushes before an evaluation pass."""
+    global _MODE, _COMM
+    if mode not in ("sync", "async", "geo"):
+        raise ValueError(f"unknown PS mode {mode!r}")
+    if _COMM is not None:
+        _COMM.stop()
+        _COMM = None
+    _MODE = mode
+    if mode == "async":
+        _COMM = _AsyncCommunicator(interval=async_interval)
+    elif mode == "geo":
+        _COMM = _GeoCommunicator(geo_step=geo_step)
+
+
+def barrier_worker():
+    """Drain any pending async/geo sends (reference
+    fleet.barrier_worker before save/evaluate)."""
+    if _COMM is not None:
+        _COMM.flush()
 
 
 # ---------------------------------------------------------------------------
@@ -175,18 +362,35 @@ def run_server(poll_s=0.1):
     rpc.shutdown()
 
 
-def init_worker(name: Optional[str] = None):
-    """Join the PS cluster as a trainer (reference fleet.init_worker)."""
+def init_worker(name: Optional[str] = None, mode: str = "sync",
+                geo_step: int = 100, async_interval: float = 0.02):
+    """Join the PS cluster as a trainer (reference fleet.init_worker).
+
+    ``mode`` selects the communicator: "sync" (blocking pushes),
+    "async" (merge+background-send), or "geo" (GeoSGD local training
+    with delta sync every ``geo_step`` pushes)."""
     idx = int(os.environ.get("PADDLE_TRAINER_ID", 0))
     _join(name or f"trainer:{idx}", idx, as_server=False)
+    set_training_mode(mode, geo_step=geo_step,
+                      async_interval=async_interval)
 
 
 def stop_worker():
+    global _COMM, _MODE
+    if _COMM is not None:
+        _COMM.stop()
+        _COMM = None
+    _MODE = "sync"
     rpc.shutdown()
 
 
 def shutdown():
     """Trainer-side: stop every server, then leave the rpc world."""
+    global _COMM, _MODE
+    if _COMM is not None:
+        _COMM.stop()
+        _COMM = None
+    _MODE = "sync"
     for s in range(server_num()):
         try:
             rpc.rpc_sync(_server_name(s), _srv_stop, timeout=10)
@@ -209,6 +413,8 @@ def _shard(ids: np.ndarray):
 def create_table(name, dim, init_range=0.01, optimizer="sgd", lr=0.1,
                  seed=0):
     """Create ``name`` on every server shard (idempotent)."""
+    _TABLE_META[name] = {"dim": int(dim), "lr": float(lr),
+                         "optimizer": optimizer}
     futs = [rpc.rpc_async(_server_name(s), _srv_create_table,
                           args=(name, dim, init_range, optimizer, lr,
                                 seed + s), timeout=60)
@@ -217,11 +423,7 @@ def create_table(name, dim, init_range=0.01, optimizer="sgd", lr=0.1,
         f.wait(65)
 
 
-def pull_sparse(name, ids) -> np.ndarray:
-    """Fetch rows for ``ids`` (any shape) → array of shape ids.shape+(dim,).
-    Fan-out to owning servers runs concurrently."""
-    ids = np.asarray(ids, np.int64)
-    flat = ids.reshape(-1)
+def _pull_sparse_sync(name, flat) -> np.ndarray:
     out = None
     shards = _shard(flat)
     futs = {s: rpc.rpc_async(_server_name(s), _srv_pull,
@@ -234,22 +436,62 @@ def pull_sparse(name, ids) -> np.ndarray:
         out[shards[s]] = rows
     if out is None:
         raise ValueError("pull_sparse with empty ids")
+    return out
+
+
+def pull_sparse(name, ids) -> np.ndarray:
+    """Fetch rows for ``ids`` (any shape) → array of shape ids.shape+(dim,).
+    Fan-out to owning servers runs concurrently. In geo mode, rows come
+    from the trainer's local GeoSGD cache (local updates visible)."""
+    ids = np.asarray(ids, np.int64)
+    flat = ids.reshape(-1)
+    if _MODE == "geo" and _COMM is not None:
+        out = _COMM.pull(name, flat)
+    else:
+        out = _pull_sparse_sync(name, flat)
     return out.reshape(ids.shape + (out.shape[-1],))
 
 
-def push_sparse(name, ids, grads):
-    """Apply gradients to rows of ``ids``; duplicate ids within the batch
-    are pre-summed host-side (the reference merges by key in the worker)."""
+def _merge_by_id(ids, grads):
     ids = np.asarray(ids, np.int64).reshape(-1)
     grads = np.asarray(grads, np.float32).reshape(ids.size, -1)
     uniq, inv = np.unique(ids, return_inverse=True)
     merged = np.zeros((uniq.size, grads.shape[1]), np.float32)
     np.add.at(merged, inv, grads)
+    return uniq, merged
+
+
+def _push_sparse_sync(name, uniq, merged):
     futs = [rpc.rpc_async(_server_name(s), _srv_push,
                           args=(name, uniq[pos], merged[pos]), timeout=60)
             for s, pos in _shard(uniq).items()]
     for f in futs:
         f.wait(65)
+
+
+def _push_delta_sync(name, ids, deltas) -> np.ndarray:
+    """Ship GeoSGD deltas; returns merged rows in input order."""
+    out = np.empty((ids.size, deltas.shape[1]), np.float32)
+    shards = _shard(ids)
+    futs = {s: rpc.rpc_async(_server_name(s), _srv_push_delta,
+                             args=(name, ids[pos], deltas[pos]),
+                             timeout=60)
+            for s, pos in shards.items()}
+    for s, fut in futs.items():
+        out[shards[s]] = fut.wait(65)
+    return out
+
+
+def push_sparse(name, ids, grads):
+    """Apply gradients to rows of ``ids``; duplicate ids within the batch
+    are pre-summed host-side (the reference merges by key in the worker).
+    Routing: sync → blocking server update; async → merge into the
+    background send buffer; geo → local SGD + delta accumulation."""
+    uniq, merged = _merge_by_id(ids, grads)
+    if _COMM is not None and _MODE in ("async", "geo"):
+        _COMM.push(name, uniq, merged)
+    else:
+        _push_sparse_sync(name, uniq, merged)
 
 
 def table_size(name) -> int:

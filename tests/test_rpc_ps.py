@@ -112,6 +112,57 @@ TRAINER_SCRIPT = textwrap.dedent("""
 """)
 
 
+MODES_TRAINER_SCRIPT = textwrap.dedent("""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from paddle_tpu.distributed import ps
+
+    ps.init_worker(mode="async", async_interval=0.01)
+    assert ps.training_mode() == "async"
+    ps.create_table("a", 4, optimizer="sgd", lr=0.5)
+
+    ids = np.array([1, 2])
+    before = ps.pull_sparse("a", ids)
+    # async push returns immediately; barrier drains the send buffer
+    ps.push_sparse("a", np.array([1, 2, 1]), np.ones((3, 4), np.float32))
+    ps.barrier_worker()
+    after = ps.pull_sparse("a", ids)
+    exp = before.copy()
+    exp[0] -= 0.5 * 2.0
+    exp[1] -= 0.5
+    assert np.allclose(after, exp, atol=1e-6), (after, exp)
+
+    # ---- GeoSGD: local updates, delta sync every geo_step pushes ----
+    ps.set_training_mode("geo", geo_step=3)
+    ps.create_table("g", 4, optimizer="sgd", lr=0.5)
+    ids = np.array([7])
+    r0 = ps.pull_sparse("g", ids).copy()
+    g = np.ones((1, 4), np.float32)
+    ps.push_sparse("g", ids, g)          # local only
+    ps.push_sparse("g", ids, g)          # local only
+    local = ps.pull_sparse("g", ids)
+    assert np.allclose(local, r0 - 1.0, atol=1e-6)           # 2 * lr*g
+    srv = ps._pull_sparse_sync("g", ids.reshape(-1))
+    assert np.allclose(srv, r0, atol=1e-6), "delta shipped early"
+    ps.push_sparse("g", ids, g)          # 3rd push -> flush
+    srv = ps._pull_sparse_sync("g", ids.reshape(-1))
+    assert np.allclose(srv, r0 - 1.5, atol=1e-6), (srv, r0)
+    assert np.allclose(ps.pull_sparse("g", ids), r0 - 1.5, atol=1e-6)
+
+    # explicit barrier also flushes a partial window
+    ps.push_sparse("g", ids, g)
+    ps.barrier_worker()
+    srv = ps._pull_sparse_sync("g", ids.reshape(-1))
+    assert np.allclose(srv, r0 - 2.0, atol=1e-6)
+
+    ps.shutdown()
+    print("MODES_DONE")
+""")
+
+
 class TestPsCluster:
     def test_one_server_one_trainer(self, tmp_path):
         port = _free_port()
@@ -143,3 +194,33 @@ class TestPsCluster:
         assert "TRAINER_DONE" in t_out, t_out
         assert srv.returncode == 0, s_out
         assert "SERVER_DONE" in s_out, s_out
+
+    def test_async_and_geo_modes(self):
+        port = _free_port()
+        base_env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "PADDLE_PSERVER_NUM": "1",
+            "PADDLE_TRAINER_NUM": "1",
+            "PADDLE_TRAINER_ID": "0",
+        }
+        srv = subprocess.Popen(
+            [sys.executable, "-c", SERVER_SCRIPT],
+            env={**base_env, "TRAINING_ROLE": "PSERVER"},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        trn = subprocess.Popen(
+            [sys.executable, "-c", MODES_TRAINER_SCRIPT],
+            env={**base_env, "TRAINING_ROLE": "TRAINER"},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            t_out, _ = trn.communicate(timeout=180)
+            s_out, _ = srv.communicate(timeout=60)
+        finally:
+            for p in (srv, trn):
+                if p.poll() is None:
+                    p.kill()
+        assert trn.returncode == 0, t_out
+        assert "MODES_DONE" in t_out, t_out
+        assert srv.returncode == 0, s_out

@@ -1,0 +1,15 @@
+#!/bin/bash
+# Poll the axon tunnel; the moment it answers, run the measurement
+# session. The wedge after a killed remote compile clears on its own —
+# this watcher converts the first healthy window into artifacts.
+cd "$(dirname "$0")"
+for i in $(seq 1 200); do
+    if timeout 75 python -c "import jax; jax.devices()" 2>/dev/null; then
+        echo "tunnel healthy at attempt $i: $(date)" >&2
+        bash tpu_session.sh
+        exit 0
+    fi
+    sleep 90
+done
+echo "tunnel never recovered" >&2
+exit 1
