@@ -480,13 +480,17 @@ def sdpa(q, k, v, mask=None, is_causal=False, dropout_p=0.0, scale=None,
         # (compile-time Mosaic errors surface later and are covered by
         # the on-hardware kernel tests)
         gqa = k.shape[2] != q.shape[2]
+        # PT_SDPA_PREFER overrides the equal-heads route for on-chip
+        # A/B ("splash" | "jax_flash" | "fused"); GQA/window always
+        # prefer splash (the only kernel that avoids K/V repeat)
+        prefer = os.environ.get("PT_SDPA_PREFER", "")
         try:
-            if gqa or window is not None:
+            if gqa or window is not None or prefer == "splash":
                 out = _splash_attention(q, k, v, is_causal, scale, window)
                 if out is not None:
                     LAST_DISPATCH = "splash"
                     return out
-            else:
+            elif prefer != "fused":
                 out = _jax_tpu_flash(q, k, v, is_causal, scale)
                 if out is not None:
                     LAST_DISPATCH = "jax_flash"
