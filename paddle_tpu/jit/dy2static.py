@@ -110,6 +110,22 @@ def convert_ifelse(pred, true_fn, false_fn, inputs, names):
     return out if isinstance(out, tuple) else (out,)
 
 
+def loop_flag(value):
+    """Exit-flag constructor for converted loop returns/breaks: a scalar
+    int32 Tensor carried through ``lax.while_loop`` (0 = running,
+    -1 = break, r+1 = the r-th ``return`` fired)."""
+    from ..tensor import to_tensor
+    import numpy as np
+    return to_tensor(np.int32(value))
+
+
+def flag_clear_and(flag, test):
+    """Converted loop guard: continue while no exit fired AND the
+    original test holds. ``test`` may be a Tensor or a Python bool."""
+    from .. import ops
+    return ops.logical_and(flag == 0, test)
+
+
 def convert_while(cond_fn, body_fn, inputs, names):
     """Runtime dispatch for a converted ``while``: Python predicate →
     plain loop; Tensor predicate → lax.while_loop (state must be
@@ -262,21 +278,177 @@ class _EarlyReturnTransformer:
     the function's TAIL path are restructured — ``process`` walks the
     function body and the absorbed continuations, never the branches of
     untouched ifs, so falling off a processed block always means
-    returning from the function. Returns inside loops (and other
-    constructs) keep the eager fallback."""
+    returning from the function.
+
+    ``return`` / ``break`` / ``continue`` inside a ``while`` convert
+    too (the reference's SOT handles these at bytecode level): the loop
+    gains an int32 exit flag (0 running, -1 break, r+1 = r-th return),
+    each exit statement tail-absorbs into a flag assignment, the guard
+    becomes ``flag == 0 and test``, and the loop is followed by an
+    ``if flag == r+1: return <expr_r>`` chain that this same pass then
+    absorbs. The return expression is re-evaluated AFTER the loop from
+    carried state — sound because tail absorption guarantees nothing
+    runs between the flag assignment and loop exit. Exits this can't
+    express (returns under ``with``/``try``/``for``, names first bound
+    in-loop, which would be UNDEF in the carry) keep the eager
+    fallback."""
 
     # ONE shared return slot per function: every rewritten path assigns
     # it, so the converted ifs never carry a branch-local temp that is
     # UNDEF on the other side (which would force the eager fallback)
     RET = "__jst_ret"
+    BRK = -1
+
+    def __init__(self):
+        self.loop_counter = 0
+        # flag inits of NESTED rewritten loops: their flag lives in an
+        # enclosing loop's carry, so it must also be bound before the
+        # outermost loop (the in-place init then acts as the per-
+        # iteration reset); drained by process() at the splice point
+        self.pending_hoists: list = []
 
     def _ret_value(self, ret):
         return ret.value if ret.value is not None \
             else ast.Constant(value=None)
 
+    def _jst_call(self, attr, args):
+        return ast.Call(
+            func=ast.Attribute(value=ast.Name(id="_jst", ctx=ast.Load()),
+                               attr=attr, ctx=ast.Load()),
+            args=args, keywords=[])
+
+    def _flag_assign(self, flag, val):
+        return ast.Assign(
+            targets=[ast.Name(id=flag, ctx=ast.Store())],
+            value=self._jst_call("loop_flag", [ast.Constant(value=val)]))
+
+    def _absorb_exits(self, stmts, flag, exprs):
+        """Rewrite return/break/continue on the straight-line paths of a
+        loop body into flag assignments (tail-absorbing the rest of the
+        iteration, like ``process`` does for function returns).
+        Returns ``(new_stmts, changed, terminated)`` — ``terminated``
+        means every path through the block ends the iteration."""
+        stmts = list(stmts)
+        changed = False
+        j = 0
+        while j < len(stmts):
+            st = stmts[j]
+            if isinstance(st, ast.Return):
+                exprs.append(self._ret_value(st))
+                return (stmts[:j] + [self._flag_assign(flag, len(exprs))],
+                        True, True)
+            if isinstance(st, ast.Break):
+                return (stmts[:j] + [self._flag_assign(flag, self.BRK)],
+                        True, True)
+            if isinstance(st, ast.Continue):
+                return stmts[:j], True, True
+            if isinstance(st, ast.While) and not st.orelse:
+                repl = self._rewrite_loop(st)
+                if repl is not None:
+                    # the nested loop's own exits became a flag + a
+                    # post-loop if-return chain: re-absorb at this
+                    # level, and hoist its flag init past the
+                    # enclosing loop (carry needs a pre-loop binding)
+                    self.pending_hoists.append(self._flag_assign(
+                        repl[0].targets[0].id, 0))
+                    sub, _, term = self._absorb_exits(
+                        stmts[:j] + repl + stmts[j + 1:], flag, exprs)
+                    return sub, True, term
+            if isinstance(st, ast.If):
+                body, b_ch, b_t = self._absorb_exits(st.body, flag, exprs)
+                orelse, e_ch, e_t = self._absorb_exits(st.orelse, flag,
+                                                       exprs)
+                if b_ch or e_ch:
+                    rest = stmts[j + 1:]
+                    if b_t and e_t:
+                        new_body, new_else, term = body, orelse, True
+                    elif b_t:
+                        r2, _, r_t = self._absorb_exits(rest, flag, exprs)
+                        new_body, new_else, term = body, orelse + r2, r_t
+                    elif e_t:
+                        r2, _, r_t = self._absorb_exits(rest, flag, exprs)
+                        new_body, new_else, term = body + r2, orelse, r_t
+                    else:
+                        # only nested (deeper-loop) rewrites: keep the
+                        # if's shape and keep scanning the rest
+                        stmts[j] = ast.If(test=st.test,
+                                          body=body or [ast.Pass()],
+                                          orelse=orelse)
+                        changed = True
+                        j += 1
+                        continue
+                    new_if = ast.If(test=st.test,
+                                    body=new_body or [ast.Pass()],
+                                    orelse=new_else)
+                    return stmts[:j] + [new_if], True, term
+            j += 1
+        return stmts, changed, False
+
+    @staticmethod
+    def _has_stray_exit(stmts):
+        """Any Return left anywhere (outside nested defs), or any
+        Break/Continue not owned by a nested loop, means the rewrite
+        failed to absorb every exit — give up on converting the loop."""
+        def walk(node, in_loop):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Return):
+                    return True
+                if isinstance(child, (ast.Break, ast.Continue)) \
+                        and not in_loop:
+                    return True
+                if walk(child, in_loop or isinstance(
+                        child, (ast.While, ast.For))):
+                    return True
+            return False
+        for st in stmts:
+            if isinstance(st, (ast.Return, ast.Break, ast.Continue)):
+                return True
+            if walk(st, isinstance(st, (ast.While, ast.For))):
+                return True
+        return False
+
+    def _rewrite_loop(self, node):
+        """While containing return/break/continue → flag-carried loop +
+        post-loop if-return chain. Returns the replacement statements,
+        or None when there is nothing to absorb / absorption failed."""
+        saved_counter = self.loop_counter
+        saved_hoists = list(self.pending_hoists)
+        self.loop_counter += 1
+        flag = f"__jst_lflag_{self.loop_counter}"
+        exprs: list = []
+        new_body, changed, _ = self._absorb_exits(node.body, flag, exprs)
+        if not changed or self._has_stray_exit(new_body):
+            # discard this attempt (incl. hoists queued by nested
+            # rewrites inside the discarded body)
+            self.loop_counter = saved_counter
+            self.pending_hoists = saved_hoists
+            return None
+        init = self._flag_assign(flag, 0)
+        guard = self._jst_call(
+            "flag_clear_and",
+            [ast.Name(id=flag, ctx=ast.Load()), node.test])
+        new_while = ast.While(test=guard, body=new_body or [ast.Pass()],
+                              orelse=[])
+        chain = [
+            ast.If(test=ast.Compare(
+                left=ast.Name(id=flag, ctx=ast.Load()),
+                ops=[ast.Eq()], comparators=[ast.Constant(value=r + 1)]),
+                body=[ast.Return(value=expr)], orelse=[])
+            for r, expr in enumerate(exprs)]
+        return [init, new_while] + chain
+
     def process(self, stmts):
         stmts = list(stmts)
         for i, st in enumerate(stmts):
+            if isinstance(st, ast.While) and not st.orelse:
+                repl = self._rewrite_loop(st)
+                if repl is not None:
+                    hoists, self.pending_hoists = self.pending_hoists, []
+                    return self.process(stmts[:i] + hoists + repl
+                                        + stmts[i + 1:])
             if not isinstance(st, ast.If):
                 continue
             body = _truncate_at_return(st.body)

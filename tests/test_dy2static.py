@@ -91,15 +91,15 @@ class TestToStaticIntegration:
     def test_unsupported_falls_back_to_eager(self):
         @to_static
         def k(x):
-            while (x.sum() < 10):
+            for _ in range(20):
                 if (x.max() > 100):
-                    return x        # return inside a LOOP: not converted
+                    return x    # return inside a FOR: not converted
                 x = x * 2
             return x - 1
 
         with warnings.catch_warnings(record=True) as w:
             warnings.simplefilter("always")
-            np.testing.assert_allclose(k(t([1.])).numpy(), [15.])
+            np.testing.assert_allclose(k(t([1.])).numpy(), [128.])
         assert any("EAGER" in str(x.message) for x in w)
 
     def test_python_bool_predicate_untouched(self):
@@ -432,3 +432,156 @@ class TestLivenessCarry:
             warnings.simplefilter("error")   # must stay compiled
             np.testing.assert_allclose(m(t([1.])).numpy(), [2.])
             np.testing.assert_allclose(m(t([-1.])).numpy(), [-2.])
+
+
+class TestLoopExits:
+    """return/break/continue inside a tensor ``while`` convert via the
+    exit-flag transform (SOT loop-exit analogue) instead of bailing the
+    whole function to eager."""
+
+    def test_return_in_while_converts(self):
+        def f(x):
+            while (x.sum() < 10):
+                if (x.max() > 100):
+                    return x
+                x = x * 2
+            return x - 1
+        new = dy2static.convert_function(f)
+        assert new is not None
+        np.testing.assert_allclose(new(t([1.])).numpy(), [15.])
+        # sum<10 but max>100: the in-loop return path
+        np.testing.assert_allclose(new(t([-500., 505.])).numpy(),
+                                   [-500., 505.])
+
+    def test_return_in_while_stays_compiled(self):
+        @to_static
+        def f(x):
+            while (x.sum() < 10):
+                if (x.max() > 100):
+                    return x
+                x = x * 2
+            return x - 1
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")    # no EAGER fallback warning
+            np.testing.assert_allclose(f(t([1.])).numpy(), [15.])
+            np.testing.assert_allclose(f(t([-500., 505.])).numpy(),
+                                       [-500., 505.])
+
+    def test_returned_loop_variable(self):
+        def g(x):
+            i = t(0.)
+            while (i < 10):
+                if ((x * i).sum() > 6):
+                    return i
+                i = i + 1
+            return i * 0 - 1
+        ng = dy2static.convert_function(g)
+        assert ng is not None
+        np.testing.assert_allclose(ng(t([1.])).numpy(), 7.)
+        np.testing.assert_allclose(ng(t([0.])).numpy(), -1.)
+
+    def test_break_converts(self):
+        def h(x):
+            s = x * 0
+            while (s.sum() < 100):
+                s = s + x
+                if (s.sum() > 10):
+                    break
+            return s
+        nh = dy2static.convert_function(h)
+        assert nh is not None
+        np.testing.assert_allclose(nh(t([4.])).numpy(), [12.])
+        np.testing.assert_allclose(nh(t([60.])).numpy(), [60.])
+
+    def test_continue_converts(self):
+        def c(x):
+            s = x * 0
+            i = t(0.)
+            while (i < 5):
+                i = i + 1
+                if (i > 3):
+                    continue
+                s = s + x
+            return s
+        nc = dy2static.convert_function(c)
+        assert nc is not None
+        np.testing.assert_allclose(nc(t([2.])).numpy(), [6.])
+
+    def test_two_returns_in_loop(self):
+        def f(x):
+            i = t(0.)
+            while (i < 8):
+                if ((x + i).sum() > 10):
+                    return x + i
+                if ((x - i).sum() < -10):
+                    return x - i
+                i = i + 1
+            return x * 0
+        nf = dy2static.convert_function(f)
+        assert nf is not None
+        # x=9: at i=2, 9+2=11 > 10 -> returns 11
+        np.testing.assert_allclose(nf(t([9.])).numpy(), [11.])
+        # x=-9: at i=2, -9-2=-11 < -10 -> returns -11
+        np.testing.assert_allclose(nf(t([-9.])).numpy(), [-11.])
+        # x=0: neither fires -> [0.]
+        np.testing.assert_allclose(nf(t([0.])).numpy(), [0.])
+
+    def test_nested_while_return_converts(self):
+        # inner-loop state must be bound BEFORE the outer loop (the
+        # lax.while carry needs an initial value); the reset happens
+        # in-loop
+        def f(x):
+            i = t(0.)
+            j = t(0.)
+            while (i < 3):
+                j = j * 0
+                while (j < 3):
+                    if ((x + i + j).sum() > 4):
+                        return x + i + j
+                    j = j + 1
+                i = i + 1
+            return x * 0
+        nf = dy2static.convert_function(f)
+        assert nf is not None
+        # x=1: first (i,j) with 1+i+j>4: i=2, j=2 -> 5
+        np.testing.assert_allclose(nf(t([1.])).numpy(), [5.])
+        np.testing.assert_allclose(nf(t([9.])).numpy(), [9.])
+
+    def test_grad_through_loop_return(self):
+        @to_static
+        def f(x):
+            while (x.sum() < 10):
+                if (x.max() > 100):
+                    return (x * 5).sum()
+                x = x * 2
+            return (x * 3).sum()
+
+        xp = t([1.])
+        xp.stop_gradient = False
+        f(xp).backward()
+        # path: x doubles 4 times (16), then *3 -> d/dx = 48
+        np.testing.assert_allclose(xp.grad.numpy(), [48.])
+        xq = t([-500., 505.])
+        xq.stop_gradient = False
+        f(xq).backward()
+        np.testing.assert_allclose(xq.grad.numpy(), [5., 5.])
+
+    def test_inloop_bound_return_value_falls_back(self):
+        # the returned name is first bound INSIDE the loop: its carry
+        # init is UNDEF -> runtime ConversionError -> loud eager fallback
+        @to_static
+        def f(x):
+            while (x.sum() < 10):
+                y = x * 7
+                if (y.max() > 100):
+                    return y
+                x = x + 1
+            return x
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            np.testing.assert_allclose(f(t([8.])).numpy(), [10.])
+        assert any("falling back to eager" in str(x.message)
+                   or "EAGER" in str(x.message) for x in w), \
+            [str(x.message) for x in w]
