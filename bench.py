@@ -28,7 +28,11 @@ PEAK_FLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v4": 275e12,
               "v5p": 459e12, "v6e": 918e12, "cpu": 1e12}
 
 TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
-TPU_DEADLINE_S = float(os.environ.get("BENCH_TPU_DEADLINE_S", "480"))
+# r3 learning: 480s deadline-killed the ~1B config mid-compile (its
+# scan_layers compile + 3-batch ladder needs ~10-15 min end to end);
+# the 90s probe already bounds the wedged-tunnel cost, and per-stage
+# BENCH_JSON emission preserves earlier stages if the child dies
+TPU_DEADLINE_S = float(os.environ.get("BENCH_TPU_DEADLINE_S", "1100"))
 CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_DEADLINE_S", "420"))
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
