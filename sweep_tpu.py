@@ -60,6 +60,15 @@ CONFIGS["small_b32_fusedce"] = (dict(SMALL, fused_head_ce=True), 32, 1024,
 CONFIGS["small_b32_nofuse"] = (dict(SMALL, fused_head_ce=False), 32, 1024,
                                True)
 
+# TPU-friendly head geometry: head_dim 64 is padded to 128 lanes by
+# Mosaic inside every attention kernel (2x HBM + MXU waste on the
+# score/value matmuls). Same hidden size + params, 8 heads x 128d
+# (Llama-2 13B's real head_dim) — PROFILE_r03 says attention kernels
+# are 53% of step time, so this is a first-order lever.
+SMALL_HD128 = dict(SMALL, num_attention_heads=8, num_key_value_heads=8)
+CONFIGS["small128_b32_s1024"] = (SMALL_HD128, 32, 1024, True)
+CONFIGS["small128_b16_s2048"] = (SMALL_HD128, 16, 2048, True)
+
 
 if __name__ == "__main__":
     name = sys.argv[1]
