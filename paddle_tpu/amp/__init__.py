@@ -229,3 +229,16 @@ class GradScaler:
     set_state_dict = load_state_dict
 
 from . import debugging  # noqa: F401
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the native TPU matmul dtype (reference:
+    paddle.amp.is_bfloat16_supported — verify)."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """fp16 compute is emulated on TPU (XLA upcasts); supported as a
+    storage dtype."""
+    import jax
+    return jax.default_backend() != "tpu" or True

@@ -9,9 +9,9 @@ jax.distributed.initialize.
 from .parallel import (init_parallel_env, get_rank, get_world_size,
                        ParallelEnv, DataParallel)                 # noqa
 from .communication import (all_reduce, all_gather, all_gather_object,
-                            reduce_scatter, broadcast, scatter, reduce,
-                            alltoall, alltoall_single, send, recv, barrier,
-                            new_group, get_group, wait, stream,
+                            reduce_scatter, broadcast, scatter, gather,
+                            reduce, alltoall, alltoall_single, send, recv,
+                            barrier, new_group, get_group, wait, stream,
                             ReduceOp, P2POp, batch_isend_irecv, irecv, isend)  # noqa
 from .mesh import (HybridCommunicateGroup, get_hybrid_communicate_group,
                    build_device_mesh)                             # noqa
@@ -34,6 +34,26 @@ from . import consistency                                         # noqa
 from .consistency import (program_fingerprint,                    # noqa
                           check_program_consistency)
 
+from . import rpc                                                 # noqa
+from . import ps                                                  # noqa
+from .checkpoint import save_state_dict, load_state_dict          # noqa
+from .fleet import DistributedStrategy as Strategy                # noqa
+from .parallel_layers import split, unshard_dtensor, shard_dataloader  # noqa
+
 # short aliases matching paddle.distributed.*
 is_initialized = parallel_initialized = \
     lambda: ParallelEnv().world_size >= 1
+
+
+def destroy_process_group(group=None):
+    """Release a comm group (reference: dist.destroy_process_group).
+    Mesh-axis groups own no persistent native resources here — XLA
+    collectives are per-program — so this only drops the registry
+    entry (all groups when ``group`` is None)."""
+    from . import communication as _c
+    if group is None:
+        _c._GROUPS.clear()
+        return
+    for k, v in list(_c._GROUPS.items()):
+        if v is group:
+            del _c._GROUPS[k]

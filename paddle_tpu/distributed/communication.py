@@ -539,6 +539,27 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
     return tensor
 
 
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Collect ``tensor`` from every rank into ``gather_list`` on rank
+    ``dst`` (reference: paddle.distributed.gather — verify). Other
+    ranks leave ``gather_list`` untouched. Control-plane transport like
+    the other eager collectives; bulk data belongs inside jitted
+    programs."""
+    g = group or _world()
+    if _single_process() and _is_world(group):
+        if gather_list is not None:
+            gather_list.append(Tensor(_val(tensor)))
+        return gather_list
+    parts = _store_gather(_val(tensor), g, "gather")
+    idx = g.get_group_rank(dst)
+    if idx < 0:
+        raise ValueError(f"gather dst={dst} is not a member of {g}")
+    me = g.rank if not _is_world(g) else _my_rank()
+    if me == idx and gather_list is not None:
+        gather_list.extend(Tensor(jnp.asarray(p)) for p in parts)
+    return gather_list
+
+
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     g = group or _world()
     if _single_process() and _is_world(group):

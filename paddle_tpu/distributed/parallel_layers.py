@@ -1,0 +1,113 @@
+"""Thin paddle.distributed.* op/layer helpers that sit on top of the
+mesh + mpu layers (reference: python/paddle/distributed/collective.py's
+``split`` and python/paddle/distributed/auto_parallel/api.py's
+``unshard_dtensor`` / ``shard_dataloader`` — verify)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=None,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel helper (reference: paddle.distributed.split):
+    builds the partitioned layer for ``operation`` over the current
+    mesh's mp axis and applies it to ``x``.
+
+    - ``operation="linear"``: ``size=(in, out)``; axis=1 column-splits
+      the weight (ColumnParallelLinear), axis=0 row-splits it
+      (RowParallelLinear).
+    - ``operation="embedding"``: ``size=(vocab, dim)``; the vocab dim
+      shards (VocabParallelEmbedding).
+
+    Note: each call BUILDS the layer (static-graph usage, as in the
+    reference); imperative models should instantiate the
+    fleet.meta_parallel layers once instead.
+    """
+    from .fleet.meta_parallel import (ColumnParallelLinear,
+                                      RowParallelLinear,
+                                      VocabParallelEmbedding)
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 1:
+            layer = ColumnParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                gather_output=gather_out)
+        elif axis == 0:
+            layer = RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=not gather_out)
+        else:
+            raise ValueError(f"linear split axis must be 0 or 1, got {axis}")
+    elif operation == "embedding":
+        vocab, dim = size
+        layer = VocabParallelEmbedding(vocab, dim, weight_attr=weight_attr)
+    else:
+        raise ValueError(f"unsupported split operation {operation!r}")
+    return layer(x)
+
+
+def unshard_dtensor(dist_tensor):
+    """Dist tensor → plain replicated Tensor with the full global value
+    (reference: dist.unshard_dtensor). Partial placements are summed
+    first (never silently dropped)."""
+    from ..tensor import Tensor
+    mesh = getattr(dist_tensor, "process_mesh", None)
+    if mesh is None:
+        return dist_tensor
+    from .auto_parallel_api import Replicate, reshard
+    ndim = len(mesh.shape)
+    rep = reshard(dist_tensor, mesh, [Replicate() for _ in range(ndim)])
+    out = Tensor(rep._dense_value(),
+                 stop_gradient=dist_tensor.stop_gradient)
+    return out
+
+
+class _ShardDataloader:
+    """Iterates a loader, placing each batch on ``mesh`` sharded along
+    ``shard_dims`` (batch dim by default) — the input side of the
+    semi-auto-parallel story (reference: dist.shard_dataloader)."""
+
+    def __init__(self, dataloader, meshes, input_keys=None,
+                 shard_dims=None, is_dataset_splitted=False):
+        self._loader = dataloader
+        self._mesh = meshes[0] if isinstance(meshes, (list, tuple)) \
+            else meshes
+        self._input_keys = input_keys
+        # shard_dims: mesh axis name to shard the batch over (defaults
+        # to the first mesh axis); None disables sharding (replicate)
+        if shard_dims is None:
+            shard_dims = self._mesh.dim_names[0] \
+                if getattr(self._mesh, "dim_names", None) else None
+        self._shard_dim = shard_dims
+
+    def __len__(self):
+        return len(self._loader)
+
+    def _place(self, value):
+        from .auto_parallel_api import Replicate, Shard, shard_tensor
+        placements = []
+        for name in self._mesh.dim_names:
+            if name == self._shard_dim:
+                placements.append(Shard(0))
+            else:
+                placements.append(Replicate())
+        return shard_tensor(value, self._mesh, placements)
+
+    def __iter__(self):
+        for batch in self._loader:
+            if isinstance(batch, dict):
+                keys = self._input_keys or list(batch)
+                yield {k: self._place(batch[k]) if k in keys else batch[k]
+                       for k in batch}
+            elif isinstance(batch, (list, tuple)):
+                yield type(batch)(self._place(b) for b in batch)
+            else:
+                yield self._place(batch)
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    return _ShardDataloader(dataloader, meshes, input_keys, shard_dims,
+                            is_dataset_splitted)

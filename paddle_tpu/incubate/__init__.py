@@ -116,3 +116,27 @@ class ModelAverage:
     def __exit__(self, *exc):
         self.restore()
 
+
+
+# reference: paddle.incubate.segment_* / graph_send_recv re-export the
+# geometric kernels (python/paddle/incubate/operators/ — verify)
+from ..geometric import (segment_sum, segment_mean, segment_max,  # noqa
+                         segment_min)
+from ..geometric import send_u_recv as graph_send_recv            # noqa
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused masked softmax (reference: incubate.softmax_mask_fuse —
+    the CUDA fusion; XLA fuses the add+softmax chain natively)."""
+    from ..nn import functional as F
+    return F.softmax(x + mask, axis=-1)
+
+
+def identity_loss(x, reduction="none"):
+    """Marks a tensor as a loss without changing it (reference:
+    incubate.identity_loss; reduction: none|sum|mean)."""
+    if reduction in (1, "sum"):
+        return x.sum()
+    if reduction in (2, "mean"):
+        return x.mean()
+    return x

@@ -17,3 +17,8 @@ from ..param_attr import ParamAttr            # noqa: F401
 from . import common, conv, norm, pooling, loss, transformer, rnn  # noqa
 from . import decode  # noqa
 from . import utils  # noqa
+
+# grad-clip classes live on the optimizer module; paddle exposes them
+# under paddle.nn as well (reference: python/paddle/nn/clip.py — verify)
+from ..optimizer import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa
+                         ClipGradByValue)

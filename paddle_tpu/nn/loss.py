@@ -5,7 +5,7 @@ from . import functional as F
 from .layer import Layer
 
 __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
-           "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss",
+           "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss", "HuberLoss",
            "MarginRankingLoss", "CosineEmbeddingLoss"]
 
 
@@ -101,6 +101,29 @@ class SmoothL1Loss(Layer):
 
     def forward(self, input, label):
         return F.smooth_l1_loss(input, label, self.reduction, self.delta)
+
+
+class HuberLoss(Layer):
+    """0.5*d^2 for |d|<=delta else delta*(|d|-0.5*delta) (reference:
+    paddle.nn.HuberLoss — verify; differs from SmoothL1Loss by the
+    1/delta scaling of the quadratic zone)."""
+
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self.reduction = reduction
+        self.delta = float(delta)
+
+    def forward(self, input, label):
+        from .. import ops
+        d = input - label
+        ad = d.abs()
+        loss = ops.where(ad <= self.delta, 0.5 * d * d,
+                         self.delta * (ad - 0.5 * self.delta))
+        if self.reduction == "mean":
+            return loss.mean()
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss
 
 
 class MarginRankingLoss(Layer):

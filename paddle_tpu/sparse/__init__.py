@@ -21,7 +21,7 @@ from ..tensor import Tensor, to_tensor
 
 __all__ = [
     "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
-    "sparse_csr_tensor", "is_same_shape", "matmul", "masked_matmul", "mv",
+    "sparse_csr_tensor", "is_same_shape", "mask_as", "matmul", "masked_matmul", "mv",
     "add", "subtract", "multiply", "divide", "transpose", "relu", "tanh",
     "sin", "abs", "pow", "neg", "coalesce", "sqrt", "square", "cast",
 ]
@@ -135,6 +135,20 @@ def is_same_shape(x, y):
 
 def coalesce(x):
     return x.coalesce()
+
+
+def mask_as(x, mask, name=None):
+    """Dense ``x`` filtered by the sparsity pattern of ``mask``
+    (reference: paddle.sparse.mask_as — verify): returns a sparse
+    tensor with mask's layout/indices and values taken from x."""
+    xv = _as_array(x)
+    was_csr = isinstance(mask, SparseCsrTensor)
+    m = mask._mat.to_bcoo() if was_csr else mask._mat
+    vals = xv[tuple(m.indices[:, d] for d in range(m.indices.shape[1]))]
+    out = jsparse.BCOO((vals, m.indices), shape=m.shape)
+    if was_csr:
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(out))
+    return SparseCooTensor(out)
 
 
 # --- linear algebra ---------------------------------------------------------

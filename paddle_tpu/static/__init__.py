@@ -18,6 +18,7 @@ donated step and writes updated parameters back.
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Dict, Optional, Sequence
 
 import numpy as np
@@ -29,7 +30,8 @@ from ..tensor import Parameter, Tensor
 __all__ = ["InputSpec", "Program", "Executor", "data", "program_guard",
            "default_main_program", "default_startup_program",
            "name_scope", "device_guard", "amp", "CompiledProgram",
-           "global_scope", "cpu_places", "append_backward"]
+           "global_scope", "cpu_places", "append_backward", "gradients",
+           "save_inference_model", "load_inference_model"]
 
 
 class InputSpec:
@@ -122,6 +124,29 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     return []
 
 
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Symbolic gradients of sum(targets) w.r.t. ``inputs`` (reference:
+    paddle.static.gradients — verify). Returns one grad tensor per
+    input; fetching it makes Executor.run differentiate the recorded
+    program with jax.grad during replay."""
+    import jax
+    if target_gradients is not None:
+        raise NotImplementedError(
+            "static.gradients(target_gradients=...) is unsupported")
+    tgts = tuple(targets) if isinstance(targets, (list, tuple)) \
+        else (targets,)
+    ins = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    outs = []
+    for x in ins:
+        g = Tensor(jax.ShapeDtypeStruct(tuple(x.shape), x._value.dtype),
+                   stop_gradient=True,
+                   name=f"{getattr(x, 'name', 'x')}@GRAD")
+        g._static_src = None
+        g._static_grad = (tgts, x)
+        outs.append(g)
+    return outs
+
+
 def _mark_train(program: Program, loss: Tensor, optimizer) -> None:
     """Called by Optimizer.minimize under static mode."""
     program._train = (loss, optimizer)
@@ -131,6 +156,23 @@ def _replay(t, env, feeds_by_name):
     """Evaluate tensor `t` from its producer record (memoized in env)."""
     if id(t) in env:
         return env[id(t)]
+    gsrc = getattr(t, "_static_grad", None)
+    if gsrc is not None:           # a static.gradients() output
+        import jax
+        targets, wrt = gsrc
+        xval = _replay(wrt, env, feeds_by_name)
+
+        def scalar(xv):
+            env2 = {id(wrt): xv}
+            tot = None
+            for tg in targets:
+                s = _replay(tg, env2, feeds_by_name).sum()
+                tot = s if tot is None else tot + s
+            return tot
+
+        val = jax.grad(scalar)(xval)
+        env[id(t)] = val
+        return val
     src = getattr(t, "_static_src", None)
     if src is None:
         val = feeds_by_name.get(t.name, t._value)
@@ -163,6 +205,13 @@ class Executor:
 
         program = program or _default_program
         fetch_list = list(fetch_list or [])
+        if isinstance(program, _LoadedInference):
+            vals = [jnp_val for jnp_val in (
+                self._feeds(feed)[n] for n in program.feed_names)]
+            outs = program(*vals)
+            if return_numpy:
+                return [np.asarray(o) for o in outs]
+            return [Tensor(o) for o in outs]
         if not fetch_list:
             return []
         if program._train is not None:
@@ -225,6 +274,64 @@ class Executor:
 class CompiledProgram:
     def __init__(self, program, build_strategy=None):
         self.program = program
+
+
+class _LoadedInference:
+    """Deserialized inference program: Executor.run recognizes it and
+    calls the compiled StableHLO directly."""
+
+    def __init__(self, exported, feed_names, n_fetch):
+        self._exported = exported
+        self.feed_names = list(feed_names)
+        self.n_fetch = n_fetch
+
+    def __call__(self, *feed_vals):
+        return self._exported.call(*feed_vals)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Serialize the recorded static program feeds→fetches as StableHLO
+    (reference: paddle.static.save_inference_model writes
+    .pdmodel/.pdiparams — verify; here ONE portable artifact holds
+    program + constants, the same contract as inference.export_model)."""
+    import json as _json
+    import jax
+
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+
+    def fn(*feed_vals):
+        feeds_by_name = {v.name: val for v, val in
+                         zip(feed_vars, feed_vals)}
+        env: dict = {}
+        return [_replay(t, env, feeds_by_name) for t in fetch_vars]
+
+    specs = [jax.ShapeDtypeStruct(tuple(v.shape), v._value.dtype)
+             for v in feed_vars]
+    exported = jax.export.export(jax.jit(fn))(*specs)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdmeta", "w") as f:
+        _json.dump({"feed_names": [v.name for v in feed_vars],
+                    "n_fetch": len(fetch_vars)}, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns ``[program, feed_target_names, fetch_targets]`` as the
+    reference does; pass the program to :meth:`Executor.run`."""
+    import json as _json
+    import jax
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    with open(path_prefix + ".pdmeta") as f:
+        meta = _json.load(f)
+    prog = _LoadedInference(exported, meta["feed_names"],
+                            meta["n_fetch"])
+    fetch_targets = list(range(meta["n_fetch"]))
+    return [prog, prog.feed_names, fetch_targets]
 
 
 def global_scope():

@@ -123,7 +123,8 @@ class Tensor:
                  # dense value on first consumption (dist.reshard p→r)
                  "_partial_axes",
                  # static-graph mode: producer record (paddle_tpu.static)
-                 "_static_src", "__weakref__")
+                 # + static.gradients() marker (targets, wrt)
+                 "_static_src", "_static_grad", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True,
                  name: Optional[str] = None):
