@@ -78,6 +78,13 @@ _METHODS = [
     "subtract_", "scale_", "clip_", "remainder_", "zero_", "stack",
     "unstack", "diagonal", "tril", "triu", "moveaxis", "flip",
     "count_nonzero", "nan_to_num", "neg", "atan2", "frexp", "ldexp",
+    # r3 long-tail method bindings (each already a module-level op)
+    "masked_fill_", "cross", "histogram", "bincount", "t", "inner",
+    "outer", "diag", "rot90", "index_fill", "index_fill_", "index_put",
+    "index_put_", "fill_diagonal_", "lerp_", "cov", "corrcoef",
+    "nanmedian", "mode", "kthvalue", "quantile", "view", "view_as",
+    "unfold", "as_strided", "swapaxes", "amin", "amax", "nansum",
+    "nanmean", "logcumsumexp", "renorm", "multiplex", "stanh", "softsign",
 ]
 
 for m in _METHODS:
@@ -90,3 +97,7 @@ for m in _METHODS:
 # a few methods whose names collide with properties / need wrapping
 Tensor.cast = lambda self, dtype: cast(self, dtype)
 Tensor.astype = lambda self, dtype: cast(self, dtype)
+Tensor.ndimension = lambda self: len(self.shape)
+# XLA arrays are always dense/row-major from the API's perspective
+Tensor.contiguous = lambda self: self
+Tensor.is_contiguous = lambda self: True

@@ -394,6 +394,35 @@ def masked_fill(x, mask, value, name=None):
         x, mask)
 
 
+def masked_fill_(x, mask, value, name=None):
+    """In-place masked_fill (tape-aware like index_fill_)."""
+    val = _v(value)
+    m_v = mask._value if isinstance(mask, Tensor) else jnp.asarray(mask)
+    if x._inplace_wants_grad():
+        def pure(v):
+            return jnp.where(m_v.astype(bool), jnp.asarray(val, v.dtype), v)
+        return x._record_inplace(pure)
+    out = masked_fill(x, mask, value)
+    x._update_value(out._value)
+    return x
+
+
+def index_put_(x, indices, value, accumulate=False, name=None):
+    """In-place index_put (tape-aware)."""
+    idx = tuple(i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in indices)
+    idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(
+        i.dtype, jnp.integer) else i for i in idx)
+    u = value._value if isinstance(value, Tensor) else jnp.asarray(value)
+    if x._inplace_wants_grad():
+        def pure(v):
+            return v.at[idx].add(u) if accumulate else v.at[idx].set(u)
+        return x._record_inplace(pure)
+    out = index_put(x, indices, value, accumulate)
+    x._update_value(out._value)
+    return x
+
+
 def masked_scatter(x, mask, value, name=None):
     v = np.asarray(x._value)
     m = np.broadcast_to(np.asarray(mask._value).astype(bool), v.shape)

@@ -211,6 +211,23 @@ def lerp(x, y, weight, name=None):
     return apply_op(lambda a, b: a + weight * (b - a), x, y)
 
 
+def lerp_(x, y, weight, name=None):
+    """In-place lerp (tape-aware)."""
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    wv = weight._value if isinstance(weight, Tensor) else weight
+    if x._inplace_wants_grad():
+        return x._record_inplace(lambda a: a + wv * (yv - a))
+    out = lerp(x, y, weight)
+    x._update_value(out._value)
+    return x
+
+
+def softsign(x, name=None):
+    """x / (1 + |x|) (reference: paddle.nn.functional.softsign; exposed
+    as a Tensor method too — verify)."""
+    return apply_op(lambda v: v / (1 + jnp.abs(v)), x)
+
+
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
     return apply_op(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
 

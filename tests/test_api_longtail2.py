@@ -135,6 +135,54 @@ class TestStaticGradients:
         np.testing.assert_allclose(out[0], [3., 5.], rtol=1e-6)
 
 
+class TestTensorMethodBindings:
+    def test_new_method_bindings_present(self):
+        t = paddle.to_tensor(np.ones((2, 2), np.float32))
+        for m in ("masked_fill_", "cross", "histogram", "bincount", "t",
+                  "inner", "outer", "diag", "rot90", "index_fill",
+                  "index_put_", "fill_diagonal_", "lerp_", "ndimension",
+                  "contiguous", "is_contiguous", "cov", "corrcoef",
+                  "kthvalue", "quantile", "view", "unfold", "swapaxes",
+                  "amin", "amax", "nansum", "nanmean", "logcumsumexp",
+                  "renorm", "multiplex", "stanh", "softsign"):
+            assert hasattr(t, m), m
+        assert t.ndimension() == 2
+        assert t.is_contiguous() is True
+
+    def test_masked_fill_inplace_grad(self):
+        x = paddle.to_tensor(np.array([1., 2., 3.], np.float32))
+        x.stop_gradient = False
+        y = x * 1.0
+        y.masked_fill_(paddle.to_tensor(np.array([True, False, False])),
+                       9.0)
+        y.sum().backward()
+        np.testing.assert_allclose(y.numpy(), [9., 2., 3.])
+        np.testing.assert_allclose(x.grad.numpy(), [0., 1., 1.])
+
+    def test_lerp_inplace_grad(self):
+        x = paddle.to_tensor(np.array([0., 0.], np.float32))
+        x.stop_gradient = False
+        z = x * 1.0
+        z.lerp_(paddle.to_tensor(np.array([2., 4.], np.float32)), 0.5)
+        z.sum().backward()
+        np.testing.assert_allclose(z.numpy(), [1., 2.])
+        np.testing.assert_allclose(x.grad.numpy(), [0.5, 0.5])
+
+    def test_index_put_inplace_grad(self):
+        w = paddle.to_tensor(np.zeros(3, np.float32))
+        w.stop_gradient = False
+        u = w * 1.0
+        u.index_put_((paddle.to_tensor(np.array([0, 2])),),
+                     paddle.to_tensor(np.array([5., 6.], np.float32)))
+        u.sum().backward()
+        np.testing.assert_allclose(u.numpy(), [5., 0., 6.])
+        np.testing.assert_allclose(w.grad.numpy(), [0., 1., 0.])
+
+    def test_softsign(self):
+        out = paddle.to_tensor(np.array([1., -3.], np.float32)).softsign()
+        np.testing.assert_allclose(out.numpy(), [0.5, -0.75])
+
+
 class TestDistributedAdditions:
     def test_gather_single_process(self):
         import paddle_tpu.distributed as dist
