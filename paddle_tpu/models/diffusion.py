@@ -25,7 +25,8 @@ from ..ops.manipulation import concat, reshape, transpose
 from ..tensor import apply_op
 
 __all__ = ["UNetConfig", "UNet2DConditionModel", "DDPMScheduler",
-           "DDIMScheduler", "LatentDiffusion", "sdxl_tiny_config",
+           "DDIMScheduler", "LatentDiffusion", "AutoencoderKL",
+           "StableDiffusionPipeline", "sdxl_tiny_config",
            "sdxl_base_config", "get_timestep_embedding"]
 
 
@@ -419,3 +420,162 @@ class LatentDiffusion(nn.Layer):
         pred = self.unet(noisy, timesteps, encoder_hidden_states,
                          added_cond)
         return F.mse_loss(pred, noise)
+
+
+# ---------------------------------------------------------------------------
+# VAE (AutoencoderKL) — the latent codec of the SD/SDXL pipeline
+# ---------------------------------------------------------------------------
+
+class _VaeResBlock(nn.Layer):
+    """Time-embedding-free resnet block for the autoencoder."""
+
+    def __init__(self, cin, cout, groups=32):
+        super().__init__()
+        g = min(groups, cin, cout)
+        self.norm1 = nn.GroupNorm(min(g, cin), cin)
+        self.conv1 = nn.Conv2D(cin, cout, 3, padding=1)
+        self.norm2 = nn.GroupNorm(min(g, cout), cout)
+        self.conv2 = nn.Conv2D(cout, cout, 3, padding=1)
+        self.skip = nn.Conv2D(cin, cout, 1) if cin != cout else None
+
+    def forward(self, x):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = self.conv2(F.silu(self.norm2(h)))
+        return (self.skip(x) if self.skip is not None else x) + h
+
+
+class AutoencoderKL(nn.Layer):
+    """Compact KL autoencoder (reference: ppdiffusers AutoencoderKL —
+    verify): conv encoder to (mean, logvar) latents at 1/2^L resolution,
+    conv decoder back to pixels. ``scaling_factor`` matches the SD latent
+    convention (latents multiplied by it before the UNet)."""
+
+    def __init__(self, in_channels=3, latent_channels=4,
+                 block_out_channels=(128, 256, 512, 512),
+                 scaling_factor=0.13025):
+        super().__init__()
+        self.scaling_factor = scaling_factor
+        chs = list(block_out_channels)
+        self.conv_in = nn.Conv2D(in_channels, chs[0], 3, padding=1)
+        downs = []
+        for i, c in enumerate(chs):
+            cin = chs[i - 1] if i else chs[0]
+            downs.append(_VaeResBlock(cin, c))
+            if i < len(chs) - 1:
+                downs.append(nn.Conv2D(c, c, 3, stride=2, padding=1))
+        self.down_blocks = nn.LayerList(downs)
+        self.mid = _VaeResBlock(chs[-1], chs[-1])
+        self.conv_norm_out = nn.GroupNorm(min(32, chs[-1]), chs[-1])
+        self.quant_conv = nn.Conv2D(chs[-1], 2 * latent_channels, 1)
+        # decoder
+        self.post_quant_conv = nn.Conv2D(latent_channels, chs[-1], 1)
+        self.mid_dec = _VaeResBlock(chs[-1], chs[-1])
+        ups = []
+        rev = chs[::-1]
+        for i, c in enumerate(rev):
+            cin = rev[i - 1] if i else rev[0]
+            ups.append(_VaeResBlock(cin, c))
+            if i < len(rev) - 1:
+                ups.append(Upsample2D(c))
+        self.up_blocks = nn.LayerList(ups)
+        self.norm_out = nn.GroupNorm(min(32, rev[-1]), rev[-1])
+        self.conv_out = nn.Conv2D(rev[-1], in_channels, 3, padding=1)
+
+    def encode(self, x):
+        """pixels (b,c,h,w) → (mean, logvar) latents."""
+        h = self.conv_in(x)
+        for blk in self.down_blocks:
+            h = blk(h)
+        h = self.mid(h)
+        h = self.quant_conv(F.silu(self.conv_norm_out(h)))
+        c = h.shape[1] // 2
+        from ..ops.manipulation import split as _split
+        mean, logvar = _split(h, 2, axis=1)
+        return mean, logvar
+
+    def sample_latent(self, x, key=None):
+        mean, logvar = self.encode(x)
+        if key is None:
+            return mean * self.scaling_factor
+        eps = apply_op(
+            lambda lv: jax.random.normal(key, lv.shape, lv.dtype), logvar)
+        z = mean + (logvar * 0.5).exp() * eps
+        return z * self.scaling_factor
+
+    def decode(self, z):
+        """latents → pixels; undoes the scaling factor."""
+        h = self.post_quant_conv(z * (1.0 / self.scaling_factor))
+        h = self.mid_dec(h)
+        for blk in self.up_blocks:
+            h = blk(h)
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+    def forward(self, x):
+        """Reconstruction + KL terms (training objective)."""
+        mean, logvar = self.encode(x)
+        z = mean  # deterministic forward for the loss path
+        rec = self.decode(z * self.scaling_factor)
+        rec_loss = F.mse_loss(rec, x)
+        kl = (0.5 * ((mean * mean) + logvar.exp() - 1.0 - logvar)).mean()
+        return rec_loss + 1e-6 * kl
+
+
+class StableDiffusionPipeline:
+    """Text-to-image sampling: classifier-free guidance over the UNet,
+    the whole denoising loop as ONE lax.scan program, then VAE decode
+    (reference: ppdiffusers StableDiffusionXLPipeline.__call__ —
+    verify). Text encoding is caller-supplied embeddings (any encoder —
+    e.g. models.t5.T5Encoder — plays the CLIP role)."""
+
+    def __init__(self, unet: UNet2DConditionModel, vae: AutoencoderKL,
+                 scheduler: DDIMScheduler = None):
+        self.unet = unet
+        self.vae = vae
+        self.scheduler = scheduler or DDIMScheduler()
+
+    def __call__(self, prompt_embeds, negative_embeds, *, steps=30,
+                 guidance_scale=5.0, latents=None, seed=0,
+                 added_cond=None):
+        """prompt_embeds / negative_embeds: (b, s, context_dim) Tensors
+        (``added_cond``, if given, must already be batched for the
+        doubled cfg batch). Returns decoded images (b, c, H, W).
+        Requires a DDIM-compatible scheduler (step(...) accepting
+        ``prev_timestep``) — the default."""
+        import numpy as _np
+        from ..framework import functional_mode, rng_context
+        from ..tensor import Tensor as TT
+
+        cfg = self.unet.config
+        b = prompt_embeds.shape[0]
+        T = self.scheduler.num_train_timesteps
+        ts = jnp.asarray(_np.linspace(T - 1, 0, steps).round()
+                         .astype(_np.int32))
+        prev = jnp.concatenate([ts[1:], jnp.array([-1], jnp.int32)])
+        ctx_v = concat([negative_embeds, prompt_embeds], axis=0)._value
+
+        def denoise(z0):
+            def body(z, t_pair):
+                t, tp = t_pair
+                zz = jnp.concatenate([z, z], axis=0)
+                tt = jnp.full((2 * b,), t, jnp.int32)
+                with functional_mode(), rng_context(
+                        jax.random.PRNGKey(0)):
+                    eps = self.unet(TT(zz), TT(tt), TT(ctx_v),
+                                    added_cond)._value
+                e_un, e_tx = eps[:b], eps[b:]
+                e = e_un + guidance_scale * (e_tx - e_un)
+                z = self.scheduler.step(e, t, z, prev_timestep=tp)
+                return z, None
+
+            out, _ = jax.lax.scan(body, z0, (ts, prev))
+            return out
+
+        if latents is None:
+            z = jax.random.normal(
+                jax.random.PRNGKey(seed),
+                (b, cfg.in_channels, cfg.sample_size, cfg.sample_size),
+                jnp.float32)
+        else:
+            z = latents._value
+        z = jax.jit(denoise)(z)
+        return self.vae.decode(TT(z))
