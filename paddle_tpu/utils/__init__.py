@@ -17,3 +17,5 @@ def try_import(module_name):
         return None
 
 from . import dlpack  # noqa: F401
+from . import cpp_extension  # noqa: F401,E402
+from . import unique_name    # noqa: F401,E402

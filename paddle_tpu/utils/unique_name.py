@@ -1,0 +1,32 @@
+"""Unique-name generator (reference: python/paddle/utils/unique_name.py
+— the name scopes behind parameter/op auto-naming — verify)."""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+_counters: dict[str, int] = {}
+
+
+def generate(key: str = "tmp") -> str:
+    _counters[key] = _counters.get(key, 0)
+    name = f"{key}_{_counters[key]}"
+    _counters[key] += 1
+    return name
+
+
+def switch(new_state=None):
+    global _counters
+    old = _counters
+    _counters = {} if new_state is None else new_state
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_state=None):
+    old = switch({} if new_state is None else new_state)
+    try:
+        yield
+    finally:
+        switch(old)

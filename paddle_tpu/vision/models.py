@@ -729,3 +729,147 @@ def googlenet(pretrained=False, **kwargs):
 
 __all__ += ["MobileNetV3", "mobilenet_v3_large", "mobilenet_v3_small",
             "GoogLeNet", "googlenet"]
+
+
+class _ConvBN(Layer):
+    def __init__(self, in_c, out_c, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(in_c, out_c, k, stride=stride, padding=padding,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(out_c)
+        self.act = ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class _InceptionA(Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 64, 1)
+        self.b5 = Sequential(_ConvBN(in_c, 48, 1),
+                             _ConvBN(48, 64, 5, padding=2))
+        self.b3 = Sequential(_ConvBN(in_c, 64, 1),
+                             _ConvBN(64, 96, 3, padding=1),
+                             _ConvBN(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1),
+                             _ConvBN(in_c, pool_c, 1))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                      axis=1)
+
+
+class _InceptionB(Layer):           # grid reduction 35 -> 17
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _ConvBN(in_c, 384, 3, stride=2)
+        self.bd = Sequential(_ConvBN(in_c, 64, 1),
+                             _ConvBN(64, 96, 3, padding=1),
+                             _ConvBN(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b3(x), self.bd(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(Layer):           # factorized 7x7
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 192, 1)
+        self.b7 = Sequential(
+            _ConvBN(in_c, c7, 1),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b77 = Sequential(
+            _ConvBN(in_c, c7, 1),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            _ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            _ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1),
+                             _ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b1(x), self.b7(x), self.b77(x), self.bp(x)],
+                      axis=1)
+
+
+class _InceptionD(Layer):           # grid reduction 17 -> 8
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = Sequential(_ConvBN(in_c, 192, 1),
+                             _ConvBN(192, 320, 3, stride=2))
+        self.b7 = Sequential(_ConvBN(in_c, 192, 1),
+                             _ConvBN(192, 192, (1, 7), padding=(0, 3)),
+                             _ConvBN(192, 192, (7, 1), padding=(3, 0)),
+                             _ConvBN(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(Layer):           # expanded-filter-bank output blocks
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _ConvBN(in_c, 320, 1)
+        self.b3_stem = _ConvBN(in_c, 384, 1)
+        self.b3_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bd_stem = Sequential(_ConvBN(in_c, 448, 1),
+                                  _ConvBN(448, 384, 3, padding=1))
+        self.bd_a = _ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.bd_b = _ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, 1, padding=1),
+                             _ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        s3 = self.b3_stem(x)
+        sd = self.bd_stem(x)
+        return concat([self.b1(x),
+                       concat([self.b3_a(s3), self.b3_b(s3)], axis=1),
+                       concat([self.bd_a(sd), self.bd_b(sd)], axis=1),
+                       self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    """(reference: python/paddle/vision/models/inceptionv3.py — verify;
+    aux head omitted as in inference-mode reference use). 299x299 input."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        from ..nn import Dropout
+        self.stem = Sequential(
+            _ConvBN(3, 32, 3, stride=2), _ConvBN(32, 32, 3),
+            _ConvBN(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            _ConvBN(64, 80, 1), _ConvBN(80, 192, 3), MaxPool2D(3, 2))
+        self.blocks = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64),
+            _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        self.avgpool = AdaptiveAvgPool2D((1, 1))
+        self.dropout = Dropout(0.5)
+        self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        from ..ops.manipulation import flatten
+        x = self.blocks(self.stem(x))
+        x = self.dropout(flatten(self.avgpool(x), 1))
+        return self.fc(x)
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)
+
+
+__all__ += ["InceptionV3", "inception_v3"]

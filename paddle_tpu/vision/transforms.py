@@ -129,3 +129,251 @@ class Transpose:
         arr = np.asarray(img._value if isinstance(img, Tensor) else img)
         out = arr.transpose(self.order)
         return to_tensor(out) if isinstance(img, Tensor) else out
+
+
+def _arr(img):
+    return np.asarray(img._value if isinstance(img, Tensor) else img)
+
+
+def _ret(out, img):
+    return to_tensor(np.ascontiguousarray(out)) \
+        if isinstance(img, Tensor) else np.ascontiguousarray(out)
+
+
+def _hwc_view(arr):
+    """(channel-first?, hwc array) — transforms operate in HWC."""
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+    return chw, (arr.transpose(1, 2, 0) if chw else arr)
+
+
+def _back(out, chw):
+    return out.transpose(2, 0, 1) if chw else out
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+class Pad:
+    """Constant/edge/reflect padding (reference: transforms.Pad)."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding, padding, padding, padding)
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding           # (left, top, right, bottom)
+        self.fill = fill
+        self.mode = padding_mode
+
+    def __call__(self, img):
+        arr = _arr(img)
+        chw, hwc = _hwc_view(arr)
+        l, t, r, b = self.padding
+        pad = ((t, b), (l, r)) + (((0, 0),) if hwc.ndim == 3 else ())
+        if self.mode == "constant":
+            out = np.pad(hwc, pad, constant_values=self.fill)
+        else:
+            out = np.pad(hwc, pad, mode=self.mode)
+        return _ret(_back(out, chw), img)
+
+
+class RandomRotation:
+    """Rotation by a uniform angle in [-degrees, degrees]; bilinear
+    sampling on the HWC grid (reference: transforms.RandomRotation)."""
+
+    def __init__(self, degrees, interpolation="nearest", fill=0):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.fill = fill
+
+    def __call__(self, img):
+        angle = np.random.uniform(*self.degrees) * np.pi / 180.0
+        arr = _arr(img).astype(np.float32)
+        chw, hwc = _hwc_view(arr)
+        h, w = hwc.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+        ys, xs = yy - cy, xx - cx
+        cos, sin = np.cos(angle), np.sin(angle)
+        sy = (cos * ys - sin * xs + cy).round().astype(np.int64)
+        sx = (sin * ys + cos * xs + cx).round().astype(np.int64)
+        valid = (sy >= 0) & (sy < h) & (sx >= 0) & (sx < w)
+        sy, sx = sy.clip(0, h - 1), sx.clip(0, w - 1)
+        out = hwc[sy, sx]
+        out[~valid] = self.fill
+        return _ret(_back(out, chw), img)
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop then resize (reference:
+    transforms.RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear"):
+        self.size = size if isinstance(size, (tuple, list)) \
+            else (size, size)
+        self.scale, self.ratio = scale, ratio
+
+    def __call__(self, img):
+        arr = _arr(img)
+        chw, hwc = _hwc_view(arr)
+        h, w = hwc.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                crop = hwc[i:i + ch, j:j + cw]
+                break
+        else:
+            m = min(h, w)
+            i, j = (h - m) // 2, (w - m) // 2
+            crop = hwc[i:i + m, j:j + m]
+        out = Resize(self.size)(_back(crop, chw) if chw else crop)
+        return out if isinstance(img, Tensor) == isinstance(out, Tensor) \
+            else _ret(np.asarray(out), img)
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        arr = _arr(img).astype(np.float32)
+        chw, hwc = _hwc_view(arr)
+        if hwc.ndim == 2:
+            g = hwc[..., None]
+        else:
+            g = (hwc[..., :3] @ np.array([0.299, 0.587, 0.114],
+                                         np.float32))[..., None]
+        out = np.repeat(g, self.n, axis=-1)
+        return _ret(_back(out, chw), img)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _factor(self):
+        return np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+
+    def __call__(self, img):
+        return _ret(_arr(img).astype(np.float32) * self._factor(), img)
+
+
+class ContrastTransform(BrightnessTransform):
+    def __call__(self, img):
+        arr = _arr(img).astype(np.float32)
+        f = self._factor()
+        return _ret(arr.mean() + f * (arr - arr.mean()), img)
+
+
+class SaturationTransform(BrightnessTransform):
+    def __call__(self, img):
+        arr = _arr(img).astype(np.float32)
+        chw, hwc = _hwc_view(arr)
+        gray = Grayscale(hwc.shape[-1] if hwc.ndim == 3 else 1)
+        g = _arr(gray(_back(hwc, False)))
+        f = self._factor()
+        out = g + f * (hwc - g)
+        return _ret(_back(out, chw), img)
+
+
+class HueTransform:
+    """Hue shift by a uniform delta in [-value, value] (value <= 0.5),
+    via RGB->HSV->RGB on floats."""
+
+    def __init__(self, value):
+        self.value = float(value)
+
+    def __call__(self, img):
+        import colorsys
+        arr = _arr(img).astype(np.float32)
+        chw, hwc = _hwc_view(arr)
+        scale = 255.0 if hwc.max() > 1.5 else 1.0
+        x = hwc / scale
+        delta = np.random.uniform(-self.value, self.value)
+        mx, mn = x[..., :3].max(-1), x[..., :3].min(-1)
+        # vectorized hue rotation through HSV
+        r, g, b = x[..., 0], x[..., 1], x[..., 2]
+        c = mx - mn
+        hue = np.zeros_like(mx)
+        m = c > 1e-8
+        rc = np.where(m, (mx - r) / np.where(m, c, 1), 0)
+        gc = np.where(m, (mx - g) / np.where(m, c, 1), 0)
+        bc = np.where(m, (mx - b) / np.where(m, c, 1), 0)
+        hue = np.where(mx == r, bc - gc,
+                       np.where(mx == g, 2 + rc - bc, 4 + gc - rc)) / 6.0
+        hue = (hue + delta) % 1.0
+        i = np.floor(hue * 6).astype(np.int64) % 6
+        f = hue * 6 - np.floor(hue * 6)
+        p, q, t = mn, mx - c * f, mx - c * (1 - f)
+        rgb = np.stack([
+            np.select([i == k for k in range(6)],
+                      [mx, q, p, p, t, mx]),
+            np.select([i == k for k in range(6)],
+                      [t, mx, mx, q, p, p]),
+            np.select([i == k for k in range(6)],
+                      [p, p, t, mx, mx, q])], axis=-1)
+        out = rgb * scale
+        return _ret(_back(out, chw), img)
+
+
+class ColorJitter:
+    """Random brightness/contrast/saturation/hue in random order
+    (reference: transforms.ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def __call__(self, img):
+        for idx in np.random.permutation(len(self.ts)):
+            img = self.ts[idx](img)
+        return img
+
+
+class RandomErasing:
+    """Random rectangle erase (reference: transforms.RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0):
+        self.prob, self.scale, self.ratio, self.value = \
+            prob, scale, ratio, value
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = _arr(img).copy()
+        chw, hwc = _hwc_view(arr)
+        h, w = hwc.shape[:2]
+        for _ in range(10):
+            target = h * w * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                hwc[i:i + eh, j:j + ew] = self.value
+                break
+        return _ret(_back(hwc, chw), img)
+
+
+__all__ += ["Pad", "RandomRotation", "RandomResizedCrop", "Grayscale",
+            "BrightnessTransform", "ContrastTransform",
+            "SaturationTransform", "HueTransform", "ColorJitter",
+            "RandomErasing", "resize"]

@@ -146,3 +146,48 @@ def test_round2_vision_zoo_param_parity_and_forward():
     g = M.googlenet(num_classes=7)
     g.eval()
     assert list(g(x).shape) == [1, 7]
+
+
+def test_inception_v3_params_and_forward():
+    """InceptionV3 parameter count matches torchvision's aux-free count
+    (== the reference's inceptionv3 without the aux head)."""
+    from paddle_tpu.vision import models as M
+    m = M.inception_v3(num_classes=1000)
+    n = sum(int(np.prod(p.shape)) for p in m.parameters())
+    assert n == 23_834_568, n  # torchvision aux_logits=False + fc(1000)
+    m2 = M.inception_v3(num_classes=5)
+    m2.eval()
+    x = paddle.to_tensor(np.random.rand(1, 3, 299, 299).astype(np.float32))
+    assert list(m2(x).shape) == [1, 5]
+
+
+def test_round3_transforms():
+    from paddle_tpu.vision import transforms as T
+    np.random.seed(0)
+    img = (np.random.rand(3, 16, 16) * 255).astype(np.float32)
+    out = T.Compose([
+        T.Pad(2), T.RandomRotation(15), T.RandomResizedCrop(12),
+        T.ColorJitter(0.2, 0.2, 0.2, 0.1), T.RandomErasing(prob=1.0),
+        T.Grayscale(3)])(img)
+    assert np.asarray(out).shape == (3, 12, 12)
+    assert np.isfinite(np.asarray(out)).all()
+    # hue delta=0 is identity; grayscale of gray is itself
+    hwc = np.random.rand(8, 8, 3).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(T.HueTransform(0.0)(hwc)), hwc,
+                               atol=1e-5)
+    g = np.asarray(T.Grayscale(3)(hwc))
+    np.testing.assert_allclose(np.asarray(T.Grayscale(3)(g)), g, atol=1e-5)
+    # padding geometry: (left, top, right, bottom)
+    p = np.asarray(T.Pad((1, 2))(hwc))
+    assert p.shape == (12, 10, 3)
+    assert np.asarray(T.resize(img, 10)).shape == (3, 10, 10)
+
+
+def test_unique_name():
+    from paddle_tpu.utils import unique_name
+    with unique_name.guard():
+        a = unique_name.generate("fc")
+        b = unique_name.generate("fc")
+        assert a == "fc_0" and b == "fc_1"
+    with unique_name.guard():
+        assert unique_name.generate("fc") == "fc_0"
