@@ -18,8 +18,8 @@ from ..framework import convert_dtype
 from ..tensor import Tensor
 
 __all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
-           "is_auto_cast_enabled", "get_amp_dtype", "white_cast",
-           "black_cast"]
+           "is_auto_cast_enabled", "get_amp_dtype", "op_amp_role",
+           "white_cast", "black_cast"]
 
 # op lists come from the op-metadata registry (reference: amp_lists.py
 # keyed off the op YAML table — here ops/registry.py is that table).
@@ -40,16 +40,48 @@ def is_auto_cast_enabled() -> bool:
     return bool(st) and st[-1]["enable"]
 
 
-def get_amp_dtype():
+def op_amp_role(op_name):
+    """Role of an op in the innermost enabled auto_cast scope:
+    ``"white"`` (run in the low dtype), ``"black"`` (keep fp32),
+    ``"neutral"`` (no list — follow inputs), or ``None`` (no scope).
+    ``op_name`` may be a str or a tuple of alias names (e.g. ``mm``
+    dispatches as the ``matmul`` op type; black-listing either name
+    must catch it). Precedence: the scope's custom_white_list beats
+    every black entry (user override of a framework-black op), then
+    black (custom or framework), then white."""
     st = framework.state().amp_stack
     if not st or not st[-1]["enable"]:
+        return None
+    top = st[-1]
+    names = (op_name,) if isinstance(op_name, str) else tuple(op_name)
+    if any(n in top["custom_white"] for n in names):
+        return "white"
+    if any(n in top["black"] for n in names):
+        return "black"
+    if any(n in top["white"] for n in names):
+        return "white"
+    return "neutral"
+
+
+def get_amp_dtype(op_name=None):
+    """Low dtype of the innermost enabled auto_cast scope, or None.
+    With ``op_name`` (str or alias tuple), honors the scope's
+    custom_black_list: an op the user black-listed gets None (kept in
+    fp32) even if the framework white-lists it."""
+    st = framework.state().amp_stack
+    if not st or not st[-1]["enable"]:
+        return None
+    if op_name is not None and op_amp_role(op_name) == "black":
         return None
     return st[-1]["dtype"]
 
 
-def white_cast(*arrays):
-    """Cast op inputs to the AMP low dtype (white-listed op callsites)."""
-    d = get_amp_dtype()
+def white_cast(*arrays, op_name=None):
+    """Cast op inputs to the AMP low dtype (white-listed op callsites).
+    The single cast implementation — matmul-class ops in ops/math.py and
+    nn/functional.py all route through this so black-list overrides and
+    non-float passthrough behave identically everywhere."""
+    d = get_amp_dtype(op_name)
     if d is None:
         return arrays if len(arrays) > 1 else arrays[0]
     out = tuple(a.astype(d) if hasattr(a, "dtype") and
@@ -58,9 +90,14 @@ def white_cast(*arrays):
     return out if len(out) > 1 else out[0]
 
 
-def black_cast(*arrays):
-    """Cast op inputs up to fp32 (black-listed op callsites)."""
+def black_cast(*arrays, op_name=None):
+    """Cast op inputs up to fp32 (black-listed op callsites). With
+    ``op_name``, only upcasts when the scope resolves the op to black —
+    a custom_white_list entry for a framework-black op (user says "run
+    my softmax in bf16") suppresses the upcast."""
     if get_amp_dtype() is None:
+        return arrays if len(arrays) > 1 else arrays[0]
+    if op_name is not None and op_amp_role(op_name) != "black":
         return arrays if len(arrays) > 1 else arrays[0]
     out = tuple(a.astype(jnp.float32) if hasattr(a, "dtype") and
                 a.dtype in (jnp.float16, jnp.bfloat16) else a
@@ -74,6 +111,7 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
     d = convert_dtype(dtype)
     framework.state().amp_stack.append(
         {"enable": enable, "dtype": d, "level": level,
+         "custom_white": set(custom_white_list or ()),
          "white": set(custom_white_list or ()) | amp_white_list(),
          "black": set(custom_black_list or ()) | amp_black_list()})
     try:

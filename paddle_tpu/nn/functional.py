@@ -54,13 +54,13 @@ def _v(x):
 
 def linear(x, weight, bias=None, name=None):
     def f(a, w, *b):
-        from ..amp import get_amp_dtype
-        d = get_amp_dtype()
-        if d is not None:
-            a, w = a.astype(d), w.astype(d)
+        from ..amp import white_cast
+        a, w = white_cast(a, w, op_name=("linear", "matmul"))
         out = a @ w
         if b:
-            out = out + (b[0].astype(d) if d is not None else b[0])
+            bias_arr = b[0].astype(out.dtype) if jnp.issubdtype(
+                out.dtype, jnp.floating) else b[0]
+            out = out + bias_arr
         return out
     if bias is None:
         return apply_op(f, x, weight)
@@ -199,6 +199,9 @@ def softmax(x, axis=-1, dtype=None, name=None):
     def f(v):
         if d is not None:
             v = v.astype(d)
+        else:
+            from ..amp import black_cast
+            v = black_cast(v, op_name="softmax")  # fp32 inside auto_cast
         return jax.nn.softmax(v, axis=axis)
     return apply_op(f, x)
 
@@ -209,6 +212,9 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
     def f(v):
         if d is not None:
             v = v.astype(d)
+        else:
+            from ..amp import black_cast
+            v = black_cast(v, op_name="log_softmax")
         return jax.nn.log_softmax(v, axis=axis)
     return apply_op(f, x)
 
@@ -402,6 +408,27 @@ def _conv_padding(padding, nd, stride, kernel, dilation):
     return [tuple(p) for p in padding]
 
 
+def _conv_amp_dtypes(v, w, op_name):
+    """lax.conv requires equal input/weight dtypes. Under auto_cast the
+    conv is a white-list op (runs in the amp dtype, like matmul); a
+    user-black-listed conv runs in fp32 even over O2-decorated bf16
+    weights. With no cast scope but O2 bf16 weights fed by a kept-fp32
+    norm, the conv runs in the param dtype rather than silently
+    upcasting."""
+    from ..amp import get_amp_dtype, op_amp_role
+    if not jnp.issubdtype(v.dtype, jnp.floating) or not jnp.issubdtype(
+            w.dtype, jnp.floating):
+        return v, w
+    d = get_amp_dtype(op_name)
+    if d is not None:
+        return v.astype(d), w.astype(d)
+    if op_amp_role(op_name) == "black":
+        return v.astype(jnp.float32), w.astype(jnp.float32)
+    if v.dtype != w.dtype:
+        return v.astype(w.dtype), w
+    return v, w
+
+
 def _convnd(x, weight, bias, stride, padding, dilation, groups, nd,
             data_format):
     strides = _pair(stride, nd)
@@ -417,18 +444,20 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, nd,
     pad_arg = _conv_padding(padding, nd, strides, kshape, dils)
 
     def f(v, w, *b):
+        v, w = _conv_amp_dtypes(v, w, f"conv{nd}d")
+        # NOTE: no preferred_element_type=fp32 for bf16 — the MXU already
+        # accumulates partial products in fp32 before rounding the bf16
+        # output, and jax's conv transpose rule rejects the fp32
+        # cotangent a widened output dtype produces (bf16/fp32 mismatch
+        # in _conv_general_dilated_transpose_rhs).
         out = jax.lax.conv_general_dilated(
             v, w, window_strides=strides, padding=pad_arg,
             rhs_dilation=dils, dimension_numbers=spec,
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32
-            if v.dtype == jnp.bfloat16 else None)
-        if v.dtype == jnp.bfloat16:
-            out = out.astype(v.dtype)
+            feature_group_count=groups)
         if b:
             bias_shape = [1] * out.ndim
             bias_shape[1 if not chan_last else -1] = b[0].size
-            out = out + b[0].reshape(bias_shape)
+            out = out + b[0].astype(out.dtype).reshape(bias_shape)
         return out
     args = [x, weight] + ([bias] if bias is not None else [])
     return apply_op(f, *args)
@@ -454,7 +483,8 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
-                     data_format="NCHW", output_size=None, name=None):
+                     data_format="NCHW", output_size=None, name=None,
+                     _amp_op="conv2d_transpose"):
     """Transposed conv as a forward conv with lhs dilation (paddle output
     size semantics: (H-1)*stride - 2*pad + dilation*(k-1) + 1 + out_pad).
     Weight layout (in, out/groups, kh, kw)."""
@@ -469,6 +499,7 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
             "conv2d_transpose currently supports NCHW only")
 
     def f(v, w, *b):
+        v, w = _conv_amp_dtypes(v, w, _amp_op)
         kh, kw = w.shape[2], w.shape[3]
         # (in, out/g, kh, kw) -> (out, in/g, kh, kw) flipped spatially
         if groups == 1:
@@ -500,13 +531,9 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
             v, w2, window_strides=(1, 1), padding=pad_arg,
             lhs_dilation=strides, rhs_dilation=dils,
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32
-            if v.dtype == jnp.bfloat16 else None)
-        if v.dtype == jnp.bfloat16:
-            out = out.astype(v.dtype)
+            feature_group_count=groups)
         if b:
-            out = out + b[0].reshape(1, -1, 1, 1)
+            out = out + b[0].astype(out.dtype).reshape(1, -1, 1, 1)
         return out
     args = [x, weight] + ([bias] if bias is not None else [])
     return apply_op(f, *args)
@@ -519,7 +546,8 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
     w4 = apply_op(lambda v: v[:, :, None, :], weight)
     out = conv2d_transpose(x4, w4, bias, (1, _pair(stride, 1)[0]),
                            (0, _pair(padding, 1)[0]), output_padding, groups,
-                           (1, _pair(dilation, 1)[0]))
+                           (1, _pair(dilation, 1)[0]),
+                           _amp_op="conv1d_transpose")
     return apply_op(lambda v: v[:, :, 0, :], out)
 
 
@@ -726,6 +754,8 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
     def f(logits, lab, *w):
+        from ..amp import black_cast
+        logits = black_cast(logits, op_name="cross_entropy")
         nclass = logits.shape[axis]
         if use_softmax:
             logp = jax.nn.log_softmax(logits, axis=axis)
@@ -1740,6 +1770,7 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
         raise NotImplementedError("conv3d_transpose supports NCDHW only")
 
     def f(v, w, *b):
+        v, w = _conv_amp_dtypes(v, w, "conv3d_transpose")
         kd, kh, kw = w.shape[2:]
         if groups == 1:
             w2 = jnp.swapaxes(w, 0, 1)
@@ -1771,7 +1802,7 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
             dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
             feature_group_count=groups)
         if b:
-            out = out + b[0].reshape(1, -1, 1, 1, 1)
+            out = out + b[0].astype(out.dtype).reshape(1, -1, 1, 1, 1)
         return out
     args = [x, weight] + ([bias] if bias is not None else [])
     return apply_op(f, *args)

@@ -228,8 +228,20 @@ def softsign(x, name=None):
     return apply_op(lambda v: v / (1 + jnp.abs(v)), x)
 
 
+def _amp_cast(*arrays, op_name=None):
+    """White-list cast at dispatch (matmul-class ops run in the amp
+    dtype inside an auto_cast scope, unless the user black-listed the
+    op; no-op otherwise). Thin alias for amp.white_cast."""
+    from ..amp import white_cast
+    out = white_cast(*arrays, op_name=op_name)
+    return out if isinstance(out, tuple) else (out,)
+
+
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
-    return apply_op(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+    def f(i, a, b):
+        i, a, b = _amp_cast(i, a, b, op_name="addmm")
+        return beta * i + alpha * (a @ b)
+    return apply_op(f, input, x, y)
 
 
 def increment(x, value=1.0):
@@ -552,12 +564,10 @@ def index_sample(x, index):
 # linalg
 # ---------------------------------------------------------------------------
 
-def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None,
+           _amp_op=("matmul",)):
     def f(a, b):
-        from ..amp import get_amp_dtype
-        d = get_amp_dtype()
-        if d is not None and jnp.issubdtype(a.dtype, jnp.floating):
-            a, b = a.astype(d), b.astype(d)
+        a, b = _amp_cast(a, b, op_name=_amp_op)
         if transpose_x:
             a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
         if transpose_y:
@@ -567,23 +577,36 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
 
 
 def mm(x, y, name=None):
-    return matmul(x, y)
+    # dispatches as the matmul op type; either name may be listed
+    return matmul(x, y, _amp_op=("matmul", "mm"))
 
 
 def bmm(x, y, name=None):
-    return apply_op(jnp.matmul, x, y)
+    def f(a, b):
+        a, b = _amp_cast(a, b, op_name="bmm")
+        return jnp.matmul(a, b)
+    return apply_op(f, x, y)
 
 
 def dot(x, y, name=None):
-    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+    def f(a, b):
+        a, b = _amp_cast(a, b, op_name="dot")
+        return jnp.sum(a * b, axis=-1)
+    return apply_op(f, x, y)
 
 
 def mv(x, vec, name=None):
-    return apply_op(jnp.matmul, x, vec)
+    def f(a, b):
+        a, b = _amp_cast(a, b, op_name="mv")
+        return jnp.matmul(a, b)
+    return apply_op(f, x, vec)
 
 
 def outer(x, y, name=None):
-    return apply_op(jnp.outer, x, y)
+    def f(a, b):
+        a, b = _amp_cast(a, b, op_name="outer")
+        return jnp.outer(a, b)
+    return apply_op(f, x, y)
 
 
 def inner(x, y, name=None):
@@ -647,7 +670,10 @@ def kron(x, y, name=None):
 
 
 def einsum(equation, *operands):
-    return apply_op(lambda *ops: jnp.einsum(equation, *ops), *operands)
+    def f(*ops):
+        ops = _amp_cast(*ops, op_name="einsum")
+        return jnp.einsum(equation, *ops)
+    return apply_op(f, *operands)
 
 
 def matrix_power(x, n, name=None):

@@ -52,6 +52,7 @@ BIG16 = dict(BIG, dtype="bfloat16", scan_layers=True,
 CONFIGS["big16_b8_s2048"] = (BIG16, 8, 2048, False)
 CONFIGS["big16_b4_s2048"] = (BIG16, 4, 2048, False)
 CONFIGS["big16_b16_s1024"] = (BIG16, 16, 1024, False)
+CONFIGS["big16_b16_s2048"] = (BIG16, 16, 2048, False)
 
 # fused-CE A/B at the headline config (run both on a healthy tunnel to
 # measure the chunked lm-head CE win on hardware)

@@ -1,0 +1,30 @@
+"""CPU smoke for bench_workloads.py (PT_WORKLOADS_TINY shapes) so a
+chip session never spends its window discovering an API break in the
+workload-bench code paths."""
+import os
+import subprocess
+import sys
+import json
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("name", ["resnet50", "bert_base", "ernie_moe",
+                                  "sdxl_unet"])
+def test_workload_tiny(name):
+    env = dict(os.environ, PT_WORKLOADS_TINY="1", JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)  # single fake device is enough
+    p = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench_workloads.py"), name],
+        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
+    lines = [l for l in p.stdout.splitlines() if l.startswith("WORKLOAD ")]
+    assert lines, f"no WORKLOAD line: {p.stdout[-2000:]} {p.stderr[-2000:]}"
+    r = json.loads(lines[-1][len("WORKLOAD "):])
+    assert "error" not in r, r["error"]
+    assert r["workload"].startswith(name.split("_")[0])
+    if name == "sdxl_unet":
+        assert r["infer_step_ms"] > 0 and r["train_step_ms"] > 0
+    else:
+        assert r["step_ms"] > 0

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Measure the non-Llama BASELINE workloads on the chip; merge each
+# point into WORKLOADS_r03.json as it completes (a later tunnel wedge
+# keeps earlier points).
+cd "$(dirname "$0")"
+OUT=WORKLOADS_r03.json
+for w in resnet50 bert_base ernie_moe sdxl_unet; do
+    line=$(timeout 600 python bench_workloads.py "$w" 2>&1 \
+           | grep '^WORKLOAD ' | tail -1 | sed 's/^WORKLOAD //')
+    [ -z "$line" ] && line="{\"workload\": \"$w\", \"error\": \"no output (timeout/crash)\"}"
+    python - "$w" "$line" <<'EOF'
+import json, os, sys
+out = "WORKLOADS_r03.json"
+d = json.load(open(out)) if os.path.exists(out) else {
+    "artifact": "WORKLOADS_r03", "chip": "v5e",
+    "note": ("throughput for the BASELINE.json workloads beyond the "
+             "Llama headline (bench.py); utilization_vs_peak uses "
+             "XLA cost-analysis FLOPs, see bench_workloads.py")}
+d[sys.argv[1]] = json.loads(sys.argv[2])
+json.dump(d, open(out, "w"), indent=1)
+EOF
+    echo "done $w: $line"
+done
