@@ -211,14 +211,20 @@ def ernie_moe():
         return out[0] if isinstance(out, (tuple, list)) else out
 
     batch_t = (paddle.to_tensor(ids), paddle.to_tensor(labels))
-    params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    # analytic fallback must count ACTIVE params: only top_k of
+    # num_experts expert MLPs run per token
+    expert_p = sum(int(np.prod(p.shape)) for n, p in
+                   model.named_parameters() if ".experts." in n)
+    total_p = sum(int(np.prod(p.shape)) for p in model.parameters())
+    active_p = total_p - expert_p * (1 - cfg.top_k / cfg.num_experts)
     r = _train_common(model, loss_fn, batch_t,
                       steps=2 if TINY else 8, warmup=1 if TINY else 2,
-                      analytic_flops=6 * params * batch * seq)
+                      analytic_flops=6 * active_p * batch * seq)
     tok_s = batch * seq / (r["step_ms"] / 1000)
     return {"workload": "ernie_moe_train", "tokens_per_sec":
             round(tok_s, 1), "batch": batch, "seq": seq,
-            "num_experts": cfg.num_experts, **r}
+            "num_experts": cfg.num_experts, "top_k": cfg.top_k,
+            "active_params": int(active_p), **r}
 
 
 def sdxl_unet():

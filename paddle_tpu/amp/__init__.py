@@ -80,9 +80,18 @@ def white_cast(*arrays, op_name=None):
     """Cast op inputs to the AMP low dtype (white-listed op callsites).
     The single cast implementation — matmul-class ops in ops/math.py and
     nn/functional.py all route through this so black-list overrides and
-    non-float passthrough behave identically everywhere."""
+    non-float passthrough behave identically everywhere. A
+    user-black-listed op UPCASTS low-precision inputs to fp32 (the op
+    must run fp32 even over O2-decorated bf16 weights), it doesn't just
+    skip the downcast."""
     d = get_amp_dtype(op_name)
     if d is None:
+        if op_name is not None and is_auto_cast_enabled() and \
+                op_amp_role(op_name) == "black":
+            out = tuple(a.astype(jnp.float32) if hasattr(a, "dtype") and
+                        a.dtype in (jnp.float16, jnp.bfloat16) else a
+                        for a in arrays)
+            return out if len(out) > 1 else out[0]
         return arrays if len(arrays) > 1 else arrays[0]
     out = tuple(a.astype(d) if hasattr(a, "dtype") and
                 jnp.issubdtype(a.dtype, jnp.floating) else a

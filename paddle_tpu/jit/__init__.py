@@ -162,12 +162,16 @@ class StaticFunction:
         if new_fn is None:
             return None
         sub = StaticFunction(new_fn, layers=self._layers)
+        self._dy2static_sub = sub   # introspection (tests/debugging)
 
         def run(*a, **k):
             sig = self._sig_key(a, k)
             try:
                 return sub(*a, **k)
             except dy2static.ConversionError as ce:
+                split = self._try_graph_break(sig)
+                if split is not None:
+                    return split(*a, **k)
                 import warnings
                 warnings.warn(
                     f"to_static: dy2static conversion not lowerable "
@@ -193,6 +197,31 @@ class StaticFunction:
         self._cache[static_key] = ("dy2static", run)
         return run
 
+    def _try_graph_break(self, static_key):
+        """SOT-analogue stage (reference: python/paddle/jit/sot/ —
+        verify): split the function at breaking statements and compile
+        the spans between them, instead of running the WHOLE function
+        eagerly. Conversion runs once; later signatures reuse it."""
+        from . import graph_break
+        if getattr(self, "_graph_break_run", None) is not None:
+            self._cache[static_key] = ("dy2static", self._graph_break_run)
+            return self._graph_break_run
+        if getattr(self, "_graph_break_attempted", False):
+            return None
+        self._graph_break_attempted = True
+        split = graph_break.split_function(self._fn, layers=self._layers)
+        if split is None:
+            return None
+        import warnings
+        warnings.warn(
+            f"to_static: {getattr(self._fn, '__name__', '?')} contains "
+            f"host-materializing statements; compiled with "
+            f"{len(split._jst_spans)} subgraph span(s) and eager graph "
+            f"breaks between them (SOT-analogue)", stacklevel=2)
+        self._graph_break_run = split
+        self._cache[static_key] = ("dy2static", split)
+        return split
+
     @staticmethod
     def _sig_key(args, kwargs):
         arg_template = tuple(
@@ -207,7 +236,12 @@ class StaticFunction:
         ptensors, btensors = self._state()
         static_key = self._sig_key(args, kwargs)
         inputs = [a for a in args if isinstance(a, Tensor)]
-        entry = self._cache.get(static_key)
+        try:
+            entry = self._cache.get(static_key)
+        except TypeError:
+            # an unhashable non-Tensor arg (list/dict) cannot key the
+            # program cache — run this call eagerly rather than crash
+            return self._fn(*args, **kwargs)
         if entry == "eager":
             return self._fn(*args, **kwargs)
         if isinstance(entry, tuple) and entry and entry[0] == "dy2static":
@@ -218,6 +252,12 @@ class StaticFunction:
                 # re-tracing the original would just re-raise — reuse the
                 # converted runner for this new signature directly
                 run = self._dy2static_run
+                self._cache[static_key] = ("dy2static", run)
+                return run(*args, **kwargs)
+            if getattr(self, "_graph_break_run", None) is not None:
+                # same for an already-split function: a new signature
+                # must not re-pay the failed whole-function trace
+                run = self._graph_break_run
                 self._cache[static_key] = ("dy2static", run)
                 return run(*args, **kwargs)
             entry = self._build(len(inputs), static_key)
@@ -244,16 +284,20 @@ class StaticFunction:
             converted = self._try_dy2static(static_key)
             if converted is not None:
                 return converted(*args, **kwargs)
-            # the trace-based analogue of a SOT graph break (reference:
+            # SOT-analogue graph breaks: keep compiled spans, run only
+            # the breaking statements in Python (reference:
             # python/paddle/jit/sot/ opcode-level breaks — verify)
+            split = self._try_graph_break(static_key)
+            if split is not None:
+                return split(*args, **kwargs)
             import warnings
             first_line = str(e).splitlines()[0] if str(e) else repr(e)
             warnings.warn(
                 "to_static: forward has data-dependent Python control "
                 f"flow ({first_line}); falling back to EAGER execution "
-                "for this input signature (the reference's SOT inserts "
-                "a graph break here). Rewrite with lax.cond/where for a "
-                "fully compiled step.", stacklevel=2)
+                "for this input signature (no compilable span found). "
+                "Rewrite with lax.cond/where for a fully compiled step.",
+                stacklevel=2)
             self._cache[static_key] = "eager"
             return self._fn(*args, **kwargs)
         outs = list(out) if isinstance(out, (tuple, list)) else [out]

@@ -33,6 +33,9 @@ __all__ = ["convert_function", "convert_ifelse", "convert_while",
            "ConversionError", "ld", "UNDEF"]
 
 
+_SRC_COUNTER = 0
+
+
 class ConversionError(RuntimeError):
     """Raised at runtime when converted control flow cannot be lowered
     (e.g. a branch-carried value is not a Tensor); callers treat it as a
@@ -719,8 +722,17 @@ def convert_function(fn: Callable) -> Optional[Callable]:
     if tr.converted == 0:
         return None
     ast.fix_missing_locations(tree)
-    code = compile(tree, filename=f"<dy2static {fn.__name__}>",
-                   mode="exec")
+    # register the generated source so inspect.getsource works on the
+    # converted def — the graph-break splitter can then re-split it
+    # (control-flow conversion composes with SOT-style span breaking)
+    import linecache
+    global _SRC_COUNTER
+    _SRC_COUNTER += 1
+    fname = f"<dy2static {fn.__name__} {_SRC_COUNTER}>"
+    new_src = ast.unparse(tree)
+    linecache.cache[fname] = (len(new_src), None,
+                              new_src.splitlines(True), fname)
+    code = compile(new_src, filename=fname, mode="exec")
     import paddle_tpu.jit.dy2static as _jst_mod
     glb = dict(getattr(fn, "__globals__", {}))
     glb["_jst"] = _jst_mod

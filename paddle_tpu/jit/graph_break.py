@@ -1,0 +1,322 @@
+"""SOT-analogue graph breaks: keep compiled subgraphs when a function
+cannot compile whole (reference: python/paddle/jit/sot/ — the symbolic
+opcode translator breaks the bytecode at unsupported constructs and
+still runs the captured subgraphs as static programs — verify).
+
+TPU-native design (AST-level, not bytecode-level): when ``to_static``'s
+trace fails AND the dy2static control-flow conversion cannot make the
+whole function one program, `split_function` partitions the function
+body at *breaking statements* — statements that must run in Python
+because they materialize values or perform host side effects:
+
+    ``.item()`` / ``.numpy()`` / ``.tolist()`` / ``float()/int()/bool()``
+    on computed values, ``print``, bare-call Expr statements (possible
+    side effects), ``for``/``while``/``if`` bodies containing any of
+    those, nested defs/lambdas we cannot see through.
+
+Every maximal run of non-breaking statements is hoisted into its own
+top-level def and wrapped in a :class:`StaticFunction` — each span gets
+the FULL compile pipeline (trace → dy2static control-flow conversion →
+eager), so tensor `if`/`while` inside a span still lowers to
+`lax.cond`/`lax.while_loop`. Breaking statements stay verbatim in the
+rewritten body and execute eagerly between span calls.
+
+Scalars materialized at a break (the canonical `loss = float(x.mean())`)
+are re-injected into following spans as 0-d arrays (dynamic jit inputs),
+NOT as Python-static arguments — otherwise every new value would force
+a recompile of the span. Ints/bools stay static (they are shapes/flags
+more often than data).
+
+Known limits (documented, degrade to eager — never wrong results):
+statements that mutate Python state through method calls inside an
+assignment are treated as pure; loops containing breaks run fully in
+Python; a span whose inputs are unhashable Python objects (list/dict
+locals) runs eagerly inside its StaticFunction (the program cache
+cannot key on them).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import itertools
+import linecache
+import textwrap
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from . import dy2static
+from .dy2static import (_assigned_names, _reads, _upward_reads,
+                        _truncate_at_return)
+
+__all__ = ["split_function", "run_span", "BREAK_METHODS"]
+
+# Tensor methods whose CALL forces host materialization
+BREAK_METHODS = {"item", "numpy", "tolist", "cpu", "__array__",
+                 "__float__", "__int__", "__bool__"}
+# builtins that concretize their argument
+_BREAK_BUILTINS = {"float", "int", "bool", "print", "input", "repr",
+                   "str", "format"}
+
+_counter = itertools.count()
+
+
+def _is_breaking_expr(node) -> bool:
+    """Does this expression subtree contain a construct that needs
+    Python/host execution?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute) and f.attr in BREAK_METHODS:
+                return True
+            if isinstance(f, ast.Name) and f.id in _BREAK_BUILTINS:
+                # float("1.5") etc. on literals is harmless
+                if not all(isinstance(a, ast.Constant) for a in n.args):
+                    return True
+        elif isinstance(n, (ast.Await, ast.Yield, ast.YieldFrom)):
+            return True
+    return False
+
+
+def _is_span_stmt(st) -> bool:
+    """Statement eligible to live inside a compiled span."""
+    if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        return not _is_breaking_expr(st)
+    if isinstance(st, (ast.If, ast.While, ast.For)):
+        # compound statements join a span only when fully non-breaking
+        # (their tensor control flow is then the span's StaticFunction's
+        # problem — dy2static converts it, or the span runs eager)
+        for sub in ast.walk(st):
+            if isinstance(sub, (ast.Return, ast.Global, ast.Nonlocal,
+                                ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda, ast.Try, ast.With,
+                                ast.Import, ast.ImportFrom)):
+                return False
+        return not _is_breaking_expr(st)
+    if isinstance(st, ast.Expr) and isinstance(st.value, ast.Constant):
+        return True                         # docstring / bare literal
+    return False
+
+
+def _contains_break_anywhere(stmts) -> bool:
+    for st in stmts:
+        for n in ast.walk(st):
+            if isinstance(n, (ast.expr, ast.stmt)) and \
+                    _is_breaking_expr(n):
+                return True
+    return False
+
+
+class _Splitter:
+    """Partition a function body into verbatim statements and hoisted
+    span defs, emitting the rewritten body + the span defs."""
+
+    def __init__(self, fdef):
+        self.fdef = fdef
+        self.local_names = set(_assigned_names(fdef.body)) | {
+            a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                            + fdef.args.kwonlyargs)}
+        if fdef.args.vararg:
+            self.local_names.add(fdef.args.vararg.arg)
+        if fdef.args.kwarg:
+            self.local_names.add(fdef.args.kwarg.arg)
+        self.span_defs: list[ast.FunctionDef] = []
+        self.n_spans = 0
+        # names bound before the current partition point: a
+        # conservative upward-read of a branch-assigned name (e.g. an
+        # if/else where both arms assign y, read later) must not become
+        # a span input unless something earlier could have bound it
+        self.bound = {a.arg for a in (fdef.args.posonlyargs
+                                      + fdef.args.args
+                                      + fdef.args.kwonlyargs)}
+        if fdef.args.vararg:
+            self.bound.add(fdef.args.vararg.arg)
+        if fdef.args.kwarg:
+            self.bound.add(fdef.args.kwarg.arg)
+
+    def _emit_span(self, stmts, rest, bound_before, ret_expr=None):
+        """Hoist `stmts` (+ optional trailing `return ret_expr`) into a
+        span def; return replacement statements, or None to keep the
+        statements verbatim (not worth a span). ``bound_before``: names
+        bound before the span starts — a conservative upward-read of a
+        branch-assigned-only name must not become an input."""
+        analyzed = list(stmts) + ([ast.Expr(value=ret_expr)]
+                                  if ret_expr is not None else [])
+        inputs = sorted(_upward_reads(analyzed) & self.local_names
+                        & bound_before)
+        live_after = _reads(rest)
+        outputs = sorted(set(_assigned_names(stmts)) & live_after)
+        if ret_expr is None and not outputs:
+            return None                 # nothing visible escapes
+        if ret_expr is None and not any(
+                isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                               ast.If, ast.While, ast.For))
+                for s in stmts):
+            return None
+        i = self.n_spans
+        self.n_spans += 1
+        body = list(stmts)
+        ret_elts = [ast.Name(id=n, ctx=ast.Load()) for n in outputs]
+        if ret_expr is not None:
+            ret_elts.append(ret_expr)
+        body.append(ast.Return(value=ast.Tuple(elts=ret_elts,
+                                               ctx=ast.Load())))
+        sdef = ast.FunctionDef(
+            name=f"_jst_span_{i}",
+            args=ast.arguments(posonlyargs=[],
+                               args=[ast.arg(arg=n) for n in inputs],
+                               kwonlyargs=[], kw_defaults=[],
+                               defaults=[]),
+            body=body, decorator_list=[])
+        self.span_defs.append(sdef)
+        call = ast.Call(
+            func=ast.Subscript(value=ast.Name(id="_jst_spans",
+                                              ctx=ast.Load()),
+                               slice=ast.Constant(value=i),
+                               ctx=ast.Load()),
+            args=[ast.Name(id=n, ctx=ast.Load()) for n in inputs],
+            keywords=[])
+        out = []
+        if ret_expr is not None:
+            tmp = f"_jst_out_{i}"
+            out.append(ast.Assign(
+                targets=[ast.Name(id=tmp, ctx=ast.Store())], value=call))
+            for j, n in enumerate(outputs):
+                out.append(ast.Assign(
+                    targets=[ast.Name(id=n, ctx=ast.Store())],
+                    value=ast.Subscript(
+                        value=ast.Name(id=tmp, ctx=ast.Load()),
+                        slice=ast.Constant(value=j), ctx=ast.Load())))
+            out.append(ast.Return(value=ast.Subscript(
+                value=ast.Name(id=tmp, ctx=ast.Load()),
+                slice=ast.Constant(value=len(outputs)), ctx=ast.Load())))
+        else:
+            out.append(ast.Assign(
+                targets=[ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Store())
+                                         for n in outputs],
+                                   ctx=ast.Store())],
+                value=call))
+        return out
+
+    def process(self):
+        stmts = _truncate_at_return(self.fdef.body)
+        new_body, run = [], []
+        run_bound = set(self.bound)   # bound names at current run start
+        for idx, st in enumerate(stmts):
+            if isinstance(st, ast.Return):
+                rest = stmts[idx + 1:]
+                if run and st.value is not None and \
+                        not _is_breaking_expr(st.value):
+                    rep = self._emit_span(run, rest, run_bound,
+                                          ret_expr=st.value)
+                    if rep is not None:
+                        new_body.extend(rep)
+                        run = []
+                        continue
+                if run:
+                    rep = self._emit_span(run, [st] + rest, run_bound)
+                    new_body.extend(rep if rep is not None else run)
+                    run = []
+                new_body.append(st)
+            elif _is_span_stmt(st):
+                run.append(st)
+            else:
+                if run:
+                    rep = self._emit_span(run, stmts[idx:], run_bound)
+                    new_body.extend(rep if rep is not None else run)
+                    run = []
+                new_body.append(st)
+            self.bound |= set(_assigned_names([st]))
+            if not run:
+                run_bound = set(self.bound)
+        if run:
+            rep = self._emit_span(run, [], run_bound)
+            new_body.extend(rep if rep is not None else run)
+        self.fdef.body = new_body
+        return self.n_spans
+
+
+def run_span(entry, *args):
+    """Execute one span. `entry` is the dict made by split_function:
+    {"static": StaticFunction, "raw": fn}. Python floats become 0-d f32
+    arrays (dynamic inputs — a new value must NOT force a recompile);
+    ints/bools/Tensors/arrays pass through. Unhashable span inputs
+    (list/dict locals) are handled by StaticFunction itself, which runs
+    such calls eagerly instead of crashing on the program-cache key."""
+    import numpy as np
+    conv = tuple(
+        Tensor(jnp.float32(a)) if isinstance(a, (float, np.floating))
+        and not isinstance(a, bool)
+        else Tensor(jnp.asarray(a)) if isinstance(a, np.ndarray)
+        else a for a in args)
+    return entry["static"](*conv)
+
+
+def split_function(fn: Callable, layers=None) -> Optional[Callable]:
+    """Rewrite ``fn`` with graph breaks. Returns the rewritten callable
+    (with ``._jst_spans`` exposing the per-span StaticFunctions), or
+    None when the function has no breaking construct / no compilable
+    span / no retrievable source."""
+    from . import StaticFunction
+
+    if getattr(fn, "_jst_split", False) or getattr(fn, "_jst_no_split",
+                                                   False):
+        return None
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return None
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        return None
+    for dec in fdef.decorator_list:
+        if "to_static" not in ast.unparse(dec):
+            return None
+    fdef.decorator_list = []
+    if getattr(fn, "__code__", None) is not None and \
+            fn.__code__.co_freevars:
+        return None                     # closures over free vars
+    if not _contains_break_anywhere(fdef.body):
+        return None                     # nothing to break on
+    sp = _Splitter(fdef)
+    if sp.process() == 0:
+        return None
+    tree.body = sp.span_defs + [fdef]
+    ast.fix_missing_locations(tree)
+
+    # a real (linecache-registered) filename so inspect.getsource works
+    # on the generated defs — the span StaticFunctions can then run the
+    # dy2static conversion on their own bodies
+    fname = f"<graph_break {fn.__name__} {next(_counter)}>"
+    new_src = ast.unparse(tree)
+    linecache.cache[fname] = (len(new_src), None,
+                              new_src.splitlines(True), fname)
+    code = compile(new_src, filename=fname, mode="exec")
+    glb = dict(getattr(fn, "__globals__", {}))
+    glb["_jst"] = dy2static
+    loc: dict = {}
+    exec(code, glb, loc)
+
+    entries = []
+    for i in range(sp.n_spans):
+        raw = loc[f"_jst_span_{i}"]
+        raw._jst_no_split = True        # a span never re-splits
+        entries.append({"static": StaticFunction(raw, layers=layers),
+                        "raw": raw})
+    glb["_jst_spans"] = [functools.partial(run_span, e) for e in entries]
+
+    new_fn = loc[fdef.name]
+    if inspect.ismethod(fn):
+        new_fn = functools.partial(new_fn, fn.__self__)
+        new_fn = functools.update_wrapper(new_fn, fn.__func__)
+    else:
+        new_fn = functools.wraps(fn)(new_fn)
+    new_fn._jst_split = True
+    new_fn._jst_spans = entries
+    return new_fn

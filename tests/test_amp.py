@@ -286,3 +286,15 @@ def test_autocast_black_conv_over_o2_weights_runs_fp32():
     with amp.auto_cast(dtype="bfloat16", custom_black_list={"conv2d"}):
         out = m(x)
     assert str(out.dtype) == "float32"
+
+
+def test_black_listed_matmul_upcasts_bf16_inputs():
+    # O2-decorated weights are bf16; a black-listed matmul-class op
+    # must still run fp32 (upcast), mirroring the conv behavior
+    lin = nn.Linear(4, 4)
+    amp.decorate(lin, level="O2", dtype="bfloat16")
+    x = paddle.to_tensor(rnd(4, 4)).astype("bfloat16")
+    with amp.auto_cast(dtype="bfloat16",
+                       custom_black_list={"matmul", "linear"}):
+        assert str(paddle.matmul(x, x).dtype) == "float32"
+        assert str(lin(x).dtype) == "float32"

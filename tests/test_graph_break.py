@@ -1,0 +1,239 @@
+"""SOT-analogue graph breaks (reference: python/paddle/jit/sot/ —
+bytecode-level breaks keep compiled subgraphs; here: AST span splitting
+behind to_static, tests mirror test/sot/ parity style — verify)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit import StaticFunction, to_static
+from paddle_tpu.jit.graph_break import split_function
+
+
+def rnd(*s):
+    return np.random.rand(*s).astype(np.float32)
+
+
+def _spans(sf):
+    # the split may engage on the outer StaticFunction or on the inner
+    # dy2static-converted one (when control-flow conversion ran first)
+    run = getattr(sf, "_graph_break_run", None)
+    if run is None:
+        sub = getattr(sf, "_dy2static_sub", None)
+        if sub is not None:
+            run = getattr(sub, "_graph_break_run", None)
+    assert run is not None, "graph break stage did not engage"
+    return run._jst_spans
+
+
+class TestSplitFunction:
+    def test_item_between_matmuls_keeps_two_spans(self):
+        def f(x, w1, w2):
+            a = paddle.matmul(x, w1)
+            b = a + 1.0
+            v = float(b.mean().item())        # BREAK
+            c = paddle.matmul(b, w2)
+            d = c * v
+            return d
+
+        x = paddle.to_tensor(rnd(2, 4))
+        w1 = paddle.to_tensor(rnd(4, 4))
+        w2 = paddle.to_tensor(rnd(4, 4))
+        eager = f(x, w1, w2)
+        sf = StaticFunction(f)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = sf(x, w1, w2)
+        np.testing.assert_allclose(out.numpy(), eager.numpy(),
+                                   rtol=2e-5, atol=2e-5)
+        spans = _spans(sf)
+        assert len(spans) == 2
+        # both spans actually compiled (their StaticFunction cache holds
+        # a jitted entry, not the "eager" marker)
+        for e in spans:
+            vals = list(e["static"]._cache.values())
+            assert vals and all(v != "eager" for v in vals)
+
+    def test_materialized_float_is_dynamic_no_recompile(self):
+        def f(x, w):
+            a = paddle.matmul(x, w)
+            v = float(a.sum().item())         # new value every call
+            b = a * v + a
+            c = paddle.matmul(b, w)
+            return c
+
+        w = paddle.to_tensor(rnd(4, 4))
+        sf = StaticFunction(f)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            o1 = sf(paddle.to_tensor(rnd(2, 4)), w)
+            o2 = sf(paddle.to_tensor(rnd(2, 4) + 5), w)
+        assert not np.allclose(o1.numpy(), o2.numpy())
+        # the float rides as a 0-d array: ONE signature in the span cache
+        for e in _spans(sf):
+            assert len(e["static"]._cache) == 1
+
+    def test_print_and_numpy_break(self, capsys):
+        def f(x):
+            y = x * 2 + 1
+            print("mid:", y.numpy().sum())    # BREAK (host side effect)
+            z = y * 3
+            return z
+
+        x = paddle.to_tensor(rnd(3))
+        sf = StaticFunction(f)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = sf(x)
+        np.testing.assert_allclose(out.numpy(), (rnd(0).sum() * 0 +
+                                                 x.numpy() * 2 + 1) * 3,
+                                   rtol=1e-6)
+        assert "mid:" in capsys.readouterr().out
+        assert len(_spans(sf)) == 2
+
+    def test_python_if_on_materialized_scalar(self):
+        def f(x):
+            s = x.sum()
+            v = float(s.item())               # BREAK
+            if v > 0:                         # python branch, eager
+                y = x * 2
+            else:
+                y = x * 3
+            return y + 1
+
+        sf = StaticFunction(f)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pos = sf(paddle.to_tensor(np.ones(3, np.float32)))
+            neg = sf(paddle.to_tensor(-np.ones(3, np.float32)))
+        np.testing.assert_allclose(pos.numpy(), np.full(3, 3.0),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(neg.numpy(), np.full(3, -2.0),
+                                   rtol=1e-6)
+
+    def test_tensor_if_inside_span_converts(self):
+        def f(x):
+            a = x * 2
+            if a.sum() > 0:                   # tensor if INSIDE a span
+                b = a + 10
+            else:
+                b = a - 10
+            v = float(b.mean().item())        # BREAK
+            c = b * v
+            return c
+
+        sf = StaticFunction(f)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pos = sf(paddle.to_tensor(np.ones(2, np.float32)))
+            neg = sf(paddle.to_tensor(-np.ones(2, np.float32)))
+        np.testing.assert_allclose(pos.numpy(), np.full(2, 12.0 * 12.0),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(neg.numpy(), np.full(2, 144.0),
+                                   rtol=1e-5)
+        # first span carried the tensor-if through its own dy2static
+        spans = _spans(sf)
+        assert len(spans) == 2
+
+    def test_layer_params_thread_not_baked(self):
+        lin = nn.Linear(4, 4)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = lin
+
+            def forward(self, x):
+                a = self.lin(x)
+                v = float(a.mean().item())    # BREAK
+                return a * 0 + v
+
+        m = M()
+        sf = StaticFunction(m.forward, layers=[m])
+        x = paddle.to_tensor(rnd(2, 4))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            o1 = float(sf(x).mean().item())
+            # change the weights: the span must see the NEW values
+            lin.weight.set_value(lin.weight.numpy() * 2)
+            o2 = float(sf(x).mean().item())
+        assert abs(o1 - o2) > 1e-7
+
+    def test_unhashable_span_input_degrades_gracefully(self):
+        def f(x):
+            lst = [float(x.sum().item()), 2.0]   # BREAK builds a list
+            y = x * lst[0] + lst[1]              # span reads the list
+            q = y * 2
+            v = float(q.sum().item())            # BREAK again
+            z = q + v
+            return z
+
+        sf = StaticFunction(f)
+        x = paddle.to_tensor(rnd(3))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = sf(x)
+        xs = x.numpy()
+        q = (xs * xs.sum() + 2.0) * 2
+        np.testing.assert_allclose(out.numpy(), q + q.sum(), rtol=1e-5)
+        # the list-input span stayed uncached (eager per call inside
+        # StaticFunction); the clean span compiled normally
+        spans = _spans(sf)
+        assert len(spans) == 2
+        assert len(spans[0]["static"]._cache) == 0
+        assert len(spans[1]["static"]._cache) > 0
+
+    def test_unhashable_outer_arg_runs_eager(self):
+        def f(x, scale_list):
+            return x * scale_list[0]
+
+        sf = StaticFunction(f)
+        x = paddle.to_tensor(rnd(3))
+        out = sf(x, [2.0])
+        np.testing.assert_allclose(out.numpy(), x.numpy() * 2.0,
+                                   rtol=1e-6)
+
+    def test_no_breaks_returns_none(self):
+        def f(x):
+            return x * 2
+
+        assert split_function(f) is None
+
+    def test_to_static_decorator_end_to_end(self):
+        @to_static
+        def f(x):
+            a = paddle.exp(x)
+            b = a / a.sum()
+            v = float(b.max().item())         # BREAK
+            c = b * (1.0 / v)
+            return c
+
+        x = paddle.to_tensor(rnd(5))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = f(x)
+        e = np.exp(x.numpy())
+        b = e / e.sum()
+        np.testing.assert_allclose(out.numpy(), b / b.max(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_return_expression_absorbed_into_span(self):
+        def f(x, w):
+            v = float(x.sum().item())         # BREAK first
+            a = x + v
+            b = paddle.matmul(a, w)
+            return b * 2
+
+        sf = StaticFunction(f)
+        x = paddle.to_tensor(rnd(2, 4))
+        w = paddle.to_tensor(rnd(4, 4))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = sf(x, w)
+        a = x.numpy() + x.numpy().sum()
+        np.testing.assert_allclose(out.numpy(), (a @ w.numpy()) * 2,
+                                   rtol=2e-5, atol=2e-5)
+        spans = _spans(sf)
+        assert len(spans) == 1   # a+matmul+return fused into one span
