@@ -306,14 +306,33 @@ def _run_child(mode: str, deadline: float):
     if mode == "--child-cpu":
         env["JAX_PLATFORMS"] = "cpu"
     stdout, stderr, rc = "", "", "killed"
+    # deadline → SIGINT first (KeyboardInterrupt lets the axon client
+    # release its exclusive chip claim; a hard kill mid-compile wedges
+    # the tunnel for everyone after — observed twice this round), only
+    # then SIGKILL
+    import signal
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), mode], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
     try:
-        r = subprocess.run([sys.executable, os.path.abspath(__file__), mode],
-                           env=env, timeout=deadline,
-                           capture_output=True, text=True)
-        stdout, stderr, rc = r.stdout, r.stderr or "", r.returncode
-    except subprocess.TimeoutExpired as e:
-        stdout = (e.stdout or b"").decode() if isinstance(
-            e.stdout, bytes) else (e.stdout or "")
+        try:
+            stdout, stderr = proc.communicate(timeout=deadline)
+            rc = proc.returncode
+        except subprocess.TimeoutExpired:
+            proc.send_signal(signal.SIGINT)
+            try:
+                stdout, stderr = proc.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                stdout, stderr = proc.communicate()
+            rc = "killed"   # a deadline kill, however gracefully it went
+    except BaseException:
+        # ANY other escape (KeyboardInterrupt to the parent, ...) must
+        # not leak a child holding the exclusive chip claim
+        proc.kill()
+        proc.communicate()
+        raise
+    stdout, stderr = stdout or "", stderr or ""
     result = None
     for line in stdout.splitlines():
         if line.startswith("BENCH_JSON "):
@@ -335,6 +354,26 @@ def _run_child(mode: str, deadline: float):
         return None, "deadline exceeded (backend init or compile hang)"
     tail = (stdout + stderr)[-2000:]
     return None, f"rc={rc}: {tail}"
+
+
+def _last_measured_tpu():
+    """Provenance pointer for a cpu-fallback artifact: the most recent
+    SELF-reported on-chip measurement (clearly labeled as recorded, not
+    live — the fallback's own numbers stay the CPU ones)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_TPU_MEASURED_r03.json")
+    try:
+        with open(path) as f:
+            d = json.load(f)
+        return {"source": os.path.basename(path), "chip": d.get("chip"),
+                "value": d.get("value"), "mfu": d.get("mfu"),
+                "config_small": d.get("config_small"),
+                "config_big": d.get("config_big"),
+                "decode_tokens_per_sec": d.get("decode_tokens_per_sec"),
+                "note": "recorded mid-round on-chip measurement, NOT "
+                        "this run"}
+    except (OSError, json.JSONDecodeError):
+        return None
 
 
 def _child_probe():
@@ -360,31 +399,86 @@ def main():
         return
 
     errors = []
+    try:
+        _main_measured(errors)
+    except KeyboardInterrupt:
+        # the session scripts deadline-SIGINT the whole process group;
+        # the one-JSON-line/rc-0 contract must survive that path too
+        print(json.dumps({
+            "metric": "llama_pretrain_tokens_per_sec_per_chip",
+            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+            "tpu_unavailable": True, "interrupted": True,
+            "tpu_errors": _err_slots(errors),
+            "last_measured_tpu": _last_measured_tpu(),
+        }))
+
+
+def _err_slots(errors):
+    """First + last error: the probe-retry loop floods the front with
+    near-identical lines; the tail holds the real TPU-attempt failure."""
+    return errors[:1] if len(errors) <= 1 else [errors[0], errors[-1]]
+
+
+def _main_measured(errors):
+    t_start = time.time()
+    # wall budget for the WHOLE bench (session scripts run bench under
+    # an outer `timeout`); probe retries must not eat the TPU child's
+    # window — and a too-late recovery must skip to the CPU fallback
+    # rather than start a doomed heavy run
+    total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "0")) \
+        or (TPU_DEADLINE_S + 60)
+
+    def remaining():
+        return total_budget - (time.time() - t_start)
+
     tpu_intended = os.environ.get("JAX_PLATFORMS", "axon") != "cpu"
     tpu_healthy = tpu_intended
     if tpu_intended:
-        probe, perr = _run_child("--child-probe", PROBE_DEADLINE_S)
-        if probe is None or probe.get("platform") == "cpu":
-            # wedged tunnel: skip the heavy attempts entirely and leave
-            # budget for the CPU fallback artifact (VERDICT r2 weak #1)
-            errors.append(f"probe: {perr or 'backend fell back to cpu'}")
-            tpu_healthy = False
+        # a wedged tunnel often recovers within minutes (r3: wedged for
+        # hours mid-round, healthy windows either side) — keep probing
+        # inside a bounded retry window before surrendering the round's
+        # only driver-visible TPU artifact to the CPU fallback
+        retry_budget = float(os.environ.get("BENCH_PROBE_RETRY_S", "600"))
+        attempt = 0
+        while True:
+            attempt += 1
+            probe, perr = _run_child("--child-probe", PROBE_DEADLINE_S)
+            if probe is not None and probe.get("platform") != "cpu":
+                break
+            errors.append(
+                f"probe {attempt}: {perr or 'backend fell back to cpu'}")
+            if time.time() - t_start > retry_budget or \
+                    remaining() < CPU_DEADLINE_S + PROBE_DEADLINE_S:
+                tpu_healthy = False
+                break
+            time.sleep(min(120, retry_budget / 4))
     if tpu_healthy:
         for attempt in range(TPU_ATTEMPTS):
-            result, err = _run_child("--child-tpu", TPU_DEADLINE_S)
+            # leave the CPU fallback its window; a late tunnel recovery
+            # gets a shortened child deadline instead of a doomed run
+            child_deadline = min(TPU_DEADLINE_S,
+                                 remaining() - CPU_DEADLINE_S - 30)
+            if child_deadline < 120:
+                errors.append("tpu: recovered too late in the budget")
+                break
+            result, err = _run_child("--child-tpu", child_deadline)
             if result is not None:
                 print(json.dumps(result))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
             time.sleep(5)
 
-    result, err = _run_child("--child-cpu", CPU_DEADLINE_S)
+    result, err = _run_child(
+        "--child-cpu", max(60.0, min(CPU_DEADLINE_S, remaining() - 10)))
     if result is not None:
         if tpu_intended:
             # a TPU run was intended and failed/skipped — mark the outage
             result["tpu_unavailable"] = True
             result["chip"] = "cpu-fallback"
-            result["tpu_errors"] = errors[:2]
+            # first + last error: the retry loop floods the front with
+            # near-identical probe lines, the tail has the real failure
+            result["tpu_errors"] = _err_slots(errors)
+            result["last_measured_tpu"] = _last_measured_tpu()
         print(json.dumps(result))
         return
     # last resort: still one JSON line, rc 0, explicit marker
@@ -392,7 +486,8 @@ def main():
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
         "tpu_unavailable": True, "cpu_fallback_failed": True,
-        "tpu_errors": errors[:2], "cpu_error": (err or "")[:500],
+        "tpu_errors": _err_slots(errors),
+        "cpu_error": (err or "")[:500],
     }))
 
 

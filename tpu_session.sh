@@ -15,16 +15,23 @@
 set -x
 cd "$(dirname "$0")"
 
-timeout 580 python sweep_flash_blocks.py 2>&1 | grep -v WARNING | tail -12
+timeout -s INT -k 30 580 python sweep_flash_blocks.py 2>&1 | grep -v WARNING | tail -12
 
-BENCH_TPU_DEADLINE_S=1500 timeout 1560 python bench.py \
+BENCH_TPU_DEADLINE_S=1500 BENCH_TOTAL_BUDGET_S=2100 \
+    timeout -s INT -k 30 2160 python bench.py \
     | tee /tmp/bench_last.json
-# keep the self-reported artifact regardless of the driver's own run
-if grep -q '"chip": "v5e"' /tmp/bench_last.json 2>/dev/null; then
+# keep the self-reported artifact regardless of the driver's own run.
+# Parse the TOP-LEVEL chip field — a cpu-fallback artifact embeds the
+# previous v5e numbers under last_measured_tpu, so a substring grep
+# would overwrite the genuine measurement with the fallback.
+if python -c '
+import json, sys
+d = json.load(open("/tmp/bench_last.json"))
+sys.exit(0 if d.get("chip") == "v5e" else 1)' 2>/dev/null; then
     cp /tmp/bench_last.json BENCH_TPU_MEASURED_r03.json
 fi
 
-timeout 580 python profile_tpu.py 2>&1 | tail -3
+timeout -s INT -k 30 580 python profile_tpu.py 2>&1 | tail -3
 
-PT_TPU_TESTS=1 timeout 560 python -m pytest tests/test_pallas_tpu.py -q \
+PT_TPU_TESTS=1 timeout -s INT -k 30 560 python -m pytest tests/test_pallas_tpu.py -q \
     2>&1 | tail -5

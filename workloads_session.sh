@@ -5,7 +5,7 @@
 cd "$(dirname "$0")"
 OUT=WORKLOADS_r03.json
 for w in resnet50 bert_base ernie_moe sdxl_unet; do
-    line=$(timeout 600 python bench_workloads.py "$w" 2>&1 \
+    line=$(timeout -s INT -k 30 600 python bench_workloads.py "$w" 2>&1 \
            | grep '^WORKLOAD ' | tail -1 | sed 's/^WORKLOAD //')
     [ -z "$line" ] && line="{\"workload\": \"$w\", \"error\": \"no output (timeout/crash)\"}"
     python - "$w" "$line" <<'EOF'
