@@ -426,9 +426,11 @@ def _main_measured(errors):
     # window — and a too-late recovery must skip to the CPU fallback
     # rather than start a doomed heavy run
     total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "0")) \
-        or (TPU_DEADLINE_S + 60)
+        or None     # unset → unbounded: never shrink the child deadline
 
     def remaining():
+        if total_budget is None:
+            return float("inf")
         return total_budget - (time.time() - t_start)
 
     tpu_intended = os.environ.get("JAX_PLATFORMS", "axon") != "cpu"
@@ -447,8 +449,11 @@ def _main_measured(errors):
                 break
             errors.append(
                 f"probe {attempt}: {perr or 'backend fell back to cpu'}")
+            # headroom accounts for the sleep + one more failed probe
+            # this iteration may spend before the guard runs again
             if time.time() - t_start > retry_budget or \
-                    remaining() < CPU_DEADLINE_S + PROBE_DEADLINE_S:
+                    remaining() < CPU_DEADLINE_S + 2 * PROBE_DEADLINE_S \
+                    + 150:
                 tpu_healthy = False
                 break
             time.sleep(min(120, retry_budget / 4))

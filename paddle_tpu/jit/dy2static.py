@@ -129,6 +129,28 @@ def flag_clear_and(flag, test):
     return ops.logical_and(flag == 0, test)
 
 
+def loop_prebind(cur, idx):
+    """Pre-bind value for a desugared for-loop variable: keep the
+    caller's existing binding (Python leaves it untouched when the loop
+    runs zero trips); only an unbound name takes the start index so the
+    while carry has a defined init."""
+    return idx if cur is UNDEF else cur
+
+
+def loop_index(start, stop):
+    """Index initializer for a desugared ``for i in range(...)``: when
+    either bound is a Tensor the index must itself be a carried int32
+    Tensor (lax.while_loop state), otherwise keep the Python int so a
+    static range still trace-unrolls exactly as before."""
+    if isinstance(stop, Tensor) or isinstance(start, Tensor):
+        from ..tensor import to_tensor
+        import numpy as np
+        if isinstance(start, Tensor):
+            return start.astype("int32")
+        return to_tensor(np.int32(start))
+    return start
+
+
 def convert_while(cond_fn, body_fn, inputs, names):
     """Runtime dispatch for a converted ``while``: Python predicate →
     plain loop; Tensor predicate → lax.while_loop (state must be
@@ -260,6 +282,102 @@ def _truncate_at_return(stmts):
 
 def _ends_in_return(stmts):
     return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+class _ForToWhile(ast.NodeTransformer):
+    """Desugar ``for NAME in range(...)`` to a while loop (reference:
+    dy2static LoopTransformer handles for-range the same way — verify)
+    so a tensor trip count lowers through the existing while machinery
+    (lax.while_loop at runtime; Python ranges still unroll — the
+    runtime ``convert_while`` dispatches on the predicate type).
+
+    The increment happens BEFORE the body so a ``continue`` cannot skip
+    it (the classic for→while pitfall); ``break``/``return`` inside the
+    body are then the EarlyReturnTransformer's standard while-exit
+    cases, which is why this pass runs first. Only constant (or absent)
+    steps convert — a dynamic step's comparison direction is unknowable
+    statically."""
+
+    def __init__(self):
+        self.counter = 0
+        self.converted = 0
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and isinstance(node.target, ast.Name)
+                and not node.orelse):
+            return node
+        step = 1
+        if len(it.args) == 3:
+            s = it.args[2]
+            neg = (isinstance(s, ast.UnaryOp)
+                   and isinstance(s.op, ast.USub)
+                   and isinstance(s.operand, ast.Constant))
+            if neg:
+                s = s.operand
+            if not (isinstance(s, ast.Constant)
+                    and isinstance(s.value, int) and s.value != 0):
+                return node
+            step = -s.value if neg else s.value
+        start = it.args[0] if len(it.args) >= 2 else ast.Constant(value=0)
+        stop = it.args[1] if len(it.args) >= 2 else it.args[0]
+        self.counter += 1
+        k = self.counter
+        stop_n, idx_n = f"_jst_fstop_{k}", f"_jst_fidx_{k}"
+        init = [
+            ast.Assign(targets=[ast.Name(id=stop_n, ctx=ast.Store())],
+                       value=stop),
+            ast.Assign(
+                targets=[ast.Name(id=idx_n, ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id="_jst", ctx=ast.Load()),
+                        attr="loop_index", ctx=ast.Load()),
+                    args=[start, ast.Name(id=stop_n, ctx=ast.Load())],
+                    keywords=[])),
+            # pre-bind the loop variable: it is carried by the while
+            # (assigned in its body) and an UNDEF carry init would
+            # reject the conversion at runtime. loop_prebind keeps an
+            # EXISTING binding (zero-trip Python semantics) and only
+            # falls to the start index for an unbound name
+            ast.Assign(
+                targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+                value=ast.Call(
+                    func=ast.Attribute(
+                        value=ast.Name(id="_jst", ctx=ast.Load()),
+                        attr="loop_prebind", ctx=ast.Load()),
+                    args=[ast.Call(
+                        func=ast.Attribute(
+                            value=ast.Name(id="_jst", ctx=ast.Load()),
+                            attr="ld", ctx=ast.Load()),
+                        args=[ast.Call(func=ast.Name(id="locals",
+                                                     ctx=ast.Load()),
+                                       args=[], keywords=[]),
+                              ast.Constant(value=node.target.id)],
+                        keywords=[]),
+                        ast.Name(id=idx_n, ctx=ast.Load())],
+                    keywords=[])),
+        ]
+        test = ast.Compare(
+            left=ast.Name(id=idx_n, ctx=ast.Load()),
+            ops=[ast.Lt() if step > 0 else ast.Gt()],
+            comparators=[ast.Name(id=stop_n, ctx=ast.Load())])
+        body = [
+            ast.Assign(targets=[ast.Name(id=node.target.id,
+                                         ctx=ast.Store())],
+                       value=ast.Name(id=idx_n, ctx=ast.Load())),
+            ast.Assign(
+                targets=[ast.Name(id=idx_n, ctx=ast.Store())],
+                value=ast.BinOp(left=ast.Name(id=idx_n, ctx=ast.Load()),
+                                op=ast.Add(),
+                                right=ast.Constant(value=step))),
+        ] + node.body
+        self.converted += 1
+        return init + [ast.While(test=test, body=body, orelse=[])]
 
 
 class _EarlyReturnTransformer:
@@ -715,6 +833,9 @@ def convert_function(fn: Callable) -> Optional[Callable]:
             # would change behavior on exactly the converted signatures
             return None
     fdef.decorator_list = []          # don't re-apply @to_static
+    f2w = _ForToWhile()               # for-range → while, BEFORE the
+    f2w.visit(fdef)                   # exit transformer (see its doc)
+    ast.fix_missing_locations(fdef)
     ert = _EarlyReturnTransformer()
     fdef.body = ert.process(fdef.body)
     tr = _ControlFlowTransformer()

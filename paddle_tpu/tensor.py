@@ -210,6 +210,12 @@ class Tensor:
     def __int__(self):
         return int(self._dense_value())
 
+    def __index__(self):
+        # lets range(n)/slicing accept a concrete 0-d integer Tensor;
+        # under tracing jax raises TracerIntegerConversionError, which
+        # to_static catches and routes into the conversion pipeline
+        return self._dense_value().__index__()
+
     def __bool__(self):
         return bool(self._dense_value())
 
