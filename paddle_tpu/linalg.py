@@ -20,15 +20,16 @@ from .ops.math import (cholesky, cholesky_solve, cond, corrcoef, cov, cross,
                        det, dist, dot, eig, eigh, eigvals, eigvalsh,
                        householder_product, inv, lstsq, lu, lu_unpack,
                        matmul, matrix_exp, matrix_norm, matrix_power,
-                       matrix_rank, multi_dot, mv, norm, pca_lowrank, pinv,
-                       qr, slogdet, solve, svd, svd_lowrank, t,
-                       triangular_solve, vecdot, vector_norm)
+                       matrix_rank, multi_dot, mv, norm, ormqr, pca_lowrank,
+                       pinv, qr, slogdet, solve, svd, svd_lowrank, svdvals,
+                       t, triangular_solve, vecdot, vector_norm)
 
 __all__ = [
     "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "cross", "det",
     "dist", "dot", "eig", "eigh", "eigvals", "eigvalsh",
     "householder_product", "inv", "lstsq", "lu", "lu_unpack", "matmul",
     "matrix_exp", "matrix_norm", "matrix_power", "matrix_rank", "multi_dot",
-    "mv", "norm", "pca_lowrank", "pinv", "qr", "slogdet", "solve", "svd",
-    "svd_lowrank", "t", "triangular_solve", "vecdot", "vector_norm",
+    "mv", "norm", "ormqr", "pca_lowrank", "pinv", "qr", "slogdet", "solve",
+    "svd", "svd_lowrank", "svdvals", "t", "triangular_solve", "vecdot",
+    "vector_norm",
 ]

@@ -657,3 +657,24 @@ SiLU = Silu  # paddle keeps both spellings
 
 __all__ += ["RReLU", "ThresholdedReLU", "Softmax2D", "PairwiseDistance",
             "Unflatten", "ZeroPad2D", "PixelUnshuffle", "Fold", "SiLU"]
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding, self.data_format = padding, data_format
+
+    def forward(self, x):
+        return F.zeropad1d(x, self.padding, self.data_format)
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding, self.data_format = padding, data_format
+
+    def forward(self, x):
+        return F.zeropad3d(x, self.padding, self.data_format)
+
+
+__all__ += ["ZeroPad1D", "ZeroPad3D"]

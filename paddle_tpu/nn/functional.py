@@ -1866,3 +1866,183 @@ __all__ += [
     "grid_sample", "conv3d_transpose", "local_response_norm", "zeropad2d",
     "bilinear",
 ]
+
+
+# ---- pooling/pad/loss long tail (reference: python/paddle/nn/functional/
+# pooling.py lp_pool*/fractional_max_pool*, loss.py gaussian_nll_loss,
+# common.py zeropad — verify) ------------------------------------------------
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """NLL of a Gaussian with predicted mean+variance."""
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(
+            f"reduction must be 'mean', 'sum' or 'none', got {reduction!r}")
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * jnp.log(2 * jnp.pi).astype(loss.dtype)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply_op(f, input, label, variance)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """Power-average pooling: (sum |x|^p over window)^(1/p).
+    exclusive=False below so avg*k equals the true windowed sum even on
+    padding-truncated edge windows (padded zeros contribute 0 to the
+    p-power sum, matching the reference)."""
+    p = float(norm_type)
+    k = _pair(kernel_size, 1)[0]
+    if data_format == "NLC":
+        x = apply_op(lambda v: jnp.swapaxes(v, 1, 2), x)
+    powed = apply_op(lambda v: jnp.power(jnp.abs(v), p), x)
+    pooled = avg_pool1d(powed, kernel_size, stride, padding,
+                        exclusive=False, ceil_mode=ceil_mode)
+    out = apply_op(lambda v: jnp.power(v * k, 1.0 / p), pooled)
+    if data_format == "NLC":
+        out = apply_op(lambda v: jnp.swapaxes(v, 1, 2), out)
+    return out
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    kh, kw = _pair(kernel_size, 2)
+    powed = apply_op(lambda v: jnp.power(jnp.abs(v), p), x)
+    pooled = avg_pool2d(powed, kernel_size, stride, padding,
+                        ceil_mode=ceil_mode, exclusive=False,
+                        data_format=data_format)
+    return apply_op(lambda v: jnp.power(v * (kh * kw), 1.0 / p), pooled)
+
+
+def zeropad1d(x, padding, data_format="NCL", name=None):
+    pl, pr = _pair(padding, 2) if isinstance(padding, (list, tuple)) \
+        else (padding, padding)
+    def f(v):
+        cfg = [(0, 0), (0, 0), (pl, pr)] if data_format == "NCL" \
+            else [(0, 0), (pl, pr), (0, 0)]
+        return jnp.pad(v, cfg)
+    return apply_op(f, x)
+
+
+def zeropad3d(x, padding, data_format="NCDHW", name=None):
+    if isinstance(padding, int):
+        pads = [padding] * 6
+    else:
+        pads = list(padding)
+    l, r, t, b, f_, bk = pads
+    def f(v):
+        cfg = [(0, 0), (0, 0), (f_, bk), (t, b), (l, r)] \
+            if data_format == "NCDHW" \
+            else [(0, 0), (f_, bk), (t, b), (l, r), (0, 0)]
+        return jnp.pad(v, cfg)
+    return apply_op(f, x)
+
+
+def _fractional_edges(size, out, u):
+    """Fractional-pooling region edges (Graham): monotone, last == size.
+    ``u`` may be traced (sampled per call); edges are dynamic ints."""
+    alpha = size / out
+    ks = jnp.arange(out + 1, dtype=jnp.float32)
+    edges = jnp.ceil(alpha * (ks + u)).astype(jnp.int32) - \
+        jnp.ceil(alpha * u).astype(jnp.int32)
+    return jnp.clip(edges, 0, size).at[-1].set(size)
+
+
+def _fractional_pool_axis(v, axis, out, u, kernel=None):
+    """Max-pool ``axis`` into ``out`` fractional regions. kernel=None:
+    disjoint regions (segment-max between edges); kernel=k: paddle's
+    overlapping mode — a k-wide window anchored at each region start."""
+    size = v.shape[axis]
+    edges = _fractional_edges(size, out, u)
+    moved = jnp.moveaxis(v, axis, 0)
+    if kernel is None:
+        # region id of every input index: # of edges <= idx (right-open)
+        ids = jnp.searchsorted(edges, jnp.arange(size), side="right") - 1
+        ids = jnp.clip(ids, 0, out - 1)
+        seg = jax.ops.segment_max(moved, ids, num_segments=out)
+    else:
+        starts = jnp.clip(edges[:-1], 0, max(size - kernel, 0))
+        idx = jnp.clip(starts[:, None] + jnp.arange(kernel)[None, :],
+                       0, size - 1)                    # (out, k)
+        seg = jnp.max(moved[idx], axis=1)
+    return jnp.moveaxis(seg, 0, axis)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (Graham 2014): pseudo-random pooling
+    regions whose sizes average H/out. ``random_u`` fixes the region
+    offset; None samples it per call from the global generator."""
+    oh, ow = _pair(output_size, 2)
+    kh, kw = _pair(kernel_size, 2) if kernel_size is not None \
+        else (None, None)
+    if random_u is None:
+        from .. import framework
+        key = framework.split_key()
+        u = jax.random.uniform(key, ())
+    else:
+        u = jnp.float32(random_u)
+    if return_mask and kernel_size is not None:
+        raise NotImplementedError(
+            "fractional_max_pool2d: return_mask with an explicit "
+            "kernel_size (overlapping mode) is not supported")
+
+    def f(v):
+        out = _fractional_pool_axis(v, 2, oh, u, kh)
+        return _fractional_pool_axis(out, 3, ow, u, kw)
+    out = apply_op(f, x)
+    if return_mask:
+        # indices of the max within each region (flattened H*W), found
+        # by comparing the upsampled pooled map against the input
+        def mask_f(v, o):
+            h, w = v.shape[2], v.shape[3]
+            he = _fractional_edges(h, oh, u)
+            we = _fractional_edges(w, ow, u)
+            hid = jnp.clip(jnp.searchsorted(
+                he, jnp.arange(h), side="right") - 1, 0, oh - 1)
+            wid = jnp.clip(jnp.searchsorted(
+                we, jnp.arange(w), side="right") - 1, 0, ow - 1)
+            up = o[:, :, hid][:, :, :, wid]
+            flat = jnp.arange(h * w).reshape(h, w)
+            cand = jnp.where(v >= up, flat, h * w)
+            ids2 = hid[:, None] * ow + wid[None, :]
+            m = jax.ops.segment_min(
+                cand.reshape(*cand.shape[:2], -1).swapaxes(0, -1),
+                ids2.reshape(-1), num_segments=oh * ow)
+            return m.swapaxes(0, -1).reshape(*v.shape[:2], oh, ow)
+        mask = apply_op(mask_f, x, out)
+        return out, mask
+    return out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    od, oh, ow = _pair(output_size, 3)
+    kd, kh, kw = _pair(kernel_size, 3) if kernel_size is not None \
+        else (None, None, None)
+    if random_u is None:
+        from .. import framework
+        key = framework.split_key()
+        u = jax.random.uniform(key, ())
+    else:
+        u = jnp.float32(random_u)
+
+    def f(v):
+        out = _fractional_pool_axis(v, 2, od, u, kd)
+        out = _fractional_pool_axis(out, 3, oh, u, kh)
+        return _fractional_pool_axis(out, 4, ow, u, kw)
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True) is not supported")
+    return apply_op(f, x)
+
+
+__all__ += ["gaussian_nll_loss", "lp_pool1d", "lp_pool2d", "zeropad1d",
+            "zeropad3d", "fractional_max_pool2d", "fractional_max_pool3d"]

@@ -295,3 +295,96 @@ __all__ += ["CTCLoss", "HingeEmbeddingLoss", "HSigmoidLoss",
             "MultiLabelSoftMarginLoss", "MultiMarginLoss", "PoissonNLLLoss",
             "SoftMarginLoss", "TripletMarginLoss",
             "TripletMarginWithDistanceLoss"]
+
+
+class GaussianNLLLoss(Layer):
+    """reference: python/paddle/nn/layer/loss.py GaussianNLLLoss — verify."""
+
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Efficient softmax over a frequency-sorted vocabulary: a small
+    head over [frequent classes + one logit per tail cluster], tail
+    clusters projected down by div_value^i (reference:
+    python/paddle/nn/layer/loss.py AdaptiveLogSoftmaxWithLoss — verify).
+
+    forward(input, label) -> (target_log_probs, loss); also provides
+    log_prob(input) (full (N, n_classes) log-probabilities) and
+    predict(input)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        from .common import Linear, Sequential
+        cutoffs = list(cutoffs)
+        if not cutoffs or cutoffs != sorted(set(cutoffs)) \
+                or cutoffs[-1] > n_classes - 1 or min(cutoffs) <= 0:
+            raise ValueError(
+                f"cutoffs must be unique, increasing, positive ints "
+                f"< n_classes-1; got {cutoffs} for {n_classes} classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head = Linear(in_features, self.head_size,
+                           bias_attr=head_bias)
+        self.tail = []
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = Sequential(Linear(in_features, hsz, bias_attr=False),
+                              Linear(hsz, osz, bias_attr=False))
+            self.tail.append(proj)
+            setattr(self, f"tail_{i}", proj)   # registers parameters
+
+    def _head_logprob(self, input):
+        return F.log_softmax(self.head(input), axis=-1)
+
+    def forward(self, input, label):
+        from ..ops import math as M
+        from ..ops import manipulation as MP
+        head_lp = self._head_logprob(input)          # (N, head_size)
+        # shortlist target logprob (clamped gather; masked out later)
+        short_idx = M.clip(label, 0, self.shortlist_size - 1)
+        out = MP.squeeze(MP.take_along_axis(
+            head_lp, MP.unsqueeze(short_idx.astype("int64"), -1), 1), -1)
+        for i in range(self.n_clusters):
+            lo, hi = self.cutoffs[i], self.cutoffs[i + 1]
+            in_cl = M.logical_and(label >= lo, label < hi)
+            tail_lp = F.log_softmax(self.tail[i](input), axis=-1)
+            rel = M.clip(label - lo, 0, hi - lo - 1)
+            cl_lp = MP.squeeze(MP.take_along_axis(
+                tail_lp, MP.unsqueeze(rel.astype("int64"), -1), 1), -1)
+            cluster_logit = head_lp[:, self.shortlist_size + i]
+            out = MP.where(in_cl, cluster_logit + cl_lp, out)
+        loss = -out.mean()
+        return out, loss
+
+    def log_prob(self, input):
+        from ..ops import manipulation as MP
+        head_lp = self._head_logprob(input)
+        parts = [head_lp[:, :self.shortlist_size]]
+        for i in range(self.n_clusters):
+            tail_lp = F.log_softmax(self.tail[i](input), axis=-1)
+            parts.append(
+                tail_lp + MP.unsqueeze(
+                    head_lp[:, self.shortlist_size + i], -1))
+        return MP.concat(parts, axis=-1)
+
+    def predict(self, input):
+        from ..ops import math as M
+        return M.argmax(self.log_prob(input), axis=-1)
+
+
+__all__ += ["GaussianNLLLoss", "AdaptiveLogSoftmaxWithLoss"]

@@ -2,6 +2,8 @@
 python/paddle/tensor/manipulation.py — verify)."""
 from __future__ import annotations
 
+import builtins
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -749,3 +751,74 @@ def combinations(x, r=2, with_replacement=False, name=None):
 
 
 __all__ += ["cat", "multiplex", "combinations"]
+
+
+# ---- scatter-variant + construction long tail (reference:
+# python/paddle/tensor/manipulation.py block_diag / diagonal_scatter /
+# select_scatter / slice_scatter; creation.py cartesian_prod — verify) -------
+
+def block_diag(inputs, name=None):
+    """Block-diagonal matrix from a list of 2-D (or promotable) tensors."""
+    def f(*vs):
+        vs = [jnp.atleast_2d(v) for v in vs]
+        return jax.scipy.linalg.block_diag(*vs)
+    return apply_op(f, *inputs)
+
+
+def cartesian_prod(x, name=None):
+    """Cartesian product of 1-D tensors: shape (prod(n_i), len(x))."""
+    if isinstance(x, Tensor):
+        x = [x]
+    if len(x) == 1:
+        return apply_op(lambda v: v, x[0])
+
+    def f(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply_op(f, *x)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write ``y`` onto the (offset) diagonal of the (axis1, axis2)
+    planes of ``x`` (out-of-place)."""
+    def f(v, d):
+        a = jnp.moveaxis(v, (axis1, axis2), (-2, -1))
+        m, n = a.shape[-2], a.shape[-1]
+        k = offset
+        dlen = builtins.min(m + builtins.min(k, 0), n - builtins.max(k, 0))
+        di = jnp.arange(dlen) + builtins.max(-k, 0)
+        dj = jnp.arange(dlen) + builtins.max(k, 0)
+        # y's layout matches x.diagonal(...): batch dims first, diag last
+        a = a.at[..., di, dj].set(d)
+        return jnp.moveaxis(a, (-2, -1), (axis1, axis2))
+    return apply_op(f, x, y)
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """Write ``values`` into ``x`` at position ``index`` along ``axis``."""
+    def f(v, val):
+        a = jnp.moveaxis(v, axis, 0)
+        a = a.at[index].set(val.astype(a.dtype))
+        return jnp.moveaxis(a, 0, axis)
+    return apply_op(f, x, values)
+
+
+def slice_scatter(x, value, axes=None, starts=None, ends=None,
+                  strides=None, name=None):
+    """Write ``value`` into the slice of ``x`` selected by
+    (axes, starts, ends, strides)."""
+    axes = list(axes or [])
+    starts = list(starts or [])
+    ends = list(ends or [])
+    strides = list(strides or [1] * len(axes))
+
+    def f(v, val):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(st), int(en), int(sr))
+        return v.at[tuple(idx)].set(val.astype(v.dtype))
+    return apply_op(f, x, value)
+
+
+__all__ += ["block_diag", "cartesian_prod", "diagonal_scatter",
+            "select_scatter", "slice_scatter"]

@@ -858,18 +858,10 @@ def matrix_rank(x, tol=None, hermitian=False, name=None):
 
 
 def householder_product(x, tau, name=None):
-    """Accumulate Householder reflectors (geqrf convention) into Q."""
+    """Accumulate Householder reflectors (geqrf convention) into Q
+    (the thin m×n slice; ormqr uses the same accumulation full-width)."""
     def f(a, t):
-        m, n = a.shape[-2], a.shape[-1]
-        q = jnp.broadcast_to(jnp.eye(m, dtype=a.dtype),
-                             (*a.shape[:-2], m, m))
-        for i in range(t.shape[-1] - 1, -1, -1):
-            v = a[..., :, i]
-            v = jnp.where(jnp.arange(m) < i, 0.0, v)
-            v = v.at[..., i].set(1.0)
-            vv = v[..., :, None] * v[..., None, :]
-            q = q - t[..., i, None, None] * (vv @ q)
-        return q[..., :, :n]
+        return _householder_q_full(a, t)[..., :, :a.shape[-1]]
     return apply_op(f, x, tau)
 
 
@@ -1191,3 +1183,53 @@ def is_tensor(x):
 
 __all__ += ["complex", "polar", "sgn", "pdist", "rank", "is_complex",
             "is_floating_point", "is_integer", "is_empty", "is_tensor"]
+
+
+# ---- gamma family + extra linalg (reference: python/paddle/tensor/math.py
+# gammaln/gammainc/gammaincc; linalg.py ormqr — verify) ----------------------
+
+def gammaln(x, name=None):
+    return apply_op(jax.scipy.special.gammaln, x)
+
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y)."""
+    return apply_op(jax.scipy.special.gammainc, x, y)
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y)."""
+    return apply_op(jax.scipy.special.gammaincc, x, y)
+
+
+def _householder_q_full(a, t):
+    """Accumulate geqrf-convention reflectors into the FULL m×m Q."""
+    m = a.shape[-2]
+    q = jnp.broadcast_to(jnp.eye(m, dtype=a.dtype),
+                         (*a.shape[:-2], m, m))
+    for i in range(t.shape[-1] - 1, -1, -1):
+        v = a[..., :, i]
+        v = jnp.where(jnp.arange(m) < i, 0.0, v)
+        v = v.at[..., i].set(1.0)
+        vv = v[..., :, None] * v[..., None, :]
+        q = q - t[..., i, None, None] * (vv @ q)
+    return q
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply ``y`` by the orthogonal Q encoded in (x, tau) —
+    reference: paddle.linalg.ormqr over LAPACK ormqr."""
+    def f(a, t, other):
+        q = _householder_q_full(a, t)
+        if transpose:
+            q = jnp.swapaxes(q, -1, -2)
+        return q @ other if left else other @ q
+    return apply_op(f, x, tau, y)
+
+
+def svdvals(x, name=None):
+    """Singular values only (reference: paddle.linalg.svdvals)."""
+    return apply_op(lambda v: jnp.linalg.svd(v, compute_uv=False), x)
+
+
+__all__ += ["gammaln", "gammainc", "gammaincc", "ormqr", "svdvals"]

@@ -242,3 +242,186 @@ class TestDistributedAdditions:
             assert tuple(out.shape) == (2, 6)
         finally:
             set_current_mesh(None)
+
+
+class TestRound3LongTail:
+    """gamma family, scatter variants, ormqr/svdvals, pooling/pad/loss
+    additions (reference: tensor/math.py + manipulation.py +
+    nn/layer/{pooling,loss}.py — verify)."""
+
+    def test_gamma_family(self):
+        import scipy.special as sp
+        x = np.array([0.5, 1.0, 3.0], np.float32)
+        y = np.array([0.2, 1.0, 2.5], np.float32)
+        np.testing.assert_allclose(
+            paddle.gammaln(paddle.to_tensor(x)).numpy(),
+            sp.gammaln(x), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            paddle.gammainc(paddle.to_tensor(x),
+                            paddle.to_tensor(y)).numpy(),
+            sp.gammainc(x, y), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.gammaincc(paddle.to_tensor(x),
+                             paddle.to_tensor(y)).numpy(),
+            sp.gammaincc(x, y), rtol=1e-5)
+
+    def test_block_diag_cartesian_prod(self):
+        a = paddle.to_tensor(np.eye(2, dtype=np.float32))
+        b = paddle.to_tensor(np.full((1, 3), 2.0, np.float32))
+        bd = paddle.block_diag([a, b]).numpy()
+        assert bd.shape == (3, 5)
+        assert bd[:2, :2].trace() == 2 and (bd[2, 2:] == 2).all()
+        assert bd[:2, 2:].sum() == 0 and bd[2, :2].sum() == 0
+        cp = paddle.cartesian_prod(
+            [paddle.to_tensor(np.array([1, 2])),
+             paddle.to_tensor(np.array([5, 6, 7]))]).numpy()
+        expect = np.array([[1, 5], [1, 6], [1, 7], [2, 5], [2, 6], [2, 7]])
+        np.testing.assert_array_equal(cp, expect)
+
+    def test_scatter_variants(self):
+        x = np.zeros((3, 4), np.float32)
+        ds = paddle.diagonal_scatter(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.array([1., 2., 3.], np.float32))).numpy()
+        np.testing.assert_array_equal(np.diag(ds)[:3], [1, 2, 3])
+        ds2 = paddle.diagonal_scatter(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.array([9., 9., 9.], np.float32)),
+            offset=1).numpy()
+        np.testing.assert_array_equal(ds2[[0, 1, 2], [1, 2, 3]], [9, 9, 9])
+        ss = paddle.select_scatter(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.array([7., 7., 7.], np.float32)),
+            axis=1, index=2).numpy()
+        assert (ss[:, 2] == 7).all() and ss.sum() == 21
+        sl = paddle.slice_scatter(
+            paddle.to_tensor(x),
+            paddle.to_tensor(np.ones((3, 2), np.float32)),
+            axes=[1], starts=[0], ends=[4], strides=[2]).numpy()
+        assert (sl[:, [0, 2]] == 1).all() and sl.sum() == 6
+
+    def test_ormqr_svdvals(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 3).astype(np.float32)
+        s = paddle.linalg.svdvals(paddle.to_tensor(a)).numpy()
+        np.testing.assert_allclose(s, np.linalg.svd(a, compute_uv=False),
+                                   rtol=1e-4)
+        # ormqr against scipy's geqrf/ormqr ground truth
+        import scipy.linalg as sl
+        qr_raw, tau = sl.lapack.sgeqrf(a)[:2]
+        y = rng.randn(4, 2).astype(np.float32)
+        got = paddle.linalg.ormqr(
+            paddle.to_tensor(qr_raw), paddle.to_tensor(tau),
+            paddle.to_tensor(y)).numpy()
+        want = sl.lapack.sormqr("L", "N", qr_raw, tau, y,
+                                max(1, y.size))[0]
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    def test_lp_pool_and_zeropad(self):
+        from paddle_tpu import nn
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.random.rand(1, 2, 6, 6).astype(np.float32))
+        o = nn.LPPool2D(2, 2, 2)(x)
+        ref = np.sqrt(F.avg_pool2d(x * x, 2, 2).numpy() * 4)
+        np.testing.assert_allclose(o.numpy(), ref, rtol=1e-5)
+        o1 = nn.LPPool1D(1, 3, 3)(
+            paddle.to_tensor(np.ones((1, 1, 6), np.float32)))
+        np.testing.assert_allclose(o1.numpy(), np.full((1, 1, 2), 3.0),
+                                   rtol=1e-6)
+        assert nn.ZeroPad1D((1, 2))(
+            paddle.to_tensor(np.ones((1, 1, 3), np.float32))).shape \
+            == [1, 1, 6]
+        z3 = nn.ZeroPad3D((1, 0, 2, 0, 0, 1))(
+            paddle.to_tensor(np.ones((1, 1, 2, 2, 2), np.float32)))
+        assert z3.shape == [1, 1, 3, 4, 3]
+
+    def test_fractional_max_pool(self):
+        from paddle_tpu import nn
+        x = paddle.to_tensor(
+            np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6))
+        out = nn.FractionalMaxPool2D(output_size=2, random_u=0.4)(x)
+        assert out.shape == [1, 1, 2, 2]
+        assert float(out.numpy().max()) == 35.0
+        # regions partition the input: every output is a real input value
+        assert np.isin(out.numpy(), x.numpy()).all()
+        out3 = nn.FractionalMaxPool3D(output_size=2, random_u=0.7)(
+            paddle.to_tensor(
+                np.arange(27, dtype=np.float32).reshape(1, 1, 3, 3, 3)))
+        assert out3.shape == [1, 1, 2, 2, 2]
+        # sampled-u path runs (and differs run-to-run is fine)
+        r = nn.FractionalMaxPool2D(output_size=3)(x)
+        assert r.shape == [1, 1, 3, 3]
+
+    def test_gaussian_nll_and_adaptive_softmax(self):
+        from paddle_tpu import nn
+        mu = paddle.to_tensor(np.zeros(3, np.float32))
+        y = paddle.to_tensor(np.ones(3, np.float32))
+        var = paddle.to_tensor(np.full(3, 2.0, np.float32))
+        got = nn.GaussianNLLLoss()(mu, y, var).numpy()
+        np.testing.assert_allclose(
+            got, 0.5 * (np.log(2.0) + 0.5), rtol=1e-5)
+        full = nn.GaussianNLLLoss(full=True)(mu, y, var).numpy()
+        np.testing.assert_allclose(
+            full - got, 0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+        paddle.seed(7)
+        asm = nn.AdaptiveLogSoftmaxWithLoss(8, 15, cutoffs=[4, 10],
+                                            div_value=2.0)
+        xin = paddle.to_tensor(np.random.RandomState(1).randn(
+            5, 8).astype(np.float32))
+        lab = paddle.to_tensor(np.array([0, 3, 4, 9, 14]))
+        out, loss = asm(xin, lab)
+        lp = asm.log_prob(xin)
+        # full distribution normalizes; forward gathers the target col
+        np.testing.assert_allclose(np.exp(lp.numpy()).sum(-1),
+                                   np.ones(5), rtol=1e-4)
+        np.testing.assert_allclose(
+            out.numpy(), lp.numpy()[np.arange(5), lab.numpy()], rtol=1e-4)
+        np.testing.assert_allclose(loss.numpy(), -out.numpy().mean(),
+                                   rtol=1e-5)
+        assert asm.predict(xin).shape == [5]
+        # training signal flows into head AND tail params
+        loss2 = asm(xin, lab)[1]
+        loss2.backward()
+        assert asm.head.weight.grad is not None
+        assert asm.tail_0[0].weight.grad is not None
+
+    def test_lp_pool_padded_edges_and_nlc(self):
+        from paddle_tpu import nn
+        import paddle_tpu.nn.functional as F
+        # padded corner windows: p=1 lp_pool == true windowed |x| sum
+        # (padded zeros contribute nothing, NOT inflated by k/count)
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), np.float32))
+        o = F.lp_pool2d(x, 1, 2, stride=2, padding=1).numpy()
+        np.testing.assert_allclose(o[0, 0], [[1, 2, 1], [2, 4, 2],
+                                             [1, 2, 1]], rtol=1e-6)
+        # NLC layout pools the length axis, not channels
+        xn = paddle.to_tensor(np.ones((1, 6, 2), np.float32))
+        on = F.lp_pool1d(xn, 1, 3, data_format="NLC")
+        assert on.shape == [1, 2, 2]
+        np.testing.assert_allclose(on.numpy(), np.full((1, 2, 2), 3.0),
+                                   rtol=1e-6)
+
+    def test_fractional_overlapping_kernel_mode(self):
+        from paddle_tpu import nn
+        x = paddle.to_tensor(
+            np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6))
+        dis = nn.FractionalMaxPool2D(output_size=3, random_u=0.2)(x)
+        ovl = nn.FractionalMaxPool2D(output_size=3, kernel_size=3,
+                                     random_u=0.2)(x)
+        assert ovl.shape == [1, 1, 3, 3]
+        # overlapping 3-windows see at least as much as disjoint regions
+        assert (ovl.numpy() >= dis.numpy() - 1e-6).all()
+        assert float(ovl.numpy().max()) == 35.0
+        with pytest.raises(NotImplementedError):
+            nn.FractionalMaxPool2D(output_size=2, kernel_size=2,
+                                   return_mask=True)(x)
+
+    def test_gaussian_nll_invalid_reduction(self):
+        from paddle_tpu import nn
+        import paddle_tpu.nn.functional as F
+        with pytest.raises(ValueError):
+            F.gaussian_nll_loss(paddle.to_tensor(np.ones(2, np.float32)),
+                                paddle.to_tensor(np.ones(2, np.float32)),
+                                paddle.to_tensor(np.ones(2, np.float32)),
+                                reduction="Mean")
