@@ -397,6 +397,7 @@ class TrainStep:
             return loss, new_params, new_opt_state, new_bufs, aux
 
         donate = (0, 1) if self._donate else ()
+        self._step_fn = step            # uncompiled core (run_steps scans it)
         self._jitted = jax.jit(step, donate_argnums=donate)
         self._ptensors, self._btensors, self._frozen = \
             ptensors, btensors, frozen
@@ -435,6 +436,58 @@ class TrainStep:
             self._btensors[n]._update_value(v)
         self.optimizer.load_functional_state(new_opt_state)
         if aux:
+            return (Tensor(loss),) + tuple(
+                jax.tree.map(Tensor, a) for a in aux)
+        return Tensor(loss)
+
+    def _build_multi(self, n_steps):
+        """One XLA program running ``n_steps`` train steps as lax.scan —
+        no host round-trip between steps (through a tunneled chip, the
+        per-step dispatch gap shows up as device IDLE; PROFILE_r03
+        measured 9.3%). Same state threading/donation as the single
+        step; the per-step rng keys are split on device; LR is read once
+        per dispatch (a per-step LR schedule advances per CALL, not per
+        inner step — use single-step mode when that distinction
+        matters)."""
+        if self._jitted is None:
+            self._build()
+
+        def multi(pvals, opt_state, bvals, fvals, key, lr_value, batch):
+            def body(carry, k):
+                pv, os_, bv = carry
+                loss, pv, os_, bv, aux = self._step_fn(
+                    pv, os_, bv, fvals, k, lr_value, batch)
+                return (pv, os_, bv), (loss, aux)
+            keys = jax.random.split(key, n_steps)
+            (pv, os_, bv), (losses, auxes) = jax.lax.scan(
+                body, (pvals, opt_state, bvals), keys)
+            last_aux = jax.tree.map(lambda a: a[-1], auxes)
+            return losses[-1], pv, os_, bv, last_aux
+
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(multi, donate_argnums=donate)
+
+    def run_steps(self, batch, n_steps):
+        """Run ``n_steps`` optimizer steps on ``batch`` in ONE compiled
+        dispatch; returns the last step's loss. Parity with n_steps
+        sequential __call__ invocations (modulo the rng key sequence and
+        per-step LR schedules; see _build_multi)."""
+        if n_steps == 1:
+            return self(batch)
+        cache = getattr(self, "_multi_cache", None)
+        if cache is None:
+            cache = self._multi_cache = {}
+        if n_steps not in cache:
+            cache[n_steps] = self._build_multi(n_steps)
+        loss, new_params, new_opt_state, new_bufs, aux = cache[n_steps](
+            *self._step_args(batch))
+        for n, v in new_params.items():
+            self._ptensors[n]._update_value(v)
+        for n, v in new_bufs.items():
+            self._btensors[n]._update_value(v)
+        self.optimizer.load_functional_state(new_opt_state)
+        if aux:
+            # last inner step's aux — same tuple shape as __call__
             return (Tensor(loss),) + tuple(
                 jax.tree.map(Tensor, a) for a in aux)
         return Tensor(loss)

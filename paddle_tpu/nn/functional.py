@@ -1878,6 +1878,18 @@ def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
     if reduction not in ("mean", "sum", "none"):
         raise ValueError(
             f"reduction must be 'mean', 'sum' or 'none', got {reduction!r}")
+    try:  # concrete values: reject negative variance (reference
+        # raises; silently clamping would mask a missing softplus).
+        # Traced values can't be inspected — epsilon clamp applies.
+        if float(jnp.min(variance._value
+                         if hasattr(variance, "_value")
+                         else jnp.asarray(variance))) < 0:
+            raise ValueError("gaussian_nll_loss: variance has negative "
+                             "entries")
+    except jax.errors.TracerArrayConversionError:
+        pass
+    except jax.errors.ConcretizationTypeError:
+        pass
     def f(mu, y, var):
         var = jnp.maximum(var, epsilon)
         loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)

@@ -354,6 +354,19 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
     def forward(self, input, label):
         from ..ops import math as M
         from ..ops import manipulation as MP
+        import jax
+        import jax.numpy as jnp
+        try:  # concrete labels: out-of-range targets are an error, not
+            # a silently-clamped shortlist gather (reference raises)
+            lv = label._value if hasattr(label, "_value") else label
+            lo_, hi_ = int(jnp.min(lv)), int(jnp.max(lv))
+            if lo_ < 0 or hi_ >= self.n_classes:
+                raise ValueError(
+                    f"AdaptiveLogSoftmaxWithLoss: labels must be in "
+                    f"[0, {self.n_classes - 1}], got [{lo_}, {hi_}]")
+        except (jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError):
+            pass
         head_lp = self._head_logprob(input)          # (N, head_size)
         # shortlist target logprob (clamped gather; masked out later)
         short_idx = M.clip(label, 0, self.shortlist_size - 1)

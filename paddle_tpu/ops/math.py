@@ -1222,7 +1222,7 @@ def ormqr(x, tau, y, left=True, transpose=False, name=None):
     def f(a, t, other):
         q = _householder_q_full(a, t)
         if transpose:
-            q = jnp.swapaxes(q, -1, -2)
+            q = jnp.swapaxes(q.conj(), -1, -2)   # Q^H (LAPACK unmqr)
         return q @ other if left else other @ q
     return apply_op(f, x, tau, y)
 

@@ -425,3 +425,18 @@ class TestRound3LongTail:
                                 paddle.to_tensor(np.ones(2, np.float32)),
                                 paddle.to_tensor(np.ones(2, np.float32)),
                                 reduction="Mean")
+
+    def test_validation_errors(self):
+        from paddle_tpu import nn
+        import paddle_tpu.nn.functional as F
+        with pytest.raises(ValueError):
+            F.gaussian_nll_loss(
+                paddle.to_tensor(np.ones(2, np.float32)),
+                paddle.to_tensor(np.ones(2, np.float32)),
+                paddle.to_tensor(np.array([1.0, -1.0], np.float32)))
+        asm = nn.AdaptiveLogSoftmaxWithLoss(8, 10, cutoffs=[4])
+        xin = paddle.to_tensor(np.zeros((2, 8), np.float32))
+        with pytest.raises(ValueError):
+            asm(xin, paddle.to_tensor(np.array([0, 10])))
+        with pytest.raises(ValueError):
+            asm(xin, paddle.to_tensor(np.array([-1, 0])))

@@ -253,3 +253,80 @@ class TestGraphBreakFallback:
         for b in (2, 5):                    # one program, any batch
             x = paddle.to_tensor(np.ones((b, 4), "float32"))
             assert loaded(x).shape == [b, 2]
+
+
+class TestTrainStepMultiStep:
+    def test_run_steps_parity_with_sequential(self):
+        import numpy as np
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.jit import TrainStep
+
+        def build():
+            paddle.seed(0)
+            m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+            opt = optimizer.AdamW(learning_rate=1e-2,
+                                  parameters=m.parameters())
+            return m, TrainStep(m, lambda mm, b: ((mm(b[0]) - b[1]) ** 2
+                                                  ).mean(), opt)
+
+        x = paddle.to_tensor(np.random.RandomState(0).rand(
+            8, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1).rand(
+            8, 2).astype(np.float32))
+
+        m1, s1 = build()
+        for _ in range(3):
+            l_seq = s1((x, y))
+        m2, s2 = build()
+        l_multi = s2.run_steps((x, y), 3)
+        # same params after 3 steps, same final loss value
+        for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                      m2.named_parameters()):
+            np.testing.assert_allclose(p1.numpy(), p2.numpy(),
+                                       rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(float(l_seq.item()),
+                                   float(l_multi.item()),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_run_steps_one_dispatch_updates_state(self):
+        import numpy as np
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.jit import TrainStep
+        paddle.seed(1)
+        m = nn.Linear(4, 1)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=m.parameters())
+        step = TrainStep(m, lambda mm, b: (mm(b) ** 2).mean(), opt)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        w0 = m.weight.numpy().copy()
+        loss5 = step.run_steps(x, 5)
+        assert not np.allclose(m.weight.numpy(), w0)
+        # loss after 5 steps must beat the first step's loss
+        paddle.seed(1)
+        m2 = nn.Linear(4, 1)
+        opt2 = optimizer.SGD(learning_rate=0.1,
+                             parameters=m2.parameters())
+        s2 = TrainStep(m2, lambda mm, b: (mm(b) ** 2).mean(), opt2)
+        l1 = s2(x)
+        assert float(loss5.item()) < float(l1.item())
+
+    def test_run_steps_aux_consistent(self):
+        import numpy as np
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.jit import TrainStep
+        paddle.seed(2)
+        m = nn.Linear(4, 2)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+
+        def loss_fn(mm, b):
+            out = mm(b)
+            return (out ** 2).mean(), out.sum()
+
+        step = TrainStep(m, loss_fn, opt)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        r1 = step.run_steps(x, 1)
+        r3 = step.run_steps(x, 3)
+        # same tuple shape regardless of n_steps; aux is last inner step
+        assert isinstance(r1, tuple) and isinstance(r3, tuple)
+        assert len(r1) == len(r3) == 2
+        assert np.isfinite(float(r3[1].item()))
