@@ -10,7 +10,6 @@ shape: in-memory sparse embedding tables sharded across server processes
 a ``SparseEmbedding`` layer whose backward pushes gradients via the
 autograd grad-hook. Dense compute stays on the accelerator; only the
 sparse rows live host-side — which is exactly the reference's split.
-SSD/rocksdb spill is out of scope (documented in README).
 
 Training modes (reference: the ``Communicator`` family in
 paddle/fluid/distributed/ps/service/communicator/ — verify):
@@ -27,12 +26,26 @@ paddle/fluid/distributed/ps/service/communicator/ — verify):
   the local cache refreshes from the merged server state (the
   reference's GeoCommunicator).
 
+Table types (reference: paddle/fluid/distributed/ps/table/ — verify):
+
+- **memory** (default): every row lives in the server process's RAM
+  (the reference's MemorySparseTable).
+- **ssd**: hot rows in a bounded LRU cache, cold rows spilled to an
+  embedded on-disk store — the reference's SSDSparseTable keeps its
+  cold tier in rocksdb; here the stdlib's sqlite3 B-tree plays that
+  role (no new dependency). Evictions write back row + optimizer
+  state; reads fault rows back in transparently, so a table can be
+  (much) larger than server RAM.
+
 Roles follow the launch contract: ``TRAINING_ROLE`` = ``PSERVER`` |
 ``TRAINER``, ``PADDLE_PSERVER_NUM``, ``PADDLE_TRAINER_NUM``.
 """
 from __future__ import annotations
 
+import collections
 import os
+import sqlite3
+import tempfile
 import threading
 import time
 from typing import Optional
@@ -43,9 +56,9 @@ from . import rpc
 
 __all__ = ["init_server", "run_server", "init_worker", "stop_worker",
            "create_table", "pull_sparse", "push_sparse", "save_table",
-           "table_size", "SparseEmbedding", "is_server", "is_worker",
-           "server_num", "worker_num", "shutdown", "barrier_worker",
-           "training_mode", "set_training_mode"]
+           "table_size", "table_stats", "SparseEmbedding", "is_server",
+           "is_worker", "server_num", "worker_num", "shutdown",
+           "barrier_worker", "training_mode", "set_training_mode"]
 
 
 # ---------------------------------------------------------------------------
@@ -75,6 +88,13 @@ class _SparseTable:
             self.rows[i] = r
         return r
 
+    def _acc(self, i: int) -> np.ndarray:
+        acc = self.accum.get(i)
+        if acc is None:
+            acc = np.zeros(self.dim, np.float32)
+            self.accum[i] = acc
+        return acc
+
     def pull(self, ids) -> np.ndarray:
         with self._lock:
             return np.stack([self._row(int(i)) for i in ids])
@@ -85,12 +105,20 @@ class _SparseTable:
                 i = int(i)
                 row = self._row(i)
                 if self.optimizer == "adagrad":
-                    acc = self.accum.setdefault(
-                        i, np.zeros(self.dim, np.float32))
+                    acc = self._acc(i)
                     acc += g * g
                     row -= self.lr * g / (np.sqrt(acc) + 1e-8)
                 else:                                   # sgd
                     row -= self.lr * g
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self.rows)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"type": "memory", "hot_rows": len(self.rows),
+                    "disk_rows": 0, "cache_capacity": None, "path": None}
 
     def state(self):
         # deep-copy under the lock: the row arrays are mutated in place by
@@ -99,15 +127,163 @@ class _SparseTable:
             return {k: v.copy() for k, v in self.rows.items()}
 
 
+class _SSDSparseTable(_SparseTable):
+    """Disk-backed shard (reference SSDSparseTable,
+    paddle/fluid/distributed/ps/table/ssd_sparse_table.* — verify: hot
+    rows in a memory cache, cold tier in rocksdb). Here: a bounded LRU of
+    hot entries over an embedded sqlite3 B-tree. Every cache entry is
+    ``[row, adagrad_acc|None]``; eviction writes the pair back, a read
+    miss faults it in, so in-place mutation by :meth:`push` /
+    :func:`_srv_push_delta` is durable regardless of access pattern."""
+
+    def __init__(self, dim, init_range=0.01, optimizer="sgd", lr=0.1,
+                 seed=0, path=None, cache_rows=4096):
+        super().__init__(dim, init_range, optimizer, lr, seed)
+        self.cache_rows = max(1, int(cache_rows))
+        self._owns_path = path is None
+        self.path = path or os.path.join(
+            tempfile.gettempdir(),
+            f"pt_ps_ssd_{os.getpid()}_{id(self):x}.sqlite")
+        # autocommit (isolation_level=None): evictions must not pin an
+        # ever-growing implicit write transaction + rollback journal
+        self._db = sqlite3.connect(self.path, check_same_thread=False,
+                                   isolation_level=None)
+        # the sqlite file is a spill tier, not the system of record for
+        # durability (save_table is) — trade fsync for push throughput
+        self._db.execute("PRAGMA journal_mode=MEMORY")
+        self._db.execute("PRAGMA synchronous=OFF")
+        self._db.execute("CREATE TABLE IF NOT EXISTS rows"
+                         " (id INTEGER PRIMARY KEY, row BLOB, acc BLOB)")
+        self._db.execute("CREATE TABLE IF NOT EXISTS meta"
+                         " (k TEXT PRIMARY KEY, v INTEGER)")
+        prev = self._db.execute(
+            "SELECT v FROM meta WHERE k='dim'").fetchone()
+        if prev is None:
+            self._db.execute("INSERT INTO meta VALUES ('dim', ?)",
+                             (self.dim,))
+        elif int(prev[0]) != self.dim:
+            # an explicit ssd_path warm-starts from the previous run's
+            # rows — but only if the geometry matches
+            raise ValueError(
+                f"ssd table at {self.path} was created with dim "
+                f"{int(prev[0])}, reopened with dim {self.dim}")
+        self._hot: collections.OrderedDict[int, list] = \
+            collections.OrderedDict()
+        # ids initialized fresh and not yet written to disk: lets size()
+        # count without flushing the whole hot cache
+        self._fresh: set[int] = set()
+        # the parent's dict storage is unused; poison it so any code that
+        # still reaches for .rows fails loudly instead of silently
+        # reading an empty table
+        self.rows = None
+        self.accum = None
+
+    # storage --------------------------------------------------------------
+    def _entry(self, i: int) -> list:
+        e = self._hot.get(i)
+        if e is not None:
+            self._hot.move_to_end(i)
+            return e
+        cur = self._db.execute("SELECT row, acc FROM rows WHERE id=?",
+                               (i,)).fetchone()
+        if cur is None:
+            row = self._rng.uniform(-self.init_range, self.init_range,
+                                    self.dim).astype(np.float32)
+            acc = None
+            self._fresh.add(i)
+        else:
+            row = np.frombuffer(cur[0], np.float32).copy()
+            acc = (np.frombuffer(cur[1], np.float32).copy()
+                   if cur[1] is not None else None)
+        e = [row, acc]
+        self._hot[i] = e
+        while len(self._hot) > self.cache_rows:
+            old, (orow, oacc) = self._hot.popitem(last=False)
+            self._write(old, orow, oacc)
+        return e
+
+    def _write(self, i, row, acc):
+        self._db.execute(
+            "INSERT OR REPLACE INTO rows (id, row, acc) VALUES (?,?,?)",
+            (i, row.tobytes(), None if acc is None else acc.tobytes()))
+        self._fresh.discard(i)
+
+    def _row(self, i: int) -> np.ndarray:
+        return self._entry(i)[0]
+
+    def _acc(self, i: int) -> np.ndarray:
+        e = self._entry(i)
+        if e[1] is None:
+            e[1] = np.zeros(self.dim, np.float32)
+        return e[1]
+
+    def _flush_locked(self):
+        for i, (row, acc) in self._hot.items():
+            self._write(i, row, acc)
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked()
+
+    def _total_locked(self) -> int:
+        # disk rows + hot rows that have never been written out; hot
+        # rows faulted in from disk are already counted by the db
+        return (self._db.execute("SELECT COUNT(*) FROM rows").fetchone()[0]
+                + len(self._fresh))
+
+    def size(self) -> int:
+        with self._lock:
+            return self._total_locked()
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self._total_locked()
+            return {"type": "ssd", "hot_rows": len(self._hot),
+                    "disk_rows": total - len(self._hot),   # cold tier
+                    "total_rows": total,
+                    "cache_capacity": self.cache_rows,
+                    "path": self.path}
+
+    def state(self):
+        with self._lock:
+            self._flush_locked()
+            return {int(i): np.frombuffer(b, np.float32).copy()
+                    for i, b in self._db.execute(
+                        "SELECT id, row FROM rows")}
+
+    def close(self):
+        """Close the spill store; default-path (temp) files are deleted —
+        an explicit ``ssd_path`` is kept for warm starts."""
+        with self._lock:
+            if self._db is None:
+                return
+            if not self._owns_path:
+                self._flush_locked()
+            self._db.close()
+            self._db = None
+            if self._owns_path:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+
+
 _TABLES: dict[str, _SparseTable] = {}
 _SERVER_STOP = threading.Event()
 
 
 # module-level so they are picklable rpc targets ----------------------------
 
-def _srv_create_table(name, dim, init_range, optimizer, lr, seed):
+def _srv_create_table(name, dim, init_range, optimizer, lr, seed,
+                      table_type="memory", cache_rows=4096, ssd_path=None):
     if name not in _TABLES:
-        _TABLES[name] = _SparseTable(dim, init_range, optimizer, lr, seed)
+        if table_type == "ssd":
+            _TABLES[name] = _SSDSparseTable(
+                dim, init_range, optimizer, lr, seed,
+                path=ssd_path, cache_rows=cache_rows)
+        else:
+            _TABLES[name] = _SparseTable(dim, init_range, optimizer, lr,
+                                         seed)
     return True
 
 
@@ -136,7 +312,11 @@ def _srv_push_delta(name, ids, deltas):
 
 
 def _srv_size(name):
-    return len(_TABLES[name].rows)
+    return _TABLES[name].size()
+
+
+def _srv_stats(name):
+    return _TABLES[name].stats()
 
 
 def _srv_save(name, path):
@@ -150,6 +330,13 @@ def _srv_save(name, path):
 
 
 def _srv_stop():
+    # shutdown is the last rpc by contract — safe to tear down the
+    # tables' spill stores here (temp-path sqlite files are unlinked)
+    for t in _TABLES.values():
+        close = getattr(t, "close", None)
+        if close is not None:
+            close()
+    _TABLES.clear()
     _SERVER_STOP.set()
     return True
 
@@ -411,13 +598,21 @@ def _shard(ids: np.ndarray):
 
 
 def create_table(name, dim, init_range=0.01, optimizer="sgd", lr=0.1,
-                 seed=0):
-    """Create ``name`` on every server shard (idempotent)."""
+                 seed=0, table_type="memory", cache_rows=4096,
+                 ssd_path=None):
+    """Create ``name`` on every server shard (idempotent).
+
+    ``table_type="ssd"`` selects the disk-spilling table: each shard
+    keeps at most ``cache_rows`` rows hot in RAM and writes the rest to
+    ``ssd_path + ".shard<s>"`` (a server-local temp file when unset) —
+    the reference's SSDSparseTable tiering."""
     _TABLE_META[name] = {"dim": int(dim), "lr": float(lr),
-                         "optimizer": optimizer}
+                         "optimizer": optimizer, "type": table_type}
     futs = [rpc.rpc_async(_server_name(s), _srv_create_table,
                           args=(name, dim, init_range, optimizer, lr,
-                                seed + s), timeout=60)
+                                seed + s, table_type, cache_rows,
+                                f"{ssd_path}.shard{s}" if ssd_path
+                                else None), timeout=60)
             for s in range(server_num())]
     for f in futs:
         f.wait(65)
@@ -499,6 +694,14 @@ def table_size(name) -> int:
                for s in range(server_num()))
 
 
+def table_stats(name) -> list:
+    """Per-shard storage stats: ``[{type, hot_rows, disk_rows,
+    cache_capacity, path}, ...]`` (one dict per server). For ssd tables
+    ``disk_rows`` counts the spilled cold tier."""
+    return [rpc.rpc_sync(_server_name(s), _srv_stats, args=(name,))
+            for s in range(server_num())]
+
+
 def save_table(name, dirname) -> int:
     os.makedirs(dirname, exist_ok=True)
     return sum(rpc.rpc_sync(_server_name(s), _srv_save,
@@ -521,10 +724,12 @@ class SparseEmbedding:
     the optimizer, as in the reference)."""
 
     def __init__(self, name, num_embeddings, embedding_dim, optimizer="sgd",
-                 lr=0.1, init_range=0.01):
+                 lr=0.1, init_range=0.01, table_type="memory",
+                 cache_rows=4096):
         self.table_name = name
         self.dim = int(embedding_dim)
-        create_table(name, embedding_dim, init_range, optimizer, lr)
+        create_table(name, embedding_dim, init_range, optimizer, lr,
+                     table_type=table_type, cache_rows=cache_rows)
 
     def __call__(self, ids):
         from ..tensor import Tensor, to_tensor

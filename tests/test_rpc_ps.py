@@ -163,6 +163,56 @@ MODES_TRAINER_SCRIPT = textwrap.dedent("""
 """)
 
 
+SSD_TRAINER_SCRIPT = textwrap.dedent("""
+    import os, tempfile
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from paddle_tpu.distributed import ps
+
+    ps.init_worker()
+    # 64 rows through an 8-row hot cache forces eviction to the disk tier
+    ps.create_table("s", 4, optimizer="adagrad", lr=0.5,
+                    table_type="ssd", cache_rows=8)
+    # memory table with the same seed: the rows materialize in the same
+    # order, so every pull must match bit-for-bit (tier parity)
+    ps.create_table("m", 4, optimizer="adagrad", lr=0.5)
+
+    ids = np.arange(64)
+    before = ps.pull_sparse("s", ids)
+    assert np.allclose(before, ps.pull_sparse("s", ids)), "spill unstable"
+    assert np.allclose(before, ps.pull_sparse("m", ids)), "tier mismatch"
+
+    st = ps.table_stats("s")[0]
+    assert st["type"] == "ssd" and st["hot_rows"] <= 8, st
+    assert st["disk_rows"] >= 56, st          # eviction actually spilled
+    assert ps.table_stats("m")[0]["disk_rows"] == 0
+
+    # pushes land on rows on BOTH sides of the cache boundary; the
+    # adagrad accumulator must survive the spill round-trip too
+    g = np.ones((64, 4), np.float32)
+    for t in ("s", "m"):
+        ps.push_sparse(t, ids, g)
+        ps.push_sparse(t, ids, g)
+    after = ps.pull_sparse("s", ids)
+    assert np.allclose(after, ps.pull_sparse("m", ids), atol=1e-6)
+    # adagrad: step1 acc=1 -> -0.5; step2 acc=2 -> -0.5/sqrt(2)
+    exp = before - 0.5 - 0.5 / np.sqrt(2.0)
+    assert np.allclose(after, exp, atol=1e-4), (after[0], exp[0])
+
+    assert ps.table_size("s") == 64
+    d = tempfile.mkdtemp()
+    assert ps.save_table("s", d) == 64
+    saved = np.load(os.path.join(d, "s.shard0.npz"))
+    order = np.argsort(saved["ids"])
+    assert np.allclose(saved["rows"][order], after, atol=1e-6)
+
+    ps.shutdown()
+    print("SSD_DONE")
+""")
+
+
 class TestPsCluster:
     def test_one_server_one_trainer(self, tmp_path):
         port = _free_port()
@@ -223,4 +273,34 @@ class TestPsCluster:
                     p.kill()
         assert trn.returncode == 0, t_out
         assert "MODES_DONE" in t_out, t_out
+        assert srv.returncode == 0, s_out
+
+    def test_ssd_table(self):
+        port = _free_port()
+        base_env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "PADDLE_PSERVER_NUM": "1",
+            "PADDLE_TRAINER_NUM": "1",
+            "PADDLE_TRAINER_ID": "0",
+        }
+        srv = subprocess.Popen(
+            [sys.executable, "-c", SERVER_SCRIPT],
+            env={**base_env, "TRAINING_ROLE": "PSERVER"},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        trn = subprocess.Popen(
+            [sys.executable, "-c", SSD_TRAINER_SCRIPT],
+            env={**base_env, "TRAINING_ROLE": "TRAINER"},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            t_out, _ = trn.communicate(timeout=180)
+            s_out, _ = srv.communicate(timeout=60)
+        finally:
+            for p in (srv, trn):
+                if p.poll() is None:
+                    p.kill()
+        assert trn.returncode == 0, t_out
+        assert "SSD_DONE" in t_out, t_out
         assert srv.returncode == 0, s_out
