@@ -348,6 +348,16 @@ WORKLOADS = {"resnet50": resnet50, "bert_base": bert_base,
 if __name__ == "__main__":
     name = sys.argv[1]
     try:
+        import jax
+        # same persistent compile cache as bench.py: repeat sessions
+        # skip the UNet/BERT compiles if the backend supports it
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("PT_JAX_CACHE_DIR",
+                                         "/root/.pt_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
+    try:
         r = WORKLOADS[name]()
         print("WORKLOAD " + json.dumps(r))
     except Exception as e:

@@ -12,7 +12,8 @@ __version__ = "0.1.0"
 
 from . import framework
 from .framework import (set_default_dtype, get_default_dtype, seed,
-                        set_device, get_device, CPUPlace, TPUPlace, Place)
+                        set_device, get_device, CPUPlace, TPUPlace, Place,
+                        set_printoptions)
 from .tensor import Tensor, Parameter, to_tensor
 from .ops import *                      # noqa: F401,F403 — op table
 from . import ops

@@ -126,6 +126,16 @@ class _SparseTable:
         with self._lock:
             return {k: v.copy() for k, v in self.rows.items()}
 
+    def snapshot(self):
+        """(sorted ids, dense rows) for save — one dense array, no
+        intermediate per-row dict/copies."""
+        with self._lock:
+            ids = np.array(sorted(self.rows), np.int64)
+            out = np.empty((len(ids), self.dim), np.float32)
+            for j, i in enumerate(ids):
+                out[j] = self.rows[int(i)]
+            return ids, out
+
 
 class _SSDSparseTable(_SparseTable):
     """Disk-backed shard (reference SSDSparseTable,
@@ -251,6 +261,22 @@ class _SSDSparseTable(_SparseTable):
                     for i, b in self._db.execute(
                         "SELECT id, row FROM rows")}
 
+    def snapshot(self):
+        """Cursor-streamed (ids, rows) for save: one preallocated dense
+        array filled straight from the sqlite cursor — the npz format
+        needs the rows contiguous once, but nothing else is ever
+        materialized (no per-row dict, no stack of copies)."""
+        with self._lock:
+            self._flush_locked()
+            n = self._db.execute("SELECT COUNT(*) FROM rows").fetchone()[0]
+            ids = np.empty(n, np.int64)
+            out = np.empty((n, self.dim), np.float32)
+            for j, (i, b) in enumerate(self._db.execute(
+                    "SELECT id, row FROM rows ORDER BY id")):
+                ids[j] = i
+                out[j] = np.frombuffer(b, np.float32)
+            return ids, out
+
     def close(self):
         """Close the spill store; default-path (temp) files are deleted —
         an explicit ``ssd_path`` is kept for warm starts."""
@@ -321,11 +347,8 @@ def _srv_stats(name):
 
 def _srv_save(name, path):
     t = _TABLES[name]
-    rows = t.state()
-    ids = np.array(sorted(rows), np.int64)
-    np.savez(path, ids=ids,
-             rows=np.stack([rows[int(i)] for i in ids]) if len(ids)
-             else np.zeros((0, t.dim), np.float32))
+    ids, rows = t.snapshot()
+    np.savez(path, ids=ids, rows=rows)
     return len(ids)
 
 

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
-    "set_default_dtype", "get_default_dtype", "seed", "get_rng_key",
+    "set_default_dtype", "get_default_dtype", "set_printoptions",
+    "seed", "get_rng_key",
     "split_key", "rng_context", "no_grad_guard", "is_grad_enabled",
     "set_grad_enabled", "in_functional_mode", "functional_mode",
     "Place", "CPUPlace", "TPUPlace", "set_device", "get_device",
@@ -114,6 +115,26 @@ def set_default_dtype(d) -> None:
 
 def get_default_dtype() -> str:
     return jnp.dtype(_state.default_dtype).name
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr formatting (reference: paddle.set_printoptions,
+    python/paddle/tensor/to_string.py — verify). Tensor.__repr__ renders
+    through numpy, so this maps onto numpy's printoptions; ``sci_mode``
+    toggles scientific notation (numpy's ``suppress`` inverted)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
 
 
 # ---------------------------------------------------------------------------

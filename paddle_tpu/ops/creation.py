@@ -19,6 +19,8 @@ __all__ = [
     "rand", "randn", "randint", "randint_like", "randperm", "uniform",
     "normal", "standard_normal", "bernoulli", "multinomial", "poisson",
     "one_hot", "tril_indices", "triu_indices",
+
+    "log_normal",
 ]
 
 
@@ -253,3 +255,12 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
 def poisson(x, name=None):
     k = framework.split_key()
     return Tensor(jax.random.poisson(k, x._value).astype(x.dtype))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """Samples from LogNormal: exp(Normal(mean, std)) (reference:
+    paddle.log_normal, python/paddle/tensor/random.py — verify)."""
+    k = framework.split_key()
+    shp = _shape(shape) if shape is not None else ()
+    dt = framework.state().default_dtype
+    return Tensor(jnp.exp(jax.random.normal(k, shp, dt) * std + mean))

@@ -822,3 +822,24 @@ def slice_scatter(x, value, axes=None, starts=None, ends=None,
 
 __all__ += ["block_diag", "cartesian_prod", "diagonal_scatter",
             "select_scatter", "slice_scatter"]
+
+def matrix_transpose(x, name=None):
+    """Swap the last two dims (reference: paddle.matrix_transpose,
+    python/paddle/tensor/linalg.py — verify)."""
+    def f(v):
+        if v.ndim < 2:
+            raise ValueError(
+                f"matrix_transpose needs ndim >= 2, got {v.ndim}")
+        return jnp.swapaxes(v, -2, -1)
+    return apply_op(f, x)
+
+
+def shape(input, name=None):
+    """The shape as a 1-D int32 tensor (reference: paddle.shape — the
+    static-graph-friendly variant of ``Tensor.shape``)."""
+    from ..tensor import Tensor
+    v = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(jnp.asarray(v.shape, jnp.int32))
+
+
+__all__ += ["matrix_transpose", "shape"]

@@ -1233,3 +1233,22 @@ def svdvals(x, name=None):
 
 
 __all__ += ["gammaln", "gammainc", "gammaincc", "ormqr", "svdvals"]
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """Elementwise membership of ``x`` in ``test_x`` (reference:
+    paddle.isin, python/paddle/tensor/math.py — verify)."""
+    return apply_op(
+        lambda a, b: jnp.isin(a, b, assume_unique=assume_unique,
+                              invert=invert), x, test_x)
+
+
+def positive(x, name=None):
+    """+x (identity, errors on bool — reference: paddle.positive)."""
+    def f(v):
+        if v.dtype == jnp.bool_:
+            raise TypeError("positive is not supported for bool tensors")
+        return +v
+    return apply_op(f, x)
+
+
+__all__ += ["isin", "positive"]

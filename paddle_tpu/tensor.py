@@ -372,6 +372,11 @@ class Tensor:
         return self._random_overwrite_(
             lambda k: jax.random.exponential(k, shape, jnp.float32) / lam)
 
+    def log_normal_(self, mean=1.0, std=2.0, name=None):
+        shape = self._value.shape
+        return self._random_overwrite_(lambda k: jnp.exp(
+            jax.random.normal(k, shape, jnp.float32) * std + mean))
+
     def geometric_(self, probs, name=None):
         """Geometric(probs) fill: number of Bernoulli(p) trials to first
         success, support {1, 2, ...} (the reference's convention)."""

@@ -210,3 +210,56 @@ class TestLongTailReviewFixes:
         back = F.fold(cols, output_sizes=(4, 4), kernel_sizes=2,
                       strides=2, paddings=[1, 0, 1, 0])
         np.testing.assert_allclose(back.numpy(), img, rtol=1e-6)
+
+
+class TestR3FinalApiAdditions:
+    """isin/shape/log_normal/matrix_transpose/positive/set_printoptions —
+    the last missing names from the top-level API probe (reference:
+    python/paddle/tensor/{math,random,linalg}.py — verify)."""
+
+    def test_isin(self):
+        x = t(np.array([[1, 2], [3, 4]], "int32"))
+        test = t(np.array([2, 4], "int32"))
+        np.testing.assert_array_equal(
+            paddle.isin(x, test).numpy(), [[False, True], [False, True]])
+        np.testing.assert_array_equal(
+            paddle.isin(x, test, invert=True).numpy(),
+            [[True, False], [True, False]])
+
+    def test_shape_op(self):
+        s = paddle.shape(t(np.ones((2, 5, 3), "float32")))
+        assert s.numpy().tolist() == [2, 5, 3]
+        assert s.numpy().dtype == np.int32
+
+    def test_matrix_transpose(self):
+        x = np.random.RandomState(0).rand(2, 3, 4).astype("float32")
+        np.testing.assert_array_equal(
+            paddle.matrix_transpose(t(x)).numpy(), x.swapaxes(-2, -1))
+        with pytest.raises(ValueError):
+            paddle.matrix_transpose(t(np.ones((3,), "float32")))
+
+    def test_positive(self):
+        x = t(np.array([-1.0, 2.0], "float32"))
+        np.testing.assert_array_equal(paddle.positive(x).numpy(), [-1., 2.])
+        with pytest.raises(TypeError):
+            paddle.positive(t(np.array([True])))
+
+    def test_log_normal_moments(self):
+        paddle.seed(7)
+        s = paddle.log_normal(0.0, 0.5, shape=[4000])
+        lm = np.log(s.numpy())
+        assert (s.numpy() > 0).all()
+        assert abs(lm.mean()) < 0.1 and abs(lm.std() - 0.5) < 0.1
+
+    def test_log_normal_inplace(self):
+        paddle.seed(7)
+        x = t(np.zeros((200,), "float32"))
+        out = x.log_normal_(0.0, 1.0)
+        assert out is x and (x.numpy() > 0).all()
+
+    def test_set_printoptions(self):
+        paddle.set_printoptions(precision=2, sci_mode=False)
+        try:
+            assert "1.23" in repr(t(np.array([1.23456], "float32")))
+        finally:
+            np.set_printoptions(precision=8, suppress=False)

@@ -3,19 +3,19 @@
 # is healthy (probe first; a wedged tunnel hangs jax.devices()):
 #   timeout 90 python -c "import jax; print(jax.devices())" || exit 1
 #   bash tpu_session.sh
-# Priority order (each stage survives a later wedge; sweep and bench
-# write partial artifacts after every completed stage):
-#   1. flash block-size sweep            -> FLASH_BLOCKS_r03.json
-#   2. headline bench w/ tuned kernels   -> BENCH_TPU_MEASURED_r03.json
-#      (long deadline so the ~1B big-config compile isn't deadline-killed
-#       mid-flight — r3's 480s default lost the big stage AND wedged the
-#       remote compile helper)
-#   3. profile re-capture (new attribution after the kernel tuning)
+# Priority order (each stage survives a later wedge; bench and the
+# workloads runner write partial artifacts after every completed stage):
+#   1. headline bench                  -> BENCH_TPU_MEASURED_r03.json
+#      (stage order inside: small -> ~1B big -> decode; long deadline so
+#       the big-config compile isn't deadline-killed mid-flight, and a
+#       persistent compile cache so a repeat run skips the compiles)
+#   2. non-Llama BASELINE workloads    -> WORKLOADS_r03.json
+#   3. profile re-capture (attribution after kernel tuning)
 #   4. on-chip kernel validation tests
+# (the flash block sweep already produced FLASH_BLOCKS_r03.json; rerun
+#  sweep_flash_blocks.py manually if the kernel set changes)
 set -x
 cd "$(dirname "$0")"
-
-timeout -s INT -k 30 580 python sweep_flash_blocks.py 2>&1 | grep -v WARNING | tail -12
 
 BENCH_TPU_DEADLINE_S=1500 BENCH_TOTAL_BUDGET_S=2100 \
     timeout -s INT -k 30 2160 python bench.py \
@@ -30,6 +30,8 @@ d = json.load(open("/tmp/bench_last.json"))
 sys.exit(0 if d.get("chip") == "v5e" else 1)' 2>/dev/null; then
     cp /tmp/bench_last.json BENCH_TPU_MEASURED_r03.json
 fi
+
+bash workloads_session.sh
 
 timeout -s INT -k 30 580 python profile_tpu.py 2>&1 | tail -3
 
