@@ -6,7 +6,7 @@ with abstract spec-only weights — no host memory for 13B params — and
 records XLA's own per-device memory/cost estimates. Asserts the config
 fits v5p HBM with the chosen remat/donation policy.
 
-Writes SCALE_r02.json and prints it.
+Writes SCALE_r03.json (override: SCALE_OUT) and prints it.
 
 Usage:  python scale_check.py   (forces JAX_PLATFORMS=cpu, 32 devices)
 """
@@ -19,7 +19,7 @@ import time
 
 N_DEV = int(os.environ.get("SCALE_DEVICES", "32"))
 V5P_HBM_BYTES = 95 * 1024**3       # v5p: 95 GiB HBM per chip
-OUT = os.environ.get("SCALE_OUT", "SCALE_r02.json")
+OUT = os.environ.get("SCALE_OUT", "SCALE_r03.json")
 
 
 def main():
@@ -131,7 +131,7 @@ def main():
     step_time_lower_bound_s = flops / v5p_peak_flops if flops else None
 
     result = {
-        "artifact": "SCALE_r02",
+        "artifact": os.path.splitext(os.path.basename(OUT))[0],
         "model": "llama-13b",
         "n_params": int(n_params),
         "mesh": {"pp": pp, "mp": mp, "devices": N_DEV,

@@ -54,6 +54,12 @@ CONFIGS["big16_b4_s2048"] = (BIG16, 4, 2048, False)
 CONFIGS["big16_b16_s1024"] = (BIG16, 16, 1024, False)
 CONFIGS["big16_b16_s2048"] = (BIG16, 16, 2048, False)
 
+# selective remat at ~1B: fewer recomputed FLOPs per step = higher MFU
+# if the larger live-activation set clears the 15.2 GB precheck
+BIG16SEL = dict(BIG16, recompute_granularity="selective")
+CONFIGS["big16sel_b8_s2048"] = (BIG16SEL, 8, 2048, False)
+CONFIGS["big16sel_b4_s2048"] = (BIG16SEL, 4, 2048, False)
+
 # fused-CE A/B at the headline config (run both on a healthy tunnel to
 # measure the chunked lm-head CE win on hardware)
 CONFIGS["small_b32_fusedce"] = (dict(SMALL, fused_head_ce=True), 32, 1024,
