@@ -85,6 +85,17 @@ _METHODS = [
     "nanmedian", "mode", "kthvalue", "quantile", "view", "view_as",
     "unfold", "as_strided", "swapaxes", "amin", "amax", "nansum",
     "nanmean", "logcumsumexp", "renorm", "multiplex", "stanh", "softsign",
+    # r3 continuation: remaining method-parity bindings (each a
+    # module-level op in math/manipulation/creation; probe of 184
+    # well-known Tensor methods; log_normal_/geometric_ are plain
+    # Tensor methods in tensor.py, not listed here)
+    "acos", "addmm", "angle", "asin", "atan", "cholesky", "conj", "cosh",
+    "diff", "digamma", "erfinv", "frac", "imag", "index_sample", "lcm",
+    "gcd", "lgamma", "logit", "mv", "rad2deg", "deg2rad", "rank", "real",
+    "searchsorted", "sgn", "sinh", "slice", "unflatten", "exp_", "sqrt_",
+    "rsqrt_", "reciprocal_", "floor_", "ceil_", "round_", "tanh_",
+    "heaviside", "hypot", "nanquantile", "trapezoid", "vander", "cdist",
+    "isin", "positive", "matrix_transpose",
 ]
 
 for m in _METHODS:

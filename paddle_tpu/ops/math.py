@@ -60,6 +60,8 @@ __all__ = [
     # misc
     "cast", "isreal", "rsub", "stanh", "softplus_op", "floor_mod",
     "multiply_", "add_", "subtract_", "scale_", "clip_", "remainder_",
+    "exp_", "sqrt_", "rsqrt_", "reciprocal_", "floor_", "ceil_", "round_",
+    "tanh_",
     "increment", "any_op",
 ]
 
@@ -126,6 +128,7 @@ def rsub(x, y):
 def _un(fn):
     def op(x, name=None):
         return apply_op(fn, x)
+    op.__name__ = op.__qualname__ = getattr(fn, "__name__", "op")
     return op
 
 
@@ -948,12 +951,41 @@ def cast(x, dtype):
 
 
 def _inplace(op):
+    """In-place variant of a single-output op.
+
+    With grad wanted this MUST go through ``_record_inplace`` — simply
+    re-pointing ``x`` at the out-of-place result's tape node registers
+    the node's output under the temp tensor's id, so the id-keyed
+    cotangent walk skips the op and hands downstream cotangents to x's
+    OLD producer (observed: ``z.multiply_(c); z.sum().backward()``
+    ignored the multiply entirely)."""
     def f(x, *a, **k):
+        if (framework.in_static_mode()
+                and not framework.in_functional_mode()):
+            # the static graph replays by tensor identity with no SSA
+            # versioning — a silent value-copy would drop the op from
+            # the compiled program (the reference's ProgramDesc renames
+            # vars per write; our thin static layer does not)
+            raise RuntimeError(
+                f"{getattr(op, '__name__', 'op')}_ : in-place ops are "
+                "not recordable in static-graph mode; use the "
+                "out-of-place op instead")
+        extras = tuple(t for t in list(a) + list(k.values())
+                       if isinstance(t, Tensor))
+        if x._inplace_wants_grad(*extras):
+            def pure(xv, *ev):
+                it = iter(ev)
+                with framework.no_grad_guard():
+                    aa = [Tensor(next(it)) if isinstance(arg, Tensor)
+                          else arg for arg in a]
+                    kk = {kn: (Tensor(next(it)) if isinstance(kv, Tensor)
+                               else kv) for kn, kv in k.items()}
+                    return op(Tensor(xv), *aa, **kk)._value
+            pure.__qualname__ = getattr(op, "__name__", "op") + "_"
+            return x._record_inplace(pure, extras)
         out = op(x, *a, **k)
         x._value = out._value
-        x._node = out._node
-        x._out_index = out._out_index
-        x.stop_gradient = out.stop_gradient
+        x._notify_inplace_hook(getattr(op, "__name__", "op") + "_")
         return x
     return f
 
@@ -964,6 +996,14 @@ multiply_ = _inplace(multiply)
 scale_ = _inplace(scale)
 clip_ = _inplace(clip)
 remainder_ = _inplace(remainder)
+exp_ = _inplace(exp)
+sqrt_ = _inplace(sqrt)
+rsqrt_ = _inplace(rsqrt)
+reciprocal_ = _inplace(reciprocal)
+floor_ = _inplace(floor)
+ceil_ = _inplace(ceil)
+round_ = _inplace(round)
+tanh_ = _inplace(tanh)
 softplus_op = _un(jax.nn.softplus)
 
 

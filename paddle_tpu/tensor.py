@@ -323,11 +323,12 @@ class Tensor:
                                   else "inplace")
         return self
 
-    def _inplace_wants_grad(self, val=None) -> bool:
+    def _inplace_wants_grad(self, *vals) -> bool:
         return (framework.is_grad_enabled()
                 and not framework.in_static_mode()
                 and (not self.stop_gradient
-                     or (isinstance(val, Tensor) and not val.stop_gradient)))
+                     or any(isinstance(v, Tensor) and not v.stop_gradient
+                            for v in vals)))
 
     def fill_(self, v):
         if self._inplace_wants_grad():

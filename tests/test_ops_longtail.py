@@ -263,3 +263,62 @@ class TestR3FinalApiAdditions:
             assert "1.23" in repr(t(np.array([1.23456], "float32")))
         finally:
             np.set_printoptions(precision=8, suppress=False)
+
+
+class TestTensorMethodParity:
+    """Method-parity probe: the r3-continuation bindings (reference:
+    python/paddle/tensor/tensor.prototype.pyi method surface — verify)."""
+
+    def test_bound_methods_exist_and_work(self):
+        x = t(np.ones((2, 2), "float32") * 0.5)
+        for m in ("acos asin atan cosh sinh digamma lgamma erfinv frac "
+                  "logit sgn conj angle real imag rad2deg deg2rad rank "
+                  "diff").split():
+            assert hasattr(x, m), m
+        np.testing.assert_allclose(x.acos().numpy(), np.arccos(0.5),
+                                   rtol=1e-6)
+        m = t(np.eye(2, dtype="float32") * 4)
+        np.testing.assert_allclose(m.cholesky().numpy(),
+                                   np.eye(2) * 2, atol=1e-6)
+        v = t(np.array([1.0, 2.0], "float32"))
+        np.testing.assert_allclose(m.mv(v).numpy(), [4.0, 8.0])
+        s = t(np.array([1.0, 3.0, 5.0], "float32"))
+        assert s.searchsorted(
+            t(np.array([4.0], "float32"))).numpy().tolist() == [2]
+        w = t(np.arange(6, dtype="float32").reshape(2, 3))
+        assert w.unflatten(1, [3, 1]).shape == [2, 3, 1]
+        assert w.slice([1], [0], [2]).shape == [2, 2]
+        assert w.index_sample(
+            t(np.array([[0], [1]], "int32"))).shape == [2, 1]
+
+    def test_inplace_method_family(self):
+        y = t(np.full((2, 2), 4.0, "float32"))
+        out = y.sqrt_()
+        assert out is y
+        np.testing.assert_allclose(y.numpy(), 2.0)
+        y.exp_()
+        np.testing.assert_allclose(y.numpy(), np.exp(2.0), rtol=1e-6)
+        y.reciprocal_()
+        np.testing.assert_allclose(y.numpy(), np.exp(-2.0), rtol=1e-6)
+        z = t(np.array([1.7, -1.7], "float32"))
+        np.testing.assert_allclose(z.floor_().numpy(), [1.0, -2.0])
+
+    def test_inplace_exp_grad_records_on_tape(self):
+        # _inplace reuses the out-of-place op's tape node: grads flow
+        y = t(np.ones((3,), "float32"))
+        y.stop_gradient = False
+        z = y * 2.0
+        z.exp_()
+        z.sum().backward()
+        np.testing.assert_allclose(y.grad.numpy(), 2 * np.exp(2.0),
+                                   rtol=1e-5)
+
+    def test_inplace_rejected_in_static_mode(self):
+        paddle.enable_static()
+        try:
+            x = paddle.static.data("x_ip", [2], "float32")
+            y = x * 2.0
+            with pytest.raises(RuntimeError, match="static-graph mode"):
+                y.exp_()
+        finally:
+            paddle.disable_static()
