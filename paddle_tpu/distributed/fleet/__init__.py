@@ -162,11 +162,8 @@ def barrier_worker():
     barrier()
 
 
-class _UtilsNS:
-    @staticmethod
-    def recompute(fn, *args, **kwargs):
-        from .utils_recompute import recompute as rc
-        return rc(fn, *args, **kwargs)
-
-
-utils = _UtilsNS()
+# NOTE: `utils` is the real module imported at the top (fleet/utils.py:
+# fused_allreduce_gradients, recompute, recompute_sequential) — it must
+# NOT be shadowed here; an earlier namespace object hid everything but
+# recompute from attribute access (import statements still found the
+# module via sys.modules, so the break was path-dependent).

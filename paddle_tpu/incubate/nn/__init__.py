@@ -2,5 +2,6 @@
 "fused" means one jit region + Pallas attention; the layer API is kept."""
 from .functional import fused_multi_head_attention, fused_feedforward  # noqa
 from .functional import fused_linear_cross_entropy                     # noqa
-from .layers import FusedMultiHeadAttention, FusedFeedForward          # noqa
+from .layers import (FusedMultiHeadAttention, FusedFeedForward,         # noqa
+                     FusedLinear, FusedTransformerEncoderLayer)
 from . import functional                                               # noqa

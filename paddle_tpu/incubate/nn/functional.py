@@ -87,6 +87,18 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     q = qkv[:, :, 0]
     k = qkv[:, :, 1]
     v = qkv[:, :, 2]
+    new_cache = None
+    if cache_kv is not None:
+        # cache_kv: (2, b, nh, t_cache, hd) — the reference's fused
+        # incremental-decode layout; current step's k/v append to it
+        from ...ops.manipulation import concat, stack
+        k_t = transpose(k, (0, 2, 1, 3))          # (b, nh, s, hd)
+        v_t = transpose(v, (0, 2, 1, 3))
+        k_full_t = concat([cache_kv[0], k_t], axis=2)
+        v_full_t = concat([cache_kv[1], v_t], axis=2)
+        new_cache = stack([k_full_t, v_full_t], axis=0)
+        k = transpose(k_full_t, (0, 2, 1, 3))     # (b, t+s, nh, hd)
+        v = transpose(v_full_t, (0, 2, 1, 3))
     out = F.scaled_dot_product_attention(q, k, v, attn_mask,
                                          attn_dropout_rate, False, training)
     out = reshape(out, (b, s, nh * hd))
@@ -99,6 +111,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     if not pre_layer_norm:
         out = F.layer_norm(out, out.shape[-1:], ln_scale, ln_bias,
                            ln_epsilon)
+    if new_cache is not None:
+        return out, new_cache
     return out
 
 

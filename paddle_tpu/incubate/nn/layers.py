@@ -83,3 +83,73 @@ class FusedFeedForward(Layer):
             self.ln2_bias, self.act_dropout_rate, self.dropout_rate,
             self.activation, self.epsilon, self.epsilon,
             self.normalize_before, self.training)
+
+
+class FusedLinear(Layer):
+    """Linear whose bias-add rides the matmul epilogue (reference:
+    paddle.incubate.nn.FusedLinear over the fused_gemm_epilogue op —
+    verify). On TPU, XLA fuses the bias add into the dot's epilogue
+    natively, so this is the standard y = x @ W + b formulation with the
+    reference's constructor surface; ``transpose_weight`` stores W
+    as (out, in)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = bool(transpose_weight)
+        shape = ((out_features, in_features) if self.transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = None if bias_attr is False else \
+            self.create_parameter((out_features,), attr=bias_attr,
+                                  is_bias=True)
+
+    def forward(self, x):
+        from ... import ops
+        w = ops.t(self.weight) if self.transpose_weight else self.weight
+        out = ops.matmul(x, w)
+        if self.bias is not None:
+            out = ops.add(out, self.bias)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """FusedMultiHeadAttention + FusedFeedForward composed exactly like
+    the reference's FusedTransformerEncoderLayer (reference:
+    python/paddle/incubate/nn/layer/fused_transformer.py — verify)."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if weight_attr is not None or bias_attr is not None:
+            # the fused sublayers create their parameters internally;
+            # silently accepting an attr that has no effect would be a
+            # trap (reference threads these into each fused op)
+            raise NotImplementedError(
+                "FusedTransformerEncoderLayer does not support "
+                "weight_attr/bias_attr; initialize the sublayer "
+                "parameters directly")
+        attn_drop = attn_dropout_rate if attn_dropout_rate is not None \
+            else dropout_rate
+        act_drop = act_dropout_rate if act_dropout_rate is not None \
+            else dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_drop,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_drop,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        """With ``cache`` the attention runs incrementally and the
+        updated cache is returned alongside the output (reference
+        returns (output, incremental_cache))."""
+        attn_out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        if cache is not None:
+            out, new_cache = attn_out
+            return self.ffn(out), new_cache
+        return self.ffn(attn_out)

@@ -16,7 +16,7 @@ import numpy as np
 
 from .. import framework
 from ..framework import convert_dtype
-from ..tensor import Tensor, apply_op, to_tensor
+from ..tensor import Tensor, apply_op, to_tensor, make_inplace
 
 __all__ = [
     "linear", "embedding", "one_hot",
@@ -104,11 +104,7 @@ mish = _act(lambda v: v * jnp.tanh(jax.nn.softplus(v)))
 tanhshrink = _act(lambda v: v - jnp.tanh(v))
 
 
-def relu_(x, name=None):
-    out = relu(x)
-    x._value, x._node, x._out_index = out._value, out._node, out._out_index
-    x.stop_gradient = out.stop_gradient
-    return x
+relu_ = make_inplace(relu, "relu")
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):

@@ -10,7 +10,7 @@ import numpy as np
 
 from .. import framework
 from ..framework import convert_dtype
-from ..tensor import Tensor, apply_op, to_tensor
+from ..tensor import Tensor, apply_op, make_inplace, to_tensor
 
 __all__ = [
     "reshape", "reshape_", "transpose", "moveaxis", "swapaxes", "concat",
@@ -50,11 +50,7 @@ def reshape(x, shape, name=None):
     return apply_op(lambda v: jnp.reshape(v, shp), x)
 
 
-def reshape_(x, shape, name=None):
-    out = reshape(x, shape)
-    x._value, x._node, x._out_index = out._value, out._node, out._out_index
-    x.stop_gradient = out.stop_gradient
-    return x
+reshape_ = make_inplace(reshape, "reshape")
 
 
 view = reshape
@@ -170,10 +166,7 @@ def squeeze(x, axis=None, name=None):
     return apply_op(f, x)
 
 
-def squeeze_(x, axis=None, name=None):
-    out = squeeze(x, axis)
-    x._value, x._node, x._out_index = out._value, out._node, out._out_index
-    return x
+squeeze_ = make_inplace(squeeze, "squeeze")
 
 
 def unsqueeze(x, axis, name=None):
@@ -193,10 +186,7 @@ def builtins_sorted(it):
     return sorted(it)
 
 
-def unsqueeze_(x, axis, name=None):
-    out = unsqueeze(x, axis)
-    x._value, x._node, x._out_index = out._value, out._node, out._out_index
-    return x
+unsqueeze_ = make_inplace(unsqueeze, "unsqueeze")
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -209,10 +199,7 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
     return apply_op(f, x)
 
 
-def flatten_(x, start_axis=0, stop_axis=-1, name=None):
-    out = flatten(x, start_axis, stop_axis)
-    x._value, x._node, x._out_index = out._value, out._node, out._out_index
-    return x
+flatten_ = make_inplace(flatten, "flatten")
 
 
 def gather(x, index, axis=0, name=None):
@@ -243,10 +230,7 @@ def scatter(x, index, updates, overwrite=True, name=None):
     return apply_op(f, x, index, updates)
 
 
-def scatter_(x, index, updates, overwrite=True, name=None):
-    out = scatter(x, index, updates, overwrite)
-    x._value, x._node, x._out_index = out._value, out._node, out._out_index
-    return x
+scatter_ = make_inplace(scatter, "scatter")
 
 
 def scatter_nd(index, updates, shape, name=None):
@@ -398,6 +382,7 @@ def masked_fill(x, mask, value, name=None):
 
 def masked_fill_(x, mask, value, name=None):
     """In-place masked_fill (tape-aware like index_fill_)."""
+    x._reject_static_inplace("masked_fill_")
     val = _v(value)
     m_v = mask._value if isinstance(mask, Tensor) else jnp.asarray(mask)
     if x._inplace_wants_grad():
@@ -411,6 +396,7 @@ def masked_fill_(x, mask, value, name=None):
 
 def index_put_(x, indices, value, accumulate=False, name=None):
     """In-place index_put (tape-aware)."""
+    x._reject_static_inplace("index_put_")
     idx = tuple(i._value if isinstance(i, Tensor) else jnp.asarray(i)
                 for i in indices)
     idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(
@@ -610,6 +596,7 @@ def builtins_abs(v):
 
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    x._reject_static_inplace("fill_diagonal_")
     v = x._value
     n = min(v.shape[-2], v.shape[-1])
     idx = jnp.arange(n - builtins_abs(offset))
@@ -680,6 +667,7 @@ def index_fill(x, index, axis, value, name=None):
 
 
 def index_fill_(x, index, axis, value, name=None):
+    x._reject_static_inplace("index_fill_")
     idx_v = index._value if isinstance(index, Tensor) else jnp.asarray(index)
     if x._inplace_wants_grad():
         def pure(v):

@@ -14,7 +14,7 @@ import numpy as np
 
 from .. import framework
 from ..framework import convert_dtype
-from ..tensor import Tensor, apply_op, to_tensor
+from ..tensor import Tensor, apply_op, make_inplace, to_tensor
 
 __all__ = [
     # elementwise binary
@@ -216,6 +216,7 @@ def lerp(x, y, weight, name=None):
 
 def lerp_(x, y, weight, name=None):
     """In-place lerp (tape-aware)."""
+    x._reject_static_inplace("lerp_")
     yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
     wv = weight._value if isinstance(weight, Tensor) else weight
     if x._inplace_wants_grad():
@@ -950,44 +951,9 @@ def cast(x, dtype):
     return apply_op(lambda v: v.astype(d), x)
 
 
-def _inplace(op):
-    """In-place variant of a single-output op.
-
-    With grad wanted this MUST go through ``_record_inplace`` — simply
-    re-pointing ``x`` at the out-of-place result's tape node registers
-    the node's output under the temp tensor's id, so the id-keyed
-    cotangent walk skips the op and hands downstream cotangents to x's
-    OLD producer (observed: ``z.multiply_(c); z.sum().backward()``
-    ignored the multiply entirely)."""
-    def f(x, *a, **k):
-        if (framework.in_static_mode()
-                and not framework.in_functional_mode()):
-            # the static graph replays by tensor identity with no SSA
-            # versioning — a silent value-copy would drop the op from
-            # the compiled program (the reference's ProgramDesc renames
-            # vars per write; our thin static layer does not)
-            raise RuntimeError(
-                f"{getattr(op, '__name__', 'op')}_ : in-place ops are "
-                "not recordable in static-graph mode; use the "
-                "out-of-place op instead")
-        extras = tuple(t for t in list(a) + list(k.values())
-                       if isinstance(t, Tensor))
-        if x._inplace_wants_grad(*extras):
-            def pure(xv, *ev):
-                it = iter(ev)
-                with framework.no_grad_guard():
-                    aa = [Tensor(next(it)) if isinstance(arg, Tensor)
-                          else arg for arg in a]
-                    kk = {kn: (Tensor(next(it)) if isinstance(kv, Tensor)
-                               else kv) for kn, kv in k.items()}
-                    return op(Tensor(xv), *aa, **kk)._value
-            pure.__qualname__ = getattr(op, "__name__", "op") + "_"
-            return x._record_inplace(pure, extras)
-        out = op(x, *a, **k)
-        x._value = out._value
-        x._notify_inplace_hook(getattr(op, "__name__", "op") + "_")
-        return x
-    return f
+# shared in-place wrapper: keeps the op on the tape via
+# _record_inplace (see tensor.py make_inplace)
+_inplace = make_inplace
 
 
 add_ = _inplace(add)

@@ -236,3 +236,80 @@ def sequence_expand(x, y, ref_level=-1, name=None):
         "format this TPU framework does not implement (documented scope "
         "decision: ragged sequences are expressed with padding + "
         "sequence_mask)")
+
+
+class _DataNorm(_nn.Layer):
+    """Global-statistics normalization (reference: paddle.static.nn.
+    data_norm — verify): y = (x - mean) / stddev with mean/std derived
+    from accumulated batch_size / batch_sum / batch_square_sum buffers.
+    In train mode each forward folds the batch into the buffers with
+    ``summary_decay_rate`` (the reference's summary update); eval mode
+    normalizes with frozen stats."""
+
+    def __init__(self, dim, epsilon=1e-4, slot_dim=-1,
+                 summary_decay_rate=0.9999999,
+                 enable_scale_and_shift=False):
+        super().__init__()
+        from ..tensor import to_tensor
+        from ..nn import initializer as I
+        self.epsilon = float(epsilon)
+        self.decay = float(summary_decay_rate)
+        self.register_buffer(
+            "batch_size", to_tensor(np.full((dim,), 1e4, np.float32)))
+        self.register_buffer(
+            "batch_sum", to_tensor(np.zeros((dim,), np.float32)))
+        self.register_buffer(
+            "batch_square_sum",
+            to_tensor(np.full((dim,), 1e4, np.float32)))
+        self.scale_w = self.create_parameter(
+            (dim,), default_initializer=I.Constant(1.0)) \
+            if enable_scale_and_shift else None
+        self.bias = self.create_parameter((dim,), is_bias=True) \
+            if enable_scale_and_shift else None
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from .. import ops
+        if self.training and not framework.in_static_mode():
+            # summary update (no tape): buffers decay, batch folds in
+            xv = x._value
+            n = float(xv.shape[0])
+            self.batch_size._update_value(
+                self.batch_size._value * self.decay + n)
+            self.batch_sum._update_value(
+                self.batch_sum._value * self.decay + jnp.sum(xv, 0))
+            self.batch_square_sum._update_value(
+                self.batch_square_sum._value * self.decay
+                + jnp.sum(xv * xv, 0))
+        mean = ops.divide(self.batch_sum, self.batch_size)
+        var = ops.subtract(ops.divide(self.batch_square_sum,
+                                      self.batch_size),
+                           ops.multiply(mean, mean))
+        scale = ops.rsqrt(ops.add(var, ops.scale(
+            ops.ones_like(var), self.epsilon)))
+        out = ops.multiply(ops.subtract(x, mean), scale)
+        if self.scale_w is not None:
+            out = ops.add(ops.multiply(out, self.scale_w), self.bias)
+        return out
+
+
+def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    dim = int(input.shape[-1])
+    layer = _get_layer(name, "data_norm",
+                       (dim, epsilon, bool(enable_scale_and_shift)),
+                       lambda: _DataNorm(
+                           dim, epsilon=epsilon, slot_dim=slot_dim,
+                           summary_decay_rate=summary_decay_rate,
+                           enable_scale_and_shift=enable_scale_and_shift))
+    out = layer(input)
+    if act:
+        out = getattr(_nn.functional, act)(out)
+    return out
+
+
+__all__ += ["data_norm"]

@@ -323,6 +323,16 @@ class Tensor:
                                   else "inplace")
         return self
 
+    def _reject_static_inplace(self, name):
+        """Static graphs replay by tensor identity with no SSA
+        versioning — a silent value overwrite would drop the op from
+        the compiled program (see make_inplace)."""
+        if (framework.in_static_mode()
+                and not framework.in_functional_mode()):
+            raise RuntimeError(
+                f"{name}: in-place mutation is not recordable in "
+                "static-graph mode; use the out-of-place op instead")
+
     def _inplace_wants_grad(self, *vals) -> bool:
         return (framework.is_grad_enabled()
                 and not framework.in_static_mode()
@@ -331,6 +341,7 @@ class Tensor:
                             for v in vals)))
 
     def fill_(self, v):
+        self._reject_static_inplace("fill_")
         if self._inplace_wants_grad():
             # constant overwrite: gradient to the old value is zero — the
             # recorded pullback encodes exactly that cut
@@ -340,6 +351,7 @@ class Tensor:
         return self
 
     def zero_(self):
+        self._reject_static_inplace("zero_")
         if self._inplace_wants_grad():
             return self._record_inplace(lambda x: jnp.zeros_like(x))
         self._value = jnp.zeros_like(self._value)
@@ -349,6 +361,7 @@ class Tensor:
     def _random_overwrite_(self, sample):
         """Shared body of the in-place random fills (uniform_/normal_/…):
         like fill_, the overwrite cuts the gradient to the old value."""
+        self._reject_static_inplace("random_overwrite_")
         new = sample(framework.split_key())
         if self._inplace_wants_grad():
             return self._record_inplace(
@@ -423,6 +436,8 @@ class Tensor:
         return ops.getitem(self, idx)
 
     def __setitem__(self, idx, val):
+        self._reject_static_inplace("Tensor.__setitem__")
+
         def unwrap_idx(i):
             if isinstance(i, Tensor):
                 return i._value
@@ -574,6 +589,52 @@ def _run_op_hook(fn, result):
         return
     outs = result if isinstance(result, (tuple, list)) else [result]
     hook(fn, [o for o in outs if isinstance(o, Tensor)])
+
+
+def make_inplace(op, name=None):
+    """In-place variant of single-output ``op`` (the reference's
+    ``x_``-suffix ops). With grad wanted this records through
+    ``_record_inplace`` — re-pointing x at the out-of-place result's
+    node would register the output under the temp tensor's id and the
+    id-keyed cotangent walk would skip the op entirely. Static mode
+    raises (the replay graph has no SSA versioning). Differentiable
+    (inexact-dtype) Tensor operands become vjp inputs; integer tensors
+    (indices) are closed over by value."""
+    opname = name or getattr(op, "__name__", "op")
+
+    def f(x, *a, **k):
+        if (framework.in_static_mode()
+                and not framework.in_functional_mode()):
+            raise RuntimeError(
+                f"{opname}_ : in-place ops are not recordable in "
+                "static-graph mode; use the out-of-place op instead")
+        extras = tuple(
+            t for t in list(a) + list(k.values())
+            if isinstance(t, Tensor)
+            and jnp.issubdtype(t._value.dtype, jnp.inexact))
+        if x._inplace_wants_grad(*extras):
+            ids = {id(t) for t in extras}
+
+            def pure(xv, *ev):
+                it = iter(ev)
+
+                def wrap(arg):
+                    if isinstance(arg, Tensor):
+                        return Tensor(next(it)) if id(arg) in ids \
+                            else Tensor(arg._value)
+                    return arg
+                with framework.no_grad_guard():
+                    aa = [wrap(arg) for arg in a]
+                    kk = {kn: wrap(kv) for kn, kv in k.items()}
+                    return op(Tensor(xv), *aa, **kk)._value
+            pure.__qualname__ = opname + "_"
+            return x._record_inplace(pure, extras)
+        out = op(x, *a, **k)
+        x._value = out._value
+        x._notify_inplace_hook(opname + "_")
+        return x
+    f.__name__ = f.__qualname__ = opname + "_"
+    return f
 
 
 def apply_op(fn, *args, **kwargs):

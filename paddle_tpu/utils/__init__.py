@@ -19,3 +19,30 @@ def try_import(module_name):
 from . import dlpack  # noqa: F401
 from . import cpp_extension  # noqa: F401,E402
 from . import unique_name    # noqa: F401,E402
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference:
+    paddle.utils.deprecated, python/paddle/utils/deprecated.py — verify).
+    Warns once per call site; level>=2 raises instead."""
+    import functools
+    import warnings
+
+    def wrap(fn):
+        msg = f"API '{getattr(fn, '__name__', fn)}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if reason:
+            msg += f": {reason}"
+        if update_to:
+            msg += f"; use '{update_to}' instead"
+
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        inner.__deprecated_message__ = msg
+        return inner
+    return wrap

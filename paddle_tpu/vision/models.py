@@ -561,6 +561,12 @@ def vgg11(pretrained=False, **kwargs):
         **kwargs)
 
 
+def vgg13(pretrained=False, **kwargs):
+    return VGG(_vgg_features(
+        [64, 64, "M", 128, 128, "M", 256, 256, "M",
+         512, 512, "M", 512, 512, "M"]), **kwargs)
+
+
 def vgg19(pretrained=False, **kwargs):
     return VGG(_vgg_features(
         [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
@@ -570,7 +576,7 @@ def vgg19(pretrained=False, **kwargs):
 __all__ += ["AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0",
             "squeezenet1_1", "DenseNet", "densenet121", "densenet201",
             "ShuffleNetV2", "shufflenet_v2_x1_0", "wide_resnet50_2",
-            "resnext50_32x4d", "vgg11", "vgg19"]
+            "resnext50_32x4d", "vgg11", "vgg13", "vgg19"]
 
 
 class _SEModule(Layer):

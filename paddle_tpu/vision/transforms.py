@@ -188,21 +188,32 @@ class RandomRotation:
         self.fill = fill
 
     def __call__(self, img):
-        angle = np.random.uniform(*self.degrees) * np.pi / 180.0
+        angle = np.random.uniform(*self.degrees)
         arr = _arr(img).astype(np.float32)
         chw, hwc = _hwc_view(arr)
-        h, w = hwc.shape[:2]
-        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
-        yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
-        ys, xs = yy - cy, xx - cx
-        cos, sin = np.cos(angle), np.sin(angle)
-        sy = (cos * ys - sin * xs + cy).round().astype(np.int64)
-        sx = (sin * ys + cos * xs + cx).round().astype(np.int64)
-        valid = (sy >= 0) & (sy < h) & (sx >= 0) & (sx < w)
-        sy, sx = sy.clip(0, h - 1), sx.clip(0, w - 1)
-        out = hwc[sy, sx]
-        out[~valid] = self.fill
-        return _ret(_back(out, chw), img)
+        return _ret(_back(_rotate_nearest(hwc, angle, self.fill), chw),
+                    img)
+
+
+def _rotate_nearest(hwc, angle_deg, fill, center=None):
+    """Rotate HWC content counter-clockwise by ``angle_deg`` (nearest
+    sampling, same canvas): output(y,x) pulls from the source grid
+    rotated the opposite way. rotate(90) == np.rot90(img, 1)."""
+    rad = float(angle_deg) * np.pi / 180.0
+    h, w = hwc.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    ys, xs = yy - cy, xx - cx
+    cos, sin = np.cos(rad), np.sin(rad)
+    # inverse map for CCW-positive visual rotation on the y-down grid
+    sy = (cos * ys + sin * xs + cy).round().astype(np.int64)
+    sx = (-sin * ys + cos * xs + cx).round().astype(np.int64)
+    valid = (sy >= 0) & (sy < h) & (sx >= 0) & (sx < w)
+    sy, sx = sy.clip(0, h - 1), sx.clip(0, w - 1)
+    out = hwc[sy, sx]
+    out[~valid] = fill
+    return out
 
 
 class RandomResizedCrop:
@@ -293,36 +304,38 @@ class HueTransform:
         self.value = float(value)
 
     def __call__(self, img):
-        import colorsys
+        delta = np.random.uniform(-self.value, self.value)
         arr = _arr(img).astype(np.float32)
         chw, hwc = _hwc_view(arr)
-        scale = 255.0 if hwc.max() > 1.5 else 1.0
-        x = hwc / scale
-        delta = np.random.uniform(-self.value, self.value)
-        mx, mn = x[..., :3].max(-1), x[..., :3].min(-1)
-        # vectorized hue rotation through HSV
-        r, g, b = x[..., 0], x[..., 1], x[..., 2]
-        c = mx - mn
-        hue = np.zeros_like(mx)
-        m = c > 1e-8
-        rc = np.where(m, (mx - r) / np.where(m, c, 1), 0)
-        gc = np.where(m, (mx - g) / np.where(m, c, 1), 0)
-        bc = np.where(m, (mx - b) / np.where(m, c, 1), 0)
-        hue = np.where(mx == r, bc - gc,
-                       np.where(mx == g, 2 + rc - bc, 4 + gc - rc)) / 6.0
-        hue = (hue + delta) % 1.0
-        i = np.floor(hue * 6).astype(np.int64) % 6
-        f = hue * 6 - np.floor(hue * 6)
-        p, q, t = mn, mx - c * f, mx - c * (1 - f)
-        rgb = np.stack([
-            np.select([i == k for k in range(6)],
-                      [mx, q, p, p, t, mx]),
-            np.select([i == k for k in range(6)],
-                      [t, mx, mx, q, p, p]),
-            np.select([i == k for k in range(6)],
-                      [p, p, t, mx, mx, q])], axis=-1)
-        out = rgb * scale
-        return _ret(_back(out, chw), img)
+        return _ret(_back(_hue_shift(hwc, delta), chw), img)
+
+
+def _hue_shift(hwc, delta):
+    """Hue rotation by ``delta`` (in turns) via vectorized RGB→HSV→RGB
+    on an HWC float array; preserves the input's value scale."""
+    scale = 255.0 if hwc.max() > 1.5 else 1.0
+    x = hwc / scale
+    mx, mn = x[..., :3].max(-1), x[..., :3].min(-1)
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    c = mx - mn
+    m = c > 1e-8
+    rc = np.where(m, (mx - r) / np.where(m, c, 1), 0)
+    gc = np.where(m, (mx - g) / np.where(m, c, 1), 0)
+    bc = np.where(m, (mx - b) / np.where(m, c, 1), 0)
+    hue = np.where(mx == r, bc - gc,
+                   np.where(mx == g, 2 + rc - bc, 4 + gc - rc)) / 6.0
+    hue = (hue + delta) % 1.0
+    i = np.floor(hue * 6).astype(np.int64) % 6
+    f = hue * 6 - np.floor(hue * 6)
+    p, q, t = mn, mx - c * f, mx - c * (1 - f)
+    rgb = np.stack([
+        np.select([i == k for k in range(6)],
+                  [mx, q, p, p, t, mx]),
+        np.select([i == k for k in range(6)],
+                  [t, mx, mx, q, p, p]),
+        np.select([i == k for k in range(6)],
+                  [p, p, t, mx, mx, q])], axis=-1)
+    return rgb * scale
 
 
 class ColorJitter:
@@ -377,3 +390,86 @@ __all__ += ["Pad", "RandomRotation", "RandomResizedCrop", "Grayscale",
             "BrightnessTransform", "ContrastTransform",
             "SaturationTransform", "HueTransform", "ColorJitter",
             "RandomErasing", "resize"]
+
+
+class RandomVerticalFlip:
+    """(reference: transforms.RandomVerticalFlip — verify)."""
+
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if np.random.rand() < self.prob else img
+
+
+# ---------------------------------------------------------------------------
+# functional API (reference: python/paddle/vision/transforms/functional.py
+# — verify): deterministic single-image versions of the classes above
+# ---------------------------------------------------------------------------
+
+def hflip(img):
+    """Flip horizontally (W axis; HWC or CHW)."""
+    arr = _arr(img)
+    chw, hwc = _hwc_view(arr)
+    return _ret(_back(hwc[:, ::-1], chw), img)
+
+
+def vflip(img):
+    """Flip vertically (H axis)."""
+    arr = _arr(img)
+    chw, hwc = _hwc_view(arr)
+    return _ret(_back(hwc[::-1], chw), img)
+
+
+def crop(img, top, left, height, width):
+    arr = _arr(img)
+    chw, hwc = _hwc_view(arr)
+    return _ret(_back(hwc[top:top + height, left:left + width], chw), img)
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill=fill, padding_mode=padding_mode)(img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by ``angle`` degrees (nearest sampling,
+    same canvas — reference: F.rotate; expand is not supported)."""
+    if expand:
+        raise NotImplementedError("rotate(expand=True) is unsupported")
+    arr = _arr(img).astype(np.float32)
+    chw, hwc = _hwc_view(arr)
+    return _ret(_back(_rotate_nearest(hwc, angle, fill, center), chw),
+                img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    return _ret(_arr(img).astype(np.float32) * float(brightness_factor),
+                img)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _arr(img).astype(np.float32)
+    f = float(contrast_factor)
+    return _ret(arr.mean() + f * (arr - arr.mean()), img)
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
+    arr = _arr(img).astype(np.float32)
+    chw, hwc = _hwc_view(arr)
+    return _ret(_back(_hue_shift(hwc, float(hue_factor)), chw), img)
+
+
+__all__ += ["RandomVerticalFlip", "hflip", "vflip", "crop", "center_crop",
+            "pad", "rotate", "to_grayscale", "adjust_brightness",
+            "adjust_contrast", "adjust_hue"]

@@ -440,3 +440,95 @@ class TestRound3LongTail:
             asm(xin, paddle.to_tensor(np.array([0, 10])))
         with pytest.raises(ValueError):
             asm(xin, paddle.to_tensor(np.array([-1, 0])))
+
+
+class TestR3ContinuationGaps:
+    """Namespace-probe closures: functional transforms, FusedLinear/
+    FusedTransformerEncoderLayer, fleet.utils exposure, data_norm,
+    utils.deprecated, vgg13 (reference paths in each impl — verify)."""
+
+    def test_functional_transforms(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.arange(24, dtype="float32").reshape(4, 6)
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+        chw = np.arange(36, dtype="float32").reshape(3, 4, 3)\
+            .transpose(2, 0, 1)
+        np.testing.assert_array_equal(T.hflip(chw), chw[:, :, ::-1])
+        np.testing.assert_array_equal(T.crop(img, 1, 2, 2, 3),
+                                      img[1:3, 2:5])
+        np.testing.assert_allclose(T.adjust_brightness(img, 2.0), img * 2)
+        np.testing.assert_allclose(T.rotate(img, 0), img)
+        hsv = np.random.RandomState(0).rand(5, 5, 3).astype("float32")
+        np.testing.assert_allclose(T.adjust_hue(hsv, 0.0), hsv, atol=1e-5)
+        np.testing.assert_allclose(
+            T.adjust_contrast(img, 1.0), img, rtol=1e-6)
+        assert T.to_grayscale(hsv).shape == (5, 5, 1)
+        assert T.pad(img, 1).shape == (6, 8)
+        assert T.center_crop(img, 2).shape == (2, 2)
+        paddle.seed(0)
+        np.random.seed(0)
+        flipped = T.RandomVerticalFlip(prob=1.0)(img)
+        np.testing.assert_array_equal(flipped, img[::-1])
+
+    def test_fused_linear_and_encoder(self):
+        from paddle_tpu.incubate.nn import (FusedLinear,
+                                            FusedTransformerEncoderLayer)
+        x = paddle.to_tensor(np.ones((2, 8), "float32"))
+        fl = FusedLinear(8, 16)
+        assert fl(x).shape == [2, 16]
+        assert FusedLinear(8, 16, transpose_weight=True)(x).shape == [2, 16]
+        assert FusedLinear(8, 16, bias_attr=False).bias is None
+        enc = FusedTransformerEncoderLayer(16, 4, 32)
+        enc.eval()
+        src = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 5, 16).astype("float32"))
+        out = enc(src)
+        assert out.shape == [2, 5, 16]
+        out.sum().backward()   # grads flow through both fused blocks
+
+    def test_fleet_utils_exposes_all_three(self):
+        import paddle_tpu.distributed.fleet as fleet
+        for n in ("recompute", "recompute_sequential",
+                  "fused_allreduce_gradients"):
+            assert hasattr(fleet.utils, n), n
+
+    def test_data_norm(self):
+        xv = np.random.RandomState(0).randn(4, 8).astype("float32")
+        x = paddle.to_tensor(xv)
+        y = paddle.static.nn.data_norm(x, name="dn_test", epsilon=1e-4)
+        # train-mode forward folds the batch into the summary buffers
+        # (decay ~1), then normalizes with the UPDATED global stats
+        d = 0.9999999
+        size = 1e4 * d + 4
+        mean = (0.0 * d + xv.sum(0)) / size
+        var = (1e4 * d + (xv * xv).sum(0)) / size - mean * mean
+        exp = (xv - mean) / np.sqrt(var + 1e-4)
+        np.testing.assert_allclose(y.numpy(), exp, rtol=1e-4, atol=1e-5)
+        # second call accumulates again (stats actually move)
+        y2 = paddle.static.nn.data_norm(x, name="dn_test", epsilon=1e-4)
+        assert not np.allclose(y2.numpy(), y.numpy())
+
+    def test_deprecated_decorator(self):
+        import warnings
+
+        @paddle.utils.deprecated(update_to="paddle.new_api", since="2.0")
+        def old_api(v):
+            return v + 1
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_api(1) == 2
+            assert any(issubclass(x.category, DeprecationWarning)
+                       for x in w)
+
+        @paddle.utils.deprecated(level=2)
+        def gone_api():
+            pass
+        with pytest.raises(RuntimeError):
+            gone_api()
+
+    def test_vgg13(self):
+        m = paddle.vision.models.vgg13(num_classes=7)
+        out = m(paddle.to_tensor(np.zeros((1, 3, 32, 32), "float32")))
+        assert out.shape == [1, 7]

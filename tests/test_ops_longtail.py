@@ -322,3 +322,35 @@ class TestTensorMethodParity:
                 y.exp_()
         finally:
             paddle.disable_static()
+
+    def test_inplace_manipulation_tape(self):
+        # scatter_/reshape_ previously re-pointed at the out-of-place
+        # node and silently fell off the tape (same class as exp_ bug)
+        src = t(np.ones((3, 2), "float32"))
+        src.stop_gradient = False
+        x = src * 2.0
+        upd = t(np.full((1, 2), 10.0, "float32"))
+        upd.stop_gradient = False
+        x.scatter_(t(np.array([1], "int32")), upd)
+        x.sum().backward()
+        g = src.grad.numpy()
+        assert (g[1] == 0).all(), g      # overwritten row: no grad
+        assert (g[0] == 2).all() and (g[2] == 2).all(), g
+        np.testing.assert_allclose(upd.grad.numpy(), 1.0)
+
+        y = t(np.arange(6, dtype="float32"))
+        y.stop_gradient = False
+        z = y * 3.0
+        z.reshape_([2, 3])
+        assert z.shape == [2, 3]
+        z.sum().backward()
+        np.testing.assert_allclose(y.grad.numpy(), 3.0)
+
+    def test_inplace_relu_tape(self):
+        import paddle_tpu.nn.functional as F
+        y = t(np.array([-1.0, 2.0], "float32"))
+        y.stop_gradient = False
+        z = y * 2.0
+        F.relu_(z)
+        z.sum().backward()
+        np.testing.assert_allclose(y.grad.numpy(), [0.0, 2.0])
