@@ -105,12 +105,8 @@ class FusedLinear(Layer):
                                   is_bias=True)
 
     def forward(self, x):
-        from ... import ops
-        w = ops.t(self.weight) if self.transpose_weight else self.weight
-        out = ops.matmul(x, w)
-        if self.bias is not None:
-            out = ops.add(out, self.bias)
-        return out
+        return FF.fused_linear(x, self.weight, self.bias,
+                               self.transpose_weight)
 
 
 class FusedTransformerEncoderLayer(Layer):

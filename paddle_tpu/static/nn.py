@@ -270,6 +270,13 @@ class _DataNorm(_nn.Layer):
     def forward(self, x):
         import jax.numpy as jnp
         from .. import ops
+        if self.training and framework.in_static_mode():
+            import warnings
+            warnings.warn(
+                "static-mode data_norm normalizes with FROZEN summary "
+                "stats (the replay graph cannot mutate buffers); train "
+                "the stats in dygraph mode or feed pre-computed "
+                "summaries", stacklevel=2)
         if self.training and not framework.in_static_mode():
             # summary update (no tape): buffers decay, batch folds in
             xv = x._value
@@ -301,7 +308,8 @@ def data_norm(input, act=None, epsilon=1e-4, param_attr=None,
               enable_scale_and_shift=False):
     dim = int(input.shape[-1])
     layer = _get_layer(name, "data_norm",
-                       (dim, epsilon, bool(enable_scale_and_shift)),
+                       (dim, epsilon, bool(enable_scale_and_shift),
+                        summary_decay_rate, slot_dim),
                        lambda: _DataNorm(
                            dim, epsilon=epsilon, slot_dim=slot_dim,
                            summary_decay_rate=summary_decay_rate,

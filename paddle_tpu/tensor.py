@@ -603,11 +603,7 @@ def make_inplace(op, name=None):
     opname = name or getattr(op, "__name__", "op")
 
     def f(x, *a, **k):
-        if (framework.in_static_mode()
-                and not framework.in_functional_mode()):
-            raise RuntimeError(
-                f"{opname}_ : in-place ops are not recordable in "
-                "static-graph mode; use the out-of-place op instead")
+        x._reject_static_inplace(opname + "_")
         extras = tuple(
             t for t in list(a) + list(k.values())
             if isinstance(t, Tensor)

@@ -441,6 +441,10 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     same canvas — reference: F.rotate; expand is not supported)."""
     if expand:
         raise NotImplementedError("rotate(expand=True) is unsupported")
+    if interpolation != "nearest":
+        raise NotImplementedError(
+            f"rotate(interpolation={interpolation!r}): only 'nearest' "
+            "sampling is implemented")
     arr = _arr(img).astype(np.float32)
     chw, hwc = _hwc_view(arr)
     return _ret(_back(_rotate_nearest(hwc, angle, fill, center), chw),
@@ -467,6 +471,9 @@ def adjust_hue(img, hue_factor):
         raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
     arr = _arr(img).astype(np.float32)
     chw, hwc = _hwc_view(arr)
+    if hwc.ndim != 3 or hwc.shape[-1] != 3:
+        raise ValueError(
+            f"adjust_hue needs a 3-channel image, got shape {arr.shape}")
     return _ret(_back(_hue_shift(hwc, float(hue_factor)), chw), img)
 
 

@@ -532,3 +532,29 @@ class TestR3ContinuationGaps:
         m = paddle.vision.models.vgg13(num_classes=7)
         out = m(paddle.to_tensor(np.zeros((1, 3, 32, 32), "float32")))
         assert out.shape == [1, 7]
+
+    def test_fused_encoder_incremental_cache_parity(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        paddle.seed(3)
+        attn = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                       attn_dropout_rate=0.0)
+        attn.eval()
+        x = np.random.RandomState(0).randn(2, 5, 16).astype("float32")
+        full = attn(paddle.to_tensor(x)).numpy()
+        # incremental: first 3 tokens build the cache, last 2 reuse it
+        empty = paddle.to_tensor(np.zeros((2, 2, 4, 0, 4), "float32"))
+        out1, cache1 = attn(paddle.to_tensor(x[:, :3]), cache=empty)
+        out2, cache2 = attn(paddle.to_tensor(x[:, 3:]), cache=cache1)
+        assert list(cache2.shape) == [2, 2, 4, 5, 4]
+        # non-causal attention: step-2 queries see cached + new keys,
+        # exactly the full run's last two positions
+        np.testing.assert_allclose(out2.numpy(), full[:, 3:],
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_adjust_hue_rejects_grayscale(self):
+        from paddle_tpu.vision import transforms as T
+        with pytest.raises(ValueError):
+            T.adjust_hue(np.ones((4, 6), "float32"), 0.1)
+        with pytest.raises(NotImplementedError):
+            T.rotate(np.ones((4, 6), "float32"), 30,
+                     interpolation="bilinear")

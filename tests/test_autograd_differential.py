@@ -1,0 +1,144 @@
+"""Differential autograd fuzzing: random op programs run twice — once
+through the eager vjp tape (paddle Tensors, including the in-place op
+family), once as a pure-jnp function under jax.grad — and every leaf
+gradient must agree. This is the OpTest gradient check generalized to
+COMPOSITIONS, which is where the tape (not the kernels) can go wrong:
+the r3 in-place bug class (ops silently falling off the tape) would
+have been caught by any program here containing one in-place op.
+(reference analogue: test/legacy_test/gradient_checker.py — verify)
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+
+# each op: (name, arity, paddle_fn(tensors) -> Tensor,
+#           jnp_fn(values) -> value, inplace?)
+# paddle_fn for in-place ops MUTATES its first arg and returns it.
+OPS = [
+    ("tanh", 1, lambda a: a.tanh(), jnp.tanh, False),
+    ("sigmoid", 1, lambda a: a.sigmoid(), jax.nn.sigmoid, False),
+    ("softexp", 1, lambda a: (a.clip(-3, 3)).exp(),
+     lambda a: jnp.exp(jnp.clip(a, -3, 3)), False),
+    ("sqrtabs", 1, lambda a: (a * a + 1.0).sqrt(),
+     lambda a: jnp.sqrt(a * a + 1.0), False),
+    ("relu", 1, lambda a: paddle.nn.functional.relu(a), jax.nn.relu,
+     False),
+    ("square", 1, lambda a: a.square(), jnp.square, False),
+    ("add", 2, lambda a, b: a + b, jnp.add, False),
+    ("sub", 2, lambda a, b: a - b, jnp.subtract, False),
+    ("mul", 2, lambda a, b: a * b, jnp.multiply, False),
+    ("div", 2, lambda a, b: a / (b * b + 1.0),
+     lambda a, b: a / (b * b + 1.0), False),
+    ("maximum", 2, lambda a, b: paddle.maximum(a, b), jnp.maximum,
+     False),
+    ("matmul", 2, lambda a, b: a.matmul(b.t()),
+     lambda a, b: a @ b.T, False),
+    ("reshape", 1, lambda a: a.reshape([2, 6]),
+     lambda a: jnp.reshape(a, (2, 6)), False),
+    ("transpose", 1, lambda a: a.transpose([1, 0]),
+     lambda a: jnp.transpose(a), False),
+    ("slice", 1, lambda a: a[1:3], lambda a: a[1:3], False),
+    ("meankeep", 1, lambda a: a.mean(0, keepdim=True) + a,
+     lambda a: jnp.mean(a, 0, keepdims=True) + a, False),
+    # in-place family (the fixed tape paths)
+    ("exp_", 1, lambda a: a.clip(-3, 3).exp_(),
+     lambda a: jnp.exp(jnp.clip(a, -3, 3)), True),
+    ("tanh_", 1, lambda a: a.tanh_(), jnp.tanh, True),
+    ("scale_", 1, lambda a: a.scale_(0.5, bias=1.0),
+     lambda a: a * 0.5 + 1.0, True),
+    ("clip_", 1, lambda a: a.clip_(-1.0, 1.0),
+     lambda a: jnp.clip(a, -1.0, 1.0), True),
+    ("add_t", 2, lambda a, b: a.add_(b), jnp.add, True),
+    ("mul_t", 2, lambda a, b: a.multiply_(b), jnp.multiply, True),
+    ("relu_", 1, lambda a: paddle.nn.functional.relu_(a * 1.0),
+     jax.nn.relu, True),
+    ("setitem", 2, None, None, True),   # handled specially
+]
+
+
+def _run_paddle(program, leaf_vals):
+    paddle.seed(0)
+    leaves = [paddle.to_tensor(v.copy()) for v in leaf_vals]
+    for t in leaves:
+        t.stop_gradient = False
+    vals = list(leaves)
+    for (opi, srcs) in program:
+        name, arity, pfn, _, inplace = OPS[opi]
+        args = [vals[s] for s in srcs]
+        if name == "setitem":
+            tgt = args[0] * 1.0          # fresh non-leaf to mutate
+            tgt[0:1] = args[1][0:1] * 2.0
+            vals.append(tgt)
+            continue
+        if inplace:
+            # in-place must not mutate a leaf's buffer alias: operate
+            # on a fresh intermediate like real training code does
+            args = [args[0] * 1.0] + args[1:]
+        vals.append(pfn(*args))
+    loss = None
+    for v in vals[len(leaves):]:
+        s = v.sum()
+        loss = s if loss is None else loss + s
+    loss.backward()
+    return (float(loss._value),
+            [None if t.grad is None else np.asarray(t.grad._value)
+             for t in leaves])
+
+
+def _run_jax(program, leaf_vals):
+    n = len(leaf_vals)
+
+    def fn(*leaves):
+        vals = list(leaves)
+        for (opi, srcs) in program:
+            name, arity, _, jfn, inplace = OPS[opi]
+            args = [vals[s] for s in srcs]
+            if name == "setitem":
+                tgt = args[0] * 1.0
+                tgt = tgt.at[0:1].set(args[1][0:1] * 2.0)
+                vals.append(tgt)
+                continue
+            vals.append(jfn(*args))
+        tot = 0.0
+        for v in vals[n:]:
+            tot = tot + v.sum()
+        return tot
+    val, grads = jax.value_and_grad(fn, argnums=tuple(range(n)))(
+        *[jnp.asarray(v) for v in leaf_vals])
+    return float(val), [np.asarray(g) for g in grads]
+
+
+class TestDifferentialAutograd:
+    @pytest.mark.parametrize("seed", list(range(40)))
+    def test_random_program_grads_match(self, seed):
+        rng = np.random.RandomState(seed)
+        n_leaves = 2
+        leaf_vals = [rng.randn(3, 4).astype(np.float32) * 0.5
+                     for _ in range(n_leaves)]
+        # build, tracking which values are shape-(3,4)-safe sources
+        program = []
+        safe = list(range(n_leaves))
+        n_vals = n_leaves
+        for _ in range(rng.randint(3, 8)):
+            opi = rng.randint(len(OPS))
+            name, arity = OPS[opi][0], OPS[opi][1]
+            srcs = [safe[rng.randint(len(safe))] for _ in range(arity)]
+            program.append((opi, srcs))
+            if name not in ("reshape", "slice", "matmul", "transpose"):
+                safe.append(n_vals)   # same-shape output: reusable
+            n_vals += 1
+        pl, pg = _run_paddle(program, leaf_vals)
+        jl, jg = _run_jax(program, leaf_vals)
+        ops_used = [OPS[o][0] for o, _ in program]
+        assert np.isfinite(pl) and abs(pl - jl) < 1e-2 * max(
+            1.0, abs(jl)), (pl, jl, ops_used)
+        for i, (a, b) in enumerate(zip(pg, jg)):
+            ga = np.zeros_like(leaf_vals[i]) if a is None else a
+            np.testing.assert_allclose(
+                ga, b, rtol=2e-3, atol=2e-4,
+                err_msg=f"leaf {i} grad mismatch; program={ops_used}")
