@@ -1,6 +1,7 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate/ — verify):
 fused transformer ops, MoE, flash attention wrappers."""
 from . import nn          # noqa: F401
+from . import autograd    # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp          # noqa: F401
 

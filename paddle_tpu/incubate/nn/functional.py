@@ -235,3 +235,79 @@ def parallel_fused_linear_cross_entropy(x, weight, labels, mesh=None,
 
 __all__ += ["fused_linear_cross_entropy",
             "parallel_fused_linear_cross_entropy"]
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation=None):
+    """Linear + bias + activation in one epilogue (reference:
+    incubate.nn.functional.fused_linear_activation over
+    fused_gemm_epilogue — verify; XLA fuses the chain natively)."""
+    from ...ops.math import matmul
+    out = matmul(x, y, transpose_x=trans_x, transpose_y=trans_y) + bias
+    if activation in (None, "none"):
+        return out
+    return getattr(F, activation)(out)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """dropout(x) + y as one fused op (reference:
+    incubate.nn.functional.fused_dropout_add — verify)."""
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, linear_weights, linear_biases,
+                            ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+                            ffn1_biases, ffn2_weights, ffn2_biases,
+                            pre_layer_norm=True, epsilon=1e-05,
+                            cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False,
+                            mode="upscale_in_train", ring_id=-1,
+                            name=None):
+    """The whole transformer stack as one call (reference:
+    incubate.nn.functional.fused_multi_transformer — the fused
+    inference op behind fused decoding — verify). Per layer:
+    pre-LN attention with residual, pre-LN ffn with residual; weight
+    lists are per-layer. With ``cache_kvs`` (a list of (2, b, nh, t,
+    hd) caches) attention runs incrementally and the updated caches
+    are returned alongside the output, mirroring the reference's
+    decode contract."""
+    if not pre_layer_norm:
+        raise NotImplementedError(
+            "fused_multi_transformer: only pre_layer_norm=True is "
+            "implemented (the reference's default decoding config)")
+    if time_step is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: preallocated-cache decode "
+            "(time_step) is unsupported — pass growing cache_kvs "
+            "instead (each call appends the step's k/v)")
+    out = x
+    new_caches = []
+    for i in range(len(qkv_weights)):
+        cache = cache_kvs[i] if cache_kvs is not None else None
+        attn = fused_multi_head_attention(
+            out, qkv_weights[i], linear_weights[i],
+            True, ln_scales[i], ln_biases[i], None, None, epsilon,
+            qkv_biases[i] if qkv_biases is not None else None,
+            linear_biases[i] if linear_biases is not None else None,
+            cache, attn_mask, dropout_rate, dropout_rate, epsilon,
+            training, mode=mode)
+        if cache is not None:
+            attn, new_cache = attn
+            new_caches.append(new_cache)
+        out = fused_feedforward(
+            attn, ffn1_weights[i], ffn2_weights[i],
+            ffn1_biases[i] if ffn1_biases is not None else None,
+            ffn2_biases[i] if ffn2_biases is not None else None,
+            ffn_ln_scales[i], ffn_ln_biases[i], None, None,
+            dropout_rate, dropout_rate, activation, epsilon, epsilon,
+            True, training)
+    if cache_kvs is not None:
+        return out, new_caches
+    return out
+
+
+__all__ += ["fused_linear_activation", "fused_dropout_add",
+            "fused_multi_transformer"]

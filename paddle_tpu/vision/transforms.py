@@ -183,6 +183,10 @@ class RandomRotation:
     sampling on the HWC grid (reference: transforms.RandomRotation)."""
 
     def __init__(self, degrees, interpolation="nearest", fill=0):
+        if interpolation != "nearest":
+            raise NotImplementedError(
+                f"RandomRotation(interpolation={interpolation!r}): "
+                "only 'nearest' sampling is implemented")
         self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
             else tuple(degrees)
         self.fill = fill

@@ -558,3 +558,140 @@ class TestR3ContinuationGaps:
         with pytest.raises(NotImplementedError):
             T.rotate(np.ones((4, 6), "float32"), 30,
                      interpolation="bilinear")
+
+
+class TestIncubateFusedLongTail:
+    """fused_linear_activation / fused_dropout_add /
+    fused_multi_transformer / incubate.autograd (reference:
+    python/paddle/incubate/nn/functional/, incubate/autograd/ —
+    verify)."""
+
+    def test_fused_linear_activation(self):
+        import paddle_tpu.incubate.nn.functional as FF
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        w = paddle.to_tensor(np.ones((4, 3), "float32") * 0.5)
+        b = paddle.to_tensor(np.zeros(3, "float32"))
+        np.testing.assert_allclose(
+            FF.fused_linear_activation(x, w, b, activation="relu")
+            .numpy(), 2.0)
+        np.testing.assert_allclose(
+            FF.fused_linear_activation(x, w, b).numpy(), 2.0)
+
+    def test_fused_dropout_add(self):
+        import paddle_tpu.incubate.nn.functional as FF
+        x = paddle.to_tensor(np.ones((2, 4), "float32"))
+        y = paddle.to_tensor(np.ones((2, 4), "float32"))
+        np.testing.assert_allclose(
+            FF.fused_dropout_add(x, y, 0.5, training=False).numpy(), 2.0)
+
+    def test_fused_multi_transformer_parity_and_cache(self):
+        import paddle_tpu.incubate.nn.functional as FF
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        L, d, nh, hd = 2, 8, 2, 4
+
+        def T(a):
+            return paddle.to_tensor(np.asarray(a, dtype="float32"))
+        lnS = [T(np.ones(d)) for _ in range(L)]
+        lnB = [T(np.zeros(d)) for _ in range(L)]
+        qkvW = [T(rng.randn(3, nh, hd, d) * 0.1) for _ in range(L)]
+        qkvB = [T(np.zeros((3, nh, hd))) for _ in range(L)]
+        linW = [T(rng.randn(d, d) * 0.1) for _ in range(L)]
+        linB = [T(np.zeros(d)) for _ in range(L)]
+        flnS = [T(np.ones(d)) for _ in range(L)]
+        flnB = [T(np.zeros(d)) for _ in range(L)]
+        f1W = [T(rng.randn(d, 16) * 0.1) for _ in range(L)]
+        f1B = [T(np.zeros(16)) for _ in range(L)]
+        f2W = [T(rng.randn(16, d) * 0.1) for _ in range(L)]
+        f2B = [T(np.zeros(d)) for _ in range(L)]
+        xin = T(rng.randn(2, 5, d))
+        out = FF.fused_multi_transformer(
+            xin, lnS, lnB, qkvW, qkvB, linW, linB, flnS, flnB,
+            f1W, f1B, f2W, f2B, dropout_rate=0.0, training=False)
+        ref = xin
+        for i in range(L):
+            a = FF.fused_multi_head_attention(
+                ref, qkvW[i], linW[i], True, lnS[i], lnB[i], None, None,
+                1e-5, qkvB[i], linB[i], None, None, 0.0, 0.0, 1e-5,
+                False)
+            ref = FF.fused_feedforward(
+                a, f1W[i], f2W[i], f1B[i], f2B[i], flnS[i], flnB[i],
+                None, None, 0.0, 0.0, "gelu", 1e-5, 1e-5, True, False)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+        caches = [T(np.zeros((2, 2, nh, 0, hd))) for _ in range(L)]
+        out2, ncaches = FF.fused_multi_transformer(
+            xin, lnS, lnB, qkvW, qkvB, linW, linB, flnS, flnB,
+            f1W, f1B, f2W, f2B, dropout_rate=0.0, training=False,
+            cache_kvs=caches)
+        assert len(ncaches) == L
+        assert list(ncaches[0].shape) == [2, 2, nh, 5, hd]
+        np.testing.assert_allclose(out2.numpy(), out.numpy(), rtol=1e-5)
+
+    def test_incubate_autograd(self):
+        import paddle_tpu.incubate.autograd as IA
+        IA.enable_prim()
+        assert IA.prim_enabled()
+        IA.disable_prim()
+        assert not IA.prim_enabled()
+        x = paddle.to_tensor(np.array([1., 2.], "float32"))
+        t = IA.forward_grad(lambda v: v * v, x)
+        tv = t[0] if isinstance(t, (list, tuple)) else t
+        np.testing.assert_allclose(np.asarray(tv._value), [2., 4.])
+        with pytest.raises(TypeError):
+            IA.forward_grad(x * x, x)
+
+    def test_fused_multi_transformer_causal_decode_parity(self):
+        import paddle_tpu.incubate.nn.functional as FF
+        rng = np.random.RandomState(0)
+        L, d, nh, hd = 2, 8, 2, 4
+
+        def T(a):
+            return paddle.to_tensor(np.asarray(a, dtype="float32"))
+        A = dict(
+            lnS=[T(np.ones(d)) for _ in range(L)],
+            lnB=[T(np.zeros(d)) for _ in range(L)],
+            qkvW=[T(rng.randn(3, nh, hd, d) * 0.1) for _ in range(L)],
+            qkvB=[T(np.zeros((3, nh, hd))) for _ in range(L)],
+            linW=[T(rng.randn(d, d) * 0.1) for _ in range(L)],
+            linB=[T(np.zeros(d)) for _ in range(L)],
+            flnS=[T(np.ones(d)) for _ in range(L)],
+            flnB=[T(np.zeros(d)) for _ in range(L)],
+            f1W=[T(rng.randn(d, 16) * 0.1) for _ in range(L)],
+            f1B=[T(np.zeros(16)) for _ in range(L)],
+            f2W=[T(rng.randn(16, d) * 0.1) for _ in range(L)],
+            f2B=[T(np.zeros(d)) for _ in range(L)])
+
+        def run(x, caches=None, mask=None):
+            return FF.fused_multi_transformer(
+                x, A["lnS"], A["lnB"], A["qkvW"], A["qkvB"], A["linW"],
+                A["linB"], A["flnS"], A["flnB"], A["f1W"], A["f1B"],
+                A["f2W"], A["f2B"], dropout_rate=0.0, training=False,
+                cache_kvs=caches, attn_mask=mask)
+        x = T(rng.randn(1, 6, d))
+        causal = np.triu(np.full((6, 6), -1e9, np.float32), 1)[None, None]
+        full = run(x, mask=T(causal)).numpy()
+        caches = [T(np.zeros((2, 1, nh, 0, hd))) for _ in range(L)]
+        outs = []
+        for t in range(6):
+            o, caches = run(
+                paddle.to_tensor(x.numpy()[:, t:t + 1]), caches)
+            outs.append(o.numpy())
+        np.testing.assert_allclose(np.concatenate(outs, axis=1), full,
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_incubate_autograd_functors(self):
+        import paddle_tpu.incubate.autograd as IA
+        x = paddle.to_tensor(np.array([1., 2.], "float32"))
+        J = IA.Jacobian(lambda v: v * v, x)
+        assert J.shape == [2, 2]
+        np.testing.assert_allclose(J.numpy(), [[2., 0.], [0., 4.]])
+        H = IA.Hessian(lambda v: (v * v).sum(), x)
+        np.testing.assert_allclose(
+            np.asarray(H.numpy()).reshape(2, 2), [[2., 0.], [0., 2.]])
+        with pytest.raises(TypeError):
+            IA.Jacobian(np.eye(2), x)
+        with pytest.raises(NotImplementedError):
+            import paddle_tpu.incubate.nn.functional as FF
+            FF.fused_multi_transformer(
+                x, [], [], [None], [], [], [], [], [], [], [], [], [],
+                time_step=3)
