@@ -2054,3 +2054,260 @@ def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
 
 __all__ += ["gaussian_nll_loss", "lp_pool1d", "lp_pool2d", "zeropad1d",
             "zeropad3d", "fractional_max_pool2d", "fractional_max_pool3d"]
+
+
+def _grad_scale(x, s):
+    """Identity forward, cotangent scaled by ``s`` backward (the
+    FastEmit gradient trick: warprnnt scales the emit-branch gradients
+    by (1+lambda) while leaving the loss value unchanged)."""
+    import jax as _jax
+
+    @_jax.custom_vjp
+    def f(v):
+        return v
+
+    def fwd(v):
+        return v, None
+
+    def bwd(_, ct):
+        return (ct * s,)
+    f.defvjp(fwd, bwd)
+    return f(x)
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-Transducer loss (reference: warprnnt-backed
+    paddle.nn.functional.rnnt_loss, python/paddle/nn/functional/loss.py
+    — verify). TPU-native: the (T, U) lattice alpha recursion runs as a
+    ``lax.scan`` over time; the label-axis recurrence inside each step
+    is a log-semiring affine prefix composition evaluated with
+    ``lax.associative_scan`` (sequential depth T·log U, not T·U). The
+    whole thing is differentiable, so the gradient is jax's autodiff of
+    the recursion. FastEmit (arXiv 2010.11148) is applied the way
+    warprnnt does: the emit-branch cotangent is scaled by
+    (1 + fastemit_lambda) — the loss VALUE is unchanged (a value-side
+    shift would be a constant U·log1p(λ) with zero gradient effect).
+
+    ``logits``: (B, T, U+1, V) unnormalized; ``labels``: (B, U) int;
+    lengths per sample."""
+    # concrete-length validation (skipped under tracing): out-of-range
+    # lengths would silently clamp the final gather cell
+    try:
+        tlv = np.asarray(logit_lengths._value if hasattr(
+            logit_lengths, "_value") else logit_lengths)
+        ulv = np.asarray(label_lengths._value if hasattr(
+            label_lengths, "_value") else label_lengths)
+        Tmax = (logits._value if hasattr(logits, "_value")
+                else logits).shape[1]
+        Umax = (logits._value if hasattr(logits, "_value")
+                else logits).shape[2] - 1
+        if tlv.max() > Tmax or tlv.min() < 1:
+            raise ValueError(
+                f"rnnt_loss: logit_lengths must be in [1, {Tmax}], "
+                f"got max {tlv.max()}")
+        if ulv.max() > Umax or ulv.min() < 0:
+            raise ValueError(
+                f"rnnt_loss: label_lengths must be in [0, {Umax}], "
+                f"got max {ulv.max()}")
+    except (TypeError, AttributeError):
+        pass
+    except Exception as e:
+        if isinstance(e, ValueError):
+            raise
+        pass
+
+    def f(lg, lb, tl, ul):
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        B, T, U1, V = lp.shape
+        U = U1 - 1
+        bidx = jnp.arange(B)
+        # per-position transition log-probs
+        blank_lp = lp[..., blank]                       # (B, T, U+1)
+        lab = jnp.where(jnp.arange(U)[None, :] < ul[:, None], lb, 0)
+        label_lp = jnp.take_along_axis(
+            lp[:, :, :U, :], lab[:, None, :, None].astype(jnp.int32),
+            axis=-1)[..., 0]                            # (B, T, U)
+        if fastemit_lambda:
+            label_lp = _grad_scale(label_lp,
+                                   1.0 + float(fastemit_lambda))
+
+        def combine(a, b):
+            # log-semiring affine maps x -> logaddexp(bias, x + mul),
+            # composed left-to-right along the label axis
+            am, ab = a
+            bm, bb = b
+            return am + bm, jnp.logaddexp(bb, ab + bm)
+
+        def row_step(alpha_prev, t):
+            # emit-from-below: alpha[t-1, u] + blank[t-1, u]
+            from_below = alpha_prev + blank_lp[:, t - 1, :]  # (B, U+1)
+            muls = label_lp[:, t, :]                         # (B, U)
+            M, Bias = jax.lax.associative_scan(
+                combine, (muls, from_below[:, 1:]), axis=1)
+            row = jnp.concatenate(
+                [from_below[:, :1],
+                 jnp.logaddexp(Bias, from_below[:, :1] + M)], axis=1)
+            return row, row
+
+        # t = 0 row: pure label advances — a prefix sum
+        row0 = jnp.concatenate(
+            [jnp.zeros((B, 1), lp.dtype),
+             jnp.cumsum(label_lp[:, 0, :], axis=1)], axis=1)
+        if T > 1:
+            _, rows = jax.lax.scan(row_step, row0, jnp.arange(1, T))
+            rows = jnp.concatenate([row0[None], rows], axis=0)  # (T,B,U1)
+        else:
+            rows = row0[None]
+        rows = jnp.transpose(rows, (1, 0, 2))           # (B, T, U+1)
+        final_alpha = rows[bidx, tl - 1, ul]
+        final_blank = blank_lp[bidx, tl - 1, ul]
+        nll = -(final_alpha + final_blank)
+        if reduction == "mean":
+            # warprnnt convention: mean over batch
+            return jnp.mean(nll)
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+    return apply_op(f, logits, labels, logit_lengths, label_lengths)
+
+
+def embedding_bag(input, weight, offsets=None, mode="mean", name=None):
+    """Sum/mean/max of embedding rows per bag (reference:
+    paddle.nn.functional.embedding_bag — verify). 2-D ``input``
+    (B, bag): each row is one bag; with 1-D input, ``offsets`` marks
+    bag starts (the torch-style ragged form)."""
+    def f(ids, w, offs=None):
+        if ids.ndim == 2:
+            if offs is not None:
+                raise ValueError(
+                    "embedding_bag: offsets are only valid with 1-D "
+                    "input (2-D input already defines the bags)")
+            rows = w[ids]                               # (B, bag, D)
+            if mode == "sum":
+                return rows.sum(1)
+            if mode == "mean":
+                return rows.mean(1)
+            if mode == "max":
+                return rows.max(1)
+            raise ValueError(f"embedding_bag mode {mode!r}")
+        if offs is None:
+            raise ValueError("1-D input needs offsets")
+        seg = jnp.cumsum(
+            jnp.zeros(ids.shape[0], jnp.int32).at[offs[1:]].add(1))
+        rows = w[ids]
+        nseg = offs.shape[0]
+        if mode == "sum":
+            return jax.ops.segment_sum(rows, seg, num_segments=nseg)
+        if mode == "mean":
+            s = jax.ops.segment_sum(rows, seg, num_segments=nseg)
+            n = jax.ops.segment_sum(jnp.ones_like(seg, w.dtype), seg,
+                                    num_segments=nseg)
+            return s / jnp.maximum(n, 1)[:, None]
+        if mode == "max":
+            return jax.ops.segment_max(rows, seg, num_segments=nseg)
+        raise ValueError(f"embedding_bag mode {mode!r}")
+    if offsets is None:
+        return apply_op(f, input, weight)
+    return apply_op(f, input, weight, offsets)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """Adaptive softmax (reference:
+    paddle.nn.functional.adaptive_log_softmax_with_loss — verify).
+    ``head_weight``: (in, cutoffs[0] + n_clusters); ``tail_weights``:
+    list of [(in, hsz), (hsz, osz)] projection pairs per cluster.
+    Returns (per-sample log-prob of the target, mean nll loss)."""
+    def f(x, y, hw, *flat):
+        hb = flat[-1] if head_bias is not None else None
+        tw = flat[:len(flat) - (1 if head_bias is not None else 0)]
+        pairs = [(tw[2 * i], tw[2 * i + 1]) for i in range(len(tw) // 2)]
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lp = jax.nn.log_softmax(head_logits, axis=-1)
+        shortlist = cutoffs[0]
+        out = jnp.zeros(y.shape, x.dtype)
+        # shortlist targets
+        in_short = y < shortlist
+        short_lp = jnp.take_along_axis(
+            head_lp, jnp.clip(y, 0, shortlist - 1)[:, None], 1)[:, 0]
+        out = jnp.where(in_short, short_lp, out)
+        # each tail cluster
+        lo = shortlist
+        for i, (p1, p2) in enumerate(pairs):
+            hi = cutoffs[i + 1]
+            in_cl = (y >= lo) & (y < hi)
+            cl_lp = head_lp[:, shortlist + i]
+            tail_logits = (x @ p1) @ p2
+            tail_lp = jax.nn.log_softmax(tail_logits, axis=-1)
+            rel = jnp.clip(y - lo, 0, hi - lo - 1)
+            t_lp = jnp.take_along_axis(tail_lp, rel[:, None], 1)[:, 0]
+            out = jnp.where(in_cl, cl_lp + t_lp, out)
+            lo = hi
+        return out, -jnp.mean(out)
+    flat = [w for pair in tail_weights for w in pair]
+    if head_bias is not None:
+        flat.append(head_bias)
+    return apply_op(f, input, label, head_weight, *flat)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample class centers: all positives + negatives up to
+    ``num_samples`` (reference: paddle.nn.functional.class_center_sample,
+    the PartialFC sampler — verify). Returns (remapped_label,
+    sampled_class_index). Deterministic given the global RNG state."""
+    from .. import framework
+    import numpy as _np
+    lab = _np.asarray(label._value if isinstance(label, Tensor)
+                      else label).reshape(-1)
+    pos = _np.unique(lab)
+    if pos.size >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = _np.setdiff1d(_np.arange(num_classes), pos,
+                                 assume_unique=True)
+        k = int(framework.split_key()[0]) % (2 ** 31)
+        rng = _np.random.RandomState(k)
+        extra = rng.choice(neg_pool, size=num_samples - pos.size,
+                           replace=False)
+        sampled = _np.sort(_np.concatenate([pos, extra]))
+    remap = _np.full(num_classes, -1, _np.int64)
+    remap[sampled] = _np.arange(sampled.size)
+    return (to_tensor(remap[lab].astype(_np.int32)),
+            to_tensor(sampled.astype(_np.int32)))
+
+
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices=None,
+                                     attn_mask_start_row=0,
+                                     dropout_p=0.0, is_causal=True,
+                                     name=None):
+    """Row-sparse causal attention (reference:
+    flash_attention_with_sparse_mask — verify): rows below
+    ``attn_mask_start_row_indices`` per column are masked on TOP of the
+    causal mask. Composes the mask and dispatches to the fused SDPA."""
+    def build(q, idx=None):
+        s = q.shape[1]
+        causal = jnp.tril(jnp.ones((s, s), bool))
+        if idx is None:
+            m = causal
+            return jnp.where(m, 0.0, -1e30)[None, None].astype(q.dtype)
+        # idx: (B, s) start row per column; mask rows >= idx[col]
+        rows = jnp.arange(s)[None, :, None]
+        starts = idx[:, None, :]
+        keep = causal[None] & (rows < starts)
+        return jnp.where(keep, 0.0, -1e30)[:, None].astype(q.dtype)
+    if attn_mask_start_row_indices is None:
+        return scaled_dot_product_attention(
+            query, key, value, None, dropout_p, is_causal, True)
+    mask = apply_op(lambda q, i: build(q, i), query,
+                    attn_mask_start_row_indices)
+    return scaled_dot_product_attention(
+        query, key, value, mask, dropout_p, False, True)
+
+
+__all__ += ["rnnt_loss", "embedding_bag", "adaptive_log_softmax_with_loss",
+            "class_center_sample", "flash_attention_with_sparse_mask"]

@@ -401,3 +401,37 @@ class AdaptiveLogSoftmaxWithLoss(Layer):
 
 
 __all__ += ["GaussianNLLLoss", "AdaptiveLogSoftmaxWithLoss"]
+
+
+class RNNTLoss(Layer):
+    """RNN-Transducer loss layer (reference: paddle.nn.RNNTLoss over
+    warprnnt — verify; lax-native lattice recursion here)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, self.fastemit_lambda,
+                           self.reduction)
+
+
+class EmbeddingBag(Layer):
+    """Bagged embedding (reference: paddle.nn.EmbeddingBag — verify)."""
+
+    def __init__(self, num_embeddings, embedding_dim, mode="mean",
+                 weight_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr)
+
+    def forward(self, input, offsets=None):
+        return F.embedding_bag(input, self.weight, offsets, self.mode)
+
+
+__all__ += ["RNNTLoss", "EmbeddingBag"]

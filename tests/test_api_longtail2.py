@@ -695,3 +695,185 @@ class TestIncubateFusedLongTail:
             FF.fused_multi_transformer(
                 x, [], [], [None], [], [], [], [], [], [], [], [], [],
                 time_step=3)
+
+
+class TestSpeechAndSamplingOps:
+    """rnnt_loss/RNNTLoss, embedding_bag/EmbeddingBag,
+    adaptive_log_softmax_with_loss, class_center_sample,
+    flash_attention_with_sparse_mask (reference: warprnnt-backed
+    rnnt_loss + python/paddle/nn/functional/loss.py — verify)."""
+
+    def test_rnnt_loss_vs_dp_reference(self):
+        import paddle_tpu.nn.functional as F
+        from scipy.special import log_softmax
+
+        def np_rnnt(lg, lb, T, U, blank=0):
+            lp = log_softmax(lg, axis=-1)
+            alpha = np.full((T, U + 1), -np.inf)
+            alpha[0, 0] = 0.0
+            for u in range(1, U + 1):
+                alpha[0, u] = alpha[0, u - 1] + lp[0, u - 1, lb[u - 1]]
+            for t in range(1, T):
+                alpha[t, 0] = alpha[t - 1, 0] + lp[t - 1, 0, blank]
+                for u in range(1, U + 1):
+                    alpha[t, u] = np.logaddexp(
+                        alpha[t - 1, u] + lp[t - 1, u, blank],
+                        alpha[t, u - 1] + lp[t, u - 1, lb[u - 1]])
+            return -(alpha[T - 1, U] + lp[T - 1, U, blank])
+
+        rng = np.random.RandomState(0)
+        B, T, U, V = 3, 5, 3, 7
+        lg = rng.randn(B, T, U + 1, V).astype("float32")
+        lb = rng.randint(1, V, (B, U)).astype("int32")
+        tl = np.array([5, 4, 3], "int32")   # ragged lengths
+        ul = np.array([3, 2, 1], "int32")
+        loss = F.rnnt_loss(paddle.to_tensor(lg), paddle.to_tensor(lb),
+                           paddle.to_tensor(tl), paddle.to_tensor(ul),
+                           reduction="none")
+        ref = np.array([np_rnnt(lg[b], lb[b], tl[b], ul[b])
+                        for b in range(B)])
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-4)
+
+    def test_rnnt_loss_grad_finite_difference(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(1)
+        lg = rng.randn(1, 4, 3, 5).astype("float32")
+        lb = rng.randint(1, 5, (1, 2)).astype("int32")
+        tl = np.array([4], "int32")
+        ul = np.array([2], "int32")
+
+        def loss_of(a):
+            return float(F.rnnt_loss(
+                paddle.to_tensor(a), paddle.to_tensor(lb),
+                paddle.to_tensor(tl), paddle.to_tensor(ul))._value)
+        x = paddle.to_tensor(lg)
+        x.stop_gradient = False
+        F.rnnt_loss(x, paddle.to_tensor(lb), paddle.to_tensor(tl),
+                    paddle.to_tensor(ul)).backward()
+        g = x.grad.numpy()
+        eps = 1e-3
+        for idx in [(0, 1, 1, 2), (0, 0, 0, 0), (0, 3, 2, 4)]:
+            lg2 = lg.copy()
+            lg2[idx] += eps
+            fd = (loss_of(lg2) - loss_of(lg)) / eps
+            assert abs(fd - g[idx]) < 2e-2, (idx, fd, g[idx])
+
+    def test_embedding_bag(self):
+        import paddle_tpu.nn.functional as F
+        w = paddle.to_tensor(np.arange(20, dtype="float32").reshape(10, 2))
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], "int32"))
+        np.testing.assert_allclose(
+            F.embedding_bag(ids, w, mode="sum").numpy(),
+            [[6, 8], [14, 16]])
+        np.testing.assert_allclose(
+            F.embedding_bag(ids, w, mode="mean").numpy(),
+            [[3, 4], [7, 8]])
+        ids1 = paddle.to_tensor(np.array([1, 2, 3, 4, 5], "int32"))
+        offs = paddle.to_tensor(np.array([0, 2], "int32"))
+        np.testing.assert_allclose(
+            F.embedding_bag(ids1, w, offsets=offs, mode="sum").numpy(),
+            [[6, 8], [24, 27]])
+        eb = paddle.nn.EmbeddingBag(10, 2, mode="max")
+        assert eb(ids).shape == [2, 2]
+
+    def test_adaptive_log_softmax(self):
+        from scipy.special import log_softmax
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = rng.randn(6, 8).astype("float32")
+        hw = rng.randn(8, 5).astype("float32")
+        p1 = rng.randn(8, 4).astype("float32")
+        p2 = rng.randn(4, 6).astype("float32")
+        y = np.array([0, 3, 2, 5, 9, 7], "int64")
+        outp, loss = F.adaptive_log_softmax_with_loss(
+            paddle.to_tensor(x), paddle.to_tensor(y.astype("int32")),
+            paddle.to_tensor(hw),
+            [(paddle.to_tensor(p1), paddle.to_tensor(p2))], [4, 10])
+        head = log_softmax(x @ hw, axis=-1)
+        tail = log_softmax((x @ p1) @ p2, axis=-1)
+        exp = np.where(
+            y < 4,
+            np.take_along_axis(head, np.minimum(y, 3)[:, None], 1)[:, 0],
+            head[:, 4] + np.take_along_axis(
+                tail, np.maximum(y - 4, 0)[:, None], 1)[:, 0])
+        np.testing.assert_allclose(outp.numpy(), exp, rtol=1e-5)
+        np.testing.assert_allclose(float(loss._value), -exp.mean(),
+                                   rtol=1e-5)
+        layer = paddle.nn.AdaptiveLogSoftmaxWithLoss(8, 12, [4, 8])
+        o, l = layer(paddle.to_tensor(x),
+                     paddle.to_tensor((y % 12).astype("int32")))
+        l.backward()
+        assert layer.head.weight.grad is not None
+
+    def test_class_center_sample(self):
+        import paddle_tpu.nn.functional as F
+        paddle.seed(5)
+        lab = paddle.to_tensor(np.array([3, 7, 3, 1], "int32"))
+        rl, sampled = F.class_center_sample(lab, 20, 6)
+        s = sampled.numpy()
+        assert len(s) == 6 and len(set(s.tolist())) == 6
+        assert {1, 3, 7}.issubset(set(s.tolist()))
+        for orig, remapped in zip([3, 7, 3, 1], rl.numpy().tolist()):
+            assert s[remapped] == orig
+
+    def test_flash_attention_with_sparse_mask(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(1, 4, 2, 4).astype("float32"))
+        out = F.flash_attention_with_sparse_mask(q, q, q, is_causal=True)
+        ref = F.scaled_dot_product_attention(q, q, q, None, 0.0, True,
+                                             True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=2e-5,
+                                   atol=1e-5)
+        # column-start sparse mask == manual additive mask
+        idx = paddle.to_tensor(np.array([[4, 4, 3, 2]], "int32"))
+        out2 = F.flash_attention_with_sparse_mask(
+            q, q, q, attn_mask_start_row_indices=idx)
+        causal = np.tril(np.ones((4, 4), bool))
+        keep = causal[None] & (np.arange(4)[None, :, None]
+                               < idx.numpy()[:, None, :])
+        mask = np.where(keep, 0.0, -1e30).astype("float32")[:, None]
+        ref2 = F.scaled_dot_product_attention(
+            q, q, q, paddle.to_tensor(mask), 0.0, False, True)
+        np.testing.assert_allclose(out2.numpy(), ref2.numpy(),
+                                   rtol=2e-5, atol=1e-5)
+
+    def test_rnnt_fastemit_scales_grads_not_value(self):
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        lg = rng.randn(2, 4, 3, 5).astype("float32")
+        lb = rng.randint(1, 5, (2, 2)).astype("int32")
+        tl = paddle.to_tensor(np.array([4, 4], "int32"))
+        ul = paddle.to_tensor(np.array([2, 2], "int32"))
+
+        def run(lam):
+            x = paddle.to_tensor(lg)
+            x.stop_gradient = False
+            loss = F.rnnt_loss(x, paddle.to_tensor(lb), tl, ul,
+                               fastemit_lambda=lam)
+            loss.backward()
+            return float(loss._value), x.grad.numpy()
+        v0, g0 = run(0.0)
+        v1, g1 = run(0.5)
+        # warprnnt semantics: emit-branch cotangents scale, value doesn't
+        assert abs(v0 - v1) < 1e-6
+        assert np.abs(g0 - g1).max() > 1e-3
+
+    def test_rnnt_rejects_bad_lengths(self):
+        import paddle_tpu.nn.functional as F
+        lg = paddle.to_tensor(np.zeros((1, 4, 3, 5), "float32"))
+        lb = paddle.to_tensor(np.ones((1, 2), "int32"))
+        with pytest.raises(ValueError):
+            F.rnnt_loss(lg, lb, paddle.to_tensor(np.array([5], "int32")),
+                        paddle.to_tensor(np.array([2], "int32")))
+        with pytest.raises(ValueError):
+            F.rnnt_loss(lg, lb, paddle.to_tensor(np.array([4], "int32")),
+                        paddle.to_tensor(np.array([3], "int32")))
+
+    def test_embedding_bag_rejects_2d_with_offsets(self):
+        import paddle_tpu.nn.functional as F
+        with pytest.raises(ValueError):
+            F.embedding_bag(
+                paddle.to_tensor(np.ones((2, 2), "int32")),
+                paddle.to_tensor(np.ones((5, 2), "float32")),
+                offsets=paddle.to_tensor(np.array([0], "int32")))
