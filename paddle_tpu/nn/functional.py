@@ -2093,15 +2093,18 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
     lengths per sample."""
     # concrete-length validation (skipped under tracing): out-of-range
     # lengths would silently clamp the final gather cell
+    tlv = ulv = None
     try:
         tlv = np.asarray(logit_lengths._value if hasattr(
             logit_lengths, "_value") else logit_lengths)
         ulv = np.asarray(label_lengths._value if hasattr(
             label_lengths, "_value") else label_lengths)
-        Tmax = (logits._value if hasattr(logits, "_value")
-                else logits).shape[1]
-        Umax = (logits._value if hasattr(logits, "_value")
-                else logits).shape[2] - 1
+    except (TypeError, AttributeError, jax.errors.TracerArrayConversionError):
+        pass                              # tracers: checked shapes only
+    if tlv is not None and tlv.size and ulv is not None and ulv.size:
+        shp = (logits._value if hasattr(logits, "_value")
+               else logits).shape
+        Tmax, Umax = shp[1], shp[2] - 1
         if tlv.max() > Tmax or tlv.min() < 1:
             raise ValueError(
                 f"rnnt_loss: logit_lengths must be in [1, {Tmax}], "
@@ -2110,12 +2113,6 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
             raise ValueError(
                 f"rnnt_loss: label_lengths must be in [0, {Umax}], "
                 f"got max {ulv.max()}")
-    except (TypeError, AttributeError):
-        pass
-    except Exception as e:
-        if isinstance(e, ValueError):
-            raise
-        pass
 
     def f(lg, lb, tl, ul):
         lp = jax.nn.log_softmax(lg, axis=-1)
@@ -2205,7 +2202,11 @@ def embedding_bag(input, weight, offsets=None, mode="mean", name=None):
                                     num_segments=nseg)
             return s / jnp.maximum(n, 1)[:, None]
         if mode == "max":
-            return jax.ops.segment_max(rows, seg, num_segments=nseg)
+            m = jax.ops.segment_max(rows, seg, num_segments=nseg)
+            n = jax.ops.segment_sum(jnp.ones_like(seg), seg,
+                                    num_segments=nseg)
+            # empty bags are 0, not -inf (torch/paddle convention)
+            return jnp.where((n > 0)[:, None], m, 0.0)
         raise ValueError(f"embedding_bag mode {mode!r}")
     if offsets is None:
         return apply_op(f, input, weight)
@@ -2219,6 +2220,17 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
     ``head_weight``: (in, cutoffs[0] + n_clusters); ``tail_weights``:
     list of [(in, hsz), (hsz, osz)] projection pairs per cluster.
     Returns (per-sample log-prob of the target, mean nll loss)."""
+    try:
+        yv = np.asarray(label._value if hasattr(label, "_value")
+                        else label)
+    except (TypeError, AttributeError):
+        yv = None
+    if yv is not None and yv.size and (
+            yv.min() < 0 or yv.max() >= cutoffs[-1]):
+        raise ValueError(
+            f"adaptive_log_softmax_with_loss: labels must be in "
+            f"[0, {cutoffs[-1] - 1}], got [{yv.min()}, {yv.max()}]")
+
     def f(x, y, hw, *flat):
         hb = flat[-1] if head_bias is not None else None
         tw = flat[:len(flat) - (1 if head_bias is not None else 0)]
@@ -2303,6 +2315,11 @@ def flash_attention_with_sparse_mask(query, key, value,
     if attn_mask_start_row_indices is None:
         return scaled_dot_product_attention(
             query, key, value, None, dropout_p, is_causal, True)
+    if not is_causal:
+        raise ValueError(
+            "flash_attention_with_sparse_mask: start-row sparse masks "
+            "are defined on top of the causal mask (the reference "
+            "contract); is_causal=False is not meaningful here")
     mask = apply_op(lambda q, i: build(q, i), query,
                     attn_mask_start_row_indices)
     return scaled_dot_product_attention(

@@ -251,3 +251,34 @@ def transpose(x, perm, name=None):
 # sparse.nn must import after the containers above (it depends on them)
 from . import nn                                            # noqa: E402
 __all__ += ["nn"]
+
+
+def _tensor_to_sparse_coo(self, sparse_dim=None):
+    """Dense Tensor -> SparseCooTensor (reference:
+    paddle.Tensor.to_sparse_coo — verify). ``sparse_dim`` defaults to
+    the tensor's rank (every dim sparse, matching the reference)."""
+    import numpy as np
+    v = np.asarray(self._value)
+    nd = sparse_dim if sparse_dim is not None else v.ndim
+    if nd != v.ndim:
+        raise NotImplementedError(
+            "to_sparse_coo with sparse_dim < ndim (hybrid tensors) is "
+            "unsupported")
+    idx = np.stack(np.nonzero(v))
+    return sparse_coo_tensor(idx, v[tuple(idx)], shape=v.shape)
+
+
+def _tensor_to_sparse_csr(self):
+    """Dense 2-D Tensor -> SparseCsrTensor (reference:
+    paddle.Tensor.to_sparse_csr — verify)."""
+    return _tensor_to_sparse_coo(self).to_sparse_csr()
+
+
+def _attach_tensor_methods():
+    from ..tensor import Tensor
+    if not hasattr(Tensor, "to_sparse_coo"):
+        Tensor.to_sparse_coo = _tensor_to_sparse_coo
+        Tensor.to_sparse_csr = _tensor_to_sparse_csr
+
+
+_attach_tensor_methods()

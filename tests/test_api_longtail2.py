@@ -877,3 +877,11 @@ class TestSpeechAndSamplingOps:
                 paddle.to_tensor(np.ones((2, 2), "int32")),
                 paddle.to_tensor(np.ones((5, 2), "float32")),
                 offsets=paddle.to_tensor(np.array([0], "int32")))
+
+    def test_tensor_to_sparse_conversions(self):
+        x = paddle.to_tensor(np.array([[0., 2.], [3., 0.]], "float32"))
+        s = x.to_sparse_coo()
+        np.testing.assert_allclose(s.to_dense().numpy(), x.numpy())
+        c = x.to_sparse_csr()
+        assert c.is_sparse_csr()
+        np.testing.assert_allclose(c.to_dense().numpy(), x.numpy())
