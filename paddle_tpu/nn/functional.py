@@ -2093,17 +2093,13 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
     lengths per sample."""
     # concrete-length validation (skipped under tracing): out-of-range
     # lengths would silently clamp the final gather cell
-    tlv = ulv = None
-    try:
-        tlv = np.asarray(logit_lengths._value if hasattr(
-            logit_lengths, "_value") else logit_lengths)
-        ulv = np.asarray(label_lengths._value if hasattr(
-            label_lengths, "_value") else label_lengths)
-    except (TypeError, AttributeError, jax.errors.TracerArrayConversionError):
-        pass                              # tracers: checked shapes only
-    if tlv is not None and tlv.size and ulv is not None and ulv.size:
-        shp = (logits._value if hasattr(logits, "_value")
-               else logits).shape
+    from ..tensor import concrete_or_none
+    tlv = concrete_or_none(logit_lengths)
+    ulv = concrete_or_none(label_lengths)
+    shp = getattr(logits._value if hasattr(logits, "_value")
+                  else logits, "shape", None)
+    if tlv is not None and tlv.size and ulv is not None and ulv.size \
+            and shp is not None:
         Tmax, Umax = shp[1], shp[2] - 1
         if tlv.max() > Tmax or tlv.min() < 1:
             raise ValueError(
@@ -2220,11 +2216,8 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
     ``head_weight``: (in, cutoffs[0] + n_clusters); ``tail_weights``:
     list of [(in, hsz), (hsz, osz)] projection pairs per cluster.
     Returns (per-sample log-prob of the target, mean nll loss)."""
-    try:
-        yv = np.asarray(label._value if hasattr(label, "_value")
-                        else label)
-    except (TypeError, AttributeError):
-        yv = None
+    from ..tensor import concrete_or_none
+    yv = concrete_or_none(label)
     if yv is not None and yv.size and (
             yv.min() < 0 or yv.max() >= cutoffs[-1]):
         raise ValueError(

@@ -257,7 +257,6 @@ def _tensor_to_sparse_coo(self, sparse_dim=None):
     """Dense Tensor -> SparseCooTensor (reference:
     paddle.Tensor.to_sparse_coo — verify). ``sparse_dim`` defaults to
     the tensor's rank (every dim sparse, matching the reference)."""
-    import numpy as np
     v = np.asarray(self._value)
     nd = sparse_dim if sparse_dim is not None else v.ndim
     if nd != v.ndim:

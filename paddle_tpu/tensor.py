@@ -25,7 +25,8 @@ import numpy as np
 from . import framework
 from .framework import convert_dtype
 
-__all__ = ["Tensor", "Parameter", "to_tensor", "apply_op", "reset_tape"]
+__all__ = ["Tensor", "Parameter", "to_tensor", "apply_op",
+           "reset_tape", "concrete_or_none"]
 
 
 # ---------------------------------------------------------------------------
@@ -589,6 +590,15 @@ def _run_op_hook(fn, result):
         return
     outs = result if isinstance(result, (tuple, list)) else [result]
     hook(fn, [o for o in outs if isinstance(o, Tensor)])
+
+
+def concrete_or_none(x):
+    """np.ndarray of ``x``'s value when it is concrete, else None (the
+    uniform tracer-skip contract for eager-only validation checks)."""
+    try:
+        return np.asarray(x._value if isinstance(x, Tensor) else x)
+    except (TypeError, AttributeError):
+        return None
 
 
 def make_inplace(op, name=None):

@@ -1,0 +1,118 @@
+"""Differential eager-vs-compiled fuzzing: random small models train a
+few steps twice — once op-by-op on the eager tape, once through
+``jit.TrainStep`` (the functionalized one-program path) — and the loss
+trajectories and final parameters must agree. This probes the
+imperative-over-functional seam (SURVEY §7 hard part #1): state
+threading, RNG threading, buffer updates, optimizer slot handling.
+(reference analogue: dygraph↔static parity tests, test/dygraph_to_static
+— verify)"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.jit import TrainStep
+
+
+def _build(rng):
+    """Random small model + matching input shape."""
+    arch = rng.randint(4)
+    if arch == 0:                                   # MLP
+        width = int(rng.choice([8, 16]))
+        layers = [nn.Linear(6, width), nn.Tanh()]
+        for _ in range(rng.randint(1, 3)):
+            layers += [nn.Linear(width, width),
+                       nn.ReLU() if rng.rand() < 0.5 else nn.GELU()]
+        layers += [nn.Linear(width, 3)]
+        return nn.Sequential(*layers), (4, 6)
+    if arch == 1:                                   # conv stack
+        ch = int(rng.choice([4, 8]))
+        return nn.Sequential(
+            nn.Conv2D(3, ch, 3, padding=1), nn.ReLU(),
+            nn.BatchNorm2D(ch),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Linear(ch * 16, 3)), (2, 3, 8, 8)
+    if arch == 2:                                   # norm-heavy MLP
+        return nn.Sequential(
+            nn.Linear(6, 12), nn.LayerNorm([12]), nn.Silu(),
+            nn.Linear(12, 3)), (4, 6)
+    emb_like = nn.Sequential(                        # residual-ish
+        nn.Linear(6, 12), nn.Hardswish(), nn.Linear(12, 12),
+        nn.Softshrink(), nn.Linear(12, 3))
+    return emb_like, (4, 6)
+
+
+def _mk_opt(rng, params):
+    kind = rng.randint(3)
+    if kind == 0:
+        return optimizer.SGD(learning_rate=0.05, parameters=params)
+    if kind == 1:
+        return optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                  parameters=params)
+    return optimizer.AdamW(learning_rate=0.01, weight_decay=0.01,
+                           parameters=params)
+
+
+def _loss_fn(m, batch):
+    x, y = batch
+    out = m(x)
+    return ((out - y) ** 2).mean()
+
+
+class TestEagerVsCompiled:
+    @pytest.mark.parametrize("seed", list(range(10)))
+    def test_trajectories_match(self, seed):
+        rng = np.random.RandomState(seed)
+        xshape = None
+        paddle.seed(seed)
+        model_e, xshape = _build(rng)
+        # identical twin for the compiled run (same init: reseed)
+        paddle.seed(seed)
+        rng2 = np.random.RandomState(seed)
+        model_c, _ = _build(rng2)
+        for (n1, p1), (n2, p2) in zip(model_e.named_parameters(),
+                                      model_c.named_parameters()):
+            np.testing.assert_array_equal(
+                np.asarray(p1._value), np.asarray(p2._value),
+                err_msg=n1)
+
+        opt_rng = np.random.RandomState(seed + 100)
+        opt_e = _mk_opt(opt_rng, model_e.parameters())
+        opt_c = _mk_opt(np.random.RandomState(seed + 100),
+                        model_c.parameters())
+
+        xs = rng.randn(3, *xshape).astype(np.float32)
+        ys = rng.randn(3, xshape[0], 3).astype(np.float32)
+
+        # dropout-free models: trajectories must match tightly
+        eager_losses = []
+        for i in range(3):
+            batch = (paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i]))
+            loss = _loss_fn(model_e, batch)
+            loss.backward()
+            opt_e.step()
+            opt_e.clear_grad()
+            eager_losses.append(float(loss._value))
+
+        step = TrainStep(model_c, _loss_fn, opt_c)
+        compiled_losses = []
+        for i in range(3):
+            batch = (paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i]))
+            compiled_losses.append(float(step(batch)._value))
+
+        np.testing.assert_allclose(compiled_losses, eager_losses,
+                                   rtol=2e-4, atol=2e-5)
+        for (n1, p1), (_, p2) in zip(model_e.named_parameters(),
+                                     model_c.named_parameters()):
+            np.testing.assert_allclose(
+                np.asarray(p1._value), np.asarray(p2._value),
+                rtol=2e-3, atol=2e-4,
+                err_msg=f"param {n1} diverged (seed {seed})")
+        # buffers too (BatchNorm running stats must thread through)
+        for (n1, b1), (_, b2) in zip(model_e.named_buffers(),
+                                     model_c.named_buffers()):
+            np.testing.assert_allclose(
+                np.asarray(b1._value), np.asarray(b2._value),
+                rtol=2e-3, atol=2e-4,
+                err_msg=f"buffer {n1} diverged (seed {seed})")
