@@ -1,0 +1,145 @@
+"""Differential dy2static fuzzing: a catalog of control-flow shapes
+(tensor if/elif, early returns, while with break/continue, for-range,
+nesting) instantiated with random constants and inputs, run eager vs
+``@to_static`` — values and gradients must match. Complements the
+targeted conversion tests in test_dy2static.py the way the reference's
+dygraph_to_static suite sweeps program shapes (reference:
+test/dygraph_to_static — verify)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+
+
+def prog_if_else(c1, c2):
+    def f(x):
+        if x.sum() > c1:
+            y = x * c2
+        else:
+            y = x + c1
+        return y.mean()
+    return f
+
+
+def prog_early_return(c1, c2):
+    def f(x):
+        if x.sum() > c1:
+            return (x * c2).sum()
+        z = x - c1
+        return z.mean()
+    return f
+
+
+def prog_elif_chain(c1, c2):
+    def f(x):
+        s = x.sum()
+        if s > c1 + 10:
+            out = x * 3.0
+        elif s > c1:
+            out = x * c2
+        elif s > c1 - 10:
+            out = x + c2
+        else:
+            out = -x
+        return out.sum()
+    return f
+
+
+def prog_while_accum(c1, c2):
+    def f(x):
+        total = x.sum() * 0.0
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < c1:
+            total = total + (x * (i + 1.0)).mean()
+            i = i + 1.0
+        return total * c2
+    return f
+
+
+def prog_while_break(c1, c2):
+    def f(x):
+        acc = x.sum() * 0.0
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 8.0:
+            acc = acc + x.mean() * c2
+            if acc > c1:
+                break
+            i = i + 1.0
+        return acc
+    return f
+
+
+def prog_while_continue(c1, c2):
+    def f(x):
+        acc = x.sum() * 0.0
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 6.0:
+            i = i + 1.0
+            if i * 1.0 > c1:
+                continue
+            acc = acc + x.mean() * i
+        return acc * c2
+    return f
+
+
+def prog_nested(c1, c2):
+    def f(x):
+        acc = x.sum() * 0.0
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 4.0:
+            if (x.mean() + i) > c1:
+                acc = acc + x.mean() * c2
+            else:
+                acc = acc - x.mean()
+            i = i + 1.0
+        return acc
+    return f
+
+
+def prog_for_range(c1, c2):
+    def f(x):
+        acc = x.mean() * 0.0
+        for i in range(4):
+            acc = acc + x.mean() * float(i + 1)
+        return acc * c2 + c1
+    return f
+
+
+CATALOG = [prog_if_else, prog_early_return, prog_elif_chain,
+           prog_while_accum, prog_while_break, prog_while_continue,
+           prog_nested, prog_for_range]
+
+
+class TestDy2StaticDifferential:
+    @pytest.mark.parametrize("seed", list(range(16)))
+    def test_value_and_grad_parity(self, seed):
+        rng = np.random.RandomState(seed)
+        maker = CATALOG[seed % len(CATALOG)]
+        c1 = float(np.round(rng.uniform(-2, 4), 2))
+        c2 = float(np.round(rng.uniform(0.5, 2.0), 2))
+        if maker is prog_while_accum:
+            c1 = float(rng.randint(1, 5))
+        fn = maker(c1, c2)
+        sfn = to_static(maker(c1, c2))
+        for trial in range(3):
+            xv = rng.randn(3, 4).astype(np.float32)
+            xe = paddle.to_tensor(xv.copy())
+            xe.stop_gradient = False
+            out_e = fn(xe)
+            out_e.backward()
+            ge = xe.grad.numpy()
+
+            xs = paddle.to_tensor(xv.copy())
+            xs.stop_gradient = False
+            out_s = sfn(xs)
+            out_s.backward()
+            gs = xs.grad.numpy()
+
+            np.testing.assert_allclose(
+                float(out_s._value), float(out_e._value), rtol=2e-5,
+                atol=2e-6,
+                err_msg=f"{maker.__name__} c1={c1} c2={c2} t{trial}")
+            np.testing.assert_allclose(
+                gs, ge, rtol=2e-4, atol=2e-5,
+                err_msg=f"grad {maker.__name__} c1={c1} c2={c2}")
