@@ -1,0 +1,79 @@
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+
+print("imported", flush=True)
+t = paddle.to_tensor(np.array([[1., 2.], [3., 4.]], np.float32))
+print("tensor ok", flush=True)
+x = paddle.to_tensor(np.array([1., 2., 3.], np.float32))
+x.stop_gradient = False
+y = x * 2
+y.exp_()
+z = y.sum()
+z.backward()
+exp = 2 * np.exp(2 * np.array([1., 2., 3.]))
+print("grad ok" if np.allclose(x.grad.numpy(), exp, rtol=1e-5)
+      else ("BAD", x.grad.numpy(), exp), flush=True)
+missing = [m for m in [
+    'acos', 'addmm', 'cholesky', 'diff', 'erfinv', 'mv', 'searchsorted',
+    'slice', 'unflatten', 'exp_', 'tanh_', 'heaviside', 'hypot',
+    'nanquantile', 'trapezoid', 'vander', 'cdist', 'isin', 'positive',
+    'matrix_transpose', 'log_normal_', 'to_sparse_coo', 'to_sparse_csr']
+    if not hasattr(paddle.Tensor, m)]
+print("missing:", missing, flush=True)
+
+# rnnt_loss sanity vs brute force
+import itertools
+import paddle_tpu.nn.functional as F
+
+rng = np.random.RandomState(0)
+B, T, U, V = 2, 4, 2, 5
+logits = rng.randn(B, T, U + 1, V).astype(np.float32)
+labels = np.array([[1, 2], [3, 0]], np.int64)
+tl = np.array([4, 3], np.int64)
+ul = np.array([2, 1], np.int64)
+
+
+def brute(lg, lb, T_, U_):
+    lp = lg - np.log(np.exp(lg).sum(-1, keepdims=True))
+    import functools
+    memo = {}
+
+    def alpha(t, u):
+        if (t, u) in memo:
+            return memo[(t, u)]
+        if t == 0 and u == 0:
+            r = 0.0
+        else:
+            cands = []
+            if t > 0:
+                cands.append(alpha(t - 1, u) + lp[t - 1, u, 0])
+            if u > 0:
+                cands.append(alpha(t, u - 1) + lp[t, u - 1, lb[u - 1]])
+            r = np.logaddexp.reduce(cands)
+        memo[(t, u)] = r
+        return r
+    return -(alpha(T_ - 1, U_) + lp[T_ - 1, U_, 0])
+
+
+expected = np.array([brute(logits[b], labels[b], tl[b], ul[b])
+                     for b in range(B)])
+got = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                  paddle.to_tensor(tl), paddle.to_tensor(ul),
+                  reduction="none").numpy()
+print("rnnt expected", expected, "got", got, flush=True)
+print("rnnt", "OK" if np.allclose(expected, got, atol=1e-4) else "MISMATCH",
+      flush=True)
+
+# embedding_bag 1-D offsets path
+w = rng.randn(10, 3).astype(np.float32)
+ids = np.array([1, 2, 3, 4, 5], np.int64)
+offs = np.array([0, 2, 2, 4], np.int64)   # bag1=[1,2], bag2=[], bag3=[3,4] bag4=[5]
+out = F.embedding_bag(paddle.to_tensor(ids), paddle.to_tensor(w),
+                      paddle.to_tensor(offs), mode="sum").numpy()
+exp_bags = np.stack([w[1] + w[2], np.zeros(3), w[3] + w[4], w[5]])
+print("ebag", "OK" if np.allclose(out, exp_bags, atol=1e-5)
+      else ("MISMATCH", out, exp_bags), flush=True)
