@@ -1,0 +1,213 @@
+"""MoE gate/dispatch/expert time attribution + dispatch-impl A/B on chip.
+
+VERDICT r3 #4 / #2: EP was the one parallelism axis with zero perf
+evidence, and the dispatch hot path had never been timed. This tool
+times, at the ERNIE-MoE base shapes (d=768, ffn=3072, E=8, k=2,
+b16 x s1024 -> 16384 tokens, bf16):
+
+  stages (each its own jitted function, block_until_ready timing):
+    gate      logits matmul + softmax/top-k + aux loss
+    route     dual index-map build (argsort / searchsorted)
+    dispatch  token -> expert-major buffer        (per impl)
+    experts   the two stacked-expert einsums
+    combine   expert-major -> token, gate-weighted (per impl)
+
+  end-to-end (value_and_grad of the full block, the training shape):
+    scatter         r3 path: buf.at[slot].set dispatch
+    gather_jnp      r4 path: all-gather dual-map dispatch (XLA gather)
+    gather_pallas   r4 path with the Pallas scalar-prefetch row kernel
+
+Merged into WORKLOADS_r04.json under "moe_breakdown"; one JSON line per
+measurement so a mid-run wedge keeps earlier points.
+"""
+from __future__ import annotations
+
+import functools
+import json
+import math
+import os
+import time
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "WORKLOADS_r04.json")
+
+
+def _merge(result):
+    try:
+        d = json.load(open(OUT)) if os.path.exists(OUT) else {}
+    except Exception:
+        d = {}
+    d["moe_breakdown"] = result
+    tmp = OUT + ".tmp"
+    json.dump(d, open(tmp, "w"), indent=1)
+    os.replace(tmp, OUT)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    # env alone is too late — sitecustomize pre-imports jax under the
+    # axon platform; force the CPU backend before any device touch
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("PT_JAX_CACHE_DIR",
+                                         "/root/.pt_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
+    on_tpu = jax.devices()[0].platform != "cpu"
+    tiny = not on_tpu
+
+    from paddle_tpu.ops.pallas import moe_dispatch as md
+
+    # ERNIE-MoE base block shapes (bench_workloads.py ernie_moe config)
+    if tiny:
+        t, d, h, e, k = 256, 128, 256, 4, 2
+    else:
+        t, d, h, e, k = 16384, 768, 3072, 8, 2
+    cap = int(math.ceil(1.25 * t * k / e))
+    dt = jnp.bfloat16
+
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(t, d), dtype=dt)
+    wg = jnp.asarray(rs.randn(d, e) * 0.02, dtype=dt)
+    w1 = jnp.asarray(rs.randn(e, d, h) * 0.02, dtype=dt)
+    w2 = jnp.asarray(rs.randn(e, h, d) * 0.02, dtype=dt)
+
+    def gate_fn(x, wg):
+        lg = (x @ wg).astype(jnp.float32)
+        probs = jax.nn.softmax(lg, axis=-1)
+        topv, topi = jax.lax.top_k(probs, k)
+        top1 = jnp.argmax(lg, axis=-1)
+        me = jnp.mean(probs, axis=0)
+        ce = jnp.mean(jax.nn.one_hot(top1, e, dtype=lg.dtype), axis=0)
+        aux = jnp.sum(me * ce) * e
+        topv = topv / (jnp.sum(topv, axis=-1, keepdims=True) + 1e-9)
+        return lg, topi, topv, aux
+
+    def route_fn(topi):
+        # the SAME routing math the shipped layer runs (single source of
+        # truth — a drift here would silently A/B a different algorithm)
+        return md.build_index_maps(topi, e, cap)
+
+    def experts_fn(buf, w1, w2):
+        hdn = jax.nn.gelu(jnp.einsum("ecd,edh->ech",
+                                     buf.reshape(e, cap, d), w1))
+        return jnp.einsum("ech,ehd->ecd", hdn, w2).reshape(e * cap, d)
+
+    def scatter_dispatch(x, slot):
+        tok = jnp.repeat(jnp.arange(t), k)
+        buf = jnp.zeros((e * cap, d), x.dtype)
+        return buf.at[slot].set(x[tok], mode="drop")
+
+    def scatter_combine(flat, gates, slot):
+        out_tk = flat.at[slot].get(mode="fill", fill_value=0)
+        out_tk = out_tk * gates.reshape(-1, 1).astype(flat.dtype)
+        return out_tk.reshape(t, k, d).sum(axis=1)
+
+    result = {"tokens": t, "d_model": d, "d_hidden": h, "experts": e,
+              "top_k": k, "capacity": cap, "dtype": "bfloat16",
+              "chip": os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
+              if on_tpu else "cpu"}
+
+    def timeit(fn, *args, iters=20 if not tiny else 3, warmup=3):
+        c = jax.jit(fn)
+        out = c(*args)
+        for _ in range(warmup - 1):
+            out = c(*args)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = c(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / iters * 1000  # ms
+
+    # ---- stage attribution -------------------------------------------
+    lg, topi, topv, aux = jax.jit(gate_fn)(x, wg)
+    slot, inv, keep = jax.jit(route_fn)(topi)
+    gates = jnp.where(keep.reshape(t, k), topv, 0.0)
+    buf = jax.jit(md.moe_dispatch)(x, inv, slot)
+
+    stages = {}
+    stages["gate_ms"] = round(timeit(gate_fn, x, wg), 3)
+    stages["route_ms"] = round(timeit(route_fn, topi), 3)
+    stages["experts_ms"] = round(timeit(experts_fn, buf, w1, w2), 3)
+    for impl, disp, comb in (
+            ("scatter", scatter_dispatch, None),
+            ("gather_jnp", None, None),
+            ("gather_pallas", None, None)):
+        if impl.startswith("gather"):
+            os.environ["PT_MOE_GATHER"] = impl.split("_")[1]
+            if impl == "gather_pallas" and not md._pallas_ok(d, dt):
+                stages[f"dispatch_{impl}_ms"] = None
+                continue
+            stages[f"dispatch_{impl}_ms"] = round(
+                timeit(lambda xx, ii, ss: md.moe_dispatch(xx, ii, ss),
+                       x, inv, slot), 3)
+            stages[f"combine_{impl}_ms"] = round(
+                timeit(lambda ff, gg, ii, ss:
+                       md.moe_combine(ff, gg, ii, ss),
+                       buf, gates, inv, slot), 3)
+        else:
+            stages[f"dispatch_{impl}_ms"] = round(
+                timeit(disp, x, slot), 3)
+            stages[f"combine_{impl}_ms"] = round(
+                timeit(scatter_combine, buf, gates, slot), 3)
+    os.environ["PT_MOE_GATHER"] = "jnp"
+    result["stages"] = stages
+    _merge(result)
+    print("MOE_STAGES " + json.dumps(stages), flush=True)
+
+    # ---- end-to-end fwd+bwd A/B --------------------------------------
+    def block_loss(params, x, mode):
+        wg, w1, w2 = params
+        lg, topi, topv, aux = gate_fn(x, wg)
+        slot, inv, keep = route_fn(jax.lax.stop_gradient(topi))
+        gates = jnp.where(keep.reshape(t, k), topv, 0.0)
+        if mode == "scatter":
+            buf = scatter_dispatch(x, slot)
+            eo = experts_fn(buf, w1, w2)
+            out = scatter_combine(eo, gates, slot)
+        else:
+            buf = md.moe_dispatch(x, inv, slot)
+            eo = experts_fn(buf, w1, w2)
+            out = md.moe_combine(eo, gates, inv, slot)
+        return (out.astype(jnp.float32) ** 2).mean() + 0.01 * aux
+
+    e2e = {}
+    params = (wg, w1, w2)
+    for mode, impl in (("scatter", "jnp"), ("gather", "jnp"),
+                       ("gather", "pallas")):
+        name = mode if mode == "scatter" else f"gather_{impl}"
+        os.environ["PT_MOE_GATHER"] = impl
+        if impl == "pallas" and not md._pallas_ok(d, dt):
+            e2e[name] = None
+            continue
+        g = functools.partial(jax.value_and_grad(block_loss), mode=mode)
+        try:
+            e2e[name + "_fwdbwd_ms"] = round(timeit(g, params, x), 3)
+        except Exception as ex:
+            e2e[name + "_error"] = f"{type(ex).__name__}: {ex}"[:200]
+        result["e2e"] = e2e
+        _merge(result)
+    os.environ["PT_MOE_GATHER"] = "jnp"
+    print("MOE_E2E " + json.dumps(e2e), flush=True)
+
+    # expert-FLOP utilization of the best end-to-end mode
+    flops = 2 * 2 * e * cap * d * h * 3   # two einsums, fwd+2x bwd
+    best = min((v for kk, v in e2e.items()
+                if kk.endswith("_ms") and v), default=None)
+    if best:
+        result["expert_flops_per_step"] = flops
+        result["best_e2e_ms"] = best
+    _merge(result)
+    print("MOE_BREAKDOWN_DONE " + json.dumps(
+        {"best_e2e_ms": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,7 @@ from .parallel import (init_parallel_env, get_rank, get_world_size,
 from .communication import (all_reduce, all_gather, all_gather_object,
                             reduce_scatter, broadcast, scatter, gather,
                             reduce, alltoall, alltoall_single, send, recv,
+                            global_scatter, global_gather,
                             barrier, new_group, get_group, wait, stream,
                             ReduceOp, P2POp, batch_isend_irecv, irecv, isend)  # noqa
 from .mesh import (HybridCommunicateGroup, get_hybrid_communicate_group,

@@ -37,7 +37,8 @@ from ..tensor import Tensor
 
 __all__ = ["ReduceOp", "Group", "all_reduce", "all_gather",
            "all_gather_object", "reduce_scatter", "broadcast", "scatter",
-           "reduce", "alltoall", "alltoall_single", "send", "recv",
+           "reduce", "alltoall", "alltoall_single", "global_scatter",
+           "global_gather", "send", "recv",
            "barrier", "new_group", "get_group", "destroy_process_group",
            "wait", "stream", "P2POp", "batch_isend_irecv", "isend", "irecv"]
 
@@ -608,6 +609,96 @@ def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
         out_tensor._update_value(res)
         return out_tensor
     return Tensor(res)
+
+
+def global_scatter(x, local_count, global_count, group=None, sync_op=True):
+    """MoE expert exchange (reference parity: paddle.distributed.utils.
+    global_scatter / paddle/fluid/operators/collective/global_scatter_op.*
+    — verify).
+
+    ``x`` rows are grouped by GLOBAL expert id with ``local_count[i]``
+    rows destined for expert ``i`` (experts are owned round-robin-block:
+    rank r owns experts [r*e_per, (r+1)*e_per), e_per = E/nranks). Each
+    rank receives the rows for ITS experts from every rank, ordered
+    (local_expert, src_rank) — the reference's layout.
+
+    Eager control-plane shim over the object-exchange path (variable row
+    counts per destination make this a ragged alltoall). The COMPILED
+    hot path is MoELayer's dual-map gather dispatch, where GSPMD inserts
+    the equivalent all-to-all over the "ep" mesh axis — use that for
+    training steps; this API exists for reference-parity orchestration
+    and tests."""
+    import numpy as np
+    g = group or _world()
+    lc = [int(v) for v in np.asarray(_val(local_count)).reshape(-1)]
+    xv = np.asarray(_val(x))
+    nranks = 1 if (_single_process() and _is_world(group)) else g.nranks
+    if len(lc) % nranks:
+        raise ValueError(
+            f"local_count length {len(lc)} not divisible by world size "
+            f"{nranks}")
+    e_per = len(lc) // nranks
+    # split x into per-global-expert blocks
+    offs = np.cumsum([0] + lc)
+    if offs[-1] != xv.shape[0]:
+        raise ValueError(
+            f"sum(local_count)={offs[-1]} != rows of x {xv.shape[0]}")
+    blocks = [xv[offs[i]:offs[i + 1]] for i in range(len(lc))]
+    if nranks == 1:
+        return Tensor(jnp.asarray(np.concatenate(blocks)
+                                  if blocks else xv))
+    gathered = []
+    all_gather_object(gathered, blocks, group=g)
+    me = g.rank if not _is_world(g) else _my_rank()
+    out = []
+    for i_local in range(e_per):
+        for r in range(nranks):
+            out.append(gathered[r][me * e_per + i_local])
+    res = np.concatenate(out) if out else xv[:0]
+    return Tensor(jnp.asarray(res))
+
+
+def global_gather(x, local_count, global_count, group=None, sync_op=True):
+    """Inverse of :func:`global_scatter` (reference parity:
+    global_gather_op.* — verify): rows grouped (local_expert, src_rank)
+    with ``global_count[i_local*nranks + r]`` rows from rank ``r`` are
+    returned to their source ranks, restoring the sender's
+    global-expert-id grouping described by ``local_count``."""
+    import numpy as np
+    g = group or _world()
+    gc = [int(v) for v in np.asarray(_val(global_count)).reshape(-1)]
+    lc = [int(v) for v in np.asarray(_val(local_count)).reshape(-1)]
+    xv = np.asarray(_val(x))
+    nranks = 1 if (_single_process() and _is_world(group)) else g.nranks
+    if len(lc) != len(gc):
+        raise ValueError(
+            f"local_count length {len(lc)} != global_count length "
+            f"{len(gc)} (both must cover all E experts)")
+    if len(gc) % nranks:
+        raise ValueError(
+            f"global_count length {len(gc)} not divisible by world size "
+            f"{nranks}")
+    e_per = len(gc) // nranks
+    offs = np.cumsum([0] + gc)
+    if offs[-1] != xv.shape[0]:
+        raise ValueError(
+            f"sum(global_count)={offs[-1]} != rows of x {xv.shape[0]}")
+    # block (i_local, r) = rows received from rank r for my expert i_local
+    blocks = [xv[offs[i]:offs[i + 1]] for i in range(len(gc))]
+    if nranks == 1:
+        return Tensor(jnp.asarray(np.concatenate(blocks)
+                                  if blocks else xv))
+    gathered = []
+    all_gather_object(gathered, blocks, group=g)
+    me = g.rank if not _is_world(g) else _my_rank()
+    # my original send order: for each global expert i (owner o, slot
+    # i_local), my block sits at position (i_local, me) in o's buffer
+    out = []
+    for i in range(len(lc)):
+        owner, i_local = divmod(i, e_per)
+        out.append(gathered[owner][i_local * nranks + me])
+    res = np.concatenate(out) if out else xv[:0]
+    return Tensor(jnp.asarray(res))
 
 
 def barrier(group=None):

@@ -33,13 +33,31 @@ def init_parallel_env():
         return ParallelEnv()
     n = int(os.environ.get("PADDLE_TRAINERS_NUM",
                            os.environ.get("JAX_NUM_PROCESSES", "1")))
-    if n > 1 and jax.process_count() == 1:
+    # probe the coordination client WITHOUT touching the backend:
+    # jax.process_count() would initialize XLA and make the subsequent
+    # jax.distributed.initialize() unconditionally raise (found by the
+    # process-level golden test — tests/test_process_golden.py)
+    try:
+        from jax._src import distributed as _jdist
+        already = getattr(_jdist.global_state, "client", None) is not None
+    except Exception:
+        already = False   # probe unavailable: let initialize() decide
+    if n > 1 and not already:
         coord = os.environ.get("PADDLE_MASTER",
                                os.environ.get("JAX_COORDINATOR_ADDRESS"))
         pid = int(os.environ.get("PADDLE_TRAINER_ID",
                                  os.environ.get("JAX_PROCESS_ID", "0")))
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=n, process_id=pid)
+        try:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=n, process_id=pid)
+        except RuntimeError as e:
+            # double init, or backend already up in a process that never
+            # needed the coordination service — don't take down a job
+            # that may still work via the store transport
+            msg = str(e).lower()
+            if ("already" not in msg
+                    and "must be called before" not in msg):
+                raise
     _INITIALIZED = True
     return ParallelEnv()
 

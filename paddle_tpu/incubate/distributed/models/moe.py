@@ -117,19 +117,33 @@ class MoELayer(Layer):
 
     forward(x: (b, s, d)) -> (b, s, d); aux loss on self.l_aux.
 
-    TPU-native dispatch (VERDICT r1 #3): sort-based capacity routing —
-    argsort token→expert assignments, position-within-expert from segment
-    starts, one scatter into an (E·cap, d) buffer, experts module applied
-    to the (E, cap, d) batch, one gather + gate-weighted combine back.
-    Memory is O(T·d + E·cap·d) — no dense (E, cap, T) one-hots.  Under jit
-    with expert weights sharded over the "ep" mesh axis GSPMD partitions
-    the expert batch over experts and inserts the token all-to-all the
+    TPU-native dispatch (r4, VERDICT r3 #4): sort-based capacity routing
+    builds DUAL index maps (token→slot and slot→token sentinel-padded,
+    ops/pallas/moe_dispatch.build_index_maps); dispatch, combine, and
+    both their custom-vjp backwards are then pure row-GATHERS — no
+    scatter HLO anywhere in the compiled step (scatters serialize on
+    TPU). `dispatch_mode="scatter"` keeps the r3 buf.at[slot].set path
+    as the parity reference; PT_MOE_GATHER=pallas routes the gathers
+    through the Pallas scalar-prefetch row kernel. Memory is
+    O(T·d + E·cap·d) — no dense (E, cap, T) one-hots. Under jit with
+    expert weights sharded over the "ep" mesh axis GSPMD partitions the
+    expert batch over experts and inserts the token all-to-all the
     reference's global_scatter/global_gather implement by hand."""
 
     def __init__(self, d_model, experts=None, gate=None, num_expert=None,
                  d_hidden=None, top_k=2, capacity_factor=1.25,
-                 expert_axis=None, recompute_interval=0, group=None):
+                 expert_axis=None, recompute_interval=0, group=None,
+                 dispatch_mode=None):
         super().__init__()
+        # "gather" (default): dispatch/combine AND both their vjps are
+        # row-gathers over the dual slot<->token index maps — no scatter
+        # HLO anywhere (scatters serialize on TPU). "scatter" keeps the
+        # r3 buf.at[slot].set path as the parity reference.
+        # PT_MOE_GATHER=pallas additionally routes the gathers through
+        # the Pallas scalar-prefetch kernel (ops/pallas/moe_dispatch).
+        import os
+        self.dispatch_mode = (dispatch_mode
+                              or os.environ.get("PT_MOE_DISPATCH", "gather"))
         if gate is None:
             gate = GShardGate(d_model, num_expert, topk=top_k,
                               capacity_factor=capacity_factor)
@@ -166,22 +180,18 @@ class MoELayer(Layer):
         self.l_aux = l_aux
 
         # 1) routing: pure integer work on DETACHED logits (indices carry
-        #    no gradient; detaching keeps int outputs off the vjp tape)
+        #    no gradient; detaching keeps int outputs off the vjp tape).
+        #    build_index_maps produces BOTH maps: token-major `slot` and
+        #    expert-major `inv` — the dual maps are what let dispatch/
+        #    combine and their vjps all be gathers (moe_dispatch.py).
+        from ....ops.pallas.moe_dispatch import build_index_maps
+
         def route(lg):
             _, topi = jax.lax.top_k(lg.astype(jnp.float32), k)  # (T, K)
-            flat_e = topi.reshape(-1)                       # (N,) N = T*K
-            sidx = jnp.argsort(flat_e)                      # stable
-            se = flat_e[sidx]
-            starts = jnp.searchsorted(se, jnp.arange(e))    # (E,)
-            pos_sorted = jnp.arange(se.shape[0]) - starts[se]
-            pos = jnp.zeros_like(flat_e).at[sidx].set(pos_sorted)
-            keep = pos < cap                                # (N,) bool
-            # slot in the flat (E*cap) expert buffer; dropped tokens get
-            # the out-of-range slot E*cap (scatter mode='drop' skips it)
-            slot = jnp.where(keep, flat_e * cap + pos, e * cap)
-            return topi, slot.astype(jnp.int32), keep
+            slot, inv, keep = build_index_maps(topi, e, cap)
+            return topi, slot, keep, inv
 
-        topi, slot, keep = apply_op(route, logits.detach())
+        topi, slot, keep, inv = apply_op(route, logits.detach())
 
         # 2) gate weights: differentiable in logits
         def gate_weights(lg, ti, kp):
@@ -192,27 +202,42 @@ class MoELayer(Layer):
 
         gates = apply_op(gate_weights, logits, topi, keep)
 
-        # 3) dispatch: one scatter into the expert batch
-        def dispatch(xv, sl):
-            tok = jnp.repeat(jnp.arange(tokens), k)         # (N,)
-            buf = jnp.zeros((e * cap, xv.shape[-1]), xv.dtype)
-            buf = buf.at[sl].set(xv[tok], mode="drop")
-            return buf.reshape(e, cap, xv.shape[-1])
+        if self.dispatch_mode == "scatter":
+            # r3 parity path: scatter-based dispatch (slow on TPU — the
+            # scatter HLO serializes, and autodiff transposes the combine
+            # gather back into a scatter-add)
+            def dispatch(xv, sl):
+                tok = jnp.repeat(jnp.arange(tokens), k)     # (N,)
+                buf = jnp.zeros((e * cap, xv.shape[-1]), xv.dtype)
+                buf = buf.at[sl].set(xv[tok], mode="drop")
+                return buf.reshape(e, cap, xv.shape[-1])
 
-        expert_in = apply_op(dispatch, xt, slot)
+            expert_in = apply_op(dispatch, xt, slot)
+            expert_out = self.experts(expert_in)
+
+            def combine(eo, g, sl):
+                flat = eo.reshape(e * cap, eo.shape[-1])
+                out_tk = flat.at[sl].get(mode="fill", fill_value=0)
+                out_tk = out_tk * g.reshape(-1, 1).astype(flat.dtype)
+                return jnp.sum(
+                    out_tk.reshape(tokens, k, eo.shape[-1]), axis=1)
+
+            out = apply_op(combine, expert_out, gates, slot)
+            return reshape(out, (b, s, d))
+
+        # 3) dispatch: expert-major row-gather via the inverse map;
+        #    custom vjp keeps the backward a gather too
+        from ....ops.pallas.moe_dispatch import moe_combine, moe_dispatch
+        buf = apply_op(moe_dispatch, xt, inv, slot)         # (E*cap, d)
+        expert_in = reshape(buf, (e, cap, d))
 
         # 4) the experts module — custom modules and their activation run
         #    exactly as given (E, cap, d) -> (E, cap, d)
         expert_out = self.experts(expert_in)
 
-        # 5) combine: gather each token's expert outputs, gate-weight, sum
-        def combine(eo, g, sl):
-            flat = eo.reshape(e * cap, eo.shape[-1])
-            out_tk = flat.at[sl].get(mode="fill", fill_value=0)  # (N, d)
-            out_tk = out_tk * g.reshape(-1, 1).astype(flat.dtype)
-            return jnp.sum(out_tk.reshape(tokens, k, eo.shape[-1]), axis=1)
-
-        out = apply_op(combine, expert_out, gates, slot)
+        # 5) combine: token-major row-gather + gate-weighted sum
+        flat = reshape(expert_out, (e * cap, d))
+        out = apply_op(moe_combine, flat, gates, inv, slot)
         return reshape(out, (b, s, d))
 
     def forward_dense(self, x):

@@ -1,0 +1,211 @@
+"""MoE token dispatch/combine as pure row-gathers (+ Pallas gather kernel).
+
+Reference parity: the reference routes MoE tokens with dedicated CUDA
+collective ops — global_scatter / global_gather
+(paddle/fluid/operators/collective/global_scatter_op.* — verify) plus
+host-side capacity binning in incubate/distributed/models/moe.
+
+TPU-native design (SURVEY §7 MoE mapping): XLA lowers `buf.at[idx].set`
+to scatter HLO, which serializes on TPU, and the autodiff transpose of a
+gather is again a scatter-add — so a scatter-based dispatch pays the slow
+path in BOTH directions. Instead the router (moe.py `route`) produces the
+two index maps
+
+    slot : (T*k,)   token-major -> flat expert-buffer slot (sentinel E*cap
+                    for capacity-dropped tokens)
+    inv  : (E*cap,) expert-major slot -> flat token*k+j     (sentinel T*k
+                    for unfilled slots)
+
+and with both maps every data movement in the MoE layer — dispatch
+forward, dispatch backward, combine forward, combine backward (both
+cotangents) — is a row-GATHER with out-of-range masking. No scatter
+appears anywhere in the compiled step.
+
+The gather itself has two implementations, selectable via
+``PT_MOE_GATHER`` (jnp | pallas; A/B'd on chip by moe_breakdown.py):
+  - "jnp":    clip-take-mask; XLA emits a dynamic-gather.
+  - "pallas": scalar-prefetch kernel — the row index feeds the BlockSpec
+    index_map, so each grid step DMAs exactly the source row HBM->VMEM
+    (Mosaic double-buffers the row streams); invalid rows are zeroed
+    in-kernel.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["gather_rows", "moe_dispatch", "moe_combine",
+           "build_index_maps"]
+
+
+def build_index_maps(topi, num_expert: int, capacity: int):
+    """Build the dual token<->slot index maps from top-k expert choices.
+
+    topi: (T, k) int — expert id per (token, choice). Returns
+    (slot, inv, keep):
+      slot : (T*k,) flat (token, choice) -> expert-buffer slot, with the
+             out-of-range sentinel E*cap for capacity-dropped tokens
+      inv  : (E*cap,) expert-buffer slot -> flat token*k+j, with the
+             out-of-range sentinel T*k for unfilled slots
+      keep : (T*k,) bool — not capacity-dropped
+    Pure integer jnp (argsort + searchsorted); call on detached/
+    stop-gradient inputs. Single source of truth for the routing math —
+    MoELayer.forward and moe_breakdown.py both import it.
+    """
+    t, k = topi.shape
+    e, cap = num_expert, capacity
+    n = t * k
+    flat_e = topi.reshape(-1)                       # (N,)
+    sidx = jnp.argsort(flat_e)                      # stable
+    se = flat_e[sidx]
+    starts = jnp.searchsorted(se, jnp.arange(e))    # (E,)
+    pos_sorted = jnp.arange(n) - starts[se]
+    pos = jnp.zeros_like(flat_e).at[sidx].set(pos_sorted)
+    keep = pos < cap                                # (N,) bool
+    slot = jnp.where(keep, flat_e * cap + pos, e * cap)
+    # inverse: slot m = (ee, c) is fed by the (starts[ee]+c)-th entry of
+    # the expert-sorted order, when c < count[ee]
+    ee = jnp.arange(e * cap) // cap
+    c = jnp.arange(e * cap) % cap
+    src = starts[ee] + c
+    ends = jnp.append(starts[1:], n)
+    inv = jnp.where(src < ends[ee], sidx[jnp.clip(src, 0, n - 1)], n)
+    return slot.astype(jnp.int32), inv.astype(jnp.int32), keep
+
+# tests set this to run the Pallas kernel in interpret mode on CPU
+_FORCE_INTERPRET = False
+
+
+def _pallas_ok(d: int, dtype) -> bool:
+    if _FORCE_INTERPRET:
+        return True
+    try:
+        import jax.experimental.pallas  # noqa: F401
+    except Exception:
+        return False
+    return (jax.default_backend() == "tpu" and d % 128 == 0
+            and dtype in (jnp.float32, jnp.bfloat16))
+
+
+def _gather_impl() -> str:
+    return os.environ.get("PT_MOE_GATHER", "jnp")
+
+
+def _gather_rows_jnp(x, idx):
+    t = x.shape[0]
+    safe = jnp.clip(idx, 0, t - 1)
+    out = jnp.take(x, safe, axis=0)
+    valid = ((idx >= 0) & (idx < t))[:, None]
+    return jnp.where(valid, out, jnp.zeros((), x.dtype))
+
+
+def _gather_rows_pallas(x, idx):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t, d = x.shape
+    m = idx.shape[0]
+
+    def kernel(idx_ref, x_ref, out_ref):
+        i = pl.program_id(0)
+        row = idx_ref[i]
+
+        @pl.when((row >= 0) & (row < t))
+        def _copy():
+            out_ref[...] = x_ref[...]
+
+        @pl.when(~((row >= 0) & (row < t)))
+        def _zero():
+            out_ref[...] = jnp.zeros_like(out_ref)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m,),
+        in_specs=[pl.BlockSpec(
+            (1, d), lambda i, idx_ref: (jnp.clip(idx_ref[i], 0, t - 1), 0))],
+        out_specs=pl.BlockSpec((1, d), lambda i, idx_ref: (i, 0)),
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m, d), x.dtype),
+        interpret=_FORCE_INTERPRET,
+    )(idx.astype(jnp.int32), x)
+
+
+def gather_rows(x, idx):
+    """out[i] = x[idx[i]] for in-range idx, else zeros. (rows, d) gather."""
+    if _gather_impl() == "pallas" and _pallas_ok(x.shape[-1], x.dtype):
+        return _gather_rows_pallas(x, idx)
+    return _gather_rows_jnp(x, idx)
+
+
+def _f0(a):
+    return np.zeros(a.shape, jax.dtypes.float0)
+
+
+# ---------------------------------------------------------------- dispatch
+
+@jax.custom_vjp
+def moe_dispatch(x, inv, slot):
+    """(T, d) tokens -> (E*cap, d) expert-major buffer, all-gather form.
+
+    ``inv // k`` maps a slot to its source token; the sentinel T*k divides
+    to T which gather_rows masks to zeros (an unfilled capacity slot).
+    """
+    k = slot.shape[0] // x.shape[0]
+    return gather_rows(x, inv // k)
+
+
+def _dispatch_fwd(x, inv, slot):
+    return moe_dispatch(x, inv, slot), (x.shape[0], inv, slot)
+
+
+def _dispatch_bwd(res, dbuf):
+    t, inv, slot = res
+    k = slot.shape[0] // t
+    # dx[t] = sum_j dbuf[slot[t, j]]; dropped tokens hit the E*cap
+    # sentinel, which gathers as zeros — their gradient contribution is
+    # correctly nothing
+    dx = gather_rows(dbuf, slot).reshape(t, k, -1).sum(axis=1)
+    return dx, _f0(inv), _f0(slot)
+
+
+moe_dispatch.defvjp(_dispatch_fwd, _dispatch_bwd)
+
+
+# ----------------------------------------------------------------- combine
+
+@jax.custom_vjp
+def moe_combine(flat, gates, inv, slot):
+    """(E*cap, d) expert outputs + (T, k) gate weights -> (T, d)."""
+    t, k = gates.shape
+    rows = gather_rows(flat, slot).reshape(t, k, -1)
+    return (rows * gates[..., None].astype(flat.dtype)).sum(axis=1)
+
+
+def _combine_fwd(flat, gates, inv, slot):
+    return moe_combine(flat, gates, inv, slot), (flat, gates, inv, slot)
+
+
+def _combine_bwd(res, dout):
+    flat, gates, inv, slot = res
+    t, k = gates.shape
+    n = t * k
+    # d flat[m] = gates[inv[m]] * dout[token(m)] — expert-major gather
+    gates_flat = gates.reshape(n)
+    gval = jnp.where(inv < n, jnp.take(gates_flat,
+                                       jnp.clip(inv, 0, n - 1)), 0.0)
+    dflat = (gval[:, None].astype(dout.dtype)
+             * gather_rows(dout, inv // k)).astype(flat.dtype)
+    # d gates[t, j] = <dout[t], flat[slot[t, j]]> — recompute the row
+    # gather instead of saving the (T, k, d) rows tensor (memory-lean,
+    # one extra bandwidth pass, mirroring flash-style recompute)
+    rows = gather_rows(flat, slot).reshape(t, k, -1)
+    dgates = (rows.astype(dout.dtype) * dout[:, None, :]).sum(axis=-1)
+    return dflat, dgates.astype(gates.dtype), _f0(inv), _f0(slot)
+
+
+moe_combine.defvjp(_combine_fwd, _combine_bwd)

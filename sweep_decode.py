@@ -1,0 +1,151 @@
+"""Decode serving sweep on the chip (VERDICT r3 #7).
+
+The r3 artifact characterized decode at exactly one operating point
+(b8, greedy, prompt 128, new 128). This sweeps the serving envelope:
+
+    batch {8, 32, 64} x {greedy, top-p 0.9 sampling}  +
+    one ragged LEFT-padded batch (per-row prompt lengths)
+
+on the 0.27B Llama config used by bench.py's config_small, recording
+tokens/s and per-new-token latency for each point, merged into
+`BENCH_TPU_MEASURED_r04.json` under "decode_sweep".
+
+Run only in a healthy tunnel window (tpu_session.sh stage 3):
+
+    python sweep_decode.py
+
+Each point runs in-process (the compiled prefill+decode step is shared
+across points that share shapes; a crash loses only later points since
+the artifact is merged after every point).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "BENCH_TPU_MEASURED_r04.json")
+
+
+def _merge(points, chip):
+    try:
+        d = json.load(open(OUT)) if os.path.exists(OUT) else {}
+    except Exception:
+        d = {}
+    if d.get("chip") not in (None, "v5e") and chip == "v5e":
+        d = {}
+    d.setdefault("chip", chip)
+    d["decode_sweep"] = points
+    tmp = OUT + ".tmp"
+    json.dump(d, open(tmp, "w"), indent=1)
+    os.replace(tmp, OUT)
+
+
+def main():
+    import jax
+    # env alone is too late — sitecustomize pre-imports jax under the
+    # axon platform; force the CPU backend before any device touch
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("PT_JAX_CACHE_DIR",
+                                         "/root/.pt_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+    except Exception:
+        pass
+    chip = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
+    if jax.devices()[0].platform == "cpu":
+        chip = "cpu"
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, llama_tiny_config
+
+    tiny = chip == "cpu"  # smoke mode off-chip
+    if tiny:
+        cfg = llama_tiny_config(tensor_parallel=False)
+        batches, prompt, new = [2], 16, 8
+    else:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=1024,
+            tensor_parallel=False)
+        batches, prompt, new = [8, 32, 64], 128, 128
+
+    paddle.seed(0)
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    model = LlamaForCausalLM(cfg)
+
+    points = []
+
+    def _point(batch, mode, **gen_kwargs):
+        ids = paddle.to_tensor(np.random.randint(
+            0, cfg.vocab_size, (batch, prompt)).astype(np.int32))
+        t_warm0 = time.perf_counter()
+        model.generate(ids, max_new_tokens=new, **gen_kwargs)  # compile
+        warm_s = time.perf_counter() - t_warm0
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=new, **gen_kwargs)
+        assert out.shape[1] == prompt + new
+        dt = time.perf_counter() - t0
+        p = {"batch": batch, "mode": mode, "prompt": prompt,
+             "new_tokens": new,
+             "tokens_per_sec": round(batch * new / dt, 1),
+             "ms_per_token": round(dt / new * 1000, 3),
+             "warmup_compile_s": round(warm_s, 1)}
+        points.append(p)
+        _merge(points, chip)
+        print("DECODE " + json.dumps(p), flush=True)
+
+    for b in batches:
+        try:
+            _point(b, "greedy")
+        except Exception as e:
+            points.append({"batch": b, "mode": "greedy",
+                           "error": f"{type(e).__name__}: {e}"[:300]})
+            _merge(points, chip)
+    for b in batches:
+        try:
+            _point(b, "top_p0.9", do_sample=True, top_p=0.9,
+                   temperature=1.0)
+        except Exception as e:
+            points.append({"batch": b, "mode": "top_p0.9",
+                           "error": f"{type(e).__name__}: {e}"[:300]})
+            _merge(points, chip)
+
+    # ragged LEFT-padded batch: half the rows use a half-length prompt
+    try:
+        b = batches[0]
+        ids_np = np.random.randint(
+            0, cfg.vocab_size, (b, prompt)).astype(np.int32)
+        mask = np.ones((b, prompt), np.int32)
+        mask[: b // 2, : prompt // 2] = 0     # left padding
+        ids_np[: b // 2, : prompt // 2] = 0
+        ids = paddle.to_tensor(ids_np)
+        am = paddle.to_tensor(mask)
+        model.generate(ids, max_new_tokens=new, attention_mask=am)
+        t0 = time.perf_counter()
+        out = model.generate(ids, max_new_tokens=new, attention_mask=am)
+        dt = time.perf_counter() - t0
+        p = {"batch": b, "mode": "ragged_left_padded", "prompt": prompt,
+             "short_rows": b // 2, "short_prompt": prompt // 2,
+             "new_tokens": new,
+             "tokens_per_sec": round(b * new / dt, 1),
+             "ms_per_token": round(dt / new * 1000, 3)}
+        points.append(p)
+        _merge(points, chip)
+        print("DECODE " + json.dumps(p), flush=True)
+    except Exception as e:
+        points.append({"mode": "ragged_left_padded",
+                       "error": f"{type(e).__name__}: {e}"[:300]})
+        _merge(points, chip)
+
+    print("DECODE_SWEEP_DONE " + json.dumps({"points": len(points)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -282,3 +282,53 @@ class TestFusedAllreduceGradients:
         net = nn.Linear(4, 2)
         fused_allreduce_gradients(list(net.parameters()))  # no grads: ok
         assert net.weight.grad is None
+
+
+def test_global_scatter_gather_threads():
+    """MoE expert exchange shims (reference global_scatter/global_gather
+    ops): 2 ranks x 4 experts (2 per rank) with ragged per-expert row
+    counts — verifies the (local_expert, src_rank) receive layout and
+    the exact round trip through global_gather."""
+    e_per = 2
+
+    def make(r):
+        lc = [1, 0, 2, 1] if r == 0 else [2, 1, 0, 1]
+        rows = []
+        for i, c in enumerate(lc):
+            for j in range(c):
+                rows.append([r * 100 + i * 10 + j])
+        return np.asarray(rows, np.float32), lc
+
+    lcs = {r: make(r) for r in (0, 1)}
+
+    def body(g, results, r):
+        x, lc = lcs[r]
+        gc = [lcs[src][1][r * e_per + i_local]
+              for i_local in range(e_per) for src in (0, 1)]
+        y = comm.global_scatter(paddle.to_tensor(x), lc, gc, group=g)
+        back = comm.global_gather(y, lc, gc, group=g)
+        results[r] = (y.numpy(), back.numpy())
+
+    results = _run_group_members(body, gid=120)
+    for r in (0, 1):
+        np.testing.assert_array_equal(results[r][1], lcs[r][0])
+    # rank0 owns experts {0,1}: e0 <- r0:[0], r1:[100,101]; e1 <- r1:[110]
+    np.testing.assert_array_equal(
+        results[0][0].reshape(-1), [0.0, 100.0, 101.0, 110.0])
+    # rank1 owns experts {2,3}: e2 <- r0:[20,21]; e3 <- r0:[30], r1:[130]
+    np.testing.assert_array_equal(
+        results[1][0].reshape(-1), [20.0, 21.0, 30.0, 130.0])
+
+
+def test_global_scatter_single_process_world():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+    y = comm.global_scatter(x, [1, 2], [1, 2])
+    np.testing.assert_array_equal(y.numpy(), x.numpy())
+    z = comm.global_gather(y, [1, 2], [1, 2])
+    np.testing.assert_array_equal(z.numpy(), x.numpy())
+
+
+def test_global_scatter_count_mismatch_raises():
+    x = paddle.to_tensor(np.zeros((3, 2), np.float32))
+    with pytest.raises(ValueError):
+        comm.global_scatter(x, [1, 1], [1, 1])  # sum != rows

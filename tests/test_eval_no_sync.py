@@ -1,0 +1,143 @@
+"""Regression guard (VERDICT r3 weak #2 / next #6): no per-batch
+device→host sync inside any fit/evaluate inner loop.
+
+The defect pattern is `float(loss.item())` per batch — each call blocks
+on the device and defeats XLA async dispatch. These tests count host
+syncs (Tensor.item + jax.device_get calls) while driving the loops with
+N batches and assert the count does NOT scale with N.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+class _SyncCounter:
+    """Counts Tensor.item() and jax.device_get() invocations."""
+
+    def __init__(self, monkeypatch):
+        self.items = 0
+        self.gets = 0
+        from paddle_tpu.tensor import Tensor
+        orig_item = Tensor.item
+
+        def counting_item(t):
+            self.items += 1
+            return orig_item(t)
+
+        monkeypatch.setattr(Tensor, "item", counting_item)
+        import jax
+        orig_get = jax.device_get
+
+        def counting_get(x):
+            self.gets += 1
+            return orig_get(x)
+
+        monkeypatch.setattr(jax, "device_get", counting_get)
+
+    @property
+    def total(self):
+        return self.items + self.gets
+
+
+def _net():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+
+
+class DS(paddle.io.Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rs = np.random.RandomState(i)
+        return (rs.rand(8).astype("float32"),
+                rs.rand(2).astype("float32"))
+
+
+N_BATCHES = 8  # 32 samples / batch 4
+
+
+def _mse(o, y):
+    return ((o - y) ** 2).mean()
+
+
+class TestEngineNoSync:
+    def test_evaluate_syncs_once(self, monkeypatch):
+        from paddle_tpu.distributed.auto_parallel_api import Engine
+        net = _net()
+        eng = Engine(net, loss=_mse,
+                     optimizer=optimizer.SGD(
+                         learning_rate=0.1, parameters=net.parameters()))
+        ctr = _SyncCounter(monkeypatch)
+        res = eng.evaluate(DS(32), batch_size=4)
+        assert np.isfinite(res["loss"])
+        assert ctr.total < N_BATCHES, (
+            f"evaluate performed {ctr.total} host syncs for "
+            f"{N_BATCHES} batches — per-batch sync is back")
+
+    def test_fit_syncs_once_per_epoch(self, monkeypatch):
+        from paddle_tpu.distributed.auto_parallel_api import Engine
+        net = _net()
+        eng = Engine(net, loss=_mse,
+                     optimizer=optimizer.SGD(
+                         learning_rate=0.1, parameters=net.parameters()))
+        ctr = _SyncCounter(monkeypatch)
+        eng.fit(DS(32), epochs=1, batch_size=4, verbose=0)
+        assert ctr.total < N_BATCHES
+
+    def test_predict_no_sync(self, monkeypatch):
+        from paddle_tpu.distributed.auto_parallel_api import Engine
+        net = _net()
+        eng = Engine(net, loss=_mse,
+                     optimizer=optimizer.SGD(
+                         learning_rate=0.1, parameters=net.parameters()))
+        ctr = _SyncCounter(monkeypatch)
+        outs = eng.predict(DS(32), batch_size=4)
+        assert len(outs) == N_BATCHES
+        assert ctr.total == 0
+
+
+class TestHapiNoSync:
+    def test_evaluate_syncs_once(self, monkeypatch):
+        from paddle_tpu.hapi import Model
+        m = Model(_net())
+        m.prepare(optimizer=optimizer.SGD(
+            learning_rate=0.1, parameters=m.parameters()),
+            loss=nn.MSELoss())
+        ctr = _SyncCounter(monkeypatch)
+        res = m.evaluate(DS(32), batch_size=4, verbose=0)
+        assert np.isfinite(res["loss"][0])
+        assert ctr.total < N_BATCHES
+
+    def test_fit_fast_path_syncs_once(self, monkeypatch):
+        from paddle_tpu.hapi import Model
+        m = Model(_net())
+        m.prepare(optimizer=optimizer.SGD(
+            learning_rate=0.1, parameters=m.parameters()),
+            loss=nn.MSELoss())
+        ctr = _SyncCounter(monkeypatch)
+        m.fit(DS(32), batch_size=4, epochs=1, verbose=0, log_freq=100)
+        assert ctr.total < N_BATCHES
+
+    def test_custom_eval_batch_still_honored(self, monkeypatch):
+        """Subclass overrides keep their per-batch contract."""
+        from paddle_tpu.hapi import Model
+        calls = []
+
+        class MyModel(Model):
+            def eval_batch(self, inputs, labels=None):
+                calls.append(1)
+                return super(MyModel, self).eval_batch(inputs, labels)
+
+        m = MyModel(_net())
+        m.prepare(optimizer=optimizer.SGD(
+            learning_rate=0.1, parameters=m.parameters()),
+            loss=nn.MSELoss())
+        res = m.evaluate(DS(32), batch_size=4, verbose=0)
+        assert len(calls) == N_BATCHES
+        assert np.isfinite(res["loss"][0])

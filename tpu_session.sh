@@ -5,17 +5,22 @@
 #   bash tpu_session.sh
 # Priority order (each stage survives a later wedge; bench and the
 # workloads runner write partial artifacts after every completed stage):
-#   1. headline bench                  -> BENCH_TPU_MEASURED_r03.json
-#      (stage order inside: small -> ~1B big -> decode; long deadline so
-#       the big-config compile isn't deadline-killed mid-flight, and a
+#   1. headline bench                  -> BENCH_TPU_MEASURED_r04.json
+#      (stage order inside: tiny liveness stamp -> small -> ~1B big
+#       [run_steps scan dispatch] -> selective-remat probe -> decode;
 #       persistent compile cache so a repeat run skips the compiles)
-#   2. non-Llama BASELINE workloads    -> WORKLOADS_r03.json
-#   3. profile re-capture (attribution after kernel tuning)
-#   4. on-chip kernel validation tests
-# (the flash block sweep already produced FLASH_BLOCKS_r03.json; rerun
-#  sweep_flash_blocks.py manually if the kernel set changes)
+#   2. non-Llama BASELINE workloads    -> WORKLOADS_r04.json
+#   3. decode serving sweep            -> merged into BENCH_TPU_MEASURED_r04
+#   4. MoE gate/dispatch/expert breakdown + Pallas-vs-jnp dispatch A/B
+#                                      -> merged into WORKLOADS_r04.json
+#   5. profile re-capture (attribution after run_steps lever)
+#   6. on-chip kernel validation tests
 set -x
 cd "$(dirname "$0")"
+# a concurrently-polling watcher would contend for the exclusive axon
+# chip claim mid-session (r3 post-mortem: the leftover r3 watcher is
+# the prime suspect for the driver-window backend-init hangs)
+touch .watch_stop
 
 BENCH_TPU_DEADLINE_S=1500 BENCH_TOTAL_BUDGET_S=2100 \
     timeout -s INT -k 30 2160 python bench.py \
@@ -32,7 +37,7 @@ except Exception:
     raise SystemExit
 if new.get("chip") != "v5e":
     raise SystemExit
-out = "BENCH_TPU_MEASURED_r03.json"
+out = "BENCH_TPU_MEASURED_r04.json"
 # merge: a deadline-cut stage in the new run must not erase a number
 # the previous session measured (e.g. decode_* / config_big keys) —
 # but run-specific diagnostics must never be carried into a clean run
@@ -68,7 +73,15 @@ EOF
 
 bash workloads_session.sh
 
+# decode serving sweep (VERDICT r3 #7): batch x sampling x ragged table
+timeout -s INT -k 30 900 python sweep_decode.py 2>&1 | tail -3
+
+# MoE breakdown + dispatch A/B (VERDICT r3 #4): merged into WORKLOADS
+timeout -s INT -k 30 700 python moe_breakdown.py 2>&1 | tail -3
+
 timeout -s INT -k 30 580 python profile_tpu.py 2>&1 | tail -3
 
 PT_TPU_TESTS=1 timeout -s INT -k 30 560 python -m pytest tests/test_pallas_tpu.py -q \
     2>&1 | tail -5
+
+touch .session_done

@@ -1,15 +1,41 @@
 #!/bin/bash
 # Poll the axon tunnel; the moment it answers, run the measurement
-# session. The wedge after a killed remote compile clears on its own —
-# this watcher converts the first healthy window into artifacts.
+# session ONCE and exit. Hard lessons encoded here:
+#   - r3 post-mortem: a leftover watcher from the previous round kept
+#     probing through the driver's end-of-round bench window — probes
+#     contend for the EXCLUSIVE axon chip claim and wedge backend init
+#     for everyone. So this watcher (a) self-expires after WATCH_MAX_S,
+#     (b) stops the moment .watch_stop exists (tpu_session.sh creates
+#     it; any manual chip work should `touch .watch_stop` first).
 cd "$(dirname "$0")"
-for i in $(seq 1 200); do
-    if timeout 75 python -c "import jax; jax.devices()" 2>/dev/null; then
-        echo "tunnel healthy at attempt $i: $(date)" >&2
+# single-instance guard: a second watcher must never run concurrently
+# (two probe loops double the chip-claim contention)
+exec 9>.watch_lock
+flock -n 9 || { echo "watcher: another instance holds .watch_lock" >&2; exit 1; }
+# never clear the stop flag while a session (manual or watcher-started)
+# is mid-flight on the chip
+if pgrep -f "bash tpu_session.sh" >/dev/null 2>&1; then
+    echo "watcher: tpu_session.sh already running; not starting" >&2
+    exit 1
+fi
+# an existing stop flag means someone asked for the chip (manual bench/
+# sweep work touches it per the header) — honor it; the operator
+# re-arms with `rm .watch_stop` when the chip is free again
+if [ -e .watch_stop ]; then
+    echo "watcher: .watch_stop present (manual chip work?); rm it to re-arm" >&2
+    exit 1
+fi
+rm -f .session_done
+START=$(date +%s)
+MAX=${WATCH_MAX_S:-25200}   # 7h default — well inside the round window
+while :; do
+    [ -e .watch_stop ] && { echo "watcher: stop requested" >&2; exit 0; }
+    now=$(date +%s)
+    [ $((now - START)) -gt "$MAX" ] && { echo "watcher: expired with no healthy window" >&2; exit 1; }
+    if timeout -s INT -k 15 75 python -c "import jax; jax.devices()" 2>/dev/null; then
+        echo "tunnel healthy: $(date)" >&2
         bash tpu_session.sh
         exit 0
     fi
     sleep 90
 done
-echo "tunnel never recovered" >&2
-exit 1
