@@ -1,0 +1,52 @@
+"""Shared plumbing for the measurement tools (bench_workloads.py,
+sweep_decode.py, moe_breakdown.py): jax platform/cache setup and
+chip-provenance-safe artifact merging."""
+from __future__ import annotations
+
+import json
+import os
+
+
+def configure_jax():
+    """Force the CPU backend when asked (env alone is too late — the
+    site hook pre-imports jax under the axon platform) and enable the
+    persistent compile cache. Returns the jax module."""
+    import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("PT_JAX_CACHE_DIR",
+                                         "/root/.pt_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          2.0)
+    except Exception:
+        pass
+    return jax
+
+
+def merge_artifact(path: str, key: str, value, chip: str) -> bool:
+    """Atomically set ``key`` in the JSON artifact at ``path``.
+
+    Chip provenance guard: a CPU smoke run must never overwrite data a
+    real chip session recorded — if the artifact says chip "v5e" and
+    this run is "cpu", the merge is refused (returns False) and the
+    smoke result goes to ``path + .cpu-smoke.json`` instead.
+    """
+    try:
+        d = json.load(open(path)) if os.path.exists(path) else {}
+    except Exception:
+        d = {}
+    existing = d.get("chip")
+    if existing == "v5e" and chip != "v5e":
+        side = path + ".cpu-smoke.json"
+        json.dump({"chip": chip, key: value}, open(side, "w"), indent=1)
+        return False
+    if existing not in (None, chip):
+        d = {}                       # stale other-platform artifact
+    d.setdefault("chip", chip)
+    d[key] = value
+    tmp = path + ".tmp"
+    json.dump(d, open(tmp, "w"), indent=1)
+    os.replace(tmp, path)            # atomic: kill mid-write can't corrupt
+    return True

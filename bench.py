@@ -309,6 +309,31 @@ def _child_tpu():
                 sel["remat"] = "selective"
                 big = sel
         _emit(small, big, None, errors)
+        # sdpa kernel A/B on the headline shape: PROFILE_r03 charges the
+        # equal-heads jax_flash route 20.5% of self-time plus a 5.7%
+        # HBM-bound broadcast_in_dim in its bwd; splash (block-sparse
+        # CausalMask, skips fully-masked tiles) may beat it — measure,
+        # keep the winner, and record both so the choice is on-artifact
+        if big is not None:
+            os.environ["PT_SDPA_PREFER"] = "splash"
+            try:
+                sp, err = _staged(lambda: _bench_train(
+                    big_cfg(big.get("remat", "full")), batch=big["batch"],
+                    seq=2048, steps=8, warmup=2, peak=peak,
+                    multi_precision=False), "big-splash")
+            finally:
+                os.environ.pop("PT_SDPA_PREFER", None)
+            if err:
+                errors.append(err)
+            if sp is not None:
+                big["sdpa_ab"] = {"jax_flash": big["mfu"],
+                                  "splash": sp["mfu"]}
+                if sp["mfu"] > big["mfu"]:
+                    sp["remat"] = big.get("remat")
+                    sp["sdpa_ab"] = big["sdpa_ab"]
+                    sp["sdpa"] = "splash"
+                    big = sp
+        _emit(small, big, None, errors)
         # decode runs LAST: it is the least informative stage for the
         # MFU contract, and r3 showed it can eat the deadline window
         # the ~1B headline config needed

@@ -135,6 +135,11 @@ def resnet50():
 
     paddle.seed(0)
     batch, hw, ncls = (2, 32, 10) if TINY else (64, 224, 1000)
+    if TINY:
+        # tool-machinery smoke only: resnet18 walks the identical code
+        # path (amp decorate, TrainStep, AOT precheck, timing) at a
+        # third of the CPU compile cost of the 50-layer build
+        from paddle_tpu.vision.models import resnet18 as build
     model = build(num_classes=ncls)
     amp.decorate(model, level="O2", dtype="bfloat16")
     ce = nn.CrossEntropyLoss()
@@ -346,20 +351,17 @@ WORKLOADS = {"resnet50": resnet50, "bert_base": bert_base,
 
 
 if __name__ == "__main__":
-    name = sys.argv[1]
-    try:
-        import jax
-        # same persistent compile cache as bench.py: repeat sessions
-        # skip the UNet/BERT compiles if the backend supports it
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("PT_JAX_CACHE_DIR",
-                                         "/root/.pt_jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-    except Exception:
-        pass
-    try:
-        r = WORKLOADS[name]()
-        print("WORKLOAD " + json.dumps(r))
-    except Exception as e:
-        print("WORKLOAD " + json.dumps(
-            {"workload": name, "error": f"{type(e).__name__}: {e}"[:300]}))
+    # several names in one invocation share the interpreter/jax startup
+    # (the CPU smoke tests run all four in one process; chip sessions
+    # keep one-point-per-process isolation via workloads_session.sh)
+    names = sys.argv[1:]
+    from _bench_common import configure_jax
+    configure_jax()
+    for name in names:
+        try:
+            r = WORKLOADS[name]()
+            print("WORKLOAD " + json.dumps(r), flush=True)
+        except Exception as e:
+            print("WORKLOAD " + json.dumps(
+                {"workload": name,
+                 "error": f"{type(e).__name__}: {e}"[:300]}), flush=True)

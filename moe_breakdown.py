@@ -30,35 +30,15 @@ import time
 
 import numpy as np
 
+from _bench_common import configure_jax, merge_artifact
+
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "WORKLOADS_r04.json")
 
 
-def _merge(result):
-    try:
-        d = json.load(open(OUT)) if os.path.exists(OUT) else {}
-    except Exception:
-        d = {}
-    d["moe_breakdown"] = result
-    tmp = OUT + ".tmp"
-    json.dump(d, open(tmp, "w"), indent=1)
-    os.replace(tmp, OUT)
-
-
 def main():
-    import jax
+    jax = configure_jax()
     import jax.numpy as jnp
-    # env alone is too late — sitecustomize pre-imports jax under the
-    # axon platform; force the CPU backend before any device touch
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("PT_JAX_CACHE_DIR",
-                                         "/root/.pt_jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-    except Exception:
-        pass
     on_tpu = jax.devices()[0].platform != "cpu"
     tiny = not on_tpu
 
@@ -109,10 +89,14 @@ def main():
         out_tk = out_tk * gates.reshape(-1, 1).astype(flat.dtype)
         return out_tk.reshape(t, k, d).sum(axis=1)
 
+    chip = (os.environ.get("PALLAS_AXON_TPU_GEN", "v5e") if on_tpu
+            else "cpu")
     result = {"tokens": t, "d_model": d, "d_hidden": h, "experts": e,
               "top_k": k, "capacity": cap, "dtype": "bfloat16",
-              "chip": os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-              if on_tpu else "cpu"}
+              "chip": chip}
+
+    def _merge(result):
+        merge_artifact(OUT, "moe_breakdown", result, chip)
 
     def timeit(fn, *args, iters=20 if not tiny else 3, warmup=3):
         c = jax.jit(fn)

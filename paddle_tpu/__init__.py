@@ -50,6 +50,7 @@ from . import hapi
 from .hapi import Model, summary
 from .hapi.flops import flops
 from . import hub
+from . import onnx
 from .framework import iinfo, finfo
 
 # paddle API aliases

@@ -1,0 +1,76 @@
+"""paddle_tpu.onnx — ONNX export without external dependencies.
+
+Reference parity: `paddle.onnx.export` (delegating to the external
+paddle2onnx converter over ProgramDesc — SURVEY §2.2 Misc row, verify).
+
+TPU-native design: the traced program is a jaxpr (the same trace
+`jit.to_static`/StableHLO export uses), converted op-by-op to ONNX
+opset 13 (`converter.py`) and serialized with an in-tree proto3 wire
+codec (`proto.py`) because no onnx/protobuf package exists in this
+environment. `runtime.py` is a numpy evaluator over the emitted subset
+so export correctness is testable end-to-end in-tree; files are
+standard ONNX and load in stock onnxruntime/netron outside.
+
+    paddle_tpu.onnx.export(layer, "model", input_spec=[spec])
+    # -> model.onnx
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import converter, proto, runtime  # noqa: F401
+
+
+def export(layer, path: str, input_spec, opset: int = 13,
+           output_names=None):
+    """Trace ``layer`` in eval mode over ``input_spec`` (InputSpec /
+    Tensor / ndarray examples; static shapes only — ONNX dynamic dims
+    are not modeled here) and write ``<path>.onnx``. Returns the path.
+    """
+    import jax
+
+    from .. import framework
+    from ..static import InputSpec
+    from ..tensor import Tensor
+
+    def to_sds(s):
+        if isinstance(s, InputSpec):
+            shape = tuple(int(d) if d and int(d) > 0 else 1
+                          for d in s.shape)
+            return jax.ShapeDtypeStruct(
+                shape, framework.convert_dtype(s.dtype))
+        if isinstance(s, Tensor):
+            return jax.ShapeDtypeStruct(tuple(s.shape), s._value.dtype)
+        arr = np.asarray(s)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    specs = [to_sds(s) for s in input_spec]
+
+    def fn(*inputs):
+        was_training = layer.training
+        layer.eval()
+        try:
+            with framework.functional_mode(), framework.rng_context(
+                    jax.random.PRNGKey(0)):
+                out = layer(*[Tensor(x) for x in inputs])
+        finally:
+            if was_training:
+                layer.train()
+        return jax.tree_util.tree_map(
+            lambda o: o._value if isinstance(o, Tensor) else o, out,
+            is_leaf=lambda o: isinstance(o, Tensor))
+
+    closed = jax.make_jaxpr(fn)(*specs)
+    # DCE first: eval-mode traces still thread PRNG-key plumbing
+    # (random_seed/random_wrap) for unused dropout paths — dead code
+    # that would otherwise hit the converter as unmapped primitives
+    from ..passes import dce_pass
+    closed = dce_pass(closed)
+    input_names = [f"input_{i}" for i in range(len(specs))]
+    graph = converter.convert(closed, input_names,
+                              output_names=output_names,
+                              graph_name=type(layer).__name__)
+    model = converter.model_proto(graph, opset=opset)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    converter.save(model, out_path)
+    return out_path

@@ -1,0 +1,222 @@
+"""Minimal proto3 wire-format codec for the ONNX schema.
+
+Reference parity: the reference exports ONNX through the external
+paddle2onnx package (SURVEY §2.2 Misc row). This environment has no
+onnx/protobuf-python packages, so the subset of onnx.proto this exporter
+emits is encoded directly at the wire level: schemas below transcribe
+the public field numbers of onnx/onnx.proto (proto3). Only what the
+exporter uses is modeled; the decoder skips unknown fields, so files
+produced by other tools still parse for inspection.
+
+Messages are plain dicts; repeated fields are lists. Encoder and decoder
+are schema-driven and symmetric, which gives the test suite a full
+round-trip path without any external dependency.
+"""
+from __future__ import annotations
+
+import struct
+
+# field types: "int64" (varint), "float" (fixed32), "string", "bytes",
+# "msg:<Name>"; prefix "rep:" for repeated. proto3 packs repeated
+# numerics by default — the encoder packs, the decoder accepts both.
+SCHEMAS = {
+    "Model": {
+        "ir_version": (1, "int64"),
+        "producer_name": (2, "string"),
+        "producer_version": (3, "string"),
+        "domain": (4, "string"),
+        "model_version": (5, "int64"),
+        "doc_string": (6, "string"),
+        "graph": (7, "msg:Graph"),
+        "opset_import": (8, "rep:msg:OperatorSetId"),
+    },
+    "OperatorSetId": {"domain": (1, "string"), "version": (2, "int64")},
+    "Graph": {
+        "node": (1, "rep:msg:Node"),
+        "name": (2, "string"),
+        "initializer": (5, "rep:msg:Tensor"),
+        "doc_string": (10, "string"),
+        "input": (11, "rep:msg:ValueInfo"),
+        "output": (12, "rep:msg:ValueInfo"),
+        "value_info": (13, "rep:msg:ValueInfo"),
+    },
+    "Node": {
+        "input": (1, "rep:string"),
+        "output": (2, "rep:string"),
+        "name": (3, "string"),
+        "op_type": (4, "string"),
+        "attribute": (5, "rep:msg:Attribute"),
+        "doc_string": (6, "string"),
+        "domain": (7, "string"),
+    },
+    "Attribute": {
+        "name": (1, "string"),
+        "f": (2, "float"),
+        "i": (3, "int64"),
+        "s": (4, "bytes"),
+        "t": (5, "msg:Tensor"),
+        "floats": (7, "rep:float"),
+        "ints": (8, "rep:int64"),
+        "strings": (9, "rep:bytes"),
+        "type": (20, "int64"),
+    },
+    "Tensor": {
+        "dims": (1, "rep:int64"),
+        "data_type": (2, "int64"),
+        "float_data": (4, "rep:float"),
+        "int64_data": (7, "rep:int64"),
+        "name": (8, "string"),
+        "raw_data": (9, "bytes"),
+    },
+    "ValueInfo": {"name": (1, "string"), "type": (2, "msg:Type")},
+    "Type": {"tensor_type": (1, "msg:TypeTensor")},
+    "TypeTensor": {"elem_type": (1, "int64"), "shape": (2, "msg:Shape")},
+    "Shape": {"dim": (1, "rep:msg:Dim")},
+    "Dim": {"dim_value": (1, "int64"), "dim_param": (2, "string")},
+}
+
+# AttributeProto.AttributeType enum values
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+# TensorProto.DataType enum values
+DT = {"float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+      "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+      "uint32": 12, "uint64": 13, "bfloat16": 16}
+DT_REV = {v: k for k, v in DT.items()}
+
+
+def _varint(n: int) -> bytes:
+    n &= (1 << 64) - 1          # negatives as 64-bit two's complement
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _enc_scalar(ftype: str, v) -> tuple[int, bytes]:
+    """-> (wire_type, payload)."""
+    if ftype == "int64":
+        return 0, _varint(int(v))
+    if ftype == "float":
+        return 5, struct.pack("<f", float(v))
+    if ftype == "string":
+        b = v.encode() if isinstance(v, str) else bytes(v)
+        return 2, _varint(len(b)) + b
+    if ftype == "bytes":
+        b = bytes(v)
+        return 2, _varint(len(b)) + b
+    raise ValueError(ftype)
+
+
+def encode(msg_name: str, d: dict) -> bytes:
+    schema = SCHEMAS[msg_name]
+    out = bytearray()
+    for key, v in d.items():
+        if v is None:
+            continue
+        field, ftype = schema[key]
+        rep = ftype.startswith("rep:")
+        base = ftype[4:] if rep else ftype
+        if base.startswith("msg:"):
+            sub = base[4:]
+            items = v if rep else [v]
+            for item in items:
+                body = encode(sub, item)
+                out += _tag(field, 2) + _varint(len(body)) + body
+        elif rep:
+            if base in ("int64", "float"):
+                # packed (proto3 default for repeated numerics)
+                body = bytearray()
+                for item in v:
+                    _, payload = _enc_scalar(base, item)
+                    body += payload
+                out += _tag(field, 2) + _varint(len(body)) + bytes(body)
+            else:                   # repeated string/bytes: one tag each
+                for item in v:
+                    wire, payload = _enc_scalar(base, item)
+                    out += _tag(field, wire) + payload
+        else:
+            wire, payload = _enc_scalar(base, v)
+            out += _tag(field, wire) + payload
+    return bytes(out)
+
+
+def _read_varint(buf: bytes, pos: int) -> tuple[int, int]:
+    n = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+
+
+def _to_signed64(n: int) -> int:
+    return n - (1 << 64) if n >= (1 << 63) else n
+
+
+def decode(msg_name: str, buf: bytes) -> dict:
+    schema = SCHEMAS[msg_name]
+    by_field = {f: (k, t) for k, (f, t) in schema.items()}
+    out: dict = {}
+    pos = 0
+    while pos < len(buf):
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            raw, pos = _read_varint(buf, pos)
+            val: object = _to_signed64(raw)
+            payload = None
+        elif wire == 5:
+            val = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+            payload = None
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            payload = buf[pos:pos + ln]
+            pos += ln
+            val = None
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        if field not in by_field:
+            continue                            # unknown field: skip
+        key_name, ftype = by_field[field]
+        rep = ftype.startswith("rep:")
+        base = ftype[4:] if rep else ftype
+        if base.startswith("msg:"):
+            val = decode(base[4:], payload)
+        elif payload is not None:
+            if base == "string":
+                val = payload.decode("utf-8", "replace")
+            elif base == "bytes":
+                val = payload
+            elif base in ("int64", "float") and rep:
+                vals, p2 = [], 0          # packed numerics
+                while p2 < len(payload):
+                    if base == "int64":
+                        raw, p2 = _read_varint(payload, p2)
+                        vals.append(_to_signed64(raw))
+                    else:
+                        vals.append(
+                            struct.unpack("<f", payload[p2:p2 + 4])[0])
+                        p2 += 4
+                out.setdefault(key_name, []).extend(vals)
+                continue
+            else:
+                raise ValueError(f"field {key_name}: bad wire for {base}")
+        if rep:
+            out.setdefault(key_name, []).append(val)
+        else:
+            out[key_name] = val
+    return out

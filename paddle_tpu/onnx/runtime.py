@@ -1,0 +1,274 @@
+"""Minimal numpy evaluator for the ONNX subset this package emits.
+
+Purpose: numerical round-trip validation of the exporter in-tree (no
+onnx/onnxruntime exists in this environment). It decodes the wire bytes
+with proto.decode and executes nodes in graph order — the same OpTest
+philosophy the reference applies to its converters (numpy reference
+implementation checked against the traced program).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import proto
+from .proto import DT_REV
+
+
+def _np_dtype(enum: int):
+    name = DT_REV.get(int(enum))
+    if name is None:
+        raise ValueError(f"unknown onnx dtype enum {enum}")
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def tensor_value(t: dict):
+    shape = [int(d) for d in t.get("dims", [])]
+    dt = _np_dtype(t.get("data_type", 1))
+    if "raw_data" in t:
+        arr = np.frombuffer(t["raw_data"], dtype=dt)
+    elif "float_data" in t:
+        arr = np.asarray(t["float_data"], dtype=dt)
+    elif "int64_data" in t:
+        arr = np.asarray(t["int64_data"], dtype=dt)
+    else:
+        arr = np.zeros(0, dt)
+    return arr.reshape(shape)
+
+
+def _attrs(node: dict) -> dict:
+    out = {}
+    for a in node.get("attribute", []):
+        t = a.get("type")
+        if t == proto.ATTR_INT:
+            out[a["name"]] = a.get("i", 0)
+        elif t == proto.ATTR_FLOAT:
+            out[a["name"]] = a.get("f", 0.0)
+        elif t == proto.ATTR_STRING:
+            out[a["name"]] = a.get("s", b"").decode()
+        elif t == proto.ATTR_INTS:
+            out[a["name"]] = [int(v) for v in a.get("ints", [])]
+        elif t == proto.ATTR_FLOATS:
+            out[a["name"]] = [float(v) for v in a.get("floats", [])]
+        elif t == proto.ATTR_TENSOR:
+            out[a["name"]] = tensor_value(a["t"])
+    return out
+
+
+_ERF = np.vectorize(math.erf, otypes=[np.float64])
+
+
+def _run_node(op, ins, at):
+    if op == "Identity":
+        return ins[0]
+    if op == "Add":
+        return ins[0] + ins[1]
+    if op == "Sub":
+        return ins[0] - ins[1]
+    if op == "Mul":
+        return ins[0] * ins[1]
+    if op == "Div":
+        return ins[0] / ins[1]
+    if op == "Max":
+        return np.maximum(ins[0], ins[1])
+    if op == "Min":
+        return np.minimum(ins[0], ins[1])
+    if op == "Pow":
+        return np.power(ins[0], ins[1].astype(ins[0].dtype))
+    if op == "Mod":
+        return np.fmod(ins[0], ins[1])
+    if op == "Neg":
+        return -ins[0]
+    if op == "Abs":
+        return np.abs(ins[0])
+    if op == "Sign":
+        return np.sign(ins[0])
+    if op == "Floor":
+        return np.floor(ins[0])
+    if op == "Ceil":
+        return np.ceil(ins[0])
+    if op == "Round":
+        return np.round(ins[0])
+    if op == "Exp":
+        return np.exp(ins[0])
+    if op == "Log":
+        return np.log(ins[0])
+    if op == "Tanh":
+        return np.tanh(ins[0])
+    if op == "Sin":
+        return np.sin(ins[0])
+    if op == "Cos":
+        return np.cos(ins[0])
+    if op == "Sqrt":
+        return np.sqrt(ins[0])
+    if op == "Reciprocal":
+        return 1.0 / ins[0]
+    if op == "Sigmoid":
+        return 1.0 / (1.0 + np.exp(-ins[0]))
+    if op == "Erf":
+        return _ERF(ins[0]).astype(ins[0].dtype)
+    if op == "Not":
+        return ~ins[0]
+    if op == "And":
+        return ins[0] & ins[1]
+    if op == "Or":
+        return ins[0] | ins[1]
+    if op == "Xor":
+        return ins[0] ^ ins[1]
+    if op == "Equal":
+        return ins[0] == ins[1]
+    if op == "Less":
+        return ins[0] < ins[1]
+    if op == "LessOrEqual":
+        return ins[0] <= ins[1]
+    if op == "Greater":
+        return ins[0] > ins[1]
+    if op == "GreaterOrEqual":
+        return ins[0] >= ins[1]
+    if op == "Where":
+        return np.where(ins[0], ins[1], ins[2])
+    if op == "Reshape":
+        return ins[0].reshape([int(d) for d in ins[1]])
+    if op == "Expand":
+        return np.broadcast_to(
+            ins[0], np.broadcast_shapes(
+                ins[0].shape, tuple(int(d) for d in ins[1]))).copy()
+    if op == "Transpose":
+        return np.transpose(ins[0], at.get("perm"))
+    if op == "Concat":
+        return np.concatenate(ins, axis=at["axis"])
+    if op == "Split":
+        sizes = [int(v) for v in ins[1]]
+        offs = np.cumsum(sizes)[:-1]
+        return np.split(ins[0], offs, axis=at.get("axis", 0))
+    if op == "Slice":
+        data, starts, ends, axes, steps = ins
+        sl = [slice(None)] * data.ndim
+        for s, e, ax, st in zip(starts, ends, axes, steps):
+            s, e, ax, st = int(s), int(e), int(ax), int(st)
+            dim = data.shape[ax]
+            if st > 0:
+                e = min(e, dim)
+            sl[ax] = slice(s, None if e < -dim else e, st)
+        return data[tuple(sl)]
+    if op == "Pad":
+        data, pads, cval = ins
+        n = data.ndim
+        pw = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+        return np.pad(data, pw, constant_values=float(cval))
+    if op == "Cast":
+        return ins[0].astype(_np_dtype(at["to"]))
+    if op == "Einsum":
+        return np.einsum(at["equation"], *[np.asarray(x, np.float64)
+                                           for x in ins]
+                         ).astype(ins[0].dtype)
+    if op == "MatMul":
+        return np.matmul(ins[0], ins[1])
+    if op == "Gather":
+        return np.take(ins[0], ins[1].astype(np.int64),
+                       axis=at.get("axis", 0))
+    if op == "ReduceSum":
+        axes = tuple(int(a) for a in ins[1]) if len(ins) > 1 else None
+        return np.sum(ins[0], axis=axes,
+                      keepdims=bool(at.get("keepdims", 1)))
+    if op in ("ReduceMax", "ReduceMin", "ReduceProd", "ReduceMean"):
+        fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+              "ReduceProd": np.prod, "ReduceMean": np.mean}[op]
+        axes = tuple(at["axes"]) if "axes" in at else None
+        return fn(ins[0], axis=axes,
+                  keepdims=bool(at.get("keepdims", 1)))
+    if op in ("ArgMax", "ArgMin"):
+        fn = np.argmax if op == "ArgMax" else np.argmin
+        r = fn(ins[0], axis=at.get("axis", 0))
+        if at.get("keepdims", 1):
+            r = np.expand_dims(r, at.get("axis", 0))
+        return r.astype(np.int64)
+    if op == "Conv":
+        return _conv(ins, at)
+    if op == "MaxPool":
+        return _maxpool(ins[0], at)
+    raise NotImplementedError(f"onnx runtime: op {op}")
+
+
+def _conv(ins, at):
+    x, w = ins[0], ins[1]
+    strides = at.get("strides", [1, 1])
+    pads = at.get("pads", [0] * (2 * (x.ndim - 2)))
+    dil = at.get("dilations", [1] * (x.ndim - 2))
+    groups = int(at.get("group", 1))
+    n = x.ndim - 2
+    pw = [(0, 0), (0, 0)] + [(int(pads[i]), int(pads[i + n]))
+                             for i in range(n)]
+    xp = np.pad(x, pw)
+    N, C = xp.shape[:2]
+    O, I = w.shape[:2]
+    k = w.shape[2:]
+    out_sp = [(xp.shape[2 + i] - (int(dil[i]) * (k[i] - 1) + 1))
+              // int(strides[i]) + 1 for i in range(n)]
+    out = np.zeros((N, O, *out_sp), np.float64)
+    og = O // groups
+    for g in range(groups):
+        for o in range(g * og, (g + 1) * og):
+            for idx in np.ndindex(*out_sp):
+                sl = tuple(
+                    slice(int(strides[i]) * idx[i],
+                          int(strides[i]) * idx[i]
+                          + int(dil[i]) * (k[i] - 1) + 1, int(dil[i]))
+                    for i in range(n))
+                patch = xp[(slice(None),
+                            slice(g * I, (g + 1) * I)) + sl]
+                out[(slice(None), o) + idx] = np.sum(
+                    patch * w[o][None], axis=tuple(range(1, n + 2)))
+    if len(ins) > 2:
+        out += ins[2].reshape((1, O) + (1,) * n)
+    return out.astype(x.dtype)
+
+
+def _maxpool(x, at):
+    k = at["kernel_shape"]
+    strides = at.get("strides", k)
+    pads = at.get("pads", [0] * (2 * len(k)))
+    n = len(k)
+    pw = [(0, 0), (0, 0)] + [(int(pads[i]), int(pads[i + n]))
+                             for i in range(n)]
+    xp = np.pad(x, pw, constant_values=-np.inf)
+    out_sp = [(xp.shape[2 + i] - k[i]) // int(strides[i]) + 1
+              for i in range(n)]
+    out = np.zeros((*x.shape[:2], *out_sp), x.dtype)
+    for idx in np.ndindex(*out_sp):
+        sl = tuple(slice(int(strides[i]) * idx[i],
+                         int(strides[i]) * idx[i] + k[i])
+                   for i in range(n))
+        out[(slice(None), slice(None)) + idx] = np.max(
+            xp[(slice(None), slice(None)) + sl],
+            axis=tuple(range(2, n + 2)))
+    return out
+
+
+def load(path: str) -> dict:
+    with open(path, "rb") as f:
+        return proto.decode("Model", f.read())
+
+
+def run(model: dict, inputs: dict) -> dict:
+    """Execute the graph; inputs/outputs are name->ndarray dicts."""
+    g = model["graph"]
+    env = {t["name"]: tensor_value(t) for t in g.get("initializer", [])}
+    for vi in g.get("input", []):
+        if vi["name"] not in inputs:
+            raise ValueError(f"missing input {vi['name']}")
+    env.update({k: np.asarray(v) for k, v in inputs.items()})
+    for node in g.get("node", []):
+        ins = [env[nm] for nm in node.get("input", [])]
+        outs = node.get("output", [])
+        r = _run_node(node["op_type"], ins, _attrs(node))
+        if len(outs) == 1:
+            env[outs[0]] = np.asarray(r)
+        else:
+            for nm, v in zip(outs, r):
+                env[nm] = np.asarray(v)
+    return {vi["name"]: env[vi["name"]] for vi in g.get("output", [])}

@@ -1,10 +1,11 @@
 """Profile the headline bench step and attribute device time
 (VERDICT r2 missing #1 / weak #3: no MFU attribution existed).
 
-Captures a jax.profiler trace of the 0.27B Llama train step (the
-BENCH headline config), post-processes the xplane with xprof into an
-op-category breakdown, and writes PROFILE_r03.json + the raw trace
-directory (profile_r03/) for TensorBoard.
+Captures a jax.profiler trace of the headline Llama train step —
+default the ~0.95B bf16 config (PROFILE_CONFIG=small for the 0.27B
+one), post-processes the xplane with xprof into an
+op-category breakdown, and writes PROFILE_r04.json + the raw trace
+directory (profile_r04/) for TensorBoard.
 
 Run on the chip:      python profile_tpu.py
 Machinery test (CPU): JAX_PLATFORMS=cpu python profile_tpu.py --cpu
@@ -19,8 +20,8 @@ import time
 
 import numpy as np
 
-OUT = os.environ.get("PROFILE_OUT", "PROFILE_r03.json")
-TRACE_DIR = os.environ.get("PROFILE_TRACE_DIR", "profile_r03")
+OUT = os.environ.get("PROFILE_OUT", "PROFILE_r04.json")
+TRACE_DIR = os.environ.get("PROFILE_TRACE_DIR", "profile_r04")
 
 
 def _op_breakdown(trace_dir):
@@ -107,7 +108,19 @@ def main():
     from paddle_tpu.models.llama import LlamaForCausalLM
 
     on_tpu = jax.devices()[0].platform != "cpu"
-    if on_tpu:
+    which = os.environ.get("PROFILE_CONFIG", "big" if on_tpu else "tiny")
+    if which == "big":
+        # the 48.97%-MFU headline shape (bench.py config_big): pure-bf16
+        # states, per-layer remat, scan_layers
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            tensor_parallel=False, recompute=True,
+            recompute_granularity="full", scan_layers=True,
+            dtype="bfloat16")
+        batch, seq = 8, 2048
+    elif which == "small":
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
             num_hidden_layers=16, num_attention_heads=16,
@@ -119,11 +132,22 @@ def main():
         batch, seq = 2, 64
 
     paddle.seed(0)
-    model = LlamaForCausalLM(cfg)
-    opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
-                          parameters=model.parameters(),
-                          multi_precision=True)
-    model, opt = amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    if which == "big":
+        paddle.set_default_dtype("bfloat16")
+        try:
+            model = LlamaForCausalLM(cfg)
+        finally:
+            paddle.set_default_dtype("float32")
+        opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                              parameters=model.parameters(),
+                              multi_precision=False)
+    else:
+        model = LlamaForCausalLM(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
+                              parameters=model.parameters(),
+                              multi_precision=True)
+        model, opt = amp.decorate(model, opt, level="O2",
+                                  dtype="bfloat16")
 
     def loss_fn(m, b):
         ids, labels = b
@@ -151,11 +175,11 @@ def main():
     breakdown, err = _op_breakdown(TRACE_DIR)
     from paddle_tpu.ops.pallas.flash_attention import sdpa_last_dispatch
     artifact = {
-        "artifact": "PROFILE_r03",
+        "artifact": "PROFILE_r04",
         "chip": os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
         if on_tpu else "cpu",
-        "config": {"params": int(model.num_params()), "batch": batch,
-                   "seq": seq},
+        "config": {"name": which, "params": int(model.num_params()),
+                   "batch": batch, "seq": seq},
         "step_ms": round(dt * 1000, 2),
         "final_loss": round(final, 4),
         "tokens_per_sec": round(batch * seq / dt, 1),

@@ -26,37 +26,19 @@ import time
 
 import numpy as np
 
+from _bench_common import configure_jax, merge_artifact
+
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "BENCH_TPU_MEASURED_r04.json")
 
 
 def _merge(points, chip):
-    try:
-        d = json.load(open(OUT)) if os.path.exists(OUT) else {}
-    except Exception:
-        d = {}
-    if d.get("chip") not in (None, "v5e") and chip == "v5e":
-        d = {}
-    d.setdefault("chip", chip)
-    d["decode_sweep"] = points
-    tmp = OUT + ".tmp"
-    json.dump(d, open(tmp, "w"), indent=1)
-    os.replace(tmp, OUT)
+    # provenance-guarded: a CPU smoke run cannot clobber v5e data
+    merge_artifact(OUT, "decode_sweep", points, chip)
 
 
 def main():
-    import jax
-    # env alone is too late — sitecustomize pre-imports jax under the
-    # axon platform; force the CPU backend before any device touch
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("PT_JAX_CACHE_DIR",
-                                         "/root/.pt_jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
-    except Exception:
-        pass
+    jax = configure_jax()
     chip = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
     if jax.devices()[0].platform == "cpu":
         chip = "cpu"

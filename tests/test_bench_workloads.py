@@ -11,20 +11,28 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("name", ["resnet50", "bert_base", "ernie_moe",
-                                  "sdxl_unet"])
-def test_workload_tiny(name):
+NAMES = ["resnet50", "bert_base", "ernie_moe", "sdxl_unet"]
+
+
+def test_workload_tiny_all():
+    """All four workloads in ONE subprocess: the per-name subprocesses
+    each paid a ~10s cold jax import for no isolation benefit on CPU
+    (chip sessions keep per-point isolation via workloads_session.sh)."""
     env = dict(os.environ, PT_WORKLOADS_TINY="1", JAX_PLATFORMS="cpu")
     env.pop("XLA_FLAGS", None)  # single fake device is enough
     p = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "bench_workloads.py"), name],
-        capture_output=True, text=True, timeout=420, env=env, cwd=ROOT)
-    lines = [l for l in p.stdout.splitlines() if l.startswith("WORKLOAD ")]
-    assert lines, f"no WORKLOAD line: {p.stdout[-2000:]} {p.stderr[-2000:]}"
-    r = json.loads(lines[-1][len("WORKLOAD "):])
-    assert "error" not in r, r["error"]
-    assert r["workload"].startswith(name.split("_")[0])
-    if name == "sdxl_unet":
-        assert r["infer_step_ms"] > 0 and r["train_step_ms"] > 0
-    else:
-        assert r["step_ms"] > 0
+        [sys.executable, os.path.join(ROOT, "bench_workloads.py"), *NAMES],
+        capture_output=True, text=True, timeout=900, env=env, cwd=ROOT)
+    lines = [l for l in p.stdout.splitlines()
+             if l.startswith("WORKLOAD ")]
+    assert len(lines) == len(NAMES), (
+        f"{len(lines)} WORKLOAD lines: {p.stdout[-2000:]} "
+        f"{p.stderr[-2000:]}")
+    for name, line in zip(NAMES, lines):
+        r = json.loads(line[len("WORKLOAD "):])
+        assert "error" not in r, (name, r["error"])
+        assert r["workload"].startswith(name.split("_")[0])
+        if name == "sdxl_unet":
+            assert r["infer_step_ms"] > 0 and r["train_step_ms"] > 0
+        else:
+            assert r["step_ms"] > 0

@@ -117,10 +117,24 @@ class TestT5:
         labels = paddle.to_tensor(
             rng.randint(2, cfg.vocab_size, (4, 6)).astype(np.int32))
         losses = []
-        for _ in range(15):
+        # two EAGER iterations keep the tape-autograd coverage on the
+        # encoder-decoder graph; the convergence loop then runs through
+        # the jitted TrainStep (15 eager re-traces were 30s of suite
+        # wall for no extra coverage)
+        for _ in range(2):
             loss, _ = m(inp, dec, labels=labels)
             loss.backward()
             opt.step()
             opt.clear_grad()
             losses.append(float(loss.item()))
+        from paddle_tpu.jit import TrainStep
+
+        def loss_fn(model, batch):
+            i, d, l = batch
+            loss, _ = model(i, d, labels=l)
+            return loss
+
+        step = TrainStep(m, loss_fn, opt)
+        for _ in range(13):
+            losses.append(float(step((inp, dec, labels)).item()))
         assert losses[-1] < losses[0] - 1.0, losses
