@@ -25,6 +25,68 @@ def configure_jax():
     return jax
 
 
+def scan_chain_bench(fn, args, primary_idx=0, iters=10, warmup=1):
+    """Device-honest kernel timing through the axon tunnel.
+
+    FLASH_BLOCKS_r03's per-kernel ms were dispatch-dominated:
+    block_until_ready through the tunnel returned before device
+    completion (0.018 ms for a 68.7-GFLOP kernel ~ 20x v5e peak). This
+    helper makes the timed quantity un-fakeable: ``iters`` executions
+    are chained DEVICE-SIDE in one lax.scan with a data dependency
+    (carry += eps*output, eps a traced operand so XLA cannot fold the
+    dependency away), and the clock stops on float() of a scalar
+    reduction — a value transfer cannot return early. Per-iteration ms
+    = one dispatch + K serialized kernel executions, amortized.
+    """
+    import jax
+    import jax.numpy as jnp
+    import time
+
+    primary = args[primary_idx]
+    eps = jnp.asarray(1e-30, primary.dtype)
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=())
+    def chained(eps, *a):
+        def body(carry, _):
+            full = list(a)
+            full[primary_idx] = carry
+            out = fn(*full)
+            # scalar-broadcast dependency on EVERY output leaf: next
+            # iteration's primary input depends on all of this
+            # iteration's outputs, so the K executions are serialized
+            # AND no output (e.g. the grads of a value_and_grad) can be
+            # dead-code-eliminated out of the timed program
+            tot = sum(jnp.sum(leaf).astype(jnp.float32)
+                      for leaf in jax.tree_util.tree_leaves(out))
+            return carry + eps * tot.astype(carry.dtype), None
+        c, _ = jax.lax.scan(body, a[primary_idx], None, length=iters)
+        return jnp.sum(c.astype(jnp.float32))
+
+    for _ in range(warmup):
+        float(chained(eps, *args))      # compile + warm, fetched scalar
+    t0 = time.perf_counter()
+    s = float(chained(eps, *args))
+    dt = time.perf_counter() - t0
+    assert s == s, "NaN in chained bench output"
+    return dt / iters * 1000            # ms per iteration
+
+
+def headline_big_config(recompute_granularity: str = "full"):
+    """THE ~0.95B headline shape (single source of truth: bench.py's
+    config_big and profile_tpu.py's big profile must measure the same
+    program — a drift here silently mis-attributes PROFILE numbers)."""
+    from paddle_tpu.models.llama import LlamaConfig
+    return LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=16, num_attention_heads=16,
+        num_key_value_heads=16, max_position_embeddings=2048,
+        tensor_parallel=False, recompute=True,
+        recompute_granularity=recompute_granularity,
+        scan_layers=True, dtype="bfloat16")
+
+
 def merge_artifact(path: str, key: str, value, chip: str) -> bool:
     """Atomically set ``key`` in the JSON artifact at ``path``.
 

@@ -264,17 +264,11 @@ def _child_tpu():
         # is AOT-memory-prechecked (15.2/16 GB v5e budget) so an
         # over-budget config costs one compile, never an OOM crash.
         def big_cfg(gran):
-            return LlamaConfig(
-                vocab_size=32000, hidden_size=2048,
-                intermediate_size=5632, num_hidden_layers=16,
-                num_attention_heads=16, num_key_value_heads=16,
-                max_position_embeddings=2048, tensor_parallel=False,
-                recompute=True, recompute_granularity=gran,
-                # scan over layers: the XLA program holds ONE layer
-                # body — small enough not to stress the tunnel's
-                # compile helper (r02's unrolled big-config compile
-                # crashed it)
-                scan_layers=True, dtype="bfloat16")
+            # scan_layers inside: the XLA program holds ONE layer body —
+            # small enough not to stress the tunnel's compile helper
+            # (r02's unrolled big-config compile crashed it)
+            from _bench_common import headline_big_config
+            return headline_big_config(gran)
         big = None
         # full-remat b8 first: the known-good 48.97%-MFU headline shape
         # — lock it in before experiments. Smallest batch runs even if
@@ -317,10 +311,14 @@ def _child_tpu():
         if big is not None:
             os.environ["PT_SDPA_PREFER"] = "splash"
             try:
+                # same AOT memory precheck as the winning stage: splash's
+                # bwd footprint differs and an un-prechecked OOM crash
+                # can wedge the tunnel (the r02 failure mode)
+                lim = 15.2e9 if big["batch"] > 2 else None
                 sp, err = _staged(lambda: _bench_train(
                     big_cfg(big.get("remat", "full")), batch=big["batch"],
                     seq=2048, steps=8, warmup=2, peak=peak,
-                    multi_precision=False), "big-splash")
+                    multi_precision=False, hbm_limit=lim), "big-splash")
             finally:
                 os.environ.pop("PT_SDPA_PREFER", None)
             if err:

@@ -159,7 +159,8 @@ def resnet50():
                       steps=2 if TINY else 10, warmup=1 if TINY else 3,
                       # analytic: ~4.1 GFLOP fwd per 224x224 img, x3 bwd
                       analytic_flops=batch * 4.1e9 * 3)
-    return {"workload": "resnet50_train", "images_per_sec":
+    return {"workload": ("resnet18_train_tiny_smoke" if TINY
+                         else "resnet50_train"), "images_per_sec":
             round(batch / (r["step_ms"] / 1000), 1), "batch": batch,
             "image_size": hw, **r}
 

@@ -98,17 +98,14 @@ def main():
     def _merge(result):
         merge_artifact(OUT, "moe_breakdown", result, chip)
 
-    def timeit(fn, *args, iters=20 if not tiny else 3, warmup=3):
-        c = jax.jit(fn)
-        out = c(*args)
-        for _ in range(warmup - 1):
-            out = c(*args)
-        jax.block_until_ready(out)
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = c(*args)
-        jax.block_until_ready(out)
-        return (time.perf_counter() - t0) / iters * 1000  # ms
+    def timeit(fn, *args, iters=10 if not tiny else 2, warmup=1,
+               primary_idx=0):
+        # device-honest timing: iterations serialized in one lax.scan,
+        # clock stopped on a fetched scalar (see _bench_common; the
+        # tunnel's block_until_ready can return before completion)
+        from _bench_common import scan_chain_bench
+        return scan_chain_bench(fn, args, primary_idx=primary_idx,
+                                iters=iters, warmup=warmup)
 
     # ---- stage attribution -------------------------------------------
     lg, topi, topv, aux = jax.jit(gate_fn)(x, wg)
@@ -173,7 +170,8 @@ def main():
             continue
         g = functools.partial(jax.value_and_grad(block_loss), mode=mode)
         try:
-            e2e[name + "_fwdbwd_ms"] = round(timeit(g, params, x), 3)
+            e2e[name + "_fwdbwd_ms"] = round(
+                timeit(g, params, x, primary_idx=1), 3)
         except Exception as ex:
             e2e[name + "_error"] = f"{type(ex).__name__}: {ex}"[:200]
         result["e2e"] = e2e

@@ -51,7 +51,7 @@ from .hapi import Model, summary
 from .hapi.flops import flops
 from . import hub
 from . import onnx
-from .framework import iinfo, finfo
+from .framework import iinfo, finfo, LazyGuard
 
 # paddle API aliases
 from .param_attr import ParamAttr

@@ -79,6 +79,7 @@ class _State(threading.local):
         self.functional: bool = False  # True while compiling a pure step
         self._device: Optional[str] = None  # lazy: don't touch devices at
         self.amp_stack: list = []      # import (TPU tunnel is exclusive)
+        self.lazy_init: int = 0        # LazyGuard nesting depth
 
     @property
     def rng_key(self):
@@ -242,6 +243,28 @@ def rng_context(key):
 # ---------------------------------------------------------------------------
 # places / devices
 # ---------------------------------------------------------------------------
+
+class LazyGuard:
+    """Defer parameter initialization inside the context (reference:
+    paddle.LazyGuard — python/paddle/fluid/lazy_init.py, verify):
+    ``with paddle.LazyGuard(): model = BigModel()`` builds the full
+    module tree with :class:`~paddle_tpu.tensor.LazyParameter` leaves —
+    shapes/dtypes known, zero initializer compute and weight memory —
+    and every parameter materializes transparently on first value
+    access (forward, state_dict, optimizer)."""
+
+    def __enter__(self):
+        _state.lazy_init += 1
+        return self
+
+    def __exit__(self, *exc):
+        _state.lazy_init -= 1
+        return False
+
+
+def in_lazy_init() -> bool:
+    return _state.lazy_init > 0
+
 
 class Place:
     """Device place façade (reference: phi::Place — verify). On TPU the

@@ -118,8 +118,12 @@ class Layer:
             init = I.Constant(0.0)
         else:
             init = I.XavierNormal()
-        value = init(tuple(int(s) for s in shape), dtype)
-        p = Parameter(value)
+        if framework.in_lazy_init():
+            from ..tensor import LazyParameter
+            p = LazyParameter(init, shape, dtype)
+        else:
+            value = init(tuple(int(s) for s in shape), dtype)
+            p = Parameter(value)
         if attr is not None:
             if getattr(attr, "learning_rate", None) is not None:
                 p.optimize_attr["learning_rate"] = attr.learning_rate

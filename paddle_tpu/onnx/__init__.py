@@ -35,8 +35,15 @@ def export(layer, path: str, input_spec, opset: int = 13,
 
     def to_sds(s):
         if isinstance(s, InputSpec):
-            shape = tuple(int(d) if d and int(d) > 0 else 1
-                          for d in s.shape)
+            if any(d is None or int(d) < 0 for d in s.shape):
+                raise ValueError(
+                    "paddle_tpu.onnx.export requires static shapes "
+                    f"(got InputSpec shape {list(s.shape)}); ONNX "
+                    "dynamic dims are not modeled here — export with a "
+                    "concrete batch size, or use "
+                    "inference.export_model (StableHLO) which supports "
+                    "symbolic dims")
+            shape = tuple(int(d) for d in s.shape)
             return jax.ShapeDtypeStruct(
                 shape, framework.convert_dtype(s.dtype))
         if isinstance(s, Tensor):

@@ -501,6 +501,81 @@ class Parameter(Tensor):
         return "Parameter " + super().__repr__()
 
 
+_VALUE_SLOT = Tensor.__dict__["_value"]
+
+
+class LazyParameter(Parameter):
+    """Parameter whose initializer runs on FIRST value access
+    (reference: paddle.LazyGuard lazy init for big models —
+    python/paddle/fluid/lazy_init.py — verify).
+
+    Shape/dtype come from the deferred spec, so constructing and
+    inspecting a multi-billion-parameter architecture (param counts,
+    layer wiring, sharding planning) costs no initializer compute or
+    weight memory; any ``_value`` read — forward, state_dict, optimizer
+    — materializes transparently. Under jit this also means a sharded
+    init path can materialize directly into the target sharding."""
+    __slots__ = ("_lazy_init",)
+
+    def __init__(self, init_fn, shape, dtype, name=None, trainable=True):
+        self._lazy_init = (init_fn, tuple(int(s) for s in shape), dtype)
+        super().__init__(None, name=name, trainable=trainable)
+        _VALUE_SLOT.__delete__(self)    # reads now trigger materialize
+
+    # the subclass property shadows the Tensor slot; the slot member
+    # descriptor remains the actual storage
+    @property
+    def _value(self):
+        try:
+            return _VALUE_SLOT.__get__(self)
+        except AttributeError:
+            init_fn, shape, dtype = self._lazy_init
+            _VALUE_SLOT.__set__(self, init_fn(shape, dtype))
+            return _VALUE_SLOT.__get__(self)
+
+    @_value.setter
+    def _value(self, v):
+        _VALUE_SLOT.__set__(self, v)
+
+    def materialized(self) -> bool:
+        try:
+            _VALUE_SLOT.__get__(self)
+            return True
+        except AttributeError:
+            return False
+
+    @property
+    def shape(self):
+        if not self.materialized():
+            return list(self._lazy_init[1])
+        return super().shape
+
+    @property
+    def ndim(self):
+        if not self.materialized():
+            return len(self._lazy_init[1])
+        return super().ndim
+
+    @property
+    def size(self):
+        if not self.materialized():
+            return int(np.prod(self._lazy_init[1])) \
+                if self._lazy_init[1] else 1
+        return super().size
+
+    @property
+    def dtype(self):
+        if not self.materialized():
+            return jax.dtypes.canonicalize_dtype(self._lazy_init[2])
+        return super().dtype
+
+    def __repr__(self):
+        if not self.materialized():
+            return (f"LazyParameter(shape={self.shape}, "
+                    f"dtype={self.dtype}, unmaterialized)")
+        return "Lazy" + super().__repr__()
+
+
 # ---------------------------------------------------------------------------
 # op application: the single dispatch point of the framework
 # ---------------------------------------------------------------------------

@@ -110,15 +110,10 @@ def main():
     on_tpu = jax.devices()[0].platform != "cpu"
     which = os.environ.get("PROFILE_CONFIG", "big" if on_tpu else "tiny")
     if which == "big":
-        # the 48.97%-MFU headline shape (bench.py config_big): pure-bf16
-        # states, per-layer remat, scan_layers
-        cfg = LlamaConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-            num_hidden_layers=16, num_attention_heads=16,
-            num_key_value_heads=16, max_position_embeddings=2048,
-            tensor_parallel=False, recompute=True,
-            recompute_granularity="full", scan_layers=True,
-            dtype="bfloat16")
+        # the headline shape (SAME object bench.py's config_big uses —
+        # profiling a drifted copy would mis-attribute the BENCH number)
+        from _bench_common import headline_big_config
+        cfg = headline_big_config("full")
         batch, seq = 8, 2048
     elif which == "small":
         cfg = LlamaConfig(

@@ -31,7 +31,9 @@ def test_workload_tiny_all():
     for name, line in zip(NAMES, lines):
         r = json.loads(line[len("WORKLOAD "):])
         assert "error" not in r, (name, r["error"])
-        assert r["workload"].startswith(name.split("_")[0])
+        # TINY mode labels resnet50 as resnet18_train_tiny_smoke
+        # (provenance: a stand-in model must not carry the real label)
+        assert r["workload"].startswith(name.split("_")[0][:6])
         if name == "sdxl_unet":
             assert r["infer_step_ms"] > 0 and r["train_step_ms"] > 0
         else:

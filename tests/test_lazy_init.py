@@ -1,0 +1,74 @@
+"""paddle.LazyGuard — deferred parameter init (reference:
+python/paddle/fluid/lazy_init.py LazyGuard — verify): construction
+under the guard creates LazyParameter leaves with known shape/dtype
+and zero initializer compute; first value access materializes."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.tensor import LazyParameter
+
+
+def test_lazy_construction_defers_and_counts():
+    with paddle.LazyGuard():
+        net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(),
+                            nn.Linear(64, 8))
+    ps = list(net.parameters())
+    assert all(isinstance(p, LazyParameter) for p in ps)
+    assert not any(p.materialized() for p in ps)
+    # shape/dtype/size/ndim metadata without materializing
+    assert net[0].weight.shape == [16, 64]
+    assert net[0].weight.ndim == 2
+    assert net[0].weight.size == 16 * 64
+    assert str(net[0].weight.dtype) == "float32"
+    assert "unmaterialized" in repr(net[0].weight)
+    assert not any(p.materialized() for p in ps)
+    total = sum(p.size for p in ps)
+    assert total == 16 * 64 + 64 + 64 * 8 + 8
+
+
+def test_forward_materializes_with_init_parity():
+    paddle.seed(11)
+    with paddle.LazyGuard():
+        lazy = nn.Linear(4, 3)
+    assert not lazy.weight.materialized()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 4).astype("float32"))
+    out = lazy(x)
+    assert lazy.weight.materialized()
+    paddle.seed(11)
+    eager = nn.Linear(4, 3)
+    np.testing.assert_allclose(out.numpy(), eager(x).numpy(), rtol=1e-6)
+
+
+def test_lazy_model_trains_and_saves():
+    paddle.seed(0)
+    with paddle.LazyGuard():
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                            nn.Linear(16, 2))
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=net.parameters())
+    rs = np.random.RandomState(1)
+    x = paddle.to_tensor(rs.rand(4, 8).astype("float32"))
+    y = paddle.to_tensor(rs.rand(4, 2).astype("float32"))
+    losses = []
+    for _ in range(5):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+    sd = net.state_dict()           # materializes remaining leaves
+    assert all(hasattr(v, "numpy") for v in sd.values())
+
+
+def test_nested_guard_and_normal_after_exit():
+    with paddle.LazyGuard():
+        with paddle.LazyGuard():
+            inner = nn.Linear(2, 2)
+        still_lazy = nn.Linear(2, 2)
+    after = nn.Linear(2, 2)
+    assert isinstance(inner.weight, LazyParameter)
+    assert isinstance(still_lazy.weight, LazyParameter)
+    assert not isinstance(after.weight, LazyParameter)
