@@ -36,6 +36,7 @@ from .consistency import (program_fingerprint,                    # noqa
                           check_program_consistency)
 
 from . import rpc                                                 # noqa
+from . import utils                                               # noqa
 from . import ps                                                  # noqa
 from .checkpoint import save_state_dict, load_state_dict          # noqa
 from .fleet import DistributedStrategy as Strategy                # noqa

@@ -1,0 +1,21 @@
+"""paddle.distributed.utils — reference parity namespace
+(python/paddle/distributed/utils/ — verify): the MoE expert-exchange
+ops live here in the reference's public API, plus small env helpers."""
+from __future__ import annotations
+
+import os
+
+from .communication import global_gather, global_scatter  # noqa: F401
+
+__all__ = ["global_scatter", "global_gather", "get_host_name_ip"]
+
+
+def get_host_name_ip():
+    """(hostname, ip) of this node, or None on resolution failure
+    (reference: paddle.distributed.utils.get_host_name_ip — verify)."""
+    import socket
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(host)
+    except OSError:
+        return None

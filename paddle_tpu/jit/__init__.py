@@ -28,7 +28,10 @@ from .. import framework
 from ..tensor import Tensor, Parameter, apply_op
 from ..nn.layer import Layer
 
+from .sot import SotFunction, symbolic_call  # noqa: E402,F401
+
 __all__ = ["to_static", "not_to_static", "TrainStep", "EvalStep", "save",
+           "SotFunction", "symbolic_call",
            "load", "ignore_module", "enable_to_static"]
 
 _TO_STATIC_ENABLED = True

@@ -19,6 +19,8 @@ def try_import(module_name):
 from . import dlpack  # noqa: F401
 from . import cpp_extension  # noqa: F401,E402
 from . import unique_name    # noqa: F401,E402
+from . import download       # noqa: F401,E402
+from .download import get_weights_path_from_url  # noqa: F401,E402
 
 
 def deprecated(update_to="", since="", reason="", level=0):
