@@ -314,9 +314,20 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
-    """Decorator/wrapper compiling a Layer or function into one XLA program."""
+              backend=None, full_graph=True, **kwargs):
+    """Decorator/wrapper compiling a Layer or function into one XLA
+    program. ``full_graph=True`` (default) is whole-graph jax tracing;
+    ``full_graph=False`` routes through the bytecode-level SOT executor
+    (reference: to_static's SOT default with graph breaks —
+    python/paddle/jit/api.py — verify): Python control flow over tensor
+    DATA is allowed and splits the program at graph breaks instead of
+    raising a tracer error."""
     def decorate(obj):
+        if not full_graph:
+            if isinstance(obj, Layer):
+                obj.forward = SotFunction(obj.forward)
+                return obj
+            return SotFunction(obj)
         if isinstance(obj, Layer):
             static = StaticFunction(obj.forward, layers=[obj],
                                     input_spec=input_spec)

@@ -94,6 +94,8 @@ def _leaves(tree):
     elif isinstance(tree, dict):
         for x in tree.values():
             yield from _leaves(x)
+    elif isinstance(tree, slice):
+        yield from _leaves([tree.start, tree.stop, tree.step])
     else:
         yield tree
 
@@ -136,6 +138,10 @@ def _map_tree(tree, fn):
         return [_map_tree(x, fn) for x in tree]
     if isinstance(tree, dict):
         return {k: _map_tree(v, fn) for k, v in tree.items()}
+    if isinstance(tree, slice):
+        return slice(_map_tree(tree.start, fn),
+                     _map_tree(tree.stop, fn),
+                     _map_tree(tree.step, fn))
     return fn(tree)
 
 
@@ -212,17 +218,29 @@ class _TraceNode:
 
 # ----------------------------------------------------------- guards
 
-def _guard_of(args, kwargs):
+def _guard_of(args, kwargs, keepalive=None):
     def leaf(v):
         if isinstance(v, Tensor):
             return ("T", tuple(v._value.shape), str(v._value.dtype))
         if isinstance(v, (int, float, bool, str, bytes, type(None))):
             return ("c", v)
         if isinstance(v, np.ndarray):
-            return ("a", v.shape, str(v.dtype))
+            # ndarray VALUES are baked into the recorded trace as
+            # constants, so the guard must cover content, not just
+            # shape/dtype; big arrays would make hashing the hot cost
+            if v.nbytes > (1 << 16):
+                raise CaptureFallback(
+                    "large ndarray argument (pass a Tensor instead)")
+            import hashlib
+            return ("a", v.shape, str(v.dtype),
+                    hashlib.sha1(np.ascontiguousarray(v).tobytes())
+                    .hexdigest())
         if callable(v):
-            # functions/layers guard by object identity (their code is
-            # what the trace recorded; a different object recaptures)
+            # functions/layers guard by object identity; the guard
+            # KEEPS A REFERENCE so a GC'd callable's id can never be
+            # recycled into a silent trace hit
+            if keepalive is not None:
+                keepalive.append(v)
             return ("fn", id(v))
         raise CaptureFallback(f"unguardable argument type {type(v)}")
 
@@ -424,7 +442,7 @@ class OpcodeExecutor:
         if how == "item":
             val = np.asarray(real._value).reshape(()).item()
             self._break("item", tv.slot, None)
-            return _RtScalar(val, ("item", tv.slot))
+            return _RtScalar(val, ("item", tv.slot, None))
         if how == "numpy":
             self._break("numpy", tv.slot, None)
             # numpy data in python land: fall back — arbitrary host
@@ -530,8 +548,18 @@ class OpcodeExecutor:
                     stack.append(self._apply_op(fn, [a, b]))
                 elif op == "BINARY_SUBSCR":
                     idx_v, obj = stack.pop(), stack.pop()
+                    # runtime scalars in INDEX position (x[:n]) decide
+                    # the result SHAPE -> specialize, never re-inject
+                    idx_v = self._specialize_rts(idx_v)
                     stack.append(self._apply_op(operator.getitem,
                                                 [obj, idx_v]))
+                elif op == "BINARY_SLICE":
+                    stop = stack.pop()
+                    start = stack.pop()
+                    obj = stack.pop()
+                    sl = self._specialize_rts(slice(start, stop))
+                    stack.append(self._apply_op(operator.getitem,
+                                                [obj, sl]))
                 elif op == "BUILD_SLICE":
                     if arg == 3:
                         c, b, a = stack.pop(), stack.pop(), stack.pop()
@@ -699,7 +727,8 @@ class OpcodeExecutor:
     def _specialize_rts(self, tree):
         """Python-only computation consuming a runtime scalar: the
         scalar's ORIGIN VALUE becomes a trace-tree decision and the
-        concrete value is used (dynamo-style specialization)."""
+        concrete value is used (dynamo-style specialization). Handles
+        scalars nested in lists/tuples/dicts/slices."""
         return _map_tree(tree, lambda v: self._rt_decision(v)
                          if isinstance(v, _RtScalar) else v)
 
@@ -754,7 +783,18 @@ class OpcodeExecutor:
         if isinstance(fn_obj, (_Traced, _RtScalar)):
             raise CaptureFallback("calling a tensor")
         if fn_obj is print:
-            return None                     # side-effect: drop
+            # the capture run IS the user's call: print must happen
+            # (with real tensor values); replays stay silent like the
+            # compiled path of the reference's SOT
+            def shown(v):
+                if isinstance(v, _Traced):
+                    return v.real
+                if isinstance(v, _RtScalar):
+                    return v.val
+                return v
+            print(*[_map_tree(a, shown) for a in args],
+                  **{k: _map_tree(v, shown) for k, v in kwargs.items()})
+            return None
         recv = getattr(fn_obj, "__self__", None)
         if isinstance(recv, (list, dict, set)):
             name = getattr(fn_obj, "__name__", "")
@@ -772,10 +812,19 @@ class OpcodeExecutor:
                     isinstance(args[0], _Traced):
                 if fn_obj is bool:
                     return self._concretize(args[0], "bool")
-                return self._concretize(args[0], "item")
+                rs = self._concretize(args[0], "item")
+                conv = "int" if fn_obj is int else "float"
+                return _RtScalar(fn_obj(rs.val),
+                                 (rs.origin[0], rs.origin[1], conv))
             if fn_obj is len and len(args) == 1 and \
                     isinstance(args[0], _Traced):
                 return self._concretize(args[0], "len")
+            if fn_obj in (int, float) and len(args) == 1 and \
+                    isinstance(args[0], _RtScalar) and not kwargs:
+                rs = args[0]
+                conv = "int" if fn_obj is int else "float"
+                return _RtScalar(fn_obj(rs.val),
+                                 (rs.origin[0], rs.origin[1], conv))
             if not any(isinstance(v, _Traced)
                        for v in _leaves([args, kwargs])):
                 # only runtime scalars: python-level call (range, int,
@@ -820,9 +869,7 @@ class OpcodeExecutor:
             if isinstance(v, _Ref):
                 return self.slot_vals[v.slot]
             if isinstance(v, _Rts):
-                return np.asarray(
-                    self.slot_vals[v.origin[1]]._value
-                    ).reshape(()).item()
+                return _origin_value(self.slot_vals, v.origin)
             if isinstance(v, _Const):
                 return v.v
             return v
@@ -843,14 +890,25 @@ class SotFunction:
         return _bind_positional(self.fn, args, kwargs)
 
     def __init__(self, fn):
+        if isinstance(fn, types.MethodType):
+            # bound method (e.g. layer.forward): capture the underlying
+            # function with the receiver prepended as a guarded-by-
+            # identity positional argument
+            self._recv = fn.__self__
+            fn = fn.__func__
+        else:
+            self._recv = None
         self.fn = fn
         self.traces: dict = {}       # guard -> (root, input_order)
         self.stats = {"captures": 0, "replays": 0, "fallbacks": 0,
                       "graph_breaks": 0}
+        self._guard_keepalive: list = []
         self._fallback_forever = False
         self.__name__ = getattr(fn, "__name__", "sot_fn")
 
     def __call__(self, *args, **kwargs):
+        if self._recv is not None:
+            args = (self._recv,) + args
         if self._fallback_forever:
             return self.fn(*args, **kwargs)
         try:
@@ -860,7 +918,7 @@ class SotFunction:
             # kwargs passed in a different order at replay would
             # otherwise silently swap tensors
             args, kwargs = self._bind(args, kwargs)
-            guard = _guard_of(args, kwargs)
+            guard = _guard_of(args, kwargs, self._guard_keepalive)
         except CaptureFallback:
             self.stats["fallbacks"] += 1
             self._fallback_forever = True
@@ -902,9 +960,8 @@ class SotFunction:
             for slot, origin in rts_inputs.get(id(node), ()):
                 if slot not in slot_vals:
                     import jax.numpy as jnp
-                    src = slot_vals[origin[1]]
                     slot_vals[slot] = Tensor(jnp.asarray(
-                        np.asarray(src._value).reshape(()).item()))
+                        _origin_value(slot_vals, origin)))
             node.segment.run(slot_vals)
             if node.kind == "return":
                 self.stats["replays"] += 1
@@ -913,9 +970,7 @@ class SotFunction:
                     if isinstance(v, _Ref):
                         return slot_vals[v.slot]
                     if isinstance(v, _Rts):
-                        return np.asarray(
-                            slot_vals[v.origin[1]]._value
-                            ).reshape(()).item()
+                        return _origin_value(slot_vals, v.origin)
                     if isinstance(v, _Const):
                         return v.v
                     return v
@@ -927,9 +982,7 @@ class SotFunction:
             elif node.kind == "item":
                 nxt = node.children.get(None)
             elif node.kind == "rt":
-                o_kind, o_slot = node.break_origin
-                val = np.asarray(
-                    slot_vals[o_slot]._value).reshape(()).item()
+                val = _origin_value(slot_vals, node.break_origin)
                 nxt = node.children.get(val)
             elif node.kind is None:
                 raise _UnseenPath()
@@ -942,6 +995,20 @@ class SotFunction:
 
 class _UnseenPath(Exception):
     pass
+
+
+def _origin_value(slot_vals, origin):
+    """Recompute a runtime scalar from live slots at replay: origin =
+    (kind, slot[, conv]) where conv applies int()/float() truncation
+    exactly as the captured code did."""
+    slot = origin[1]
+    conv = origin[2] if len(origin) > 2 else None
+    val = np.asarray(slot_vals[slot]._value).reshape(()).item()
+    if conv == "int":
+        val = int(val)
+    elif conv == "float":
+        val = float(val)
+    return val
 
 
 def _bind_positional(fn, args, kwargs):

@@ -574,20 +574,37 @@ class Converter:
         self._set(eqn.outvars[0], self.g.node("Conv", [x, w],
                                               attrs=attrs))
 
-    def _p_reduce_window_max(self, eqn):
-        x = self._name_of(eqn.invars[0])
+    def _pool_attrs(self, eqn, kind):
         wd = eqn.params["window_dimensions"]
         ws = eqn.params["window_strides"]
         pad = eqn.params["padding"]
         if len(wd) < 3 or any(int(d) != 1 for d in wd[:2]):
             raise NotImplementedError(
-                "onnx export: reduce_window_max that isn't NCHW pooling")
+                f"onnx export: {kind} that isn't NCHW pooling")
         pads = [int(lo) for lo, _ in pad[2:]] + \
             [int(hi) for _, hi in pad[2:]]
-        self._set(eqn.outvars[0], self.g.node("MaxPool", [x], attrs=[
-            _attr_ints("kernel_shape", wd[2:]),
-            _attr_ints("strides", ws[2:]),
-            _attr_ints("pads", pads)]))
+        return ([_attr_ints("kernel_shape", wd[2:]),
+                 _attr_ints("strides", ws[2:]),
+                 _attr_ints("pads", pads)],
+                int(np.prod([int(d) for d in wd[2:]])))
+
+    def _p_reduce_window_max(self, eqn):
+        x = self._name_of(eqn.invars[0])
+        attrs, _ = self._pool_attrs(eqn, "reduce_window_max")
+        self._set(eqn.outvars[0], self.g.node("MaxPool", [x],
+                                              attrs=attrs))
+
+    def _p_reduce_window_sum(self, eqn):
+        # sum-pool = AveragePool(count_include_pad=1) * prod(kernel) —
+        # count_include_pad=1 makes the divisor exactly the kernel size
+        # so the scale-back is exact even over padded cells
+        x = self._name_of(eqn.invars[0])
+        attrs, ksize = self._pool_attrs(eqn, "reduce_window_sum")
+        attrs.append(_attr_i("count_include_pad", 1))
+        ap = self.g.node("AveragePool", [x], attrs=attrs)
+        k = self.g.add_init(
+            np.asarray(float(ksize), eqn.invars[0].aval.dtype), "ksz")
+        self._set(eqn.outvars[0], self.g.node("Mul", [ap, k]))
 
 
 def convert(closed_jaxpr, input_names, output_names=None,

@@ -191,6 +191,8 @@ def _run_node(op, ins, at):
         return _conv(ins, at)
     if op == "MaxPool":
         return _maxpool(ins[0], at)
+    if op == "AveragePool":
+        return _avgpool(ins[0], at)
     raise NotImplementedError(f"onnx runtime: op {op}")
 
 
@@ -247,6 +249,31 @@ def _maxpool(x, at):
             xp[(slice(None), slice(None)) + sl],
             axis=tuple(range(2, n + 2)))
     return out
+
+
+def _avgpool(x, at):
+    k = at["kernel_shape"]
+    strides = at.get("strides", k)
+    pads = at.get("pads", [0] * (2 * len(k)))
+    n = len(k)
+    if not at.get("count_include_pad", 0) and any(
+            p != 0 for p in pads):
+        raise NotImplementedError(
+            "onnx runtime: AveragePool count_include_pad=0 with pads")
+    pw = [(0, 0), (0, 0)] + [(int(pads[i]), int(pads[i + n]))
+                             for i in range(n)]
+    xp = np.pad(x, pw)                     # zeros: count_include_pad=1
+    out_sp = [(xp.shape[2 + i] - k[i]) // int(strides[i]) + 1
+              for i in range(n)]
+    out = np.zeros((*x.shape[:2], *out_sp), np.float64)
+    for idx in np.ndindex(*out_sp):
+        sl = tuple(slice(int(strides[i]) * idx[i],
+                         int(strides[i]) * idx[i] + k[i])
+                   for i in range(n))
+        out[(slice(None), slice(None)) + idx] = np.mean(
+            xp[(slice(None), slice(None)) + sl],
+            axis=tuple(range(2, n + 2)))
+    return out.astype(x.dtype)
 
 
 def load(path: str) -> dict:

@@ -142,3 +142,15 @@ class TestExportNumericParity:
         with pytest.raises(NotImplementedError, match="sort"):
             ponnx.export(Sorter(), "/tmp/_should_not_exist",
                          input_spec=[paddle.to_tensor(x)])
+
+
+def test_avg_pool_roundtrip():
+    """reduce_window_sum -> AveragePool(count_include_pad=1) * k."""
+    paddle.seed(4)
+    net = nn.Sequential(nn.Conv2D(3, 4, 3, padding=1),
+                        nn.AvgPool2D(2, 2), nn.ReLU())
+    net.eval()
+    x = np.random.RandomState(4).rand(1, 3, 8, 8).astype("float32")
+    m = _roundtrip(net, x)
+    ops = {n["op_type"] for n in m["graph"]["node"]}
+    assert "AveragePool" in ops

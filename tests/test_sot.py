@@ -315,3 +315,50 @@ class TestSideEffectSafety:
         sh = SotFunction(h)
         sh(t(np.ones((2, 2))))
         assert len(log) == 1
+
+
+class TestReviewRegressions2:
+    """Second review round: value-sensitive guards and conversion-aware
+    runtime scalars."""
+
+    def test_ndarray_value_guard(self):
+        def g(x, mask):
+            return x * mask
+
+        sg = SotFunction(g)
+        x = t(np.ones((2,)))
+        np.testing.assert_array_equal(
+            sg(x, np.array([1.0, 0.0], np.float32)).numpy(), [1.0, 0.0])
+        np.testing.assert_array_equal(
+            sg(x, np.array([0.0, 1.0], np.float32)).numpy(), [0.0, 1.0])
+
+    def test_int_conversion_truncates_on_replay(self):
+        def f(x):
+            return int(x.sum()) * 2
+
+        sf = SotFunction(f)
+        a = t(np.full((1,), 2.7))
+        b = t(np.full((1,), 3.9))
+        assert sf(a) == 4 and sf(b) == 6 and sf(a) == 4
+        assert sot_stats(sf)["fallbacks"] == 0
+
+    def test_runtime_scalar_in_slice_specializes(self):
+        def h(x, y):
+            n = int(y.sum().item())
+            return x[:n].sum()
+
+        sh = SotFunction(h)
+        xx = t(np.arange(6))
+        assert float(sh(xx, t(np.full((1,), 3.0))).numpy()) == 3.0
+        assert float(sh(xx, t(np.full((1,), 3.0))).numpy()) == 3.0
+        assert float(sh(xx, t(np.full((1,), 4.0))).numpy()) == 6.0
+        assert sot_stats(sh)["fallbacks"] == 0
+
+    def test_print_executes_during_capture(self, capsys):
+        def f(x):
+            print("loss:", x.sum())
+            return x * 2.0
+
+        sf = SotFunction(f)
+        sf(t(np.ones((2, 2))))
+        assert "loss:" in capsys.readouterr().out
