@@ -51,6 +51,8 @@ from .hapi import Model, summary
 from .hapi.flops import flops
 from . import hub
 from . import onnx
+from . import regularizer
+from .hapi import callbacks  # paddle.callbacks alias (reference parity)
 from .framework import iinfo, finfo, LazyGuard
 
 # paddle API aliases
@@ -106,3 +108,22 @@ def get_flags(flags=None):
 def set_flags(flags):
     from .utils import flags as _f
     return _f.set_flags(flags)
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader transform (reference: paddle.batch — verify):
+    wraps a sample generator into a batch generator."""
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+def disable_signal_handler():
+    """Parity no-op: signal handling here is the host Python's."""

@@ -59,3 +59,11 @@ def destroy_process_group(group=None):
     for k, v in list(_c._GROUPS.items()):
         if v is group:
             del _c._GROUPS[k]
+
+
+def get_backend(group=None):
+    """Reference parity (paddle.distributed.get_backend — verify): the
+    collective backend name. Data-plane collectives are XLA-compiled
+    (GSPMD over ICI/DCN); the eager control plane rides the TCPStore.
+    """
+    return "XLA"

@@ -141,3 +141,6 @@ def identity_loss(x, reduction="none"):
     if reduction in (2, "mean"):
         return x.mean()
     return x
+
+# reference module path (needs LookAhead/ModelAverage above)
+from . import optimizer    # noqa: F401,E402

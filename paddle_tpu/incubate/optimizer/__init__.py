@@ -1,0 +1,7 @@
+"""paddle.incubate.optimizer namespace (reference parity:
+python/paddle/incubate/optimizer/ — verify): LookAhead/ModelAverage
+live at incubate top level here; re-exported under their reference
+module path."""
+from .. import LookAhead, ModelAverage  # noqa: F401
+
+__all__ = ["LookAhead", "ModelAverage"]

@@ -32,7 +32,7 @@ from .sot import SotFunction, symbolic_call  # noqa: E402,F401
 
 __all__ = ["to_static", "not_to_static", "TrainStep", "EvalStep", "save",
            "SotFunction", "symbolic_call",
-           "load", "ignore_module", "enable_to_static"]
+           "load", "ignore_module", "enable_to_static", "set_code_level"]
 
 _TO_STATIC_ENABLED = True
 
@@ -40,6 +40,13 @@ _TO_STATIC_ENABLED = True
 def enable_to_static(flag: bool):
     global _TO_STATIC_ENABLED
     _TO_STATIC_ENABLED = bool(flag)
+
+
+def set_code_level(level=100, also_to_stderr=False):
+    """Parity no-op (reference: paddle.jit.set_code_level prints SOT-
+    transformed code — verify): our SOT records op graphs rather than
+    rewriting bytecode; inspect SotFunction.traces / sot_stats instead.
+    """
 
 
 def ignore_module(modules):

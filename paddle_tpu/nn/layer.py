@@ -130,6 +130,8 @@ class Layer:
             if getattr(attr, "trainable", True) is False:
                 p.stop_gradient = True
                 p.trainable = False
+            if getattr(attr, "regularizer", None) is not None:
+                p.regularizer = attr.regularizer
         return p
 
     # -- traversal ----------------------------------------------------------

@@ -106,10 +106,73 @@ class Optimizer:
             seen.add(base)
             names.append(base)
         self._param_names = names
-        # regularization coeff in paddle may be L2Decay object
+        # regularization (reference: append_regularization_ops —
+        # verify). Optimizer-level weight_decay may be an L1Decay/
+        # L2Decay object: L2 keeps the existing coeff-in-_wd coupled
+        # path; L1 routes through the explicit grad-term path (there is
+        # no coupled-L1 fast path). A PARAMETER-level regularizer
+        # (ParamAttr(regularizer=...) / p.regularizer, read LIVE each
+        # step like the reference) WINS for its parameter: the
+        # optimizer-level decay — coupled _wd OR decoupled (AdamW) —
+        # is suppressed for it and the explicit term applies instead.
+        from ..regularizer import L1Decay
         wd = self._weight_decay
-        if hasattr(wd, "_coeff"):
+        self._opt_reg = None
+        if isinstance(wd, L1Decay):
+            self._weight_decay = 0.0
+            self._opt_reg = wd
+        elif hasattr(wd, "_coeff"):
             self._weight_decay = wd._coeff
+
+    @staticmethod
+    def _own_reg(p):
+        from ..regularizer import WeightDecayRegularizer
+        reg = getattr(p, "regularizer", None)
+        return reg if isinstance(reg, WeightDecayRegularizer) else None
+
+    def _live_regs(self, named) -> dict:
+        """name -> effective regularizer, read from the live params."""
+        regs = {}
+        for n, p in named:
+            reg = self._own_reg(p) or self._opt_reg
+            if reg is not None:
+                regs[n] = reg
+        return regs
+
+    def _regularize(self, grads: dict, param_value_of, regs) -> dict:
+        """Add regularizer grad terms (AFTER clipping, matching the
+        reference's ordering). ``param_value_of(name)`` -> jax array."""
+        if not regs:
+            return grads
+        out = dict(grads)
+        for n, reg in regs.items():
+            g = out.get(n)
+            if g is None:
+                continue
+            term = reg.grad_term(param_value_of(n))
+            out[n] = g + term.astype(g.dtype)
+        return out
+
+    def _wd_ctx(self, suppress: bool):
+        """Temporarily zero self._weight_decay around one param's
+        _apply when its own regularizer replaces the optimizer decay.
+        One shared helper for both the eager and functional loops (the
+        _apply contract reads self._weight_decay, so per-call threading
+        would mean changing every subclass signature)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            if not suppress:
+                yield
+                return
+            saved = self._weight_decay
+            self._weight_decay = 0.0
+            try:
+                yield
+            finally:
+                self._weight_decay = saved
+        return ctx()
 
     # -- functional core (override per optimizer) ---------------------------
     def _init_slots(self, p: jax.Array) -> dict:
@@ -181,30 +244,39 @@ class Optimizer:
             return
         if self._grad_clip is not None:
             grads = self._grad_clip.apply(grads)
+        by_name = dict(named)
+        regs = self._live_regs(named)
+        grads = self._regularize(grads, lambda n: by_name[n]._value,
+                                 regs)
         lr_val = self.get_lr()
         self._step_count += 1
         for n, p in named:
             g = grads.get(n)
             if g is None:
                 continue
-            slots = self._ensure_slots(n, p)
-            plr = lr_val * p.optimize_attr.get("learning_rate", 1.0) \
-                if hasattr(p, "optimize_attr") else lr_val
-            if "master" in slots:
-                master = slots["master"]
-                new_master, new_slots = self._apply(
-                    master, g.astype(jnp.float32),
-                    {k: v for k, v in slots.items() if k != "master"},
-                    plr, self._step_count)
-                new_slots = self._keep_slot_dtypes(slots, new_slots)
-                new_slots["master"] = new_master
-                p._update_value(new_master.astype(p._value.dtype))
-            else:
-                new_p, new_slots = self._apply(p._value, g, slots, plr,
-                                               self._step_count)
-                new_slots = self._keep_slot_dtypes(slots, new_slots)
-                p._update_value(new_p.astype(p._value.dtype))
-            self._slots[n] = new_slots
+            with self._wd_ctx(self._own_reg(p) is not None):
+                self._step_one(n, p, g, lr_val)
+        return
+
+    def _step_one(self, n, p, g, lr_val):
+        slots = self._ensure_slots(n, p)
+        plr = lr_val * p.optimize_attr.get("learning_rate", 1.0) \
+            if hasattr(p, "optimize_attr") else lr_val
+        if "master" in slots:
+            master = slots["master"]
+            new_master, new_slots = self._apply(
+                master, g.astype(jnp.float32),
+                {k: v for k, v in slots.items() if k != "master"},
+                plr, self._step_count)
+            new_slots = self._keep_slot_dtypes(slots, new_slots)
+            new_slots["master"] = new_master
+            p._update_value(new_master.astype(p._value.dtype))
+        else:
+            new_p, new_slots = self._apply(p._value, g, slots, plr,
+                                           self._step_count)
+            new_slots = self._keep_slot_dtypes(slots, new_slots)
+            p._update_value(new_p.astype(p._value.dtype))
+        self._slots[n] = new_slots
 
     def clear_grad(self, set_to_zero=False):
         for p in self._param_list:
@@ -248,6 +320,10 @@ class Optimizer:
                      for n, g in grads.items()}
         if self._grad_clip is not None:
             grads = self._grad_clip.apply(grads)
+        named = list(zip(self._param_names, self._param_list))
+        regs = self._live_regs(named)
+        grads = self._regularize(grads, lambda n: params[n], regs)
+        own = {n for n, p in named if self._own_reg(p) is not None}
         step = state["step"] + 1
         slots = state["slots"]
         new_params, new_slots = {}, {}
@@ -257,26 +333,30 @@ class Optimizer:
                 new_params[n] = p
                 new_slots[n] = slots.get(n, {})
                 continue
-            s = dict(slots.get(n, {}))
-            if "master" in s:
-                master, rest = s["master"], {k: v for k, v in s.items()
-                                             if k != "master"}
-                new_master, ns = self._apply(master, g.astype(jnp.float32),
-                                             rest, lr_value, step)
-                ns = self._keep_slot_dtypes(s, ns)
-                ns["master"] = new_master
-                new_params[n] = new_master.astype(p.dtype)
-                new_slots[n] = ns
-            else:
-                new_p, ns = self._apply(p, g, s, lr_value, step)
-                new_params[n] = new_p.astype(p.dtype) \
-                    if hasattr(new_p, "astype") else new_p
-                new_slots[n] = self._keep_slot_dtypes(s, ns)
+            with self._wd_ctx(n in own):
+                new_params[n], new_slots[n] = self._fu_one(
+                    n, p, g, slots, lr_value, step)
         if self._slot_constrain is not None:
             new_slots = {n: {k: self._slot_constrain(v, n, k)
                              for k, v in s.items()}
                          for n, s in new_slots.items()}
         return new_params, {"slots": new_slots, "step": step}
+
+    def _fu_one(self, n, p, g, slots, lr_value, step):
+        """One param's pure update -> (new_param, new_slots_for_n)."""
+        s = dict(slots.get(n, {}))
+        if "master" in s:
+            master, rest = s["master"], {k: v for k, v in s.items()
+                                         if k != "master"}
+            new_master, ns = self._apply(master, g.astype(jnp.float32),
+                                         rest, lr_value, step)
+            ns = self._keep_slot_dtypes(s, ns)
+            ns["master"] = new_master
+            return new_master.astype(p.dtype), ns
+        new_p, ns = self._apply(p, g, s, lr_value, step)
+        new_p = new_p.astype(p.dtype) if hasattr(new_p, "astype") \
+            else new_p
+        return new_p, self._keep_slot_dtypes(s, ns)
 
     # -- state dict ---------------------------------------------------------
     def state_dict(self):

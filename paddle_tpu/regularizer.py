@@ -1,0 +1,48 @@
+"""paddle.regularizer — L1/L2 weight decay as grad regularization
+(reference parity: python/paddle/regularizer.py L1Decay/L2Decay —
+verify).
+
+Semantics follow the reference: a regularizer attached to a parameter
+(``ParamAttr(regularizer=...)``) WINS over the optimizer-level
+``weight_decay`` regularizer for that parameter; regularization is
+added to the gradient after gradient clipping (the reference's
+append_regularization_ops ordering); and for decoupled-decay optimizers
+(AdamW/Lamb) a parameter that carries its own regularizer is excluded
+from the decoupled decay and gets the explicit regularizer gradient
+instead.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def grad_term(self, param):
+        """Contribution added to the parameter's gradient. Pure."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param (classic coupled L2)."""
+
+    def grad_term(self, param):
+        return self._coeff * param
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param)."""
+
+    def grad_term(self, param):
+        return self._coeff * jnp.sign(param)

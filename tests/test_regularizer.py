@@ -1,0 +1,121 @@
+"""paddle.regularizer L1/L2 decay semantics (reference:
+python/paddle/regularizer.py + append_regularization_ops — verify):
+optimizer-level decay, parameter-level override, L1 sign term, AdamW
+decoupled-decay suppression for self-regularized params, and parity
+between eager step() and the jitted functional path."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.regularizer import L1Decay, L2Decay
+
+
+def _one_sgd_step(param_np, grad_np, **opt_kw):
+    p = paddle.to_tensor(param_np.copy())
+    p.stop_gradient = False
+    par = paddle.tensor.Parameter(p._value)
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[par], **opt_kw)
+    par.grad = paddle.to_tensor(grad_np.copy())
+    opt.step()
+    return np.asarray(par._value)
+
+
+def test_optimizer_level_l2decay_object():
+    w = np.full((3,), 2.0, np.float32)
+    g = np.zeros((3,), np.float32)
+    out = _one_sgd_step(w, g, weight_decay=L2Decay(0.1))
+    # p - lr*(g + 0.1*p) = 2 - 0.2
+    np.testing.assert_allclose(out, 1.8, rtol=1e-6)
+
+
+def test_optimizer_level_l1decay_object():
+    w = np.asarray([2.0, -3.0, 0.0], np.float32)
+    g = np.zeros((3,), np.float32)
+    out = _one_sgd_step(w, g, weight_decay=L1Decay(0.5))
+    # p - lr*0.5*sign(p)
+    np.testing.assert_allclose(out, [1.5, -2.5, 0.0], rtol=1e-6)
+
+
+def test_param_level_regularizer_wins():
+    from paddle_tpu.tensor import Parameter
+    import jax.numpy as jnp
+    p1 = Parameter(jnp.full((2,), 2.0))          # uses optimizer L2(0.1)
+    p2 = Parameter(jnp.full((2,), 2.0))
+    p2.regularizer = L2Decay(0.5)                # own, must WIN
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[p1, p2],
+                        weight_decay=L2Decay(0.1))
+    z = paddle.to_tensor(np.zeros((2,), np.float32))
+    p1.grad, p2.grad = z, z
+    opt.step()
+    np.testing.assert_allclose(np.asarray(p1._value), 1.8, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(p2._value), 1.0, rtol=1e-6)
+
+
+def test_adamw_decoupled_suppressed_for_own_regularizer():
+    """A param with its own regularizer gets the explicit grad term and
+    NOT AdamW's decoupled decay (reference AdamW behavior)."""
+    from paddle_tpu.tensor import Parameter
+    import jax.numpy as jnp
+    paddle.seed(0)
+    p_dec = Parameter(jnp.full((4,), 1.0))       # decoupled wd path
+    p_reg = Parameter(jnp.full((4,), 1.0))
+    p_reg.regularizer = L2Decay(0.0)             # own reg, coeff 0
+    opt = optimizer.AdamW(learning_rate=0.0, weight_decay=0.5,
+                          parameters=[p_dec, p_reg])
+    z = paddle.to_tensor(np.zeros((4,), np.float32))
+    p_dec.grad, p_reg.grad = z, z
+    opt.step()
+    # lr=0: Adam update is 0; decoupled decay (lr-independent in ref?
+    # here it scales params directly) must touch ONLY p_dec
+    dec_moved = not np.allclose(np.asarray(p_dec._value), 1.0)
+    reg_moved = not np.allclose(np.asarray(p_reg._value), 1.0)
+    assert not reg_moved, np.asarray(p_reg._value)
+    # p_dec may or may not move depending on lr coupling; the contract
+    # under test is only the suppression on p_reg
+    _ = dec_moved
+
+
+def test_train_step_functional_parity():
+    """Regularization must behave identically through the eager step()
+    and the jitted TrainStep functional path."""
+    from paddle_tpu.jit import TrainStep
+
+    def build():
+        paddle.seed(7)
+        net = nn.Linear(4, 3,
+                        weight_attr=paddle.ParamAttr(
+                            regularizer=L2Decay(0.3)))
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=net.parameters())
+        return net, opt
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(2, 4).astype("float32"))
+    y = paddle.to_tensor(
+        np.random.RandomState(1).rand(2, 3).astype("float32"))
+    mse = nn.MSELoss()
+
+    net_e, opt_e = build()
+    for _ in range(3):
+        loss = mse(net_e(x), y)
+        loss.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+
+    net_j, opt_j = build()
+    step = TrainStep(net_j, lambda m, b: mse(m(b[0]), b[1]), opt_j)
+    for _ in range(3):
+        step((x, y))
+
+    for (n1, p1), (n2, p2) in zip(net_e.named_parameters(),
+                                  net_j.named_parameters()):
+        np.testing.assert_allclose(
+            np.asarray(p1._value), np.asarray(p2._value), atol=1e-6,
+            err_msg=n1)
+
+
+def test_param_attr_regularizer_reaches_parameter():
+    net = nn.Linear(4, 3, weight_attr=paddle.ParamAttr(
+        regularizer=L1Decay(0.01)))
+    assert isinstance(net.weight.regularizer, L1Decay)
+    assert net.bias.regularizer is None
