@@ -18,6 +18,7 @@ import jax
 from ..mesh import HybridCommunicateGroup, get_hybrid_communicate_group
 from ..parallel import DataParallel
 from . import meta_parallel
+from . import meta_optimizers
 from . import utils                                        # noqa
 from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,
                             VocabParallelEmbedding, ParallelCrossEntropy,
@@ -142,6 +143,20 @@ def distributed_optimizer(optimizer, strategy=None):
         # model-side placement (stage 3) is handled by distributed_model;
         # here only the optimizer hooks are attached
         _, optimizer, _ = group_sharded_parallel(None, optimizer, level)
+    # strategy flags -> meta-optimizer wrappers (reference: the
+    # meta_optimizers pass stack applied by fleet per strategy)
+    if strategy is not None and getattr(strategy, "gradient_merge",
+                                        False):
+        cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
+        optimizer = meta_optimizers.GradientMergeOptimizer(
+            optimizer, k_steps=int(cfg.get("k_steps", 1)),
+            avg=bool(cfg.get("avg", True)))
+    if strategy is not None and getattr(strategy, "amp", False):
+        cfg = getattr(strategy, "amp_configs", {}) or {}
+        optimizer = meta_optimizers.AMPOptimizer(
+            optimizer, dtype=cfg.get("dtype", "bfloat16"),
+            init_loss_scaling=float(
+                cfg.get("init_loss_scaling", 2.**15)))
     return optimizer
 
 

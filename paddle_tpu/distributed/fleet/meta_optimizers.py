@@ -1,0 +1,170 @@
+"""fleet.meta_optimizers — reference parity namespace
+(python/paddle/distributed/fleet/meta_optimizers/ — verify).
+
+The reference's meta-optimizers are static-graph program rewriters
+(AMP pass, recompute pass, gradient-merge pass) stacked by
+DistributedStrategy flags. Here the same capabilities are functional
+wrappers over the inner optimizer / model:
+
+  - GradientMergeOptimizer: REAL k-step gradient accumulation — grads
+    sum on device across k micro-steps (optionally averaged), the inner
+    optimizer steps once per k, clear_grad between micro-steps is a
+    no-op for merged params so the accumulator survives the user's
+    standard train loop.
+  - RecomputeOptimizer: pairs with `fleet.utils.recompute` — holds the
+    inner optimizer and exposes the reference's API shape (the actual
+    rematerialization is jax.checkpoint at the layer, SURVEY §7).
+  - AMPOptimizer: wraps with `amp.decorate` semantics — scales via
+    GradScaler when fp16, plain bf16 otherwise.
+
+These also back DistributedStrategy's gradient_merge/amp/recompute
+flags in fleet.distributed_optimizer.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["GradientMergeOptimizer", "RecomputeOptimizer",
+           "AMPOptimizer"]
+
+
+class _MetaBase:
+    def __init__(self, inner):
+        self.inner_opt = inner
+
+    def __getattr__(self, name):
+        return getattr(self.inner_opt, name)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Route through THIS wrapper's step() — delegating minimize to
+        the inner optimizer would silently bypass accumulation/scaling
+        (the reference meta-optimizers own minimize for the same
+        reason)."""
+        loss.backward()
+        self.step()
+
+
+class GradientMergeOptimizer(_MetaBase):
+    """k-step gradient accumulation (reference: gradient_merge pass /
+    GradientMergeOptimizer — verify).
+
+        opt = GradientMergeOptimizer(inner, k_steps=4, avg=True)
+        for batch in loader:
+            loss.backward(); opt.step(); opt.clear_grad()
+
+    Only every k-th step() runs the inner optimizer (on the merged
+    grads); the others accumulate and return."""
+
+    def __init__(self, inner, k_steps=1, avg=True):
+        super().__init__(inner)
+        self.k_steps = max(int(k_steps), 1)
+        self.avg = bool(avg)
+        self._acc: dict[int, object] = {}
+        self._micro = 0
+
+    def step(self):
+        self._micro += 1
+        params = self.inner_opt._param_list
+        for p in params:
+            if p.grad is None or p.stop_gradient:
+                continue
+            g = p.grad._value
+            aid = id(p)
+            acc = self._acc.get(aid)
+            self._acc[aid] = g if acc is None else acc + g
+        if self._micro < self.k_steps:
+            return
+        # merged step: install accumulated grads, run the inner opt
+        from ...tensor import Tensor
+        scale = 1.0 / self.k_steps if self.avg else 1.0
+        for p in params:
+            acc = self._acc.get(id(p))
+            if acc is None:
+                continue
+            p.grad = Tensor(acc * jnp.asarray(scale, acc.dtype))
+        self.inner_opt.step()
+        self._acc.clear()
+        self._micro = 0
+        for p in params:
+            p.clear_gradient(False)
+
+    def clear_grad(self, set_to_zero=False):
+        """Clears only the CURRENT micro-step's grads; the merged
+        accumulator lives in this wrapper, so the reference train-loop
+        shape (backward/step/clear_grad) accumulates correctly."""
+        for p in self.inner_opt._param_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        """Inner state plus the mid-cycle accumulator — a checkpoint
+        taken between merged steps must not drop accumulated grads
+        (same precedent as incubate.LookAhead's wrapper slots)."""
+        from ...tensor import Tensor
+        out = dict(self.inner_opt.state_dict())
+        out["@gm_micro"] = self._micro
+        names = dict(zip((id(p) for p in self.inner_opt._param_list),
+                         self.inner_opt._param_names))
+        for aid, acc in self._acc.items():
+            n = names.get(aid)
+            if n is not None:
+                out[f"@gm_acc.{n}"] = Tensor(acc)
+        return out
+
+    def set_state_dict(self, state):
+        state = dict(state)
+        self._micro = int(state.pop("@gm_micro", 0))
+        by_name = dict(zip(self.inner_opt._param_names,
+                           self.inner_opt._param_list))
+        self._acc = {}
+        for k in list(state):
+            if k.startswith("@gm_acc."):
+                n = k[len("@gm_acc."):]
+                p = by_name.get(n)
+                v = state.pop(k)
+                if p is not None:
+                    self._acc[id(p)] = getattr(v, "_value", v)
+        self.inner_opt.set_state_dict(state)
+
+
+class RecomputeOptimizer(_MetaBase):
+    """API-shape parity (reference: RecomputeOptimizer — verify): the
+    rematerialization itself is `fleet.utils.recompute` /
+    `recompute_sequential` (jax.checkpoint) applied at the layer;
+    this wrapper carries the inner optimizer through fleet plumbing."""
+
+    def __init__(self, inner, checkpoints=None):
+        super().__init__(inner)
+        self.checkpoints = checkpoints or []
+
+    def step(self):
+        self.inner_opt.step()
+
+
+class AMPOptimizer(_MetaBase):
+    """Mixed-precision wrapper (reference: AMPOptimizer — verify):
+    fp16 uses GradScaler loss scaling; bf16 (the TPU default) needs
+    none, matching `amp.decorate(level="O2")` semantics."""
+
+    def __init__(self, inner, dtype="bfloat16", init_loss_scaling=2.**15):
+        super().__init__(inner)
+        self.dtype = dtype
+        self._scaler = None
+        if dtype == "float16":
+            from ... import amp
+            self._scaler = amp.GradScaler(
+                init_loss_scaling=init_loss_scaling)
+
+    def scale_loss(self, loss):
+        if self._scaler is not None:
+            return self._scaler.scale(loss)
+        return loss
+
+    def step(self):
+        if self._scaler is not None:
+            self._scaler.step(self.inner_opt)
+            self._scaler.update()
+            return
+        self.inner_opt.step()
