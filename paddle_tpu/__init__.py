@@ -52,6 +52,7 @@ from .hapi.flops import flops
 from . import hub
 from . import onnx
 from . import regularizer
+from . import multiprocessing
 from .hapi import callbacks  # paddle.callbacks alias (reference parity)
 from .framework import iinfo, finfo, LazyGuard
 

@@ -40,9 +40,18 @@ class _MetaBase:
         """Route through THIS wrapper's step() — delegating minimize to
         the inner optimizer would silently bypass accumulation/scaling
         (the reference meta-optimizers own minimize for the same
-        reason)."""
+        reason). Static-graph mode delegates to the inner optimizer:
+        there the capability comes from the Engine pass pipeline, and
+        an eager backward() would break program capture. Returns the
+        base (None, None) contract."""
+        from ... import framework
+        if framework.in_static_mode():
+            return self.inner_opt.minimize(
+                loss, startup_program=startup_program,
+                parameters=parameters, no_grad_set=no_grad_set)
         loss.backward()
         self.step()
+        return None, None
 
 
 class GradientMergeOptimizer(_MetaBase):
@@ -73,6 +82,11 @@ class GradientMergeOptimizer(_MetaBase):
             aid = id(p)
             acc = self._acc.get(aid)
             self._acc[aid] = g if acc is None else acc + g
+            # snapshot-and-clear: backward() ACCUMULATES into p.grad,
+            # so leaving the micro-grad there would double-count it on
+            # the next micro-step in clear_grad-free loops (minimize);
+            # clearing here makes both loop shapes correct
+            p.clear_gradient(False)
         if self._micro < self.k_steps:
             return
         # merged step: install accumulated grads, run the inner opt
@@ -161,6 +175,20 @@ class AMPOptimizer(_MetaBase):
         if self._scaler is not None:
             return self._scaler.scale(loss)
         return loss
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """fp16: the loss MUST be scaled before backward or step()'s
+        unscale_ divides never-scaled grads by the loss scale (a
+        silent 2^15 lr shrink)."""
+        from ... import framework
+        if framework.in_static_mode():
+            return self.inner_opt.minimize(
+                loss, startup_program=startup_program,
+                parameters=parameters, no_grad_set=no_grad_set)
+        self.scale_loss(loss).backward()
+        self.step()
+        return None, None
 
     def step(self):
         if self._scaler is not None:

@@ -339,3 +339,13 @@ def finfo(dtype):
         import ml_dtypes
         info = ml_dtypes.finfo(dt)
     return _DtypeInfo(info, "f")
+
+
+# paddle.framework.random parity (reference: python/paddle/framework/
+# random.py — verify): rng state get/set over the jax key machinery
+def get_rng_state():
+    return [state().rng_key]
+
+
+def set_rng_state(st):
+    state().rng_key = st[0] if isinstance(st, (list, tuple)) else st

@@ -1,0 +1,10 @@
+"""paddle.multiprocessing — reference parity shim
+(python/paddle/incubate/multiprocessing — verify). The reference adds
+CUDA-tensor-sharing reductions to std multiprocessing; jax arrays are
+immutable device buffers with no cross-process share path, so this
+module re-exports std multiprocessing plus the launch-contract spawn
+helper (each child is its own jax runtime)."""
+from multiprocessing import *          # noqa: F401,F403
+from multiprocessing import get_context, get_start_method  # noqa: F401
+
+from .distributed.launch_utils import spawn  # noqa: F401

@@ -243,3 +243,49 @@ class TestMetaOptimizers:
         # mean(3,6,9) = 6 applied once
         np.testing.assert_allclose(np.asarray(p2._value), -6.0,
                                    rtol=1e-6)
+
+    def test_minimize_loop_no_clear_no_double_count(self):
+        """backward() accumulates into .grad; the merge wrapper must
+        snapshot-and-clear each micro-step so a clear_grad-free
+        minimize loop cannot double-count (review-reproduced bug)."""
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            GradientMergeOptimizer)
+        from paddle_tpu.tensor import Parameter
+        import jax.numpy as jnp
+        p = Parameter(jnp.zeros((1,)))
+        opt = GradientMergeOptimizer(
+            optimizer.SGD(learning_rate=1.0, parameters=[p]), k_steps=2)
+        # emulate two backward()+step() micro-steps with NO clear_grad
+        p.grad = paddle.to_tensor(np.full((1,), 3.0, np.float32))
+        opt.step()
+        assert p.grad is None or np.allclose(np.asarray(p.grad._value),
+                                             0.0)
+        p.grad = paddle.to_tensor(np.full((1,), 6.0, np.float32))
+        ret = opt.step()
+        # mean(3, 6) = 4.5, NOT (3 + (3+6))/2 = 6.0
+        np.testing.assert_allclose(np.asarray(p._value), -4.5, rtol=1e-6)
+        assert ret is None
+
+    def test_amp_fp16_minimize_scales_loss(self):
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            AMPOptimizer)
+        paddle.seed(3)
+        net = nn.Linear(3, 1)
+        ref = nn.Linear(3, 1)
+        ref.set_state_dict(net.state_dict())
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        y = paddle.to_tensor(np.zeros((2, 1), np.float32))
+        mse = nn.MSELoss()
+        ao = AMPOptimizer(optimizer.SGD(learning_rate=0.1,
+                                        parameters=net.parameters()),
+                          dtype="float16")
+        out = ao.minimize(mse(net(x), y))
+        assert out == (None, None)
+        # plain SGD reference: grads must match unscaled magnitudes
+        ro = optimizer.SGD(learning_rate=0.1, parameters=ref.parameters())
+        loss = mse(ref(x), y)
+        loss.backward()
+        ro.step()
+        np.testing.assert_allclose(np.asarray(net.weight._value),
+                                   np.asarray(ref.weight._value),
+                                   rtol=1e-3)
