@@ -53,6 +53,17 @@ def _v(x):
 # ---------------------------------------------------------------------------
 
 def linear(x, weight, bias=None, name=None):
+    # shape precheck: the raw XLA dot_general error for a feature-dim
+    # mismatch is cryptic (documented verify-skill friction); name both
+    # shapes the way the reference's enforce message does
+    xs = getattr(x, "shape", None)
+    ws = getattr(weight, "shape", None)
+    if xs and ws and len(ws) == 2 and int(xs[-1]) != int(ws[0]):
+        from ..utils.enforce import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"linear: input feature dim {int(xs[-1])} (x.shape={list(xs)})"
+            f" != weight.shape[0] {int(ws[0])} (weight.shape={list(ws)})")
+
     def f(a, w, *b):
         from ..amp import white_cast
         a, w = white_cast(a, w, op_name=("linear", "matmul"))
@@ -429,7 +440,11 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, nd,
             data_format):
     strides = _pair(stride, nd)
     dils = _pair(dilation, nd)
-    chan_last = data_format in ("NHWC", "NLC", "NDHWC")
+    # conv1d translates NLC -> NHC before this point; missing it here
+    # made chan_last ALWAYS False for 1-d and ran channel-last data
+    # through channel-first dimension numbers (silent wrong output,
+    # found by review of the r4 channel precheck)
+    chan_last = data_format in ("NHWC", "NLC", "NHC", "NDHWC")
     spec = {1: ("NCH", "OIH", "NCH") if not chan_last else
                ("NHC", "OIH", "NHC"),
             2: ("NCHW", "OIHW", "NCHW") if not chan_last else
@@ -437,6 +452,19 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, nd,
             3: ("NCDHW", "OIDHW", "NCDHW") if not chan_last else
                ("NDHWC", "OIDHW", "NDHWC")}[nd]
     kshape = weight.shape[2:]
+    # channel precheck: XLA's conv dimension error is cryptic; name the
+    # shapes (reference enforce-style message)
+    xs = getattr(x, "shape", None)
+    if xs is not None and len(xs) == nd + 2:
+        cin = int(xs[-1] if chan_last else xs[1])
+        want = int(weight.shape[1]) * int(groups)
+        if cin != want:
+            from ..utils.enforce import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"conv{nd}d: input has {cin} channels "
+                f"(x.shape={list(xs)}, data_format={data_format}) but "
+                f"weight expects {want} "
+                f"(weight.shape={list(weight.shape)}, groups={groups})")
     pad_arg = _conv_padding(padding, nd, strides, kshape, dils)
 
     def f(v, w, *b):

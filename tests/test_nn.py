@@ -682,3 +682,51 @@ class TestNnUtils:
         with pytest.raises(ValueError):
             U.vector_to_parameters(
                 paddle.to_tensor(np.zeros(5, np.float32)), ps)
+
+
+class TestShapeMismatchErrors:
+    """Layer-level shape prechecks: the raw XLA dot_general/conv errors
+    are cryptic (documented verify-skill friction); the paddle-style
+    message must name both shapes."""
+
+    def test_linear_feature_mismatch(self):
+        lin = nn.Linear(4, 2)
+        x = paddle.to_tensor(np.zeros((3, 5), np.float32))
+        with pytest.raises(ValueError, match=r"5.*4|4.*5"):
+            lin(x)
+
+    def test_conv2d_channel_mismatch(self):
+        conv = nn.Conv2D(3, 8, 3)
+        x = paddle.to_tensor(np.zeros((1, 4, 8, 8), np.float32))
+        with pytest.raises(ValueError, match="4 channels"):
+            conv(x)
+
+    def test_valid_shapes_unaffected(self):
+        lin = nn.Linear(4, 2)
+        out = lin(paddle.to_tensor(np.zeros((3, 4), np.float32)))
+        assert list(out.shape) == [3, 2]
+        conv = nn.Conv2D(3, 8, 3, padding=1)
+        out = conv(paddle.to_tensor(np.zeros((1, 3, 8, 8), np.float32)))
+        assert list(out.shape) == [1, 8, 8, 8]
+
+    def test_errors_are_typed_invalid_argument(self):
+        from paddle_tpu.utils.enforce import InvalidArgumentError
+        lin = nn.Linear(4, 2)
+        with pytest.raises(InvalidArgumentError):
+            lin(paddle.to_tensor(np.zeros((3, 5), np.float32)))
+
+    def test_conv1d_nlc_matches_ncl(self):
+        """Pre-existing bug found via the r4 precheck review: NLC
+        conv1d ran with channel-FIRST dimension numbers (chan_last
+        never matched the translated NHC format) — silent wrong
+        output. NLC must equal transposed NCL."""
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(0)
+        x_ncl = rs.rand(2, 3, 8).astype(np.float32)     # N, C, L
+        w = paddle.to_tensor(rs.rand(5, 3, 3).astype(np.float32))
+        out_ncl = F.conv1d(paddle.to_tensor(x_ncl), w,
+                           data_format="NCL").numpy()
+        out_nlc = F.conv1d(paddle.to_tensor(
+            x_ncl.transpose(0, 2, 1)), w, data_format="NLC").numpy()
+        np.testing.assert_allclose(out_nlc.transpose(0, 2, 1), out_ncl,
+                                   rtol=1e-5, atol=1e-5)
