@@ -58,11 +58,13 @@ def linear(x, weight, bias=None, name=None):
     # shapes the way the reference's enforce message does
     xs = getattr(x, "shape", None)
     ws = getattr(weight, "shape", None)
-    if xs and ws and len(ws) == 2 and int(xs[-1]) != int(ws[0]):
-        from ..utils.enforce import InvalidArgumentError
-        raise InvalidArgumentError(
-            f"linear: input feature dim {int(xs[-1])} (x.shape={list(xs)})"
-            f" != weight.shape[0] {int(ws[0])} (weight.shape={list(ws)})")
+    if xs and ws and len(ws) == 2:
+        from ..utils.enforce import InvalidArgumentError, enforce
+        enforce(int(xs[-1]) == int(ws[0]),
+                f"linear: input feature dim {int(xs[-1])} "
+                f"(x.shape={list(xs)}) != weight.shape[0] {int(ws[0])} "
+                f"(weight.shape={list(ws)})",
+                error=InvalidArgumentError)
 
     def f(a, w, *b):
         from ..amp import white_cast
@@ -402,6 +404,12 @@ def _pair(v, n):
     return (int(v),) * n
 
 
+# conv1d translates NLC -> NHC before _convnd; NHC must be in this set
+# or channel-last 1-d data runs through channel-first dimension numbers
+# (silent wrong output — found by review of the r4 channel precheck)
+_CHANNEL_LAST = ("NHWC", "NLC", "NHC", "NDHWC")
+
+
 def _conv_padding(padding, nd, stride, kernel, dilation):
     if isinstance(padding, str):
         return padding.upper()  # SAME / VALID
@@ -437,14 +445,10 @@ def _conv_amp_dtypes(v, w, op_name):
 
 
 def _convnd(x, weight, bias, stride, padding, dilation, groups, nd,
-            data_format):
+            data_format, _display_format=None):
     strides = _pair(stride, nd)
     dils = _pair(dilation, nd)
-    # conv1d translates NLC -> NHC before this point; missing it here
-    # made chan_last ALWAYS False for 1-d and ran channel-last data
-    # through channel-first dimension numbers (silent wrong output,
-    # found by review of the r4 channel precheck)
-    chan_last = data_format in ("NHWC", "NLC", "NHC", "NDHWC")
+    chan_last = data_format in _CHANNEL_LAST
     spec = {1: ("NCH", "OIH", "NCH") if not chan_last else
                ("NHC", "OIH", "NHC"),
             2: ("NCHW", "OIHW", "NCHW") if not chan_last else
@@ -459,12 +463,14 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, nd,
         cin = int(xs[-1] if chan_last else xs[1])
         want = int(weight.shape[1]) * int(groups)
         if cin != want:
-            from ..utils.enforce import InvalidArgumentError
-            raise InvalidArgumentError(
-                f"conv{nd}d: input has {cin} channels "
-                f"(x.shape={list(xs)}, data_format={data_format}) but "
-                f"weight expects {want} "
-                f"(weight.shape={list(weight.shape)}, groups={groups})")
+            from ..utils.enforce import InvalidArgumentError, enforce
+            shown = _display_format or data_format
+            enforce(False,
+                    f"conv{nd}d: input has {cin} channels "
+                    f"(x.shape={list(xs)}, data_format={shown}) but "
+                    f"weight expects {want} "
+                    f"(weight.shape={list(weight.shape)}, "
+                    f"groups={groups})", error=InvalidArgumentError)
     pad_arg = _conv_padding(padding, nd, strides, kshape, dils)
 
     def f(v, w, *b):
@@ -490,7 +496,8 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, nd,
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCL", name=None):
     return _convnd(x, weight, bias, stride, padding, dilation, groups, 1,
-                   "NCH" if data_format == "NCL" else "NHC")
+                   "NCH" if data_format == "NCL" else "NHC",
+                   _display_format=data_format)
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
@@ -580,16 +587,33 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
 # ---------------------------------------------------------------------------
 
 def _pool(x, kernel, stride, padding, nd, op, include_pad=False,
-          ceil_mode=False):
+          ceil_mode=False, data_format=None, divisor_override=None):
+    """reduce_window pooling, layout-native: window/stride/pad tuples
+    are built for the actual data layout (channel-first or -last) —
+    lax.reduce_window is layout-agnostic, so no transposes are needed.
+    ceil_mode pads the spatial tail so the last partial window is
+    emitted (max: -inf pad is neutral; avg exclusive: the ones-count
+    denominator ignores all padding; avg include_pad divides by the
+    full kernel size, matching paddle's count-include-pad)."""
+    chan_last = data_format in _CHANNEL_LAST if data_format else False
     ks = _pair(kernel, nd)
     st = _pair(stride if stride is not None else kernel, nd)
     pd = _conv_padding(padding, nd, st, ks, (1,) * nd)
     if isinstance(pd, str):
         pads = pd
     else:
-        pads = [(0, 0), (0, 0)] + list(pd)
-    window = (1, 1) + ks
-    strides = (1, 1) + st
+        pd = [tuple(p) for p in pd]
+        if ceil_mode:
+            spatial = (x.shape[1:1 + nd] if chan_last
+                       else x.shape[2:2 + nd])
+            for i in range(nd):
+                size = int(spatial[i]) + pd[i][0] + pd[i][1]
+                if size >= ks[i]:
+                    extra = (st[i] - (size - ks[i]) % st[i]) % st[i]
+                    pd[i] = (pd[i][0], pd[i][1] + extra)
+        pads = ([(0, 0)] + pd + [(0, 0)]) if chan_last             else ([(0, 0), (0, 0)] + pd)
+    window = ((1,) + ks + (1,)) if chan_last else ((1, 1) + ks)
+    strides = ((1,) + st + (1,)) if chan_last else ((1, 1) + st)
 
     if op == "max":
         def f(v):
@@ -602,6 +626,8 @@ def _pool(x, kernel, stride, padding, nd, op, include_pad=False,
         def f(v):
             s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
                                       pads)
+            if divisor_override:
+                return s / float(divisor_override)
             if include_pad or (isinstance(pads, str) and pads == "VALID") or (
                     not isinstance(pads, str)
                     and all(p == (0, 0) for p in pads)):
@@ -623,7 +649,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                 f"NCHW only (got ceil_mode={ceil_mode}, "
                 f"data_format={data_format!r})")
         return max_pool2d_with_mask(x, kernel_size, stride, padding)
-    return apply_op(_pool(x, kernel_size, stride, padding, 2, "max"), x)
+    return apply_op(_pool(x, kernel_size, stride, padding, 2, "max",
+                          ceil_mode=ceil_mode, data_format=data_format),
+                    x)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -638,14 +666,18 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW", name=None):
-    return apply_op(_pool(x, kernel_size, stride, padding, 3, "max"), x)
+    return apply_op(_pool(x, kernel_size, stride, padding, 3, "max",
+                          ceil_mode=ceil_mode, data_format=data_format),
+                    x)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     return apply_op(_pool(x, kernel_size, stride, padding, 2, "avg",
-                          include_pad=not exclusive), x)
+                          include_pad=not exclusive, ceil_mode=ceil_mode,
+                          data_format=data_format,
+                          divisor_override=divisor_override), x)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -663,10 +695,19 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
     return apply_op(_pool(x, kernel_size, stride, padding, 3, "avg",
-                          include_pad=not exclusive), x)
+                          include_pad=not exclusive, ceil_mode=ceil_mode,
+                          data_format=data_format,
+                          divisor_override=divisor_override), x)
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    if data_format in _CHANNEL_LAST:
+        # channel-last: transpose in/out (adaptive windows are built
+        # from channel-first spatial dims — same silent-layout class as
+        # the pool/conv1d audit finds)
+        xt = apply_op(lambda v: jnp.transpose(v, (0, 3, 1, 2)), x)
+        out = adaptive_avg_pool2d(xt, output_size, data_format="NCHW")
+        return apply_op(lambda v: jnp.transpose(v, (0, 2, 3, 1)), out)
     os = _pair(output_size, 2)
     h_in, w_in = (int(s) for s in x.shape[2:])
 
@@ -1514,6 +1555,10 @@ def gather_tree(ids, parents, name=None):
 # ---- adaptive pools (3d / max variants) -----------------------------------
 
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    if data_format in _CHANNEL_LAST:
+        xt = apply_op(lambda v: jnp.transpose(v, (0, 4, 1, 2, 3)), x)
+        out = adaptive_avg_pool3d(xt, output_size, data_format="NCDHW")
+        return apply_op(lambda v: jnp.transpose(v, (0, 2, 3, 4, 1)), out)
     os_ = _pair(output_size, 3)
 
     d_in, h_in, w_in = (int(s) for s in x.shape[2:])

@@ -730,3 +730,79 @@ class TestShapeMismatchErrors:
             x_ncl.transpose(0, 2, 1)), w, data_format="NLC").numpy()
         np.testing.assert_allclose(out_nlc.transpose(0, 2, 1), out_ncl,
                                    rtol=1e-5, atol=1e-5)
+
+    def test_pool_channel_last_parity(self):
+        """Layout-audit find (same class as the NLC conv1d bug):
+        max/avg pool accepted data_format but pooled channel-first
+        windows over channel-last data."""
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(1)
+        x = rs.rand(2, 3, 8, 8).astype(np.float32)
+        for fname in ("max_pool2d", "avg_pool2d"):
+            fn = getattr(F, fname)
+            a = fn(paddle.to_tensor(x), kernel_size=2, stride=2).numpy()
+            b = fn(paddle.to_tensor(x.transpose(0, 2, 3, 1)),
+                   kernel_size=2, stride=2, data_format="NHWC").numpy()
+            np.testing.assert_allclose(b.transpose(0, 3, 1, 2), a,
+                                       rtol=1e-6, err_msg=fname)
+        x3 = rs.rand(1, 2, 4, 6, 6).astype(np.float32)
+        for fname in ("max_pool3d", "avg_pool3d"):
+            fn = getattr(F, fname)
+            a = fn(paddle.to_tensor(x3), kernel_size=2, stride=2).numpy()
+            b = fn(paddle.to_tensor(x3.transpose(0, 2, 3, 4, 1)),
+                   kernel_size=2, stride=2, data_format="NDHWC").numpy()
+            np.testing.assert_allclose(b.transpose(0, 4, 1, 2, 3), a,
+                                       rtol=1e-6, err_msg=fname)
+
+    def test_conv_channel_last_parity(self):
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(2)
+        x = rs.rand(2, 3, 8, 8).astype(np.float32)
+        w = paddle.to_tensor(rs.rand(5, 3, 3, 3).astype(np.float32))
+        a = F.conv2d(paddle.to_tensor(x), w, data_format="NCHW").numpy()
+        b = F.conv2d(paddle.to_tensor(x.transpose(0, 2, 3, 1)), w,
+                     data_format="NHWC").numpy()
+        np.testing.assert_allclose(b.transpose(0, 3, 1, 2), a, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_ceil_mode_and_divisor_override(self):
+        """ceil_mode/divisor_override were accepted-and-ignored
+        (review find): 5x5 k2 s2 ceil -> 3x3 like the reference."""
+        from paddle_tpu.nn import functional as F
+        x = paddle.to_tensor(np.arange(25, dtype=np.float32)
+                             .reshape(1, 1, 5, 5))
+        out = F.max_pool2d(x, kernel_size=2, stride=2, ceil_mode=True)
+        assert list(out.shape) == [1, 1, 3, 3]
+        # tail windows: max of the partial window (col/row 4)
+        np.testing.assert_allclose(out.numpy()[0, 0, 2, 2], 24.0)
+        out_f = F.max_pool2d(x, kernel_size=2, stride=2)
+        assert list(out_f.shape) == [1, 1, 2, 2]
+        # avg exclusive ceil: partial windows divide by REAL cell count
+        av = F.avg_pool2d(x, kernel_size=2, stride=2, ceil_mode=True)
+        np.testing.assert_allclose(av.numpy()[0, 0, 2, 2], 24.0)
+        np.testing.assert_allclose(av.numpy()[0, 0, 0, 2],
+                                   (4.0 + 9.0) / 2)
+        # divisor_override wins over everything
+        dv = F.avg_pool2d(x, kernel_size=2, stride=2,
+                          divisor_override=8)
+        np.testing.assert_allclose(dv.numpy()[0, 0, 0, 0],
+                                   (0 + 1 + 5 + 6) / 8.0)
+
+    def test_adaptive_pool_channel_last(self):
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(3)
+        x = rs.rand(2, 3, 8, 8).astype(np.float32)
+        a = F.adaptive_avg_pool2d(paddle.to_tensor(x), (2, 2)).numpy()
+        b = F.adaptive_avg_pool2d(paddle.to_tensor(
+            x.transpose(0, 2, 3, 1)), (2, 2), data_format="NHWC").numpy()
+        np.testing.assert_allclose(b.transpose(0, 3, 1, 2), a, rtol=1e-6)
+
+    def test_conv1d_error_names_user_format(self):
+        from paddle_tpu.nn import functional as F
+        w = paddle.to_tensor(np.zeros((5, 3, 3), np.float32))
+        x = paddle.to_tensor(np.zeros((2, 8, 4), np.float32))  # C=4 != 3
+        try:
+            F.conv1d(x, w, data_format="NLC")
+            raise AssertionError("should have raised")
+        except ValueError as e:
+            assert "NLC" in str(e) and "NHC" not in str(e), str(e)
