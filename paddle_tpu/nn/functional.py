@@ -1034,9 +1034,85 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 # vision / misc
 # ---------------------------------------------------------------------------
 
+def _resize_src(dst, in_size, out_size, align_corners, align_mode):
+    """Source coordinate per output index under the reference's
+    transforms (paddle interpolate == torch for these modes):
+    align_corners: dst*(in-1)/(out-1); else align_mode 0 = half-pixel
+    (dst+0.5)*in/out - 0.5, align_mode 1 = asymmetric dst*in/out."""
+    if align_corners:
+        if out_size == 1:
+            return np.zeros_like(dst, np.float64)
+        return dst * (in_size - 1) / (out_size - 1)
+    if align_mode == 1:
+        return dst * in_size / out_size
+    return (dst + 0.5) * in_size / out_size - 0.5
+
+
+def _resize_weights(in_size, out_size, mode, align_corners, align_mode):
+    """Dense (out, in) weight matrix for one axis — taps accumulate
+    onto clamped (border-replicated) indices, so every row sums to 1."""
+    w = np.zeros((out_size, in_size), np.float64)
+    dst = np.arange(out_size, dtype=np.float64)
+    if mode == "area":
+        # integer adaptive windows (floor/ceil), the reference's
+        # adaptive-average convention — NOT fractional overlap
+        for i in range(out_size):
+            j0 = (i * in_size) // out_size
+            j1 = -((-(i + 1) * in_size) // out_size)   # ceil
+            w[i, j0:j1] = 1.0 / (j1 - j0)
+        return w
+    if mode == "cubic":
+        align_mode = 0      # paddle defines align_mode only for linear
+    src = _resize_src(dst, in_size, out_size, align_corners, align_mode)
+    if mode == "linear":
+        src = np.clip(src, 0.0, in_size - 1)
+        j0 = np.floor(src).astype(np.int64)
+        frac = src - j0
+        np.add.at(w, (np.arange(out_size), np.clip(j0, 0, in_size - 1)),
+                  1.0 - frac)
+        np.add.at(w, (np.arange(out_size),
+                      np.clip(j0 + 1, 0, in_size - 1)), frac)
+        return w
+    if mode == "cubic":
+        a = -0.75                      # the reference's bicubic alpha
+
+        def kern(t):
+            t = np.abs(t)
+            return np.where(
+                t <= 1, (a + 2) * t**3 - (a + 3) * t**2 + 1,
+                np.where(t < 2,
+                         a * t**3 - 5 * a * t**2 + 8 * a * t - 4 * a,
+                         0.0))
+        j0 = np.floor(src).astype(np.int64)
+        for tap in (-1, 0, 1, 2):
+            j = j0 + tap
+            np.add.at(w, (np.arange(out_size),
+                          np.clip(j, 0, in_size - 1)), kern(src - j))
+        return w
+    raise ValueError(f"interpolate: unsupported mode {mode!r}")
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
+    """Reference-exact resize (paddle.nn.functional.interpolate —
+    verify; torch-oracle differential tested): nearest uses the legacy
+    floor transform, linear/cubic honor align_corners and paddle's
+    align_mode, area averages integer adaptive windows (the adaptive-
+    mean convention). Channel-last data_formats transpose in/out."""
+    if data_format in _CHANNEL_LAST:
+        ndd = {"NLC": 1, "NHC": 1, "NHWC": 2, "NDHWC": 3}[data_format]
+        perm_in = (0, ndd + 1) + tuple(range(1, ndd + 1))
+        perm_out = (0,) + tuple(range(2, ndd + 2)) + (1,)
+        xt = apply_op(lambda v: jnp.transpose(v, perm_in), x)
+        out = interpolate(xt, size, scale_factor, mode, align_corners,
+                          align_mode, "NCHW")
+        return apply_op(lambda v: jnp.transpose(v, perm_out), out)
+
+    base = {"nearest": "nearest", "bilinear": "linear",
+            "linear": "linear", "trilinear": "linear",
+            "bicubic": "cubic", "area": "area"}[mode]
+
     def f(v):
         nd = v.ndim - 2
         if size is not None:
@@ -1045,11 +1121,32 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
                 else [scale_factor] * nd
             out_sp = tuple(int(s * f_) for s, f_ in zip(v.shape[2:], sf))
-        out_shape = v.shape[:2] + out_sp
-        method = {"nearest": "nearest", "bilinear": "linear",
-                  "linear": "linear", "trilinear": "linear",
-                  "bicubic": "cubic", "area": "linear"}[mode]
-        return jax.image.resize(v, out_shape, method=method)
+        out = v
+        for ax in range(nd):
+            in_size, out_size = int(v.shape[2 + ax]), int(out_sp[ax])
+            if in_size == out_size:
+                continue    # area weights are the identity here too
+            if base == "nearest":
+                dst = np.arange(out_size, dtype=np.float64)
+                if align_corners:
+                    # paddle rounds HALF UP (static_cast<int>(src+0.5)),
+                    # not numpy's round-half-to-even
+                    idx = np.floor(dst * (in_size - 1)
+                                   / max(out_size - 1, 1) + 0.5)
+                else:
+                    idx = np.floor(dst * in_size / out_size)
+                idx = np.clip(idx, 0, in_size - 1).astype(np.int32)
+                out = jnp.take(out, jnp.asarray(idx), axis=2 + ax)
+            else:
+                w = _resize_weights(in_size, out_size, base,
+                                    align_corners, align_mode)
+                ct = jnp.promote_types(v.dtype, jnp.float32)
+                wj = jnp.asarray(w, ct)
+                moved = jnp.moveaxis(out, 2 + ax, -1)
+                res = jnp.tensordot(
+                    moved.astype(ct), wj, axes=[[-1], [1]])
+                out = jnp.moveaxis(res, -1, 2 + ax).astype(v.dtype)
+        return out
     return apply_op(f, x)
 
 
