@@ -407,7 +407,7 @@ def _pair(v, n):
 # conv1d translates NLC -> NHC before _convnd; NHC must be in this set
 # or channel-last 1-d data runs through channel-first dimension numbers
 # (silent wrong output — found by review of the r4 channel precheck)
-_CHANNEL_LAST = ("NHWC", "NLC", "NHC", "NDHWC")
+_CHANNEL_LAST = ("NHWC", "NLC", "NHC", "NWC", "NDHWC")
 
 
 def _conv_padding(padding, nd, stride, kernel, dilation):
@@ -1101,7 +1101,8 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
     align_mode, area averages integer adaptive windows (the adaptive-
     mean convention). Channel-last data_formats transpose in/out."""
     if data_format in _CHANNEL_LAST:
-        ndd = {"NLC": 1, "NHC": 1, "NHWC": 2, "NDHWC": 3}[data_format]
+        ndd = {"NLC": 1, "NHC": 1, "NWC": 1, "NHWC": 2,
+               "NDHWC": 3}[data_format]
         perm_in = (0, ndd + 1) + tuple(range(1, ndd + 1))
         perm_out = (0,) + tuple(range(2, ndd + 2)) + (1,)
         xt = apply_op(lambda v: jnp.transpose(v, perm_in), x)
@@ -1109,9 +1110,17 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
                           align_mode, "NCHW")
         return apply_op(lambda v: jnp.transpose(v, perm_out), out)
 
-    base = {"nearest": "nearest", "bilinear": "linear",
-            "linear": "linear", "trilinear": "linear",
-            "bicubic": "cubic", "area": "area"}[mode]
+    _MODES = {"nearest": "nearest", "bilinear": "linear",
+              "linear": "linear", "trilinear": "linear",
+              "bicubic": "cubic", "area": "area"}
+    if mode not in _MODES:
+        raise ValueError(
+            f"interpolate: unsupported mode {mode!r} (supported: "
+            f"{sorted(_MODES)})")
+    if size is None and scale_factor is None:
+        raise ValueError(
+            "interpolate: one of size and scale_factor must be set")
+    base = _MODES[mode]
 
     def f(v):
         nd = v.ndim - 2
@@ -1121,6 +1130,10 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
                 else [scale_factor] * nd
             out_sp = tuple(int(s * f_) for s, f_ in zip(v.shape[2:], sf))
+        # compute dtype held ACROSS axes: per-axis rounding back to a
+        # low-precision input dtype would double-round (fp16 ULP-level,
+        # bf16 visibly) and waste casts
+        ct = jnp.promote_types(v.dtype, jnp.float32)
         out = v
         for ax in range(nd):
             in_size, out_size = int(v.shape[2 + ax]), int(out_sp[ax])
@@ -1140,13 +1153,11 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
             else:
                 w = _resize_weights(in_size, out_size, base,
                                     align_corners, align_mode)
-                ct = jnp.promote_types(v.dtype, jnp.float32)
                 wj = jnp.asarray(w, ct)
-                moved = jnp.moveaxis(out, 2 + ax, -1)
-                res = jnp.tensordot(
-                    moved.astype(ct), wj, axes=[[-1], [1]])
-                out = jnp.moveaxis(res, -1, 2 + ax).astype(v.dtype)
-        return out
+                moved = jnp.moveaxis(out.astype(ct), 2 + ax, -1)
+                res = jnp.tensordot(moved, wj, axes=[[-1], [1]])
+                out = jnp.moveaxis(res, -1, 2 + ax)
+        return out.astype(v.dtype)
     return apply_op(f, x)
 
 

@@ -105,3 +105,34 @@ def test_bicubic_ignores_align_mode():
     b = F.interpolate(x, size=(14, 5), mode="bicubic",
                       align_mode=1).numpy()
     np.testing.assert_array_equal(a, b)
+
+
+def test_nwc_1d_channel_last():
+    """Paddle's 1-D channel-last spelling NWC (review find: it resized
+    the channel axis)."""
+    x1 = np.random.RandomState(5).rand(2, 11, 3).astype(np.float32)
+    got = F.interpolate(paddle.to_tensor(x1), size=7, mode="linear",
+                        data_format="NWC").numpy()
+    want = TF.interpolate(torch.tensor(x1.transpose(0, 2, 1)), size=7,
+                          mode="linear").numpy()
+    assert got.shape == (2, 7, 3)
+    np.testing.assert_allclose(got.transpose(0, 2, 1), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_clear_errors():
+    x = paddle.to_tensor(X)
+    with pytest.raises(ValueError, match="size and scale_factor"):
+        F.interpolate(x)
+    with pytest.raises(ValueError, match="unsupported mode"):
+        F.interpolate(x, size=(4, 4), mode="bilinearr")
+
+
+def test_fp16_no_per_axis_double_rounding():
+    xh = X.astype(np.float16)
+    got = F.interpolate(paddle.to_tensor(xh), size=(17, 5),
+                        mode="bilinear").numpy()
+    want = TF.interpolate(torch.tensor(xh), size=(17, 5),
+                          mode="bilinear", align_corners=False).numpy()
+    np.testing.assert_allclose(got.astype(np.float32),
+                               want.astype(np.float32), atol=2e-3)

@@ -1,0 +1,135 @@
+"""Torch-CPU-oracle differential for activations, losses, and norms
+(paddle's definitions equal torch's for this set). r4 audit: all
+matched first try — kept as a permanent guard against constant or
+reduction drift."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as TF  # noqa: E402
+
+X = (np.random.RandomState(0).rand(3, 7).astype(np.float32) * 6 - 3)
+
+
+ACTS = [
+    ("hardswish", lambda p: F.hardswish(p), lambda t: TF.hardswish(t)),
+    ("hardsigmoid", lambda p: F.hardsigmoid(p),
+     lambda t: TF.hardsigmoid(t)),
+    ("mish", lambda p: F.mish(p), lambda t: TF.mish(t)),
+    ("softplus", lambda p: F.softplus(p, beta=2.0, threshold=10.0),
+     lambda t: TF.softplus(t, beta=2.0, threshold=10.0)),
+    ("celu", lambda p: F.celu(p, alpha=1.5),
+     lambda t: TF.celu(t, alpha=1.5)),
+    ("selu", lambda p: F.selu(p), lambda t: TF.selu(t)),
+    ("elu", lambda p: F.elu(p, alpha=0.7),
+     lambda t: TF.elu(t, alpha=0.7)),
+    ("gelu_tanh", lambda p: F.gelu(p, approximate=True),
+     lambda t: TF.gelu(t, approximate="tanh")),
+    ("softsign", lambda p: F.softsign(p), lambda t: TF.softsign(t)),
+    ("tanhshrink", lambda p: F.tanhshrink(p),
+     lambda t: TF.tanhshrink(t)),
+    ("hardshrink", lambda p: F.hardshrink(p, threshold=0.6),
+     lambda t: TF.hardshrink(t, lambd=0.6)),
+    ("softshrink", lambda p: F.softshrink(p, threshold=0.6),
+     lambda t: TF.softshrink(t, lambd=0.6)),
+    ("log_sigmoid", lambda p: F.log_sigmoid(p),
+     lambda t: TF.logsigmoid(t)),
+    ("thresholded_relu", lambda p: F.thresholded_relu(p, threshold=0.7),
+     lambda t: TF.threshold(t, 0.7, 0.0)),
+    ("leaky_relu", lambda p: F.leaky_relu(p, negative_slope=0.2),
+     lambda t: TF.leaky_relu(t, 0.2)),
+    ("relu6", lambda p: F.relu6(p), lambda t: TF.relu6(t)),
+]
+
+
+@pytest.mark.parametrize("name,pf,tf", ACTS, ids=[a[0] for a in ACTS])
+def test_activation_matches_torch(name, pf, tf):
+    got = pf(paddle.to_tensor(X)).numpy()
+    want = tf(torch.tensor(X)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("red", ["mean", "sum", "none"])
+def test_losses_match_torch(red):
+    rs = np.random.RandomState(1)
+    logits = rs.rand(5, 4).astype(np.float32) * 4 - 2
+    labels = rs.randint(0, 4, (5,)).astype(np.int64)
+    target = rs.rand(5, 4).astype(np.float32)
+    cases = [
+        ("ce",
+         F.cross_entropy(paddle.to_tensor(logits),
+                         paddle.to_tensor(labels), reduction=red),
+         TF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                          reduction=red)),
+        ("bce_logits",
+         F.binary_cross_entropy_with_logits(
+             paddle.to_tensor(logits), paddle.to_tensor(target),
+             reduction=red),
+         TF.binary_cross_entropy_with_logits(
+             torch.tensor(logits), torch.tensor(target),
+             reduction=red)),
+        ("smooth_l1",
+         F.smooth_l1_loss(paddle.to_tensor(logits),
+                          paddle.to_tensor(target), reduction=red),
+         TF.smooth_l1_loss(torch.tensor(logits), torch.tensor(target),
+                           reduction=red)),
+    ]
+    for name, got, want in cases:
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                                   atol=1e-5, err_msg=f"{name}-{red}")
+
+
+def test_weighted_ignore_index_ce():
+    rs = np.random.RandomState(2)
+    logits = rs.rand(5, 4).astype(np.float32)
+    labels = rs.randint(0, 4, (5,)).astype(np.int64)
+    labels[0] = -100
+    wt = rs.rand(4).astype(np.float32) + 0.5
+    got = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels),
+                          weight=paddle.to_tensor(wt),
+                          ignore_index=-100).numpy()
+    want = TF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                            weight=torch.tensor(wt),
+                            ignore_index=-100).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_ranking_and_triplet_losses():
+    rs = np.random.RandomState(3)
+    a = rs.rand(6).astype(np.float32)
+    b = rs.rand(6).astype(np.float32)
+    lab = np.sign(rs.rand(6).astype(np.float32) - 0.5)
+    got = F.margin_ranking_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                                paddle.to_tensor(lab),
+                                margin=0.3).numpy()
+    want = TF.margin_ranking_loss(torch.tensor(a), torch.tensor(b),
+                                  torch.tensor(lab), margin=0.3).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    anc, pos, neg = (rs.rand(4, 8).astype(np.float32) for _ in range(3))
+    got = F.triplet_margin_loss(
+        paddle.to_tensor(anc), paddle.to_tensor(pos),
+        paddle.to_tensor(neg), margin=1.2).numpy()
+    want = TF.triplet_margin_loss(
+        torch.tensor(anc), torch.tensor(pos), torch.tensor(neg),
+        margin=1.2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_group_and_local_response_norm():
+    rs = np.random.RandomState(4)
+    x = rs.rand(2, 6, 5, 5).astype(np.float32)
+    w = np.ones(6, np.float32)
+    b = np.zeros(6, np.float32)
+    got = F.group_norm(paddle.to_tensor(x), num_groups=3,
+                       weight=paddle.to_tensor(w),
+                       bias=paddle.to_tensor(b)).numpy()
+    want = TF.group_norm(torch.tensor(x), 3, torch.tensor(w),
+                         torch.tensor(b)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    got = F.local_response_norm(paddle.to_tensor(x), size=3).numpy()
+    want = TF.local_response_norm(torch.tensor(x), 3).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
