@@ -133,3 +133,35 @@ def test_group_and_local_response_norm():
     got = F.local_response_norm(paddle.to_tensor(x), size=3).numpy()
     want = TF.local_response_norm(torch.tensor(x), 3).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_statistics_conventions():
+    """dof, middle-element, and norm-order conventions vs the oracles
+    (paddle: var/std unbiased by default, median averages middles)."""
+    rs = np.random.RandomState(5)
+    x = rs.rand(4, 6).astype(np.float32)
+    px, tx = paddle.to_tensor(x), torch.tensor(x)
+    np.testing.assert_allclose(paddle.var(px).numpy(), tx.var().numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.var(px, unbiased=False).numpy(),
+                               tx.var(correction=0).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.median(px, axis=1).numpy(),
+                               np.median(x, axis=1), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.logsumexp(px, axis=1).numpy(),
+        torch.logsumexp(tx, 1).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.linalg.norm(px, p=1, axis=1).numpy(),
+        torch.linalg.norm(tx, ord=1, dim=1).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.logcumsumexp(px, axis=1).numpy(),
+        torch.logcumsumexp(tx, 1).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.kthvalue(px, 2, axis=1)[0].numpy(),
+        torch.kthvalue(tx, 2, dim=1).values.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.histogram(px, bins=5, min=0., max=1.).numpy(),
+        torch.histc(tx, 5, 0., 1.).numpy())
+    np.testing.assert_allclose(
+        paddle.trapezoid(px, axis=1).numpy(),
+        torch.trapezoid(tx, dim=1).numpy(), rtol=1e-5)
