@@ -154,3 +154,29 @@ def test_avg_pool_roundtrip():
     m = _roundtrip(net, x)
     ops = {n["op_type"] for n in m["graph"]["node"]}
     assert "AveragePool" in ops
+
+
+def test_bf16_export_roundtrip():
+    """bf16 (the TPU-first dtype) exports with BFLOAT16 raw tensors and
+    evaluates in bf16 end-to-end."""
+    import ml_dtypes
+    paddle.set_default_dtype("bfloat16")
+    try:
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.GELU(),
+                            nn.Linear(16, 4))
+    finally:
+        paddle.set_default_dtype("float32")
+    x = np.random.RandomState(0).rand(2, 8).astype(ml_dtypes.bfloat16)
+    import tempfile
+    import os
+    with tempfile.TemporaryDirectory() as td:
+        p = ponnx.export(net, os.path.join(td, "m"),
+                         input_spec=[paddle.to_tensor(x)])
+        m = ponnx.runtime.load(p)
+        out = ponnx.runtime.run(m, {"input_0": x})["output_0"]
+    assert out.dtype == ml_dtypes.bfloat16
+    net.eval()
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out.astype(np.float32),
+                               ref.astype(np.float32), atol=0.1)
