@@ -65,9 +65,12 @@ def apply_passes(fn: Callable, *example_args, passes: Sequence[Callable]):
 
 def _rebuild(closed: ClosedJaxpr, eqns: List[JaxprEqn]) -> ClosedJaxpr:
     jaxpr = closed.jaxpr
+    # propagate the source jaxpr's debug_info: constructing a Jaxpr
+    # without one is deprecated (and was the suite's loudest warning)
     new_jaxpr = Jaxpr(constvars=jaxpr.constvars, invars=jaxpr.invars,
                       outvars=jaxpr.outvars, eqns=eqns,
-                      effects=jaxpr.effects)
+                      effects=jaxpr.effects,
+                      debug_info=jaxpr.debug_info)
     return ClosedJaxpr(new_jaxpr, closed.consts)
 
 
