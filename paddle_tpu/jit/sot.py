@@ -456,9 +456,14 @@ class OpcodeExecutor:
         if code.co_flags & 0x08 or code.co_flags & 0x04:
             raise CaptureFallback("*args/**kwargs signatures")
         if code.co_freevars:
-            # closures over tensors fall back; plain-value closures OK
+            # closures over tensors (at any nesting depth) fall back;
+            # plain-value closures are guarded by the wrapper
             for cell in self.fn.__closure__ or ():
-                if isinstance(cell.cell_contents, Tensor):
+                try:
+                    contents = cell.cell_contents
+                except ValueError:
+                    raise CaptureFallback("unbound closure cell")
+                if any(isinstance(v, Tensor) for v in _leaves([contents])):
                     raise CaptureFallback("closure over Tensor")
         names = code.co_varnames
         local: dict[str, Any] = {}
@@ -489,8 +494,9 @@ class OpcodeExecutor:
                 i = ins[idx]
                 op, arg, val = i.opname, i.arg, i.argval
                 if op in ("RESUME", "NOP", "PRECALL", "CACHE",
-                          "EXTENDED_ARG"):
-                    pass
+                          "EXTENDED_ARG", "COPY_FREE_VARS",
+                          "MAKE_CELL"):
+                    pass    # closure prologue: cells handled separately
                 elif op == "LOAD_FAST" or op == "LOAD_FAST_CHECK":
                     if val not in local:
                         raise CaptureFallback(f"unbound local {val}")
@@ -551,15 +557,30 @@ class OpcodeExecutor:
                     # runtime scalars in INDEX position (x[:n]) decide
                     # the result SHAPE -> specialize, never re-inject
                     idx_v = self._specialize_rts(idx_v)
-                    stack.append(self._apply_op(operator.getitem,
-                                                [obj, idx_v]))
+                    if isinstance(obj, (list, tuple, dict)) and \
+                            not _has_traced([idx_v]):
+                        # python container indexing runs CONCRETELY —
+                        # elements keep their _Traced wrappers; only
+                        # tensor indexing (or a tensor INDEX) records
+                        out_v = obj[idx_v]
+                        stack.append(out_v)
+                    else:
+                        stack.append(self._apply_op(operator.getitem,
+                                                    [obj, idx_v]))
                 elif op == "BINARY_SLICE":
                     stop = stack.pop()
                     start = stack.pop()
                     obj = stack.pop()
                     sl = self._specialize_rts(slice(start, stop))
-                    stack.append(self._apply_op(operator.getitem,
-                                                [obj, sl]))
+                    if isinstance(obj, (list, tuple)) and \
+                            not _has_traced([sl]):
+                        out_v = obj[sl]
+                        if isinstance(out_v, list):
+                            out_v = self._mark_fresh(out_v)  # new list
+                        stack.append(out_v)
+                    else:
+                        stack.append(self._apply_op(operator.getitem,
+                                                    [obj, sl]))
                 elif op == "BUILD_SLICE":
                     if arg == 3:
                         c, b, a = stack.pop(), stack.pop(), stack.pop()
@@ -795,6 +816,17 @@ class OpcodeExecutor:
             print(*[_map_tree(a, shown) for a in args],
                   **{k: _map_tree(v, shown) for k, v in kwargs.items()})
             return None
+        if fn_obj in (zip, enumerate, reversed, list, tuple) and \
+                not any(isinstance(a, (_Traced, _RtScalar))
+                        for a in list(args) + list(kwargs.values())):
+            # structure builtins over python containers run CONCRETELY:
+            # _Traced elements flow through untouched (recording them
+            # would strip wrappers and leak raw tensors into the
+            # interpreter — the zip-over-tensor-list bug)
+            out_v = fn_obj(*args, **kwargs)
+            if isinstance(out_v, list):
+                out_v = self._mark_fresh(out_v)     # new mutable list
+            return out_v
         recv = getattr(fn_obj, "__self__", None)
         if isinstance(recv, (list, dict, set)):
             name = getattr(fn_obj, "__name__", "")
@@ -918,7 +950,15 @@ class SotFunction:
             # kwargs passed in a different order at replay would
             # otherwise silently swap tensors
             args, kwargs = self._bind(args, kwargs)
-            guard = _guard_of(args, kwargs, self._guard_keepalive)
+            # closure cell VALUES participate in the guard: their
+            # contents are baked into the trace as constants, so a
+            # mutated nonlocal must recapture, not silently replay the
+            # stale value (review-reproduced unsoundness)
+            cells = tuple(c.cell_contents
+                          for c in (self.fn.__closure__ or ())
+                          if not isinstance(c.cell_contents, types.CellType))
+            guard = _guard_of(tuple(args) + (cells,), kwargs,
+                              self._guard_keepalive)
         except CaptureFallback:
             self.stats["fallbacks"] += 1
             self._fallback_forever = True

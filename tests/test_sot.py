@@ -362,3 +362,121 @@ class TestReviewRegressions2:
         sf = SotFunction(f)
         sf(t(np.ones((2, 2))))
         assert "loss:" in capsys.readouterr().out
+
+
+class TestMoreConstructs:
+    """while loops, enumerate/zip over tensor lists, container slicing
+    (list-slice once mis-routed through the record path and fell back),
+    nested python calls, builtin min/max, dict args."""
+
+    def test_while_loop_unrolls(self):
+        def f(x, n):
+            i = 0
+            acc = x
+            while i < n:
+                acc = acc + x
+                i += 1
+            return acc
+
+        sf = check(f, (t(np.ones((2, 2))), 3))
+        assert sot_stats(sf)["fallbacks"] == 0
+
+    def test_enumerate_zip_list_slice(self):
+        def f(xs):
+            acc = xs[0] * 0.0
+            for i, (a, b) in enumerate(zip(xs, xs[1:])):
+                acc = acc + a * float(i) + b
+            return acc
+
+        xs = [t(np.full((2, 2), v)) for v in (1.0, 2.0, 3.0)]
+        sf = check(f, (xs,))
+        assert sot_stats(sf)["fallbacks"] == 0
+
+    def test_nested_python_calls(self):
+        def helper(a, b):
+            return a * 2.0 + b
+
+        def f(x, y):
+            return helper(helper(x, y), x)
+
+        sf = check(f, (t(np.ones((2, 2))), t(np.full((2, 2), 3.0))))
+        assert sot_stats(sf)["fallbacks"] == 0
+
+    def test_dict_arg_and_builtins(self):
+        def f(x, d):
+            lo = min(2, 5)
+            hi = max(3, lo)
+            return x * float(d["s"] * hi)
+
+        sf = check(f, (t(np.ones((2, 2))), {"s": 3}))
+        assert sot_stats(sf)["fallbacks"] == 0
+
+
+class TestClosureGuards:
+    def test_mutated_nonlocal_recaptures(self):
+        """Closure values are baked into the trace — mutating the cell
+        must change the guard and recapture (review-reproduced)."""
+        def outer():
+            state = {"s": 1.0}
+
+            def set_s(v):
+                nonlocal s
+                s = v
+            s = 1.0
+
+            def f(x):
+                return x * s
+            return f, set_s
+
+        f, set_s = outer()
+        sf = SotFunction(f)
+        x = t(np.ones((2, 2)))
+        np.testing.assert_allclose(sf(x).numpy(), 1.0)
+        set_s(2.0)
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+
+    def test_closure_over_tensor_list_falls_back(self):
+        ws = [t(np.full((2, 2), 5.0))]
+
+        def f(x):
+            return x + ws[0]
+
+        sf = SotFunction(f)
+        out = sf(t(np.ones((2, 2))))
+        np.testing.assert_allclose(out.numpy(), 6.0)
+        out = sf(t(np.ones((2, 2))))
+        np.testing.assert_allclose(out.numpy(), 6.0)
+        assert sot_stats(sf)["fallbacks"] >= 1
+
+    def test_list_builtin_result_is_mutable(self):
+        def f(xs):
+            ys = list(xs)
+            ys.append(xs[0] * 3.0)
+            return ys[-1] + ys[0]
+
+        xs = [t(np.full((2, 2), v)) for v in (1.0, 2.0)]
+        sf = check(f, (xs,))
+        assert sot_stats(sf)["fallbacks"] == 0
+
+    def test_list_slice_result_is_mutable(self):
+        def f(xs):
+            ys = xs[:2]
+            ys.append(xs[0])
+            return ys[0] + ys[-1]
+
+        xs = [t(np.full((2, 2), v)) for v in (1.0, 2.0, 3.0)]
+        sf = check(f, (xs,))
+        assert sot_stats(sf)["fallbacks"] == 0
+
+    def test_tensor_index_into_list_still_works(self):
+        def f(xs, i):
+            n = int(i.sum().item())
+            return xs[n] * 2.0
+
+        xs = [t(np.full((2, 2), v)) for v in (1.0, 2.0, 3.0)]
+        sf = SotFunction(f)
+        out = sf(xs, t(np.full((1,), 1.0)))
+        np.testing.assert_allclose(out.numpy(), 4.0)
+        out = sf(xs, t(np.full((1,), 2.0)))
+        np.testing.assert_allclose(out.numpy(), 6.0)
