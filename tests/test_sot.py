@@ -480,3 +480,36 @@ class TestClosureGuards:
         np.testing.assert_allclose(out.numpy(), 4.0)
         out = sf(xs, t(np.full((1,), 2.0)))
         np.testing.assert_allclose(out.numpy(), 6.0)
+
+
+class TestTensorKwargsAndModels:
+    def test_tensor_kwarg_in_recorded_call(self):
+        def f(x, w):
+            h = paddle.matmul(x, y=w)
+            return paddle.nn.functional.relu(h)
+
+        x = t(rnd(3, 4))
+        w = t(rnd(4, 2, seed=1))
+        sf = check(f, (x, w))
+        assert sot_stats(sf)["fallbacks"] == 0
+
+    def test_llama_tiny_forward_captures(self):
+        from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                             llama_tiny_config)
+        paddle.seed(0)
+        m = LlamaForCausalLM(llama_tiny_config(tensor_parallel=False))
+
+        def g(ids, labels):
+            loss, logits = m(ids, labels=labels)
+            return loss
+
+        ids = t(np.random.RandomState(2).randint(
+            0, 512, (2, 8))).astype("int32")
+        labels = t(np.roll(ids.numpy(), -1, 1)).astype("int32")
+        sg = SotFunction(g)
+        want = float(g(ids, labels).numpy())
+        for _ in range(2):
+            assert abs(float(sg(ids, labels).numpy()) - want) < 1e-4
+        st = sot_stats(sg)
+        assert st["captures"] == 1 and st["replays"] >= 1
+        assert st["fallbacks"] == 0
