@@ -1,0 +1,71 @@
+#!/bin/bash
+# Late-window measurement: when a healthy tunnel appears too close to
+# the end-of-round driver window for the full tpu_session.sh ladder.
+# Usage: bash late_window.sh <hard_stop_epoch_seconds>
+# Runs bench (tiny->small->big ladder, artifacts merged incrementally)
+# then as many workloads as fit, and GUARANTEES nothing of ours holds
+# the chip past the hard stop (the driver needs a quiet tunnel).
+set -x
+cd "$(dirname "$0")"
+HARD_STOP=${1:?usage: late_window.sh <hard_stop_epoch>}
+touch .watch_stop
+
+left() { echo $(( HARD_STOP - $(date +%s) )); }
+
+L=$(left)
+[ "$L" -lt 300 ] && { echo "too little time"; exit 1; }
+BENCH_BUDGET=$(( L > 4200 ? 3900 : L - 240 ))
+BENCH_TPU_DEADLINE_S=$BENCH_BUDGET BENCH_TOTAL_BUDGET_S=$BENCH_BUDGET \
+    timeout -s INT -k 30 $(( BENCH_BUDGET + 60 )) python bench.py \
+    | tee /tmp/bench_last.json
+python - <<'EOF'
+import json, os
+try:
+    new = json.load(open("/tmp/bench_last.json"))
+except Exception:
+    raise SystemExit
+if new.get("chip") != "v5e":
+    raise SystemExit
+out = "BENCH_TPU_MEASURED_r04.json"
+NEVER_CARRY = {"config_errors", "partial", "stage_s",
+               "carried_from_previous"}
+try:
+    old = json.load(open(out)) if os.path.exists(out) else {}
+except Exception:
+    old = {}
+if old.get("chip") == "v5e":
+    carried = []
+    for k, v in old.items():
+        if k not in NEVER_CARRY and new.get(k) is None:
+            new[k] = v
+            carried.append(k)
+    if carried:
+        new["carried_from_previous"] = sorted(carried)
+    head = new.get("config_big") or new.get("config_small")
+    if head:
+        new["value"] = head["tokens_per_sec"]
+        new["mfu"] = head["mfu"]
+        new["vs_baseline"] = round(head["mfu"] / 0.45, 4)
+json.dump(new, open(out + ".tmp", "w"), indent=1)
+os.replace(out + ".tmp", out)
+EOF
+
+for w in ernie_moe resnet50 bert_base sdxl_unet; do
+    L=$(left)
+    [ "$L" -lt 700 ] && break
+    line=$(timeout -s INT -k 30 $(( L - 120 < 600 ? L - 120 : 600 )) \
+           python bench_workloads.py "$w" 2>&1 \
+           | grep '^WORKLOAD ' | tail -1 | sed 's/^WORKLOAD //')
+    [ -z "$line" ] && continue
+    python - "$w" "$line" <<'EOF'
+import json, os, sys
+out = "WORKLOADS_r04.json"
+d = json.load(open(out)) if os.path.exists(out) else {
+    "artifact": "WORKLOADS_r04", "chip": "v5e"}
+d[sys.argv[1]] = json.loads(sys.argv[2])
+json.dump(d, open(out, "w"), indent=1)
+EOF
+done
+# absolutely nothing of ours may touch the chip after this
+pkill -f "python bench" 2>/dev/null
+exit 0
