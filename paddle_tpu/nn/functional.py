@@ -519,15 +519,23 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     """Transposed conv as a forward conv with lhs dilation (paddle output
     size semantics: (H-1)*stride - 2*pad + dilation*(k-1) + 1 + out_pad).
     Weight layout (in, out/groups, kh, kw)."""
+    if data_format == "NHWC":
+        # channel-last via transpose in/out (rare path; the core stays
+        # channel-first below; only the 2-D spelling is valid here)
+        xt = apply_op(lambda v: jnp.transpose(v, (0, 3, 1, 2)), x)
+        out = conv2d_transpose(xt, weight, bias, stride, padding,
+                               output_padding, groups, dilation,
+                               "NCHW", output_size, name, _amp_op)
+        return apply_op(lambda v: jnp.transpose(v, (0, 2, 3, 1)), out)
+    if data_format != "NCHW":
+        raise NotImplementedError(
+            f"conv2d_transpose: unsupported data_format {data_format!r}")
     strides = _pair(stride, 2)
     dils = _pair(dilation, 2)
     pads = _conv_padding(padding, 2, strides, weight.shape[2:], dils)
     op = output_padding if not isinstance(output_padding, (list, tuple)) \
         or len(output_padding) != 1 else output_padding[0]
     opad = _pair(op, 2)
-    if data_format not in ("NCHW",):
-        raise NotImplementedError(
-            "conv2d_transpose currently supports NCHW only")
 
     def f(v, w, *b):
         v, w = _conv_amp_dtypes(v, w, _amp_op)

@@ -806,3 +806,27 @@ class TestShapeMismatchErrors:
             raise AssertionError("should have raised")
         except ValueError as e:
             assert "NLC" in str(e) and "NHC" not in str(e), str(e)
+
+
+
+class TestConvTransposeLayouts:
+    def test_conv2d_transpose_channel_last_parity(self):
+        from paddle_tpu.nn import functional as F
+        rs = np.random.RandomState(4)
+        x = rs.rand(2, 3, 6, 6).astype(np.float32)
+        w = paddle.to_tensor(rs.rand(3, 5, 3, 3).astype(np.float32))
+        a = F.conv2d_transpose(paddle.to_tensor(x), w, stride=2,
+                               padding=1, data_format="NCHW").numpy()
+        b = F.conv2d_transpose(paddle.to_tensor(x.transpose(0, 2, 3, 1)),
+                               w, stride=2, padding=1,
+                               data_format="NHWC").numpy()
+        np.testing.assert_allclose(b.transpose(0, 3, 1, 2), a,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_invalid_format_raises(self):
+        import pytest
+        from paddle_tpu.nn import functional as F
+        x = paddle.to_tensor(np.zeros((1, 2, 4, 4), np.float32))
+        w = paddle.to_tensor(np.zeros((2, 2, 3, 3), np.float32))
+        with pytest.raises(NotImplementedError, match="NDHWC"):
+            F.conv2d_transpose(x, w, data_format="NDHWC")
