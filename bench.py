@@ -463,7 +463,8 @@ def _last_measured_tpu():
     SELF-reported on-chip measurement (clearly labeled as recorded, not
     live — the fallback's own numbers stay the CPU ones)."""
     here = os.path.dirname(os.path.abspath(__file__))
-    for name in ("BENCH_TPU_MEASURED_r04.json", "BENCH_TPU_MEASURED_r03.json"):
+    for name in ("BENCH_TPU_MEASURED_r05.json", "BENCH_TPU_MEASURED_r04.json",
+                 "BENCH_TPU_MEASURED_r03.json"):
         path = os.path.join(here, name)
         if os.path.exists(path):
             break

@@ -26,7 +26,7 @@ except Exception:
     raise SystemExit
 if new.get("chip") != "v5e":
     raise SystemExit
-out = "BENCH_TPU_MEASURED_r04.json"
+out = "BENCH_TPU_MEASURED_r05.json"
 NEVER_CARRY = {"config_errors", "partial", "stage_s",
                "carried_from_previous"}
 try:
@@ -59,9 +59,9 @@ for w in ernie_moe resnet50 bert_base sdxl_unet; do
     [ -z "$line" ] && continue
     python - "$w" "$line" <<'EOF'
 import json, os, sys
-out = "WORKLOADS_r04.json"
+out = "WORKLOADS_r05.json"
 d = json.load(open(out)) if os.path.exists(out) else {
-    "artifact": "WORKLOADS_r04", "chip": "v5e"}
+    "artifact": "WORKLOADS_r05", "chip": "v5e"}
 d[sys.argv[1]] = json.loads(sys.argv[2])
 json.dump(d, open(out, "w"), indent=1)
 EOF

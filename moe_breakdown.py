@@ -17,7 +17,7 @@ b16 x s1024 -> 16384 tokens, bf16):
     gather_jnp      r4 path: all-gather dual-map dispatch (XLA gather)
     gather_pallas   r4 path with the Pallas scalar-prefetch row kernel
 
-Merged into WORKLOADS_r04.json under "moe_breakdown"; one JSON line per
+Merged into WORKLOADS_r05.json under "moe_breakdown"; one JSON line per
 measurement so a mid-run wedge keeps earlier points.
 """
 from __future__ import annotations
@@ -33,7 +33,7 @@ import numpy as np
 from _bench_common import configure_jax, merge_artifact
 
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "WORKLOADS_r04.json")
+                   "WORKLOADS_r05.json")
 
 
 def main():

@@ -4,8 +4,8 @@
 Captures a jax.profiler trace of the headline Llama train step —
 default the ~0.95B bf16 config (PROFILE_CONFIG=small for the 0.27B
 one), post-processes the xplane with xprof into an
-op-category breakdown, and writes PROFILE_r04.json + the raw trace
-directory (profile_r04/) for TensorBoard.
+op-category breakdown, and writes PROFILE_r05.json + the raw trace
+directory (profile_r05/) for TensorBoard.
 
 Run on the chip:      python profile_tpu.py
 Machinery test (CPU): JAX_PLATFORMS=cpu python profile_tpu.py --cpu
@@ -20,8 +20,8 @@ import time
 
 import numpy as np
 
-OUT = os.environ.get("PROFILE_OUT", "PROFILE_r04.json")
-TRACE_DIR = os.environ.get("PROFILE_TRACE_DIR", "profile_r04")
+OUT = os.environ.get("PROFILE_OUT", "PROFILE_r05.json")
+TRACE_DIR = os.environ.get("PROFILE_TRACE_DIR", "profile_r05")
 
 
 def _op_breakdown(trace_dir):
@@ -170,7 +170,7 @@ def main():
     breakdown, err = _op_breakdown(TRACE_DIR)
     from paddle_tpu.ops.pallas.flash_attention import sdpa_last_dispatch
     artifact = {
-        "artifact": "PROFILE_r04",
+        "artifact": "PROFILE_r05",
         "chip": os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
         if on_tpu else "cpu",
         "config": {"name": which, "params": int(model.num_params()),

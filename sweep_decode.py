@@ -8,7 +8,7 @@ The r3 artifact characterized decode at exactly one operating point
 
 on the 0.27B Llama config used by bench.py's config_small, recording
 tokens/s and per-new-token latency for each point, merged into
-`BENCH_TPU_MEASURED_r04.json` under "decode_sweep".
+`BENCH_TPU_MEASURED_r05.json` under "decode_sweep".
 
 Run only in a healthy tunnel window (tpu_session.sh stage 3):
 
@@ -29,7 +29,7 @@ import numpy as np
 from _bench_common import configure_jax, merge_artifact
 
 OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                   "BENCH_TPU_MEASURED_r04.json")
+                   "BENCH_TPU_MEASURED_r05.json")
 
 
 def _merge(points, chip):

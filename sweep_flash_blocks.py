@@ -4,7 +4,7 @@ PROFILE_r03 attribution: at the headline shape (b32 h16 s1024 d64) the
 three flash pallas kernels take 53% of device self-time at the default
 128-block sizes while carrying only ~14% of the step FLOPs. This sweep
 times jax's TPU flash kernel fwd+bwd across block configurations (and
-the O(s^2) XLA path as control) and writes FLASH_BLOCKS_r04.json; the
+the O(s^2) XLA path as control) and writes FLASH_BLOCKS_r05.json; the
 winning heuristic is wired into ops/pallas/flash_attention.py.
 
 Run: python sweep_flash_blocks.py            (on the chip)
@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-OUT = "FLASH_BLOCKS_r04.json"
+OUT = "FLASH_BLOCKS_r05.json"
 
 
 def bench_case(fn, args, iters=10, warmup=1):
@@ -34,7 +34,7 @@ def bench_case(fn, args, iters=10, warmup=1):
 
 def _save(results, best=None, speedup=None, shape=None):
     with open(OUT, "w") as f:
-        json.dump({"artifact": "FLASH_BLOCKS_r04", "shape": shape,
+        json.dump({"artifact": "FLASH_BLOCKS_r05", "shape": shape,
                    "chip": "v5e", "results": results, "best": best,
                    "speedup_vs_default": speedup}, f, indent=1)
 

@@ -5,14 +5,14 @@
 #   bash tpu_session.sh
 # Priority order (each stage survives a later wedge; bench and the
 # workloads runner write partial artifacts after every completed stage):
-#   1. headline bench                  -> BENCH_TPU_MEASURED_r04.json
+#   1. headline bench                  -> BENCH_TPU_MEASURED_r05.json
 #      (stage order inside: tiny liveness stamp -> small -> ~1B big
 #       [run_steps scan dispatch] -> selective-remat probe -> decode;
 #       persistent compile cache so a repeat run skips the compiles)
-#   2. non-Llama BASELINE workloads    -> WORKLOADS_r04.json
-#   3. decode serving sweep            -> merged into BENCH_TPU_MEASURED_r04
+#   2. non-Llama BASELINE workloads    -> WORKLOADS_r05.json
+#   3. decode serving sweep            -> merged into BENCH_TPU_MEASURED_r05
 #   4. MoE gate/dispatch/expert breakdown + Pallas-vs-jnp dispatch A/B
-#                                      -> merged into WORKLOADS_r04.json
+#                                      -> merged into WORKLOADS_r05.json
 #   5. profile re-capture (attribution after run_steps lever)
 #   6. on-chip kernel validation tests
 set -x
@@ -37,7 +37,7 @@ except Exception:
     raise SystemExit
 if new.get("chip") != "v5e":
     raise SystemExit
-out = "BENCH_TPU_MEASURED_r04.json"
+out = "BENCH_TPU_MEASURED_r05.json"
 # merge: a deadline-cut stage in the new run must not erase a number
 # the previous session measured (e.g. decode_* / config_big keys) —
 # but run-specific diagnostics must never be carried into a clean run

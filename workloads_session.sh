@@ -1,18 +1,18 @@
 #!/bin/bash
 # Measure the non-Llama BASELINE workloads on the chip; merge each
-# point into WORKLOADS_r04.json as it completes (a later tunnel wedge
+# point into WORKLOADS_r05.json as it completes (a later tunnel wedge
 # keeps earlier points).
 cd "$(dirname "$0")"
-OUT=WORKLOADS_r04.json
+OUT=WORKLOADS_r05.json
 for w in resnet50 bert_base ernie_moe sdxl_unet; do
     line=$(timeout -s INT -k 30 600 python bench_workloads.py "$w" 2>&1 \
            | grep '^WORKLOAD ' | tail -1 | sed 's/^WORKLOAD //')
     [ -z "$line" ] && line="{\"workload\": \"$w\", \"error\": \"no output (timeout/crash)\"}"
     python - "$w" "$line" <<'EOF'
 import json, os, sys
-out = "WORKLOADS_r04.json"
+out = "WORKLOADS_r05.json"
 d = json.load(open(out)) if os.path.exists(out) else {
-    "artifact": "WORKLOADS_r04", "chip": "v5e",
+    "artifact": "WORKLOADS_r05", "chip": "v5e",
     "note": ("throughput for the BASELINE.json workloads beyond the "
              "Llama headline (bench.py); utilization_vs_peak uses "
              "XLA cost-analysis FLOPs, see bench_workloads.py")}
