@@ -50,19 +50,27 @@ class Model:
         return [float(loss.item())]
 
     def eval_batch(self, inputs, labels=None):
+        was_training = getattr(self.network, "training", True)
         self.network.eval()
-        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
-        y = labels[0] if isinstance(labels, (list, tuple)) else labels
-        out = self.network(x)
-        loss = self._loss(out, y)
-        self.network.train()
+        try:
+            x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+            y = labels[0] if isinstance(labels, (list, tuple)) else labels
+            out = self.network(x)
+            loss = self._loss(out, y)
+        finally:
+            if was_training:
+                self.network.train()
         return [float(loss.item())], out
 
     def predict_batch(self, inputs):
+        was_training = getattr(self.network, "training", True)
         self.network.eval()
-        x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
-        out = self.network(x)
-        self.network.train()
+        try:
+            x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+            out = self.network(x)
+        finally:
+            if was_training:
+                self.network.train()
         return out
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
@@ -152,6 +160,7 @@ class Model:
         # every loss on device and fetches ONCE at the end (VERDICT r3
         # weak #2: per-batch .item() defeats XLA async dispatch)
         custom_step = type(self).eval_batch is not Model.eval_batch
+        was_training = getattr(self.network, "training", True)
         self.network.eval()
         try:
             from .. import framework
@@ -167,7 +176,10 @@ class Model:
                         losses.append(
                             self._loss(self.network(x), y)._value)
         finally:
-            self.network.train()
+            # restore the caller's mode: evaluating a network the user
+            # deliberately put in eval mode must not flip it to train
+            if was_training:
+                self.network.train()
         import jax
         res = {"loss": [float(np.mean(jax.device_get(losses)))]}
         if verbose:

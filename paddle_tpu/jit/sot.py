@@ -218,10 +218,49 @@ class _TraceNode:
 
 # ----------------------------------------------------------- guards
 
-def _guard_of(args, kwargs, keepalive=None):
-    def leaf(v):
+class _TransientFallback(Exception):
+    """Per-call eager fallback for a TRANSIENT guard condition (e.g. a
+    not-yet-bound closure cell): unlike CaptureFallback in the guard
+    path, it must NOT set fallback-forever — tracing resumes once the
+    condition clears."""
+
+
+def _builtins_dict(fn):
+    b = fn.__globals__.get("__builtins__", {})
+    return b.__dict__ if isinstance(b, types.ModuleType) else b
+
+
+def _guard_walk(v, keepalive, strict, what):
+    """Single guard encoder for arguments, closure cells, and globals.
+
+    ``strict=True`` (arguments/cells): Tensors are trace INPUTS,
+    guarded by shape/dtype; an unguardable type raises CaptureFallback
+    (the call site decides the fallback policy). ``strict=False``
+    (globals / module attrs): an unguardable object — or a Tensor,
+    which can never survive into a trace anyway (`_record` rejects raw
+    Tensors from enclosing scope) — is guarded by IDENTITY, so
+    rebinding the global recaptures while in-place mutation of the
+    same object's internals is out of contract (module-attr reads get
+    their own validation guards; see OpcodeExecutor.module_attr_guards).
+
+    Hot-path cost note: ndarray globals are content-hashed on every
+    call (bounded at 64 KiB — larger ones fall back with a pass-it-as-
+    an-argument error) and containers are walked per call; that is the
+    price of catching in-place mutation. Big constants belong in
+    arguments, where they are inputs, not baked values.
+    """
+    def ident(v):
+        if keepalive is not None:
+            keepalive[id(v)] = v
+        return ("obj", id(v))
+
+    def walk(v, stack):
         if isinstance(v, Tensor):
-            return ("T", tuple(v._value.shape), str(v._value.dtype))
+            if strict:
+                return ("T", tuple(v._value.shape), str(v._value.dtype))
+            # consumption is impossible (raw Tensors from enclosing
+            # scope are rejected at record time), so identity is enough
+            return ident(v)
         if isinstance(v, (int, float, bool, str, bytes, type(None))):
             return ("c", v)
         if isinstance(v, np.ndarray):
@@ -229,31 +268,149 @@ def _guard_of(args, kwargs, keepalive=None):
             # constants, so the guard must cover content, not just
             # shape/dtype; big arrays would make hashing the hot cost
             if v.nbytes > (1 << 16):
-                raise CaptureFallback(
-                    "large ndarray argument (pass a Tensor instead)")
+                if strict:
+                    raise CaptureFallback(
+                        f"large ndarray {what} (pass a Tensor instead)")
+                # lenient: identity, like objects — rebinding
+                # recaptures; in-place writes are out of contract
+                return ident(v)
             import hashlib
             return ("a", v.shape, str(v.dtype),
                     hashlib.sha1(np.ascontiguousarray(v).tobytes())
                     .hexdigest())
-        if callable(v):
-            # functions/layers guard by object identity; the guard
-            # KEEPS A REFERENCE so a GC'd callable's id can never be
-            # recycled into a silent trace hit
-            if keepalive is not None:
-                keepalive.append(v)
-            return ("fn", id(v))
-        raise CaptureFallback(f"unguardable argument type {type(v)}")
-
-    def walk(t):
-        if isinstance(t, (list, tuple)):
-            return ("seq", type(t).__name__,
-                    tuple(walk(x) for x in t))
-        if isinstance(t, dict):
+        if isinstance(v, types.ModuleType) or callable(v):
+            # functions/layers/modules guard by object identity; the
+            # guard KEEPS A REFERENCE so a GC'd object's id can never
+            # be recycled into a silent trace hit
+            return ("fn", ident(v)[1])
+        if isinstance(v, (list, tuple, set, frozenset, dict)):
+            if id(v) in stack:
+                # cyclic container: the repeated node degrades to
+                # identity (strict: unencodable by value -> fall back)
+                if strict:
+                    raise CaptureFallback(f"cyclic container {what}")
+                return ident(v)
+            stack = stack | {id(v)}
+            if isinstance(v, (list, tuple)):
+                return ("seq", type(v).__name__,
+                        tuple(walk(x, stack) for x in v))
+            if isinstance(v, (set, frozenset)):
+                return ("set", type(v).__name__, tuple(sorted(
+                    (walk(x, stack) for x in v), key=repr)))
+            # sort by key repr: mixed-type keys (int + str) are not
+            # mutually orderable; repr is deterministic and the raw key
+            # stays in the tuple so equality remains exact
             return ("map", tuple(sorted(
-                (k, walk(v)) for k, v in t.items())))
-        return leaf(t)
+                ((k, walk(x, stack)) for k, x in v.items()),
+                key=lambda kv: repr(kv[0]))))
+        if not strict:
+            # arbitrary object global (logger, config singleton, ...):
+            # identity-guard rather than disabling tracing for a
+            # function that may never even touch it; rebinding the
+            # global recaptures, internal mutation is out of contract
+            return ident(v)
+        raise CaptureFallback(f"unguardable {what} type {type(v)}")
 
-    return (walk(list(args)), walk(dict(kwargs)))
+    return walk(v, frozenset())
+
+
+def _guard_of(args, kwargs, keepalive=None):
+    return (_guard_walk(list(args), keepalive, True, "argument"),
+            _guard_walk(dict(kwargs), keepalive, True, "argument"))
+
+
+_CODE_GLOBAL_NAMES: dict = {}
+
+
+def _code_global_names(code):
+    """LOAD_GLOBAL name set of a code object (memoized — the dis walk
+    is the expensive part; keying by the code object keeps it alive,
+    which its owning function does anyway)."""
+    names = _CODE_GLOBAL_NAMES.get(code)
+    if names is None:
+        names = tuple(sorted({i.argval
+                              for i in dis.get_instructions(code)
+                              if i.opname == "LOAD_GLOBAL"}))
+        _CODE_GLOBAL_NAMES[code] = names
+    return names
+
+
+def _guard_globals(fn, names, keepalive):
+    """Guard leaves for the current values of ``fn``'s LOAD_GLOBAL
+    names.
+
+    Globals consumed during capture are baked into the recorded trace
+    as constants (scalars/containers/ndarrays) or called through by
+    identity (functions), so a replay is only sound while they hold
+    their capture-time values — the same unsoundness class the closure
+    -cell guard closed in r4. The name set is STATIC (read from the
+    bytecode once at wrapper construction), so the guard covers every
+    global the function could read on any path; a mutated global then
+    misses the trace cache and recaptures instead of silently
+    replaying the stale constant. Builtins resolve through the same
+    path: shadowing a builtin with a module global changes the
+    encoding and forces a recapture.
+
+    Scalars, strings, containers, sets, and small ndarrays are guarded
+    by VALUE; callables, modules, and arbitrary objects by IDENTITY
+    (rebinding recaptures). Attribute reads off module globals (e.g.
+    ``cfg.scale``) are additionally value-validated per trace entry
+    via ``module_attr_guards``, so mutating a module attribute drops
+    the stale trace; mutating internals of a non-module object global
+    consumed during capture remains out of contract.
+
+    Plain-function globals are expanded TRANSITIVELY (depth-bounded):
+    a helper called from the traced code has its own globals baked
+    into the jit-compiled segments, so ``helper``'s LOAD_GLOBAL names
+    join the guard resolved against ``helper.__globals__``. Functions
+    reached only through containers/attributes, and helpers' closure
+    cells, are not expanded (identity-guard on the helper still
+    catches rebinding the helper itself).
+    """
+    out = []
+    seen_fns = {id(fn)}
+    work = [(fn, names)]
+    for _depth in range(3):
+        if not work:
+            break
+        nxt = []
+        for owner, nms in work:
+            glb = owner.__globals__
+            builtins_ = _builtins_dict(owner)
+            oid = id(owner)
+            for name in nms:
+                if name in glb:
+                    v = glb[name]
+                    out.append((oid, name, "g",
+                                _guard_walk(v, keepalive, False,
+                                            "global")))
+                    if isinstance(v, types.FunctionType) and \
+                            id(v) not in seen_fns:
+                        seen_fns.add(id(v))
+                        sub = _code_global_names(v.__code__)
+                        if sub:
+                            nxt.append((v, sub))
+                elif name in builtins_:
+                    out.append((oid, name, "b",
+                                _guard_walk(builtins_[name], keepalive,
+                                            False, "global")))
+                else:
+                    # unbound here; if a path actually reads it,
+                    # capture falls back — binding it later changes
+                    # the encoding (recapture)
+                    out.append((oid, name, "u"))
+        work = nxt
+    return tuple(out)
+
+
+def _attr_enc(v, keepalive):
+    """Encode a module attribute's value for replay-time validation
+    (lenient: anything unguardable degrades to identity)."""
+    try:
+        return _guard_walk(v, keepalive, False, "module attr")
+    except CaptureFallback:
+        keepalive[id(v)] = v
+        return ("obj", id(v))
 
 
 # ------------------------------------------------------- the executor
@@ -287,8 +444,10 @@ class OpcodeExecutor:
     """Interprets one function's bytecode, recording tensor ops into a
     trace tree (reference: sot OpcodeExecutor — verify)."""
 
-    def __init__(self, fn, trace_root: _TraceNode):
+    def __init__(self, fn, trace_root: _TraceNode, attr_keepalive=None):
         self.fn = fn
+        self._attr_keepalive = ({} if attr_keepalive is None
+                                else attr_keepalive)
         self.code = fn.__code__
         self.instructions = list(dis.get_instructions(self.code))
         self.by_offset = {i.offset: idx
@@ -305,6 +464,11 @@ class OpcodeExecutor:
         self._rts_cache: dict = {}
         self.node_rts_inputs: dict = {}
         self.input_order: list = []
+        # (id(module), attr) -> (module, encoded value): attribute
+        # reads off module objects during capture are baked into the
+        # trace (LOAD_ATTR reads concretely), so replay validates them
+        # against the live module and drops the trace on mismatch
+        self.module_attr_guards: dict = {}
         # containers CREATED during this capture: mutating them is
         # safe (they exist only inside the trace); mutating anything
         # pre-existing (argument, closure, global) would be a silent
@@ -477,9 +641,7 @@ class OpcodeExecutor:
         idx = 0
         ins = self.instructions
         glb = self.fn.__globals__
-        builtins_ = glb.get("__builtins__", {})
-        if isinstance(builtins_, types.ModuleType):
-            builtins_ = builtins_.__dict__
+        builtins_ = _builtins_dict(self.fn)
         kw_names: tuple = ()
         cells: dict[str, Any] = {}
         for name, cell in zip(code.co_freevars, self.fn.__closure__ or ()):
@@ -791,6 +953,11 @@ class OpcodeExecutor:
             # python metadata (shape, ndim, dtype): guard-static
             return (None, real_attr) if is_method else real_attr
         attr = getattr(obj, name)
+        if isinstance(obj, types.ModuleType):
+            # the read value is baked into the trace — validate it at
+            # replay time (e.g. cfg.scale mutated between calls)
+            self.module_attr_guards[(id(obj), name)] = (
+                obj, _attr_enc(attr, self._attr_keepalive))
         if is_method:
             return (None, attr)
         return attr
@@ -931,10 +1098,13 @@ class SotFunction:
         else:
             self._recv = None
         self.fn = fn
-        self.traces: dict = {}       # guard -> (root, input_order)
+        self.traces: dict = {}  # guard -> (root, input_order, rts, attrs)
         self.stats = {"captures": 0, "replays": 0, "fallbacks": 0,
                       "graph_breaks": 0}
-        self._guard_keepalive: list = []
+        # every global name this code object can LOAD_GLOBAL, computed
+        # once; their live values join the guard on every call
+        self._global_names = _code_global_names(fn.__code__)
+        self._guard_keepalive: dict = {}
         self._fallback_forever = False
         self.__name__ = getattr(fn, "__name__", "sot_fn")
 
@@ -954,16 +1124,33 @@ class SotFunction:
             # contents are baked into the trace as constants, so a
             # mutated nonlocal must recapture, not silently replay the
             # stale value (review-reproduced unsoundness)
-            cells = tuple(c.cell_contents
-                          for c in (self.fn.__closure__ or ())
-                          if not isinstance(c.cell_contents, types.CellType))
-            guard = _guard_of(tuple(args) + (cells,), kwargs,
-                              self._guard_keepalive)
+            cells = []
+            for c in self.fn.__closure__ or ():
+                try:
+                    contents = c.cell_contents
+                except ValueError:
+                    # not-yet-bound cell: eager for THIS call only —
+                    # tracing resumes once the cell binds
+                    raise _TransientFallback("unbound closure cell")
+                if not isinstance(contents, types.CellType):
+                    cells.append(contents)
+            guard = (_guard_of(tuple(args) + (tuple(cells),), kwargs,
+                               self._guard_keepalive),
+                     _guard_globals(self.fn, self._global_names,
+                                    self._guard_keepalive))
+        except _TransientFallback:
+            self.stats["fallbacks"] += 1
+            return self.fn(*args, **kwargs)
         except CaptureFallback:
             self.stats["fallbacks"] += 1
             self._fallback_forever = True
             return self.fn(*args, **kwargs)
         entry = self.traces.get(guard)
+        if entry is not None and not self._module_attrs_valid(entry[3]):
+            # a module attribute baked into this trace changed: every
+            # path under this key is stale — drop and recapture fresh
+            self.traces.pop(guard, None)
+            entry = None
         if entry is not None:
             try:
                 return self._replay(entry, args, kwargs)
@@ -971,11 +1158,24 @@ class SotFunction:
                 pass                       # capture the new path below
         return self._capture(guard, args, kwargs)
 
+    def _module_attrs_valid(self, attr_guards):
+        for (_mid, name), (mod, enc) in attr_guards.items():
+            try:
+                cur = getattr(mod, name)
+            except AttributeError:
+                return False
+            # throwaway keepalive: validation only COMPARES encodings
+            # (both objects are alive for the comparison); pinning each
+            # transient value would leak per call
+            if _attr_enc(cur, {}) != enc:
+                return False
+        return True
+
     # ---- capture -------------------------------------------------------
     def _capture(self, guard, args, kwargs):
         entry = self.traces.get(guard)
         root = entry[0] if entry else _TraceNode()
-        ex = OpcodeExecutor(self.fn, root)
+        ex = OpcodeExecutor(self.fn, root, self._guard_keepalive)
         try:
             out = ex.run(args, kwargs)
         except CaptureFallback:
@@ -986,12 +1186,14 @@ class SotFunction:
         self.stats["graph_breaks"] += len(ex.decisions)
         rts = dict(entry[2]) if entry else {}
         rts.update(ex.node_rts_inputs)   # merge: keep other paths' slots
-        self.traces[guard] = (root, ex.input_order, rts)
+        attrs = dict(entry[3]) if entry else {}
+        attrs.update(ex.module_attr_guards)
+        self.traces[guard] = (root, ex.input_order, rts, attrs)
         return out
 
     # ---- replay --------------------------------------------------------
     def _replay(self, entry, args, kwargs):
-        root, input_order, rts_inputs = entry
+        root, input_order, rts_inputs = entry[:3]
         tensors = [v for v in _leaves([list(args), dict(kwargs)])
                    if isinstance(v, Tensor)]
         slot_vals = dict(zip(input_order, tensors))

@@ -446,6 +446,18 @@ def _conv_amp_dtypes(v, w, op_name):
 
 def _convnd(x, weight, bias, stride, padding, dilation, groups, nd,
             data_format, _display_format=None):
+    # strict format validation at the single dispatch point: an unknown
+    # or typo'd format must raise here, never silently run with
+    # channel-first semantics (conv1d passes its already-validated
+    # internal spelling NCH/NHC)
+    _valid = {1: ("NCH", "NHC"), 2: ("NCHW", "NHWC"),
+              3: ("NCDHW", "NDHWC")}[nd]
+    if data_format not in _valid:
+        _user = {1: "'NCL' or 'NLC'", 2: "'NCHW' or 'NHWC'",
+                 3: "'NCDHW' or 'NDHWC'"}[nd]
+        raise ValueError(
+            f"conv{nd}d: data_format must be {_user}, got "
+            f"{(_display_format or data_format)!r}")
     strides = _pair(stride, nd)
     dils = _pair(dilation, nd)
     chan_last = data_format in _CHANNEL_LAST
@@ -495,6 +507,10 @@ def _convnd(x, weight, bias, stride, padding, dilation, groups, nd,
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCL", name=None):
+    if data_format not in ("NCL", "NLC"):
+        raise ValueError(
+            f"conv1d: data_format must be 'NCL' or 'NLC', got "
+            f"{data_format!r}")
     return _convnd(x, weight, bias, stride, padding, dilation, groups, 1,
                    "NCH" if data_format == "NCL" else "NHC",
                    _display_format=data_format)
@@ -581,6 +597,16 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1,
                      data_format="NCL", name=None):
+    if data_format not in ("NCL", "NLC"):
+        raise ValueError(
+            f"conv1d_transpose: data_format must be 'NCL' or 'NLC', "
+            f"got {data_format!r}")
+    if data_format == "NLC":
+        xt = apply_op(lambda v: jnp.transpose(v, (0, 2, 1)), x)
+        out = conv1d_transpose(xt, weight, bias, stride, padding,
+                               output_padding, groups, dilation, "NCL",
+                               name)
+        return apply_op(lambda v: jnp.transpose(v, (0, 2, 1)), out)
     x4 = apply_op(lambda v: v[:, :, None, :], x)
     w4 = apply_op(lambda v: v[:, :, None, :], weight)
     out = conv2d_transpose(x4, w4, bias, (1, _pair(stride, 1)[0]),

@@ -78,20 +78,24 @@ class Imdb(Dataset):
         path = _resolve(data_file, ["aclImdb_v1.tar.gz", "aclImdb.tar.gz"],
                         "Imdb")
         pat_doc = f"aclImdb/{mode}"
+        # the cutoff vocabulary is built over the FULL corpus (train and
+        # test members alike, reference behavior), so mode="test" yields
+        # the same token ids and vocab size as mode="train"; only
+        # docs/labels are filtered by mode
         texts, labels = [], []
+        freq: dict = {}
         with tarfile.open(path, "r:*") as tf:
             members = [m for m in tf.getmembers()
-                       if m.name.startswith(pat_doc) and
-                       ("/pos/" in m.name or "/neg/" in m.name) and
+                       if ("/pos/" in m.name or "/neg/" in m.name) and
                        m.name.endswith(".txt")]
             for m in members:
                 data = tf.extractfile(m).read().decode("utf-8", "replace")
-                texts.append(self._tokenize(data))
-                labels.append(0 if "/neg/" in m.name else 1)
-        freq: dict = {}
-        for t in texts:
-            for w in t:
-                freq[w] = freq.get(w, 0) + 1
+                toks = self._tokenize(data)
+                for w in toks:
+                    freq[w] = freq.get(w, 0) + 1
+                if m.name.startswith(pat_doc):
+                    texts.append(toks)
+                    labels.append(0 if "/neg/" in m.name else 1)
         words = sorted((w for w, c in freq.items() if c >= cutoff),
                        key=lambda w: (-freq[w], w))
         self.word_idx = {w: i for i, w in enumerate(words)}

@@ -114,6 +114,21 @@ class TestHapiNoSync:
         assert np.isfinite(res["loss"][0])
         assert ctr.total < N_BATCHES
 
+    def test_evaluate_restores_caller_mode(self, monkeypatch):
+        """evaluate() must restore the network's prior train/eval mode,
+        not unconditionally flip it to train (advisor r4)."""
+        from paddle_tpu.hapi import Model
+        m = Model(_net())
+        m.prepare(optimizer=optimizer.SGD(
+            learning_rate=0.1, parameters=m.parameters()),
+            loss=nn.MSELoss())
+        m.network.eval()
+        m.evaluate(DS(8), batch_size=4, verbose=0)
+        assert m.network.training is False
+        m.network.train()
+        m.evaluate(DS(8), batch_size=4, verbose=0)
+        assert m.network.training is True
+
     def test_fit_fast_path_syncs_once(self, monkeypatch):
         from paddle_tpu.hapi import Model
         m = Model(_net())

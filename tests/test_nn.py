@@ -807,6 +807,50 @@ class TestShapeMismatchErrors:
         except ValueError as e:
             assert "NLC" in str(e) and "NHC" not in str(e), str(e)
 
+    def test_conv1d_rejects_unknown_format(self):
+        """A typo'd data_format must raise, not silently run with
+        channel-last semantics (advisor r4)."""
+        import pytest
+        from paddle_tpu.nn import functional as F
+        w = paddle.to_tensor(np.zeros((5, 3, 3), np.float32))
+        x = paddle.to_tensor(np.zeros((2, 3, 8), np.float32))
+        for bad in ("NCHW", "ncl", "NHC", ""):
+            with pytest.raises(ValueError, match="data_format"):
+                F.conv1d(x, w, data_format=bad)
+
+    def test_conv2d_conv3d_reject_unknown_format(self):
+        """Same typo class as conv1d: conv2d/conv3d must raise on an
+        unknown data_format, not silently run channel-first."""
+        import pytest
+        from paddle_tpu.nn import functional as F
+        w2 = paddle.to_tensor(np.zeros((5, 3, 3, 3), np.float32))
+        x2 = paddle.to_tensor(np.zeros((2, 3, 8, 8), np.float32))
+        for bad in ("nchw", "NCL", "NCWH", ""):
+            with pytest.raises(ValueError, match="data_format"):
+                F.conv2d(x2, w2, data_format=bad)
+        w3 = paddle.to_tensor(np.zeros((5, 3, 3, 3, 3), np.float32))
+        x3 = paddle.to_tensor(np.zeros((2, 3, 4, 8, 8), np.float32))
+        with pytest.raises(ValueError, match="data_format"):
+            F.conv3d(x3, w3, data_format="NCHW")
+
+    def test_conv1d_transpose_nlc_matches_ncl(self):
+        """conv1d_transpose previously IGNORED data_format; NLC must
+        equal transposed NCL, and unknown formats must raise."""
+        import pytest
+        from paddle_tpu.nn import functional as F
+        rng = np.random.RandomState(0)
+        x_ncl = rng.rand(2, 3, 8).astype(np.float32)
+        w = paddle.to_tensor(rng.rand(3, 5, 3).astype(np.float32))
+        out_ncl = F.conv1d_transpose(
+            paddle.to_tensor(x_ncl), w, stride=2).numpy()
+        out_nlc = F.conv1d_transpose(
+            paddle.to_tensor(x_ncl.transpose(0, 2, 1)), w, stride=2,
+            data_format="NLC").numpy()
+        np.testing.assert_allclose(out_nlc.transpose(0, 2, 1), out_ncl,
+                                   rtol=1e-5, atol=1e-5)
+        with pytest.raises(ValueError, match="data_format"):
+            F.conv1d_transpose(paddle.to_tensor(x_ncl), w,
+                               data_format="NCHW")
 
 
 class TestConvTransposeLayouts:

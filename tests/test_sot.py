@@ -436,6 +436,213 @@ class TestClosureGuards:
         np.testing.assert_allclose(sf(x).numpy(), 2.0)
         np.testing.assert_allclose(sf(x).numpy(), 2.0)
 
+    def test_mutated_global_scalar_recaptures(self):
+        """Module-level globals read via LOAD_GLOBAL are baked into the
+        trace as constants; mutating one must miss the guard and
+        recapture, not replay the stale value (advisor r4 medium)."""
+        import types as _types
+        mod = _types.ModuleType("sot_glb_test")
+        src = "def f(x):\n    return x * SCALE\n"
+        exec(compile(src, "<sot_glb_test>", "exec"), mod.__dict__)
+        mod.SCALE = 1.0
+        sf = SotFunction(mod.f)
+        x = t(np.ones((2, 2)))
+        np.testing.assert_allclose(sf(x).numpy(), 1.0)
+        np.testing.assert_allclose(sf(x).numpy(), 1.0)   # replay path
+        mod.SCALE = 3.0
+        np.testing.assert_allclose(sf(x).numpy(), 3.0)
+        np.testing.assert_allclose(sf(x).numpy(), 3.0)
+        assert sot_stats(sf)["captures"] >= 2
+
+    def test_rebound_global_function_recaptures(self):
+        """Rebinding a global helper to a different function must
+        change the identity guard and recapture."""
+        import types as _types
+        mod = _types.ModuleType("sot_glb_fn_test")
+        src = ("def f(x):\n"
+               "    return helper(x)\n")
+        exec(compile(src, "<sot_glb_fn_test>", "exec"), mod.__dict__)
+        mod.helper = lambda v: v + 1.0
+        sf = SotFunction(mod.f)
+        x = t(np.ones((2, 2)))
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        mod.helper = lambda v: v + 10.0
+        np.testing.assert_allclose(sf(x).numpy(), 11.0)
+
+    def test_unbound_closure_cell_falls_back(self):
+        """An unbound cell at guard time must fall back to eager for
+        that call only (raising the same NameError eager would, not a
+        ValueError crash) — and tracing must RESUME once the cell
+        binds, not stay disabled forever (advisor r4 low)."""
+        def outer():
+            def f(x):
+                return x * late        # noqa: F821 — bound after def
+            probe = SotFunction(f)
+            try:
+                probe(t(np.ones((2, 2))))     # cell still unbound
+                raise AssertionError("expected NameError")
+            except NameError:
+                pass
+            late = 2.0                         # noqa: F841 — binds cell
+            out = probe(t(np.ones((2, 2))))
+            assert sot_stats(probe)["captures"] >= 1   # traced again
+            return out
+
+        np.testing.assert_allclose(outer().numpy(), 2.0)
+
+    def test_mixed_key_dict_global_falls_back_cleanly(self):
+        """A global dict with mixed-type keys is guarded via repr-keyed
+        sort — it must never escape a raw TypeError from sorted()."""
+        import types as _types
+        mod = _types.ModuleType("sot_glb_mixed")
+        src = "def f(x):\n    return x * CFG['k']\n"
+        exec(compile(src, "<sot_glb_mixed>", "exec"), mod.__dict__)
+        mod.CFG = {1: 2.0, "k": 3.0}
+        sf = SotFunction(mod.f)
+        x = t(np.ones((2, 2)))
+        np.testing.assert_allclose(sf(x).numpy(), 3.0)
+        mod.CFG = {1: 2.0, "k": 5.0}           # value change recaptures
+        np.testing.assert_allclose(sf(x).numpy(), 5.0)
+
+    def test_mutated_module_attr_drops_stale_trace(self):
+        """cfg.scale read during capture is baked into the trace; the
+        per-entry module-attr guard must detect the mutation and
+        recapture instead of replaying the stale constant."""
+        import types as _types
+        cfg = _types.ModuleType("sot_cfg")
+        cfg.scale = 2.0
+        mod = _types.ModuleType("sot_glb_attr")
+        src = "def f(x):\n    return x * cfg.scale\n"
+        exec(compile(src, "<sot_glb_attr>", "exec"), mod.__dict__)
+        mod.cfg = cfg
+        sf = SotFunction(mod.f)
+        x = t(np.ones((2, 2)))
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)   # replay
+        cfg.scale = 7.0
+        np.testing.assert_allclose(sf(x).numpy(), 7.0)
+        np.testing.assert_allclose(sf(x).numpy(), 7.0)
+        assert sot_stats(sf)["captures"] >= 2
+
+    def test_object_global_does_not_disable_tracing(self):
+        """An arbitrary-object global (e.g. a logger) referenced only
+        on a dead path must not permanently disable tracing — it is
+        identity-guarded, and rebinding it recaptures."""
+        import types as _types
+
+        class Obj:
+            pass
+
+        mod = _types.ModuleType("sot_glb_obj")
+        src = ("def f(x):\n"
+               "    if False:\n"
+               "        LOGGER.debug('x')\n"
+               "    return x + 1.0\n")
+        exec(compile(src, "<sot_glb_obj>", "exec"), mod.__dict__)
+        mod.LOGGER = Obj()
+        sf = SotFunction(mod.f)
+        x = t(np.ones((2, 2)))
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        assert sot_stats(sf)["fallbacks"] == 0
+        assert sot_stats(sf)["captures"] == 1
+        assert sot_stats(sf)["replays"] >= 1
+
+    def test_set_global_value_guarded(self):
+        """set globals guard by VALUE: membership decisions are baked,
+        so changing the set must recapture."""
+        import types as _types
+        mod = _types.ModuleType("sot_glb_set")
+        src = ("def f(x):\n"
+               "    if 'a' in STOP:\n"
+               "        return x * 2.0\n"
+               "    return x * 3.0\n")
+        exec(compile(src, "<sot_glb_set>", "exec"), mod.__dict__)
+        mod.STOP = {"a", "b"}
+        sf = SotFunction(mod.f)
+        x = t(np.ones((2, 2)))
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        mod.STOP = {"b"}
+        np.testing.assert_allclose(sf(x).numpy(), 3.0)
+
+    def test_helper_global_mutation_recaptures(self):
+        """Globals read inside a CALLED helper are baked into the
+        compiled segments; the guard expands function globals
+        transitively, so mutating the helper's module global must
+        recapture."""
+        import types as _types
+        mod = _types.ModuleType("sot_glb_helper")
+        src = ("def helper(v):\n"
+               "    return v * K\n"
+               "def f(x):\n"
+               "    return helper(x)\n")
+        exec(compile(src, "<sot_glb_helper>", "exec"), mod.__dict__)
+        mod.K = 2.0
+        sf = SotFunction(mod.f)
+        x = t(np.ones((2, 2)))
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)   # replay
+        mod.K = 5.0
+        np.testing.assert_allclose(sf(x).numpy(), 5.0)
+        np.testing.assert_allclose(sf(x).numpy(), 5.0)
+
+    def test_cyclic_global_container_no_crash(self):
+        """A self-referential global container must not blow the stack
+        — the cyclic node degrades to identity."""
+        import types as _types
+        mod = _types.ModuleType("sot_glb_cyc")
+        src = "def f(x):\n    return x * CFG['k']\n"
+        exec(compile(src, "<sot_glb_cyc>", "exec"), mod.__dict__)
+        cfg = {"k": 2.0}
+        cfg["self"] = cfg
+        mod.CFG = cfg
+        sf = SotFunction(mod.f)
+        x = t(np.ones((2, 2)))
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        cfg["k"] = 4.0                       # value change still caught
+        np.testing.assert_allclose(sf(x).numpy(), 4.0)
+
+    def test_large_ndarray_global_does_not_disable_tracing(self):
+        """A >64KiB ndarray global on a dead path is identity-guarded
+        (not a permanent fallback); rebinding it recaptures."""
+        import types as _types
+        mod = _types.ModuleType("sot_glb_lut")
+        src = ("def f(x):\n"
+               "    if False:\n"
+               "        return x * LUT[0]\n"
+               "    return x + 1.0\n")
+        exec(compile(src, "<sot_glb_lut>", "exec"), mod.__dict__)
+        mod.LUT = np.zeros(100_000, np.float32)
+        sf = SotFunction(mod.f)
+        x = t(np.ones((2, 2)))
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        np.testing.assert_allclose(sf(x).numpy(), 2.0)
+        assert sot_stats(sf)["fallbacks"] == 0
+        assert sot_stats(sf)["replays"] >= 1
+
+    def test_attr_validation_does_not_pin_transients(self):
+        """Replay-time module-attr validation must not grow the
+        keepalive dict per call (r5 review: leak)."""
+        import types as _types
+        cfg = _types.ModuleType("sot_cfg_pin")
+
+        class State:
+            pass
+        cfg.state = State()
+        mod = _types.ModuleType("sot_glb_pin")
+        src = "def f(x):\n    return x + (1.0 if cfg.state else 0.0)\n"
+        exec(compile(src, "<sot_glb_pin>", "exec"), mod.__dict__)
+        mod.cfg = cfg
+        sf = SotFunction(mod.f)
+        x = t(np.ones((2, 2)))
+        sf(x)
+        n0 = len(sf._guard_keepalive)
+        for _ in range(20):
+            sf(x)
+        assert len(sf._guard_keepalive) == n0
+
     def test_closure_over_tensor_list_falls_back(self):
         ws = [t(np.full((2, 2), 5.0))]
 

@@ -114,6 +114,31 @@ class TestImdbImikolov:
         assert ids.dtype == np.int64 and label in (0, 1)
         assert "<unk>" in ds.word_idx and "great" in ds.word_idx
 
+    def test_imdb_vocab_shared_across_splits(self, tmp_path):
+        """The cutoff vocabulary is built from the FULL tarball (train
+        and test), so both modes see identical token ids (advisor r4:
+        split-local vocab diverged from reference)."""
+        from paddle_tpu.text.datasets import Imdb
+        import io
+        tar = tmp_path / "aclImdb_v1.tar.gz"
+        with tarfile.open(tar, "w:gz") as tf:
+            for i, (split, lab, text) in enumerate([
+                    ("train", "pos", "alpha beta"),
+                    ("train", "neg", "beta gamma"),
+                    ("test", "pos", "delta alpha"),
+                    ("test", "neg", "delta beta")]):
+                data = text.encode()
+                ti = tarfile.TarInfo(f"aclImdb/{split}/{lab}/{i}.txt")
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        tr = Imdb(data_file=str(tar), mode="train", cutoff=1)
+        te = Imdb(data_file=str(tar), mode="test", cutoff=1)
+        assert tr.word_idx == te.word_idx
+        # "delta" appears only in test docs but must be in the shared
+        # vocabulary either way
+        assert "delta" in tr.word_idx
+        assert len(tr) == 2 and len(te) == 2
+
     def test_imikolov_ngrams(self, tmp_path):
         from paddle_tpu.text.datasets import Imikolov
         p = tmp_path / "ptb.train.txt"
