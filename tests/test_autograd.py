@@ -324,3 +324,169 @@ class TestSubgraphBackward:
             l2.backward()
         # termini are validated BEFORE any deposit: z untouched
         assert z.grad is None
+
+
+class TestCreateGraph:
+    """paddle.grad(create_graph=True): differentiable grads through the
+    eager tape (VERDICT r4 missing #6; reference gradient_checker's
+    double/triple grad pattern — verify)."""
+
+    def _leaf(self, arr):
+        return paddle.to_tensor(np.asarray(arr, np.float32),
+                                stop_gradient=False)
+
+    def test_double_grad_cubic(self):
+        x = self._leaf([2.0, -1.0, 0.5])
+        y = (x * x * x).sum()                       # y = sum x^3
+        (g,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(g.numpy(), 3 * x.numpy() ** 2,
+                                   rtol=1e-5)
+        (gg,) = paddle.grad(g.sum(), x, create_graph=True)
+        np.testing.assert_allclose(gg.numpy(), 6 * x.numpy(), rtol=1e-5)
+        (ggg,) = paddle.grad(gg.sum(), x)           # triple
+        np.testing.assert_allclose(ggg.numpy(), [6.0] * 3, rtol=1e-5)
+
+    def test_double_grad_numeric_check(self):
+        """gradient_checker pattern: second grad vs central differences
+        of the analytic first grad, for a few op families."""
+        cases = [
+            (lambda v: (v * v * v).sum(), "cubic"),
+            (lambda v: paddle.sin(v).sum(), "sin"),
+            (lambda v: paddle.exp(v * 0.5).sum(), "exp"),
+            (lambda v: (paddle.matmul(v, v) * 0.5).sum(), "matmul"),
+        ]
+        rng = np.random.RandomState(0)
+        base = rng.rand(3, 3).astype(np.float32) + 0.5
+        eps = 1e-3
+        for fn, name in cases:
+            x = self._leaf(base)
+            (g,) = paddle.grad(fn(x), x, create_graph=True)
+            (gg,) = paddle.grad(g.sum(), x)
+            num = np.zeros_like(base)
+            for i in range(base.shape[0]):
+                for j in range(base.shape[1]):
+                    for sgn in (+1, -1):
+                        xp = base.copy()
+                        xp[i, j] += sgn * eps
+                        xt = self._leaf(xp)
+                        (gp,) = paddle.grad(fn(xt), xt)
+                        num[i, j] += sgn * float(gp.numpy().sum())
+            num /= (2 * eps)
+            np.testing.assert_allclose(gg.numpy(), num, rtol=2e-2,
+                                       atol=2e-2, err_msg=name)
+
+    def test_grads_flow_to_other_leaves(self):
+        """Second-order cross terms: d/dw of dy/dx must reach w when
+        backward() runs on a function of the grads (the WGAN-GP
+        mechanism)."""
+        x = self._leaf([1.0, 2.0])
+        w = self._leaf([3.0, 4.0])
+        y = (x * x * w).sum()                   # dy/dx = 2xw
+        (g,) = paddle.grad(y, x, create_graph=True)
+        loss = (g * g).sum()                    # sum 4 x^2 w^2
+        loss.backward()
+        np.testing.assert_allclose(
+            w.grad.numpy(), 8 * x.numpy() ** 2 * w.numpy(), rtol=1e-5)
+        np.testing.assert_allclose(
+            x.grad.numpy(), 8 * x.numpy() * w.numpy() ** 2, rtol=1e-5)
+
+    def test_wgan_gp_gradient_penalty_trains(self):
+        """Full WGAN-GP-style loop: the gradient penalty backwards
+        through grad(create_graph=True) into discriminator params and
+        an SGD step reduces the penalty."""
+        from paddle_tpu import nn, optimizer
+        rng = np.random.RandomState(0)
+        D = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        opt = optimizer.SGD(learning_rate=0.05,
+                            parameters=D.parameters())
+        real = paddle.to_tensor(rng.rand(8, 4).astype(np.float32))
+        fake = paddle.to_tensor(rng.rand(8, 4).astype(np.float32))
+        eps = paddle.to_tensor(rng.rand(8, 1).astype(np.float32))
+
+        def penalty():
+            interp = eps * real + (1.0 - eps) * fake
+            interp.stop_gradient = False
+            d_out = D(interp)
+            (g,) = paddle.grad(
+                d_out.sum(), interp, create_graph=True)
+            gn = (g * g).sum(axis=1).sqrt()
+            return ((gn - 1.0) * (gn - 1.0)).mean()
+
+        gp0 = float(penalty().numpy())
+        for _ in range(15):
+            gp = penalty()
+            gp.backward()
+            opt.step()
+            opt.clear_grad()
+        gp1 = float(penalty().numpy())
+        assert np.isfinite(gp1)
+        assert gp1 < gp0, (gp0, gp1)
+
+    def test_differentiable_seed(self):
+        """A Tensor grad_outputs seed participates in the graph."""
+        x = self._leaf([1.0, 2.0])
+        s = self._leaf([3.0, 5.0])
+        y = x * x                               # non-scalar output
+        (g,) = paddle.grad(y, x, grad_outputs=[s], create_graph=True)
+        np.testing.assert_allclose(g.numpy(), 2 * x.numpy() * s.numpy(),
+                                   rtol=1e-6)
+        (ds,) = paddle.grad(g.sum(), s)         # d/ds(2 x s) = 2x
+        np.testing.assert_allclose(ds.numpy(), 2 * x.numpy(), rtol=1e-6)
+
+    def test_unused_input_and_errors(self):
+        x = self._leaf([1.0])
+        z = self._leaf([1.0])
+        y = (x * x).sum()
+        with pytest.raises(RuntimeError, match="no gradient"):
+            paddle.grad(y, [x, z], create_graph=True)
+        gx, gz = paddle.grad(y, [x, z], create_graph=True,
+                             allow_unused=True)
+        assert gz is None
+        np.testing.assert_allclose(gx.numpy(), [2.0], rtol=1e-6)
+
+    def test_inplace_raises_clear_error(self):
+        x = self._leaf([1.0, 2.0])
+        y = x * 2.0
+        y.add_(paddle.to_tensor(np.ones(2, np.float32)))
+        with pytest.raises(RuntimeError, match="in-place"):
+            paddle.grad(y.sum(), x, create_graph=True)
+
+    def test_pylayer_raises_clear_error(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, v):
+                return v * 2
+
+            @staticmethod
+            def backward(ctx, dy):
+                return dy * 2
+
+        x = self._leaf([1.0])
+        y = Double.apply(x)
+        with pytest.raises(RuntimeError, match="PyLayer"):
+            paddle.grad(y.sum(), x, create_graph=True)
+
+    def test_first_order_path_unchanged(self):
+        """create_graph=False keeps the capture-based fast path:
+        .grad untouched, graph freed by default."""
+        x = self._leaf([3.0])
+        y = (x * x).sum()
+        (g,) = paddle.grad(y, x)
+        np.testing.assert_allclose(g.numpy(), [6.0], rtol=1e-6)
+        assert x.grad is None
+
+    def test_freed_graph_clear_error(self):
+        x = self._leaf([2.0])
+        y = (x * x).sum()
+        y.backward()                      # frees the trunk
+        with pytest.raises(RuntimeError, match="retain_graph"):
+            paddle.grad(y, x, create_graph=True, allow_unused=True)
+
+    def test_grad_outputs_length_mismatch(self):
+        x = self._leaf([1.0])
+        a, b = x * 2, x * 3
+        with pytest.raises(ValueError, match="grad_outputs"):
+            paddle.grad([a, b], x,
+                        grad_outputs=[paddle.to_tensor(
+                            np.ones(1, np.float32))],
+                        create_graph=True)

@@ -332,3 +332,43 @@ def test_global_scatter_count_mismatch_raises():
     x = paddle.to_tensor(np.zeros((3, 2), np.float32))
     with pytest.raises(ValueError):
         comm.global_scatter(x, [1, 1], [1, 1])  # sum != rows
+
+
+class TestBulkSizeGuard:
+    """VERDICT r4 next #9: configurable size guard on the store
+    transport — warn once per op / raise / off."""
+
+    def test_warn_once_per_op(self, monkeypatch):
+        import warnings
+        monkeypatch.setenv("PT_EAGER_COLLECTIVE_WARN_MB", "0.001")
+        monkeypatch.setattr(comm, "_BULK_WARNED_OPS", set())
+        big = np.zeros(4096, np.float32)          # 16 KB > 1 KB
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            comm._warn_if_bulk(big, "allgather")
+            comm._warn_if_bulk(big, "allgather")   # same op: no re-warn
+            comm._warn_if_bulk(big, "scatter")     # new op: warns
+        msgs = [x for x in w if "TCP store" in str(x.message)]
+        assert len(msgs) == 2
+        assert "jit/shard_map" in str(msgs[0].message)
+
+    def test_error_mode_raises(self, monkeypatch):
+        monkeypatch.setenv("PT_EAGER_COLLECTIVE_GUARD", "error")
+        monkeypatch.setenv("PT_EAGER_COLLECTIVE_WARN_MB", "0.001")
+        with pytest.raises(RuntimeError, match="TCP store"):
+            comm._warn_if_bulk(np.zeros(4096, np.float32), "alltoall")
+
+    def test_off_and_threshold(self, monkeypatch):
+        import warnings
+        monkeypatch.setattr(comm, "_BULK_WARNED_OPS", set())
+        monkeypatch.setenv("PT_EAGER_COLLECTIVE_GUARD", "off")
+        monkeypatch.setenv("PT_EAGER_COLLECTIVE_WARN_MB", "0.001")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            comm._warn_if_bulk(np.zeros(4096, np.float32), "gather")
+        assert not [x for x in w if "TCP store" in str(x.message)]
+        monkeypatch.setenv("PT_EAGER_COLLECTIVE_GUARD", "warn")
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            comm._warn_if_bulk(np.zeros(8, np.float32), "gather")  # tiny
+        assert not [x for x in w if "TCP store" in str(x.message)]
