@@ -1,0 +1,76 @@
+"""Splash-vs-jax_flash A/B on the headline shape, standalone (r5 #6).
+
+Window 1's in-child attempt OOM'd at runtime (b8 passed the 15.2 GB AOT
+precheck but splash-bwd's true footprint exceeded it, after three other
+stages had fragmented HBM). This fresh-process retry A/Bs the equal-heads
+sdpa route on the 0.95B headline config at batch 4 — half the
+activations, nothing else resident — so a repeat OOM is bounded and
+cannot poison earlier stages.
+
+PROFILE_r03 motivation: the jax_flash route carries 20.5% of self-time
+plus a 5.7% HBM-bound `broadcast_in_dim` in its bwd; splash's
+block-sparse CausalMask skips fully-masked tiles. Records BOTH MFUs in
+BENCH_TPU_MEASURED_r05.json under "splash_ab_b4" and the winner name —
+the production route choice stays data-driven (flash_attention.py keeps
+jax_flash for equal heads unless this shows splash ahead).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from _bench_common import configure_jax, headline_big_config, merge_artifact
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "BENCH_TPU_MEASURED_r05.json")
+
+
+def main():
+    jax = configure_jax()
+    on_tpu = jax.devices()[0].platform != "cpu"
+    chip = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower() \
+        if on_tpu else "cpu"
+
+    import bench
+
+    peak = bench.PEAK_FLOPS.get(chip, 1e12)
+    batch = 4 if on_tpu else 2
+    seq = 2048 if on_tpu else 64
+    steps = 8 if on_tpu else 2
+
+    def cfg():
+        if on_tpu:
+            return headline_big_config("full")
+        # CPU smoke: machinery only (route env var, merge path)
+        from paddle_tpu.models.llama import llama_tiny_config
+        return llama_tiny_config(tensor_parallel=False)
+
+    result = {"batch": batch, "seq": seq, "remat": "full"}
+    for route in ("jax_flash", "splash"):
+        os.environ["PT_SDPA_PREFER"] = route
+        try:
+            r = bench._bench_train(
+                cfg(), batch=batch, seq=seq,
+                steps=steps, warmup=2, peak=peak, multi_precision=False,
+                hbm_limit=15.2e9 if on_tpu else None)
+            result[route] = {"mfu": r["mfu"],
+                             "tokens_per_sec": r["tokens_per_sec"],
+                             "step_ms": r["step_ms"]}
+        except Exception as e:
+            result[route] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        finally:
+            os.environ.pop("PT_SDPA_PREFER", None)
+        print("SPLASH_AB " + json.dumps({route: result[route]}),
+              flush=True)
+        # merge after EVERY route: a wedge on the second route keeps
+        # the first
+        merge_artifact(OUT, "splash_ab_b4", dict(result), chip)
+    a, b = result.get("jax_flash", {}), result.get("splash", {})
+    if "mfu" in a and "mfu" in b:
+        result["winner"] = "splash" if b["mfu"] > a["mfu"] else "jax_flash"
+        merge_artifact(OUT, "splash_ab_b4", dict(result), chip)
+    print("SPLASH_AB " + json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,65 @@
+#!/bin/bash
+# Second-window measurement session (r5). Assumes tpu_session.sh's bench
+# stage already banked the headline (BENCH_TPU_MEASURED_r05.json,
+# 0.95B @ 52.18% MFU) in window 1 before the tunnel's compile service
+# degraded (all three workload children sat idle-waiting on compile
+# RPCs after bench's two runtime RESOURCE_EXHAUSTED stages — the r02
+# wedge signature). Probe ONCE before running:
+#   timeout -s INT -k 30 90 python -c "import jax; print(jax.devices())" || exit 1
+# Risk-ordered: cheap/known-fast compiles first, the runtime-OOM-risk
+# splash A/B dead last, every stage merge-incremental + stderr kept.
+set -x
+cd "$(dirname "$0")"
+touch .watch_stop
+mkdir -p /tmp/w2
+
+# 1. decode sweep (VERDICT #5): 0.27B Llama decode — config_small's
+#    compile family, proven fast in window 1; replaces the decode
+#    stage the bench child lost to RESOURCE_EXHAUSTED.
+timeout -s INT -k 30 1000 python sweep_decode.py \
+    > /tmp/w2/decode.log 2>&1
+tail -3 /tmp/w2/decode.log
+
+# 2. MoE breakdown + dispatch A/B (VERDICT #4): pure-jnp/pallas block
+#    shapes (no full-model compile); EP's first on-chip evidence.
+timeout -s INT -k 30 1000 python moe_breakdown.py \
+    > /tmp/w2/moe.log 2>&1
+tail -3 /tmp/w2/moe.log
+
+# 3. workloads (VERDICT #3), ERNIE first, windows sized to the slow
+#    compile observed in window 1 (600s was not enough; stderr kept so
+#    a SIGINT traceback shows WHERE a timed-out child was stuck).
+for spec in ernie_moe:1500 bert_base:1000 resnet50:1500 sdxl_unet:1500; do
+    w=${spec%%:*}; budget=${spec##*:}
+    timeout -s INT -k 30 "$budget" python bench_workloads.py "$w" \
+        > "/tmp/w2/$w.log" 2>&1
+    line=$(grep '^WORKLOAD ' "/tmp/w2/$w.log" | tail -1 | sed 's/^WORKLOAD //')
+    [ -z "$line" ] && line="{\"workload\": \"$w\", \"error\": \"no output (timeout/crash); see /tmp/w2/$w.log\"}"
+    python - "$w" "$line" <<'EOF'
+import json, os, sys
+out = "WORKLOADS_r05.json"
+d = json.load(open(out)) if os.path.exists(out) else {
+    "artifact": "WORKLOADS_r05", "chip": "v5e"}
+d[sys.argv[1]] = json.loads(sys.argv[2])
+json.dump(d, open(out, "w"), indent=1)
+EOF
+    echo "done $w: $line"
+done
+
+# 4. profile re-capture after the run_steps lever (VERDICT #2 tail)
+timeout -s INT -k 30 700 python profile_tpu.py > /tmp/w2/profile.log 2>&1
+tail -3 /tmp/w2/profile.log
+
+# 5. on-chip kernel validation tests
+PT_TPU_TESTS=1 timeout -s INT -k 30 560 python -m pytest \
+    tests/test_pallas_tpu.py -q > /tmp/w2/tputests.log 2>&1
+tail -5 /tmp/w2/tputests.log
+
+# 6. splash A/B retry, LAST + reduced batch: window 1's b8 attempt
+#    passed the 15.2 GB AOT precheck but RESOURCE_EXHAUSTED at runtime
+#    (splash bwd's true footprint exceeds the estimate) — b4 halves
+#    activations; a repeat OOM can only cost this final stage.
+timeout -s INT -k 30 900 python splash_ab.py > /tmp/w2/splash.log 2>&1
+tail -3 /tmp/w2/splash.log
+
+touch .session2_done
