@@ -68,6 +68,17 @@ def _precheck(compiled, limit=HBM_LIMIT):
             f"{limit / 1e9:.2f} GB; skipping execution")
 
 
+class _NoScan:
+    """Hides run_steps so _time_step's scan path (one extra XLA
+    program) is skipped for TINY families with full_machinery=False."""
+
+    def __init__(self, step):
+        self._step = step
+
+    def __call__(self, batch_t):
+        return self._step(batch_t)
+
+
 def _time_step(step, batch_t, steps, warmup):
     import paddle_tpu  # noqa: F401  (ensures backend is up)
     for _ in range(warmup):
@@ -102,8 +113,15 @@ def _sync(out):
         return -1.0
 
 
-def _train_common(model, loss_fn, batch_t, steps, warmup, analytic_flops):
-    """Shared train-step measurement: AOT flops + precheck, then timing."""
+def _train_common(model, loss_fn, batch_t, steps, warmup, analytic_flops,
+                  full_machinery=True):
+    """Shared train-step measurement: AOT flops + precheck, then timing.
+
+    ``full_machinery=False`` (TINY smoke only) skips the AOT
+    cost-analysis compile and the run_steps scan compile — each TINY
+    family otherwise pays 3 XLA programs for machinery that one family
+    (ernie_moe keeps full_machinery=True) already covers; on chip every
+    family always runs the full path."""
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
     from paddle_tpu.jit import TrainStep
@@ -112,8 +130,12 @@ def _train_common(model, loss_fn, batch_t, steps, warmup, analytic_flops):
                           parameters=model.parameters(),
                           multi_precision=False)
     step = TrainStep(model, loss_fn, opt)
-    xla_flops, compiled = _compiled_flops(step, batch_t)
-    _precheck(compiled)
+    if full_machinery or not TINY:
+        xla_flops, compiled = _compiled_flops(step, batch_t)
+        _precheck(compiled)
+    else:
+        xla_flops, compiled = -1.0, None
+        step = _NoScan(step)
     step_s, final = _time_step(step, batch_t, steps, warmup)
     flops = xla_flops if xla_flops > 0 else analytic_flops
     return {
@@ -158,7 +180,8 @@ def resnet50():
     r = _train_common(model, loss_fn, (img, label),
                       steps=2 if TINY else 10, warmup=1 if TINY else 3,
                       # analytic: ~4.1 GFLOP fwd per 224x224 img, x3 bwd
-                      analytic_flops=batch * 4.1e9 * 3)
+                      analytic_flops=batch * 4.1e9 * 3,
+                      full_machinery=not TINY)
     return {"workload": ("resnet18_train_tiny_smoke" if TINY
                          else "resnet50_train"), "images_per_sec":
             round(batch / (r["step_ms"] / 1000), 1), "batch": batch,
@@ -197,7 +220,8 @@ def bert_base():
     params = sum(int(np.prod(p.shape)) for p in model.parameters())
     r = _train_common(model, loss_fn, batch_t,
                       steps=2 if TINY else 10, warmup=1 if TINY else 3,
-                      analytic_flops=6 * params * batch * seq)
+                      analytic_flops=6 * params * batch * seq,
+                      full_machinery=not TINY)
     tok_s = batch * seq / (r["step_ms"] / 1000)
     return {"workload": "bert_base_pretrain", "tokens_per_sec":
             round(tok_s, 1), "batch": batch, "seq": seq, **r}
@@ -340,7 +364,7 @@ def sdxl_unet():
     batch_t = (lat, t2, ctx2, noise)
     r = _train_common(unet2, loss_fn, batch_t,
                       steps=2 if TINY else 8, warmup=1 if TINY else 2,
-                      analytic_flops=-1)
+                      analytic_flops=-1, full_machinery=not TINY)
     out.update({"train_" + k: v for k, v in r.items()})
     out["train_batch"] = tb
     out["train_latent"] = tlat

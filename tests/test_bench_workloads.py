@@ -19,7 +19,11 @@ def test_workload_tiny_all():
     each paid a ~10s cold jax import for no isolation benefit on CPU
     (chip sessions keep per-point isolation via workloads_session.sh)."""
     env = dict(os.environ, PT_WORKLOADS_TINY="1", JAX_PLATFORMS="cpu")
-    env.pop("XLA_FLAGS", None)  # single fake device is enough
+    # single fake device is enough, but KEEP the fast-compile flags —
+    # dropping them made every tiny XLA compile pay the full LLVM
+    # pipeline (this test was 160s of the cold suite)
+    env["XLA_FLAGS"] = ("--xla_llvm_disable_expensive_passes=true"
+                        " --xla_backend_optimization_level=0")
     p = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench_workloads.py"), *NAMES],
         capture_output=True, text=True, timeout=900, env=env, cwd=ROOT)
