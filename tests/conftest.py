@@ -33,6 +33,12 @@ if not _ON_TPU and "xla_llvm_disable_expensive_passes" not in flags:
               " --xla_backend_optimization_level=0")
 os.environ["XLA_FLAGS"] = flags.strip()
 
+# transformers (the HF parity oracles) probes TensorFlow on import —
+# ~11s of the suite for a framework no test uses. USE_TF=0 makes it
+# torch-only before any test file triggers the import.
+os.environ.setdefault("USE_TF", "0")
+os.environ.setdefault("TRANSFORMERS_NO_ADVISORY_WARNINGS", "1")
+
 import jax
 
 if not _ON_TPU:
