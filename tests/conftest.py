@@ -67,3 +67,54 @@ def _seed():
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-process / long-wall tests")
+
+
+# ---------------------------------------------------------------------------
+# Quick/full lanes (VERDICT r4 #7). The suite is XLA-CPU-compile-bound
+# (~10s per distinct conv/transformer graph on the 1-core host; measured
+# r5: fuzz files are cheap, model-compile parity tests are the cost). The
+# default lane deselects — NOT skips — the tests in tests/full_lane.txt:
+# the most compile-expensive parity/oracle tests whose capability is
+# also exercised by cheaper tests or by the on-chip session tools.
+# PT_FULL=1 runs everything (the weekly/full lane; kept green — it is
+# the lane CHANGELOG_r5 reports). Deselection is announced in the
+# header so a lower test count is never mistaken for lost coverage.
+# ---------------------------------------------------------------------------
+def _full_lane_prefixes():
+    path = os.path.join(os.path.dirname(__file__), "full_lane.txt")
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#"):
+                    out.append(line.split()[0])
+    except OSError:
+        pass
+    return out
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("PT_FULL") == "1":
+        return
+    prefixes = _full_lane_prefixes()
+    if not prefixes:
+        return
+    kept, deselected = [], []
+    for it in items:
+        nodeid = it.nodeid.replace(os.sep, "/")
+        if any(nodeid.startswith(p) for p in prefixes):
+            deselected.append(it)
+        else:
+            kept.append(it)
+    if deselected:
+        config.hook.pytest_deselected(items=deselected)
+        items[:] = kept
+
+
+def pytest_report_header(config):
+    if os.environ.get("PT_FULL") == "1":
+        return ["lane: FULL (every test; weekly lane)"]
+    n = len(_full_lane_prefixes())
+    return [f"lane: quick — tests/full_lane.txt lists {n} "
+            "compile-heavy groups deselected here; PT_FULL=1 runs all"]
