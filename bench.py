@@ -286,6 +286,22 @@ def _child_tpu():
                 big["remat"] = gran
                 break
         _emit(small, big, None, errors)
+        # r5 window-1 lesson: stages leak HBM into their successors —
+        # big-splash and decode both hit runtime RESOURCE_EXHAUSTED with
+        # three stages' buffers resident, and the OOM crashes degraded
+        # the tunnel's compile service for every child after (the r02
+        # wedge signature, re-observed). Free executables + their held
+        # buffers between the remaining stages.
+        import gc
+
+        def _release_hbm():
+            gc.collect()
+            try:
+                jax.clear_caches()   # compiled programs pin donated bufs
+            except Exception:
+                pass
+            gc.collect()
+        _release_hbm()
         # upside experiment: selective remat executes ~16% fewer FLOPs
         # per step (CPU AOT: 6.80e12 vs 8.09e12) = higher MFU at equal
         # step time, but holds more live activations — b8 estimates
@@ -303,18 +319,25 @@ def _child_tpu():
                 sel["remat"] = "selective"
                 big = sel
         _emit(small, big, None, errors)
+        # r5 window-1 lesson: stages leak HBM into their successors —
+        # big-splash and decode both hit runtime RESOURCE_EXHAUSTED with
+        # three stages' buffers resident, and the OOM crashes degraded
+        # the tunnel's compile service for every child after (the r02
         # sdpa kernel A/B on the headline shape: PROFILE_r03 charges the
         # equal-heads jax_flash route 20.5% of self-time plus a 5.7%
         # HBM-bound broadcast_in_dim in its bwd; splash (block-sparse
         # CausalMask, skips fully-masked tiles) may beat it — measure,
         # keep the winner, and record both so the choice is on-artifact
         if big is not None:
+            _release_hbm()
             os.environ["PT_SDPA_PREFER"] = "splash"
             try:
-                # same AOT memory precheck as the winning stage: splash's
-                # bwd footprint differs and an un-prechecked OOM crash
-                # can wedge the tunnel (the r02 failure mode)
-                lim = 15.2e9 if big["batch"] > 2 else None
+                # 14.5 GB, tighter than the 15.2 run limit: splash-bwd's
+                # true footprint EXCEEDS the AOT estimate (r5 window-1:
+                # est <=15.2 passed, runtime RESOURCE_EXHAUSTED — and an
+                # on-chip OOM crash can wedge the tunnel, r02 mode), so
+                # an underestimated config must be refused, not risked.
+                lim = 14.5e9 if big["batch"] > 2 else None
                 sp, err = _staged(lambda: _bench_train(
                     big_cfg(big.get("remat", "full")), batch=big["batch"],
                     seq=2048, steps=8, warmup=2, peak=peak,
@@ -335,6 +358,7 @@ def _child_tpu():
         # decode runs LAST: it is the least informative stage for the
         # MFU contract, and r3 showed it can eat the deadline window
         # the ~1B headline config needed
+        _release_hbm()
         decode, err = _staged(lambda: _bench_decode(
             cfg_small, batch=8, prompt=128, new_tokens=128), "decode")
         if err:
