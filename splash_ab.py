@@ -45,8 +45,17 @@ def main():
         from paddle_tpu.models.llama import llama_tiny_config
         return llama_tiny_config(tensor_parallel=False)
 
+    import gc
     result = {"batch": batch, "seq": seq, "remat": "full"}
     for route in ("jax_flash", "splash"):
+        # clean HBM slate per route (r5 window-1: resident buffers from
+        # a prior stage turned a fitting config into a runtime OOM)
+        gc.collect()
+        try:
+            jax.clear_caches()
+        except Exception:
+            pass
+        gc.collect()
         os.environ["PT_SDPA_PREFER"] = route
         try:
             r = bench._bench_train(
