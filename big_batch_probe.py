@@ -1,0 +1,72 @@
+"""Does the 0.95B headline config fit and win at batch 16? (r5)
+
+bench.py's ladder tries full-remat b8 first and stops on success, so
+b16 — potentially higher MFU from larger per-dispatch matmuls — has
+never been attempted. This standalone probe AOT-prechecks b16 (and
+b12 as fallback) against the 15.2 GB v5e budget and runs whichever
+fits; a refused config costs one compile, never an OOM (the r5
+window-1 wedge lesson). If a larger batch beats b8's 52.18% MFU, flip
+bench.py's ladder to try it first next round.
+
+Merged into BENCH_TPU_MEASURED_r05.json under "big_batch_probe".
+"""
+from __future__ import annotations
+
+import gc
+import json
+import os
+
+from _bench_common import configure_jax, headline_big_config, merge_artifact
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "BENCH_TPU_MEASURED_r05.json")
+
+
+def main():
+    jax = configure_jax()
+    on_tpu = jax.devices()[0].platform != "cpu"
+    chip = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower() \
+        if on_tpu else "cpu"
+
+    import bench
+
+    peak = bench.PEAK_FLOPS.get(chip, 1e12)
+    result = {}
+    batches = (16, 12) if on_tpu else (2,)
+    seq = 2048 if on_tpu else 64
+
+    def cfg():
+        if on_tpu:
+            return headline_big_config("full")
+        from paddle_tpu.models.llama import llama_tiny_config
+        return llama_tiny_config(tensor_parallel=False)
+
+    for b in batches:
+        gc.collect()
+        try:
+            jax.clear_caches()
+        except Exception:
+            pass
+        gc.collect()
+        try:
+            r = bench._bench_train(
+                cfg(), batch=b, seq=seq, steps=8, warmup=2, peak=peak,
+                multi_precision=False,
+                hbm_limit=15.2e9 if on_tpu else None)
+            result[f"b{b}"] = {"mfu": r["mfu"],
+                               "tokens_per_sec": r["tokens_per_sec"],
+                               "step_ms": r["step_ms"]}
+            print("BIG_BATCH " + json.dumps({f"b{b}": result[f"b{b}"]}),
+                  flush=True)
+            merge_artifact(OUT, "big_batch_probe", dict(result), chip)
+            break        # largest fitting batch answers the question
+        except Exception as e:
+            result[f"b{b}"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            print("BIG_BATCH " + json.dumps({f"b{b}": result[f"b{b}"]}),
+                  flush=True)
+            merge_artifact(OUT, "big_batch_probe", dict(result), chip)
+    print("BIG_BATCH " + json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

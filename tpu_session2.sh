@@ -55,7 +55,12 @@ PT_TPU_TESTS=1 timeout -s INT -k 30 560 python -m pytest \
     tests/test_pallas_tpu.py -q > /tmp/w2/tputests.log 2>&1
 tail -5 /tmp/w2/tputests.log
 
-# 6. splash A/B retry, LAST + reduced batch: window 1's b8 attempt
+# 6. big-batch probe: does full-remat b16 (or b12) fit and beat b8's
+#    52.18% MFU? Precheck-guarded; a refusal costs one compile.
+timeout -s INT -k 30 900 python big_batch_probe.py > /tmp/w2/bigbatch.log 2>&1
+tail -3 /tmp/w2/bigbatch.log
+
+# 7. splash A/B retry, LAST + reduced batch: window 1's b8 attempt
 #    passed the 15.2 GB AOT precheck but RESOURCE_EXHAUSTED at runtime
 #    (splash bwd's true footprint exceeds the estimate) — b4 halves
 #    activations; a repeat OOM can only cost this final stage.
