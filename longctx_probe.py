@@ -1,0 +1,98 @@
+"""Long-context single-chip training points (r5).
+
+The long-context story (SURVEY §2.3 SP/CP rows) is validated
+functionally by the ring/ulysses dryruns and tests, but no on-chip
+number exists for long sequences on ONE chip. This probe measures the
+0.27B-class Llama at long seq, full-causal vs sliding-window attention
+(the splash block-sparse route skips fully-masked tiles, so the window
+points also quantify the splash win at depth):
+
+    A  seq 8192,  full causal,     b4   (32k tokens/step)
+    B  seq 8192,  window 1024,     b4
+    C  seq 16384, window 1024,     b2   (the depth point)
+
+Every point is AOT-prechecked against the 15.2 GB budget (a refusal
+costs one compile — the r5 window-1 OOM-wedge lesson) and HBM is
+released between points. Merged into BENCH_TPU_MEASURED_r05.json under
+"longctx"; one merge per point so a mid-run wedge keeps earlier points.
+"""
+from __future__ import annotations
+
+import gc
+import json
+import os
+
+from _bench_common import configure_jax, merge_artifact
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "BENCH_TPU_MEASURED_r05.json")
+
+
+def main():
+    jax = configure_jax()
+    on_tpu = jax.devices()[0].platform != "cpu"
+    chip = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower() \
+        if on_tpu else "cpu"
+
+    import bench
+    from paddle_tpu.models.llama import LlamaConfig, llama_tiny_config
+
+    peak = bench.PEAK_FLOPS.get(chip, 1e12)
+
+    def cfg(seq, window):
+        if not on_tpu:
+            return llama_tiny_config(tensor_parallel=False,
+                                     max_position_embeddings=seq,
+                                     sliding_window=window)
+        return LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=seq,
+            tensor_parallel=False, recompute=True,
+            recompute_granularity="full", scan_layers=True,
+            dtype="bfloat16", sliding_window=window)
+
+    if on_tpu:
+        points = [("s8192_causal", 8192, None, 4),
+                  ("s8192_w1024", 8192, 1024, 4),
+                  ("s16384_w1024", 16384, 1024, 2)]
+    else:
+        points = [("smoke_s128_w32", 128, 32, 2)]
+
+    result = {}
+    for name, seq, window, batch in points:
+        gc.collect()
+        try:
+            jax.clear_caches()
+        except Exception:
+            pass
+        gc.collect()
+        try:
+            r = bench._bench_train(
+                cfg(seq, window), batch=batch, seq=seq, steps=4,
+                warmup=1, peak=peak, multi_precision=False,
+                hbm_limit=15.2e9 if on_tpu else None)
+            result[name] = {
+                "tokens_per_sec": r["tokens_per_sec"], "mfu": r["mfu"],
+                "step_ms": r["step_ms"], "batch": batch, "seq": seq,
+                "window": window}
+            if window is not None and on_tpu:
+                # flops_per_token charges FULL causal attention; a
+                # windowed step executes ~12*L*h*window instead — the
+                # honest utilization divides by work actually done
+                c = cfg(seq, window)
+                attn_full = 12 * c.num_hidden_layers * c.hidden_size * seq
+                attn_win = 12 * c.num_hidden_layers * c.hidden_size \
+                    * min(seq, window)
+                f_full = peak * r["mfu"] / r["tokens_per_sec"]
+                f_win = f_full - attn_full + attn_win
+                result[name]["mfu_windowed_work"] = round(
+                    r["tokens_per_sec"] * f_win / peak, 4)
+        except Exception as e:
+            result[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print("LONGCTX " + json.dumps({name: result[name]}), flush=True)
+        merge_artifact(OUT, "longctx", dict(result), chip)
+
+
+if __name__ == "__main__":
+    main()

@@ -67,4 +67,9 @@ tail -3 /tmp/w2/bigbatch.log
 timeout -s INT -k 30 900 python splash_ab.py > /tmp/w2/splash.log 2>&1
 tail -3 /tmp/w2/splash.log
 
+# 8. long-context single-chip points: seq 8192 causal vs window-1024,
+#    seq 16384 windowed (precheck-guarded, merge-per-point).
+timeout -s INT -k 30 1200 python longctx_probe.py > /tmp/w2/longctx.log 2>&1
+tail -3 /tmp/w2/longctx.log
+
 touch .session2_done
