@@ -1,0 +1,47 @@
+"""CPU smoke for the r5 chip-session probe tools (splash_ab,
+big_batch_probe, longctx_probe) — same contract as
+test_bench_workloads: a chip session must never spend its window
+discovering an API break in tool code. Full/weekly lane only (listed
+in full_lane.txt): three subprocess jax startups are too heavy for the
+quick lane, and the tools are also smoked at the top of every chip
+session."""
+import json
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOOLS = {
+    "splash_ab.py": "SPLASH_AB ",
+    "big_batch_probe.py": "BIG_BATCH ",
+    "longctx_probe.py": "LONGCTX ",
+}
+
+
+def _run(tool):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # no axon register() dial
+    env["XLA_FLAGS"] = ("--xla_llvm_disable_expensive_passes=true"
+                        " --xla_backend_optimization_level=0")
+    p = subprocess.run([sys.executable, os.path.join(ROOT, tool)],
+                       capture_output=True, text=True, timeout=600,
+                       env=env, cwd=ROOT)
+    assert p.returncode == 0, (tool, p.stdout[-1500:], p.stderr[-1500:])
+    return p.stdout
+
+
+def test_probe_tools_smoke():
+    for tool, tag in TOOLS.items():
+        out = _run(tool)
+        lines = [l for l in out.splitlines() if l.startswith(tag)]
+        assert lines, (tool, out[-1500:])
+        last = json.loads(lines[-1][len(tag):])
+        flat = json.dumps(last)
+        assert "tokens_per_sec" in flat, (tool, last)
+        # CPU runs must never masquerade as chip data: the v5e artifact
+        # merge is provenance-refused into a side file
+        side = os.path.join(ROOT,
+                            "BENCH_TPU_MEASURED_r05.json.cpu-smoke.json")
+        if os.path.exists(side):
+            os.remove(side)
