@@ -20,6 +20,26 @@ timeout -s INT -k 30 1000 python sweep_decode.py \
     > /tmp/w2/decode.log 2>&1
 tail -3 /tmp/w2/decode.log
 
+# Dead-tunnel fast abort: stage 1's tool merges "decode_sweep" into the
+# artifact within its first minutes when healthy. If after the full
+# stage window the key is still absent, every later stage would burn
+# its timeout against the same wedge (window-1 pattern: three children
+# idle-waiting 600s each) — return to quiet instead. env-stripped
+# python: the check itself must not dial axon.register().
+if ! env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python3 - <<'EOF'
+import json, sys
+try:
+    d = json.load(open("BENCH_TPU_MEASURED_r05.json"))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if "decode_sweep" in d else 1)
+EOF
+then
+    echo "SESSION2 ABORT: decode stage produced no merge - tunnel dead"
+    touch .session2_aborted
+    exit 1
+fi
+
 # 2. MoE breakdown + dispatch A/B (VERDICT #4): pure-jnp/pallas block
 #    shapes (no full-model compile); EP's first on-chip evidence.
 timeout -s INT -k 30 1000 python moe_breakdown.py \
