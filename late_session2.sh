@@ -1,0 +1,49 @@
+#!/bin/bash
+# Trimmed session-2 for a LATE healthy window (<90 min before the
+# quiet cutoff). VERDICT-priority order, hard stop enforced:
+#   moe A/B (EP: zero on-chip evidence) -> ernie_moe workload ->
+#   decode sweep -> bert_base -> resnet50 (as fit).
+# Usage: bash late_session2.sh <hard_stop_epoch_seconds>
+set -x
+cd "$(dirname "$0")"
+HARD_STOP=${1:?usage: late_session2.sh <hard_stop_epoch>}
+touch .watch_stop
+mkdir -p /tmp/w2
+
+left() { echo $(( HARD_STOP - $(date +%s) )); }
+budget() { local want=$1 l=$(left); echo $(( l - 90 < want ? l - 90 : want )); }
+
+run_stage() { # name want_seconds cmd...
+    local name=$1 want=$2; shift 2
+    local b=$(budget "$want")
+    [ "$b" -lt 240 ] && { echo "skip $name: $(left)s left"; return 1; }
+    timeout -s INT -k 30 "$b" "$@" > "/tmp/w2/$name.log" 2>&1
+    tail -2 "/tmp/w2/$name.log"
+}
+
+run_stage moe 900 python moe_breakdown.py
+run_stage ernie 1200 python bench_workloads.py ernie_moe
+line=$(grep '^WORKLOAD ' /tmp/w2/ernie.log 2>/dev/null | tail -1 | sed 's/^WORKLOAD //')
+if [ -n "$line" ]; then
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python3 - "$line" <<'EOF'
+import json, sys
+out = "WORKLOADS_r05.json"
+d = json.load(open(out))
+d["ernie_moe"] = json.loads(sys.argv[1])
+json.dump(d, open(out, "w"), indent=1)
+EOF
+fi
+run_stage decode 900 python sweep_decode.py
+for w in bert_base resnet50 sdxl_unet; do
+    run_stage "$w" 900 python bench_workloads.py "$w" || break
+    line=$(grep '^WORKLOAD ' "/tmp/w2/$w.log" 2>/dev/null | tail -1 | sed 's/^WORKLOAD //')
+    [ -n "$line" ] && env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python3 - "$w" "$line" <<'EOF'
+import json, sys
+out = "WORKLOADS_r05.json"
+d = json.load(open(out))
+d[sys.argv[1]] = json.loads(sys.argv[2])
+json.dump(d, open(out, "w"), indent=1)
+EOF
+done
+echo "late_session2 done with $(left)s to hard stop"
