@@ -120,10 +120,12 @@ def main():
     for impl, disp, comb in (
             ("scatter", scatter_dispatch, None),
             ("gather_jnp", None, None),
-            ("gather_pallas", None, None)):
+            ("gather_pallas", None, None),
+            ("gather_pallas_mr", None, None)):
         if impl.startswith("gather"):
-            os.environ["PT_MOE_GATHER"] = impl.split("_")[1]
-            if impl == "gather_pallas" and not md._pallas_ok(d, dt):
+            os.environ["PT_MOE_GATHER"] = impl[len("gather_"):]
+            if impl.startswith("gather_pallas") \
+                    and not md._pallas_ok(d, dt):
                 stages[f"dispatch_{impl}_ms"] = None
                 continue
             stages[f"dispatch_{impl}_ms"] = round(
@@ -162,10 +164,10 @@ def main():
     e2e = {}
     params = (wg, w1, w2)
     for mode, impl in (("scatter", "jnp"), ("gather", "jnp"),
-                       ("gather", "pallas")):
+                       ("gather", "pallas"), ("gather", "pallas_mr")):
         name = mode if mode == "scatter" else f"gather_{impl}"
         os.environ["PT_MOE_GATHER"] = impl
-        if impl == "pallas" and not md._pallas_ok(d, dt):
+        if impl.startswith("pallas") and not md._pallas_ok(d, dt):
             e2e[name] = None
             continue
         g = functools.partial(jax.value_and_grad(block_loss), mode=mode)

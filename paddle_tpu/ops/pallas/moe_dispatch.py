@@ -135,10 +135,78 @@ def _gather_rows_pallas(x, idx):
     )(idx.astype(jnp.int32), x)
 
 
+def _gather_rows_pallas_mr(x, idx, rows_per_step: int = 8):
+    """Multi-row gather: R async row-DMAs per grid step (VERDICT r4
+    weak #3's tuning lever for the (1, d) kernel).
+
+    The (1, d) kernel leans on Mosaic double-buffering one row stream;
+    if the per-row DMA doesn't pipeline, grid-step overhead dominates.
+    Here each grid step issues R independent HBM->VMEM row copies
+    (per-slot DMA semaphores), waits once, then zeroes the invalid
+    rows — R× fewer grid steps and R DMAs in flight by construction.
+    ``PT_MOE_GATHER=pallas_mr`` selects it; ``PT_MOE_GATHER_ROWS``
+    tunes R. A/B'd against jnp + (1, d) pallas by moe_breakdown.py.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    t, d = x.shape
+    m = idx.shape[0]
+    r_step = max(1, rows_per_step)
+    m_pad = ((m + r_step - 1) // r_step) * r_step
+    idx_p = idx.astype(jnp.int32)
+    if m_pad != m:
+        idx_p = jnp.concatenate(
+            [idx_p, jnp.full((m_pad - m,), -1, jnp.int32)])
+
+    def kernel(idx_ref, x_ref, out_ref, sems):
+        step = pl.program_id(0)
+        for r in range(r_step):              # static unroll
+            row = idx_ref[step * r_step + r]
+            safe = jnp.clip(row, 0, t - 1)
+            pltpu.make_async_copy(
+                x_ref.at[pl.ds(safe, 1), :],
+                out_ref.at[pl.ds(r, 1), :],
+                sems.at[r],
+            ).start()
+        for r in range(r_step):
+            row = idx_ref[step * r_step + r]
+            safe = jnp.clip(row, 0, t - 1)
+            pltpu.make_async_copy(
+                x_ref.at[pl.ds(safe, 1), :],
+                out_ref.at[pl.ds(r, 1), :],
+                sems.at[r],
+            ).wait()
+        for r in range(r_step):
+            row = idx_ref[step * r_step + r]
+
+            @pl.when(~((row >= 0) & (row < t)))
+            def _zero(r=r):
+                out_ref[pl.ds(r, 1), :] = jnp.zeros((1, d), x.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(m_pad // r_step,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY)],
+        out_specs=pl.BlockSpec((r_step, d), lambda i, idx_ref: (i, 0)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((r_step,))],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((m_pad, d), x.dtype),
+        interpret=_FORCE_INTERPRET,
+    )(idx_p, x)
+    return out[:m] if m_pad != m else out
+
+
 def gather_rows(x, idx):
     """out[i] = x[idx[i]] for in-range idx, else zeros. (rows, d) gather."""
-    if _gather_impl() == "pallas" and _pallas_ok(x.shape[-1], x.dtype):
+    impl = _gather_impl()
+    if impl == "pallas" and _pallas_ok(x.shape[-1], x.dtype):
         return _gather_rows_pallas(x, idx)
+    if impl == "pallas_mr" and _pallas_ok(x.shape[-1], x.dtype):
+        return _gather_rows_pallas_mr(
+            x, idx, int(os.environ.get("PT_MOE_GATHER_ROWS", "8")))
     return _gather_rows_jnp(x, idx)
 
 

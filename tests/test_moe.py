@@ -308,6 +308,39 @@ class TestPallasGatherRows:
         assert float(np.abs(np.asarray(out)[3]).sum()) == 0.0
         assert float(np.abs(np.asarray(out)[5]).sum()) == 0.0
 
+    def test_interpret_multirow_matches_jnp(self):
+        """R-row async-DMA variant: parity incl. padding (m % R != 0)
+        and out-of-range rows inside a full step."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas import moe_dispatch as md
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(16, 128).astype(np.float32))
+        # m=11 with R=4 -> one padded tail step; oob rows mid-step
+        idx = jnp.asarray(np.array(
+            [0, 5, 15, 16, 3, 99, 7, 1, -2, 14, 2], np.int32))
+        ref = md._gather_rows_jnp(x, idx)
+        old = md._FORCE_INTERPRET
+        md._FORCE_INTERPRET = True
+        try:
+            out = md._gather_rows_pallas_mr(x, idx, rows_per_step=4)
+        finally:
+            md._FORCE_INTERPRET = old
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref))
+
+    def test_moe_end_to_end_pallas_mr_interpret(self, monkeypatch):
+        from paddle_tpu.ops.pallas import moe_dispatch as md
+        monkeypatch.setenv("PT_MOE_GATHER", "pallas_mr")
+        monkeypatch.setattr(md, "_FORCE_INTERPRET", True)
+        paddle.seed(23)
+        moe_p = MoELayer(d_model=128, num_expert=4, d_hidden=64,
+                         dispatch_mode="gather")
+        x = _x(b=1, s=8, d=128, seed=16)
+        out_p = moe_p(x).numpy()
+        monkeypatch.setenv("PT_MOE_GATHER", "jnp")
+        out_j = moe_p(x).numpy()
+        np.testing.assert_allclose(out_p, out_j, rtol=1e-5, atol=1e-6)
+
     def test_moe_end_to_end_pallas_interpret(self, monkeypatch):
         from paddle_tpu.ops.pallas import moe_dispatch as md
         monkeypatch.setenv("PT_MOE_GATHER", "pallas")
