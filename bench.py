@@ -614,6 +614,9 @@ def _main_measured(errors):
             # near-identical probe lines, the tail has the real failure
             result["tpu_errors"] = _err_slots(errors)
             result["last_measured_tpu"] = _last_measured_tpu()
+            # every probe/contact this round, timestamped, with outcomes
+            # — the wedge-is-environmental evidence chain (VERDICT r4 #1)
+            result["tunnel_log"] = "TUNNEL_r05.json"
         print(json.dumps(result))
         return
     # last resort: still one JSON line, rc 0, explicit marker
