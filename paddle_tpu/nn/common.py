@@ -18,7 +18,8 @@ from .layer import Layer
 
 __all__ = [
     "Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
-    "AlphaDropout", "Flatten", "Identity", "Upsample", "UpsamplingBilinear2D",
+    "AlphaDropout", "FeatureAlphaDropout", "Flatten", "Identity",
+    "Upsample", "UpsamplingBilinear2D",
     "UpsamplingNearest2D", "Pad1D", "Pad2D", "Pad3D", "PixelShuffle",
     "ChannelShuffle", "CosineSimilarity", "Sequential", "LayerList",
     "LayerDict", "ParameterList", "Unfold", "Bilinear",
@@ -121,6 +122,19 @@ class AlphaDropout(Layer):
 
     def forward(self, x):
         return F.alpha_dropout(x, self.p, self.training)
+
+
+class FeatureAlphaDropout(Layer):
+    """Channel-wise alpha dropout (reference: nn.FeatureAlphaDropout —
+    verify): whole channels are set to the SELU negative-saturation
+    value, then the affine correction preserves mean/variance."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, self.training)
 
 
 class Flatten(Layer):

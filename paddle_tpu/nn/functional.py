@@ -32,6 +32,7 @@ __all__ = [
     "avg_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_max_pool2d",
     "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "feature_alpha_dropout",
     "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
     "binary_cross_entropy_with_logits", "mse_loss", "l1_loss",
     "smooth_l1_loss", "nll_loss", "kl_div", "margin_ranking_loss",
@@ -830,6 +831,30 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
     def f(v):
         keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2))) \
+            if (1 - p) > 0 else 1.0
+        b = -a * alpha_p * p
+        return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
+    return apply_op(f, x)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout that drops ENTIRE channels (dim 1), keeping the
+    SELU self-normalizing statistics (reference:
+    nn.FeatureAlphaDropout — verify)."""
+    if not training or p == 0.0:
+        return x
+    key = framework.split_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(v):
+        if v.ndim < 2:
+            mask_shape = v.shape
+        else:
+            mask_shape = (v.shape[0], v.shape[1]) + (1,) * (v.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
         a = (1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2))) \
             if (1 - p) > 0 else 1.0
         b = -a * alpha_p * p

@@ -1208,6 +1208,19 @@ def gammaincc(x, y, name=None):
     return apply_op(jax.scipy.special.gammaincc, x, y)
 
 
+def igamma(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) — torch-parity alias
+    of ``gammainc`` (reference: paddle.igamma, paddle/tensor/math.py —
+    verify arg convention when the mount is populated)."""
+    return apply_op(jax.scipy.special.gammainc, x, y)
+
+
+def igammac(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y) — torch-parity alias
+    of ``gammaincc`` (reference: paddle.igammac — verify)."""
+    return apply_op(jax.scipy.special.gammaincc, x, y)
+
+
 def _householder_q_full(a, t):
     """Accumulate geqrf-convention reflectors into the FULL m×m Q."""
     m = a.shape[-2]
@@ -1238,7 +1251,8 @@ def svdvals(x, name=None):
     return apply_op(lambda v: jnp.linalg.svd(v, compute_uv=False), x)
 
 
-__all__ += ["gammaln", "gammainc", "gammaincc", "ormqr", "svdvals"]
+__all__ += ["gammaln", "gammainc", "gammaincc", "igamma", "igammac",
+            "ormqr", "svdvals"]
 
 def isin(x, test_x, assume_unique=False, invert=False, name=None):
     """Elementwise membership of ``x`` in ``test_x`` (reference:

@@ -264,6 +264,46 @@ class TestRound3LongTail:
             paddle.gammaincc(paddle.to_tensor(x),
                              paddle.to_tensor(y)).numpy(),
             sp.gammaincc(x, y), rtol=1e-5)
+        # igamma/igammac: torch-parity aliases (lower P / upper Q)
+        np.testing.assert_allclose(
+            paddle.igamma(paddle.to_tensor(x),
+                          paddle.to_tensor(y)).numpy(),
+            sp.gammainc(x, y), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.igammac(paddle.to_tensor(x),
+                           paddle.to_tensor(y)).numpy(),
+            sp.gammaincc(x, y), rtol=1e-5)
+
+    def test_feature_alpha_dropout(self):
+        paddle.seed(7)
+        m = paddle.nn.FeatureAlphaDropout(p=0.5)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(
+            4, 8, 6, 6).astype(np.float32))
+        y = m(x).numpy()
+        # channel-wise: within one (n, c) map, either all values moved
+        # by the same affine of the input or the whole map is the
+        # saturated constant — never a per-element mixture
+        alpha_p = -1.6732632423543772 * 1.0507009873554805
+        a = 1.0 / np.sqrt(0.5 * (1 + 0.5 * alpha_p ** 2))
+        b = -a * alpha_p * 0.5
+        sat = a * alpha_p + b
+        for n in range(4):
+            for c in range(8):
+                blk = y[n, c]
+                dropped = np.allclose(blk, sat, atol=1e-5)
+                kept = np.allclose(blk, a * x.numpy()[n, c] + b,
+                                   atol=1e-5)
+                assert dropped or kept, (n, c)
+        # eval mode: identity
+        m.eval()
+        np.testing.assert_allclose(m(x).numpy(), x.numpy())
+        # statistics approximately preserved on large input
+        paddle.seed(11)
+        big = paddle.to_tensor(np.random.RandomState(1).randn(
+            256, 128).astype(np.float32))
+        out = paddle.nn.functional.feature_alpha_dropout(
+            big, 0.3, training=True).numpy()
+        assert abs(out.mean()) < 0.1 and abs(out.std() - 1.0) < 0.15
 
     def test_block_diag_cartesian_prod(self):
         a = paddle.to_tensor(np.eye(2, dtype=np.float32))
