@@ -167,3 +167,114 @@ class Imikolov(Dataset):
 
     def __getitem__(self, i):
         return self.samples[i]
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 semantic-role labeling (reference:
+    python/paddle/text/datasets/conll05.py — verify exact dict files).
+
+    Parses the canonical release layout locally: the tarball's
+    ``.../words/*.words.gz`` (one token per line, blank line between
+    sentences) and ``.../props/*.props.gz`` (predicate lemma + one
+    bracketed-span column per predicate). Each (sentence, predicate)
+    pair yields the reference's 9-slot sample: the word sequence, the
+    five predicate context windows (each broadcast over the sentence),
+    the predicate id, the predicate mark, and IOB label ids.
+
+    The reference downloads pre-built word/verb/label dictionaries; on
+    this no-egress host the dicts are built from the parsed corpus
+    (deterministic: sorted by frequency then token)."""
+
+    def __init__(self, data_file=None, mode="test"):
+        path = _resolve(data_file, ["conll05st-tests.tar.gz",
+                                    "conll05st.tar.gz"], "Conll05st")
+        sents = self._parse(path)
+        words = sorted({w for ws, _, _ in sents for w in ws})
+        self.word_dict = {w: i for i, w in enumerate(words)}
+        self.word_dict.setdefault("<unk>", len(self.word_dict))
+        preds = sorted({p for _, p, _ in sents})
+        self.predicate_dict = {p: i for i, p in enumerate(preds)}
+        labels = sorted({l for _, _, ls in sents for l in ls})
+        self.label_dict = {l: i for i, l in enumerate(labels)}
+        unk = self.word_dict["<unk>"]
+        self.samples = []
+        for ws, pred, ls in sents:
+            n = len(ws)
+            p = next((i for i, l in enumerate(ls)
+                      if l in ("B-V", "I-V")), 0)
+            ids = np.asarray([self.word_dict.get(w, unk) for w in ws],
+                             np.int64)
+
+            def ctx(off):
+                j = min(max(p + off, 0), n - 1)
+                return np.full((n,), self.word_dict.get(ws[j], unk),
+                               np.int64)
+
+            mark = np.asarray([1 if l in ("B-V", "I-V") else 0
+                               for l in ls], np.int64)
+            lab = np.asarray([self.label_dict[l] for l in ls], np.int64)
+            self.samples.append((
+                ids, ctx(-2), ctx(-1), ctx(0), ctx(1), ctx(2),
+                np.full((n,), self.predicate_dict[pred], np.int64),
+                mark, lab))
+
+    @staticmethod
+    def _iob(col):
+        out, cur = [], None
+        for tag in col:
+            if tag.startswith("("):
+                cur = tag[1:].split("*")[0].split(")")[0]
+                out.append("B-" + cur)
+            elif cur is not None:
+                out.append("I-" + cur)
+            else:
+                out.append("O")
+            if tag.endswith(")"):
+                cur = None
+        return out
+
+    @classmethod
+    def _parse(cls, path):
+        def read_member(tf, suffix):
+            m = next((m for m in tf.getmembers()
+                      if m.name.endswith(suffix)), None)
+            if m is None:
+                raise FileNotFoundError(
+                    f"Conll05st: no member ending in {suffix!r}")
+            data = tf.extractfile(m).read()
+            if suffix.endswith(".gz"):
+                data = gzip.decompress(data)
+            return data.decode()
+
+        with tarfile.open(path, "r:*") as tf:
+            words_txt = read_member(tf, ".words.gz")
+            props_txt = read_member(tf, ".props.gz")
+        word_sents = [s.splitlines() for s in
+                      words_txt.split("\n\n") if s.strip()]
+        prop_sents = [[ln.split() for ln in s.splitlines()] for s in
+                      props_txt.split("\n\n") if s.strip()]
+        out = []
+        for ws, rows in zip(word_sents, prop_sents):
+            if not rows:
+                continue
+            n_pred = len(rows[0]) - 1
+            lemmas = [r[0] for r in rows]
+            for j in range(n_pred):
+                col = [r[1 + j] for r in rows]
+                labels = cls._iob(col)
+                p = next((i for i, l in enumerate(labels)
+                          if l in ("B-V", "I-V")), None)
+                pred = lemmas[p] if p is not None and \
+                    lemmas[p] != "-" else next(
+                        (l for l in lemmas if l != "-"), "-")
+                out.append((ws, pred, labels))
+        return out
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+__all__ += ["Conll05st"]

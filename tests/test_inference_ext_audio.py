@@ -182,7 +182,7 @@ class TestText:
         np.testing.assert_allclose(score.numpy(), [6.0])
 
     def test_dataset_download_error(self):
-        with pytest.raises(RuntimeError, match="no network egress"):
+        with pytest.raises(FileNotFoundError, match="no egress"):
             text.Imdb()
 
     def test_viterbi_lengths_masking(self):
