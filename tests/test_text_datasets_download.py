@@ -147,3 +147,60 @@ class TestImdbImikolov:
                       min_word_freq=1)
         assert len(ds) == 5  # 4 windows from line1 + 1 from line2
         assert ds[0].shape == (3,)
+
+
+class TestConll05st:
+    def _fixture(self, tmp_path):
+        """Two sentences in the canonical words/props release format;
+        sentence 2 has two predicates (two samples)."""
+        import gzip
+        import io
+        words = ("The\ncat\nsat\n\n"
+                 "A\ndog\nchased\nthe\ncat\n\n")
+        props = ("-    *\n"
+                 "-    *\n"
+                 "sit  (V*)\n"
+                 "\n"
+                 "-      (A0*      *\n"
+                 "-      *)        (A0*)\n"
+                 "chase  (V*)      *\n"
+                 "-      (A1*      *\n"
+                 "-      *)        (V*)\n"
+                 "\n")
+        path = tmp_path / "conll05st-tests.tar.gz"
+        with tarfile.open(path, "w:gz") as tf:
+            for name, txt in (
+                    ("conll05st-release/test.wsj/words/test.wsj.words.gz",
+                     words),
+                    ("conll05st-release/test.wsj/props/test.wsj.props.gz",
+                     props)):
+                blob = gzip.compress(txt.encode())
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                tf.addfile(info, io.BytesIO(blob))
+        return str(path)
+
+    def test_parse_iob_and_samples(self, tmp_path):
+        from paddle_tpu.text.datasets import Conll05st
+        ds = Conll05st(data_file=self._fixture(tmp_path))
+        assert len(ds) == 3          # 1 predicate + 2 predicates
+        ids, c2, c1, c0, p1, p2, pred, mark, lab = ds[0]
+        n = 3
+        assert all(a.shape == (n,) for a in
+                   (ids, c2, c1, c0, p1, p2, pred, mark, lab))
+        inv_label = {v: k for k, v in ds.label_dict.items()}
+        assert [inv_label[i] for i in lab.tolist()] == ["O", "O", "B-V"]
+        assert mark.tolist() == [0, 0, 1]
+        # predicate context windows: ctx_0 is the predicate word id,
+        # ctx_n1 its left neighbor, broadcast over the sentence
+        assert c0.tolist() == [ds.word_dict["sat"]] * n
+        assert c1.tolist() == [ds.word_dict["cat"]] * n
+        # second sentence, first predicate: A0 spans 2 tokens (B-, I-)
+        ids, _, _, _, _, _, _, mark, lab = ds[1]
+        tags = [inv_label[i] for i in lab.tolist()]
+        assert tags == ["B-A0", "I-A0", "B-V", "B-A1", "I-A1"]
+        # second predicate of the same sentence
+        ids, _, _, _, _, _, _, mark, lab = ds[2]
+        tags = [inv_label[i] for i in lab.tolist()]
+        assert tags == ["O", "B-A0", "O", "O", "B-V"]
+        assert mark.tolist() == [0, 0, 0, 0, 1]
