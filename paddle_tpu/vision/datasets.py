@@ -156,3 +156,75 @@ class ImageFolder(DatasetFolder):
         if self.transform:
             img = self.transform(img)
         return (img,)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference: python/paddle/vision/datasets/
+    flowers.py — verify). Three local files (no egress): the image
+    tarball (102flowers.tgz: jpg/image_*.jpg), imagelabels.mat
+    (1-based class per image) and setid.mat (trnid/valid/tstid splits).
+    Images decode lazily from the tarball on __getitem__; ``backend``
+    'pil' returns PIL images, 'cv2'/None HWC uint8 arrays."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend=None):
+        import scipy.io as sio
+        for name, p in (("data_file", data_file),
+                        ("label_file", label_file),
+                        ("setid_file", setid_file)):
+            if p is None or not os.path.exists(p):
+                raise RuntimeError(
+                    f"Flowers: {name} not found (no network egress; "
+                    "place 102flowers.tgz / imagelabels.mat / "
+                    f"setid.mat locally and pass {name}=)")
+        self.transform = transform
+        self.backend = backend
+        labels = sio.loadmat(label_file)["labels"].ravel()
+        key = {"train": "trnid", "valid": "validid",
+               "test": "tstid"}.get(mode, "trnid")
+        setid = sio.loadmat(setid_file)
+        if key not in setid and key == "validid":
+            key = "valid"          # both spellings appear in the wild
+        self.indexes = setid[key].ravel()
+        self.labels = labels
+        self.data_file = data_file
+        self._tar = None
+        self._names = None
+
+    def _open(self):
+        import tarfile
+        if self._tar is None:
+            self._tar = tarfile.open(self.data_file, "r:*")
+            self._names = {os.path.basename(n): n
+                           for n in self._tar.getnames()
+                           if n.endswith(".jpg")}
+        return self._tar
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        import io as _io
+        n = int(self.indexes[idx])          # 1-based image number
+        tf = self._open()
+        member = self._names[f"image_{n:05d}.jpg"]
+        img = Image.open(_io.BytesIO(tf.extractfile(member).read()))
+        img = img.convert("RGB")
+        if self.backend != "pil":
+            img = np.asarray(img, np.uint8)
+        if self.transform is not None:
+            img = self.transform(img)
+        label = np.int64(self.labels[n - 1])
+        return img, label
+
+    def __getstate__(self):
+        # DataLoader workers: the open tar handle cannot cross a fork
+        s = dict(self.__dict__)
+        s["_tar"] = None
+        s["_names"] = None
+        return s
+
+
+__all__ += ["Flowers"]

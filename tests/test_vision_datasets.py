@@ -1,0 +1,67 @@
+"""vision.datasets parity tests (reference:
+python/paddle/vision/datasets/ — verify): Flowers against a synthetic
+canonical-layout fixture (tgz of jpgs + imagelabels.mat + setid.mat),
+plus the FakeData contract other tests rely on."""
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+
+class TestFlowers:
+    @pytest.fixture()
+    def fixture_files(self, tmp_path):
+        import scipy.io as sio
+        from PIL import Image
+        tgz = tmp_path / "102flowers.tgz"
+        with tarfile.open(tgz, "w:gz") as tf:
+            for n in range(1, 5):
+                p = tmp_path / f"image_{n:05d}.jpg"
+                arr = np.full((8, 8, 3), n * 40, np.uint8)
+                Image.fromarray(arr).save(p)
+                tf.add(p, arcname=f"jpg/image_{n:05d}.jpg")
+        labels = tmp_path / "imagelabels.mat"
+        sio.savemat(labels, {"labels": np.array([[3, 1, 2, 3]])})
+        setid = tmp_path / "setid.mat"
+        sio.savemat(setid, {"trnid": np.array([[1, 4]]),
+                            "validid": np.array([[2]]),
+                            "tstid": np.array([[3]])})
+        return str(tgz), str(labels), str(setid)
+
+    def test_splits_labels_and_decode(self, fixture_files):
+        from paddle_tpu.vision.datasets import Flowers
+        tgz, labels, setid = fixture_files
+        tr = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
+                     mode="train")
+        assert len(tr) == 2
+        img, lab = tr[0]                  # image_00001, label 3
+        assert img.shape == (8, 8, 3) and img.dtype == np.uint8
+        assert int(img[0, 0, 0]) == 40 and int(lab) == 3
+        img, lab = tr[1]                  # image_00004, label 3
+        assert int(img[0, 0, 0]) == 160 and int(lab) == 3
+        te = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
+                     mode="test")
+        assert len(te) == 1
+        _, lab = te[0]
+        assert int(lab) == 2
+        # pil backend + transform hook
+        va = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
+                     mode="valid", backend="pil",
+                     transform=lambda im: np.asarray(im, np.float32) / 255)
+        img, lab = va[0]
+        assert img.dtype == np.float32 and int(lab) == 1
+
+    def test_missing_files_raise(self, tmp_path):
+        from paddle_tpu.vision.datasets import Flowers
+        with pytest.raises(RuntimeError, match="no network egress"):
+            Flowers(data_file=str(tmp_path / "nope.tgz"))
+
+
+def test_fakedata_deterministic():
+    from paddle_tpu.vision.datasets import FakeData
+    ds = FakeData(size=4, image_shape=(3, 8, 8), num_classes=5)
+    a1, l1 = ds[2]
+    a2, l2 = ds[2]
+    assert np.array_equal(a1, a2) and l1 == l2
+    assert a1.shape == (3, 8, 8) and 0 <= int(l1) < 5
