@@ -278,3 +278,100 @@ class Conll05st(Dataset):
 
 
 __all__ += ["Conll05st"]
+
+
+class Movielens(Dataset):
+    """MovieLens-1M recommender dataset (reference:
+    python/paddle/text/datasets/movielens.py — verify). Parses the
+    canonical ml-1m layout locally — users.dat / movies.dat /
+    ratings.dat with ``::`` separators — from a zip archive or an
+    extracted directory. Each sample is the reference's feature tuple:
+
+        (user_id, gender_id, age_id, occupation_id,
+         movie_id, title_word_ids, genre_ids, rating)
+
+    Categorical vocabularies (age buckets, genres, title words) are
+    built deterministically from the parsed corpus. ``mode`` selects a
+    deterministic 9:1 train/test split of the ratings."""
+
+    AGES = (1, 18, 25, 35, 45, 50, 56)
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1):
+        path = _resolve(data_file, ["ml-1m.zip", "ml-1m"], "Movielens")
+        users, movies, ratings = self._read(path)
+        self.gender_dict = {"F": 0, "M": 1}
+        self.age_dict = {a: i for i, a in enumerate(self.AGES)}
+        genres = sorted({g for _, gs, _ in movies.values() for g in gs})
+        self.genre_dict = {g: i for i, g in enumerate(genres)}
+        words = sorted({w for _, _, ws in movies.values() for w in ws})
+        self.title_dict = {w: i for i, w in enumerate(words)}
+        self.samples = []
+        for i, (uid, mid, score) in enumerate(ratings):
+            is_test = (i % int(round(1 / test_ratio))) == 0
+            if (mode == "test") != is_test:
+                continue
+            if uid not in users or mid not in movies:
+                continue
+            gender, age, job = users[uid]
+            _, gs, ws = movies[mid]
+            self.samples.append((
+                np.int64(uid), np.int64(self.gender_dict[gender]),
+                np.int64(self.age_dict.get(age, 0)), np.int64(job),
+                np.int64(mid),
+                np.asarray([self.title_dict[w] for w in ws], np.int64),
+                np.asarray([self.genre_dict[g] for g in gs], np.int64),
+                np.float32(score)))
+
+    @staticmethod
+    def _read(path):
+        import io
+        import zipfile
+
+        def decode(b):
+            return b.decode("latin-1")
+
+        texts = {}
+        names = ("users.dat", "movies.dat", "ratings.dat")
+        if os.path.isdir(path):
+            for n in names:
+                texts[n] = open(os.path.join(path, n), "rb").read()
+        else:
+            with zipfile.ZipFile(path) as zf:
+                for member in zf.namelist():
+                    base = os.path.basename(member)
+                    if base in names:
+                        texts[base] = zf.read(member)
+        for n in names:
+            if n not in texts:
+                raise FileNotFoundError(f"Movielens: {n} not found in "
+                                        f"{path!r}")
+        users = {}
+        for ln in decode(texts["users.dat"]).splitlines():
+            if not ln.strip():
+                continue
+            uid, gender, age, job = ln.split("::")[:4]
+            users[int(uid)] = (gender, int(age), int(job))
+        movies = {}
+        for ln in decode(texts["movies.dat"]).splitlines():
+            if not ln.strip():
+                continue
+            mid, title, genres = ln.split("::")[:3]
+            words = [w for w in
+                     title.rsplit("(", 1)[0].strip().lower().split()]
+            movies[int(mid)] = (title, genres.split("|"), words)
+        ratings = []
+        for ln in decode(texts["ratings.dat"]).splitlines():
+            if not ln.strip():
+                continue
+            uid, mid, score = ln.split("::")[:3]
+            ratings.append((int(uid), int(mid), float(score)))
+        return users, movies, ratings
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+__all__ += ["Movielens"]

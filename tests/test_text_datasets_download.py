@@ -204,3 +204,43 @@ class TestConll05st:
         tags = [inv_label[i] for i in lab.tolist()]
         assert tags == ["O", "B-A0", "O", "O", "B-V"]
         assert mark.tolist() == [0, 0, 0, 0, 1]
+
+
+class TestMovielens:
+    def _fixture(self, tmp_path, as_zip=True):
+        users = "1::F::1::10::48067\n2::M::25::16::70072\n"
+        movies = ("1::Toy Story (1995)::Animation|Children's|Comedy\n"
+                  "2::Jumanji (1995)::Adventure|Fantasy\n")
+        ratings = ("1::1::5::978300760\n1::2::3::978302109\n"
+                   "2::1::4::978301968\n2::2::2::978300275\n")
+        if as_zip:
+            import zipfile
+            p = tmp_path / "ml-1m.zip"
+            with zipfile.ZipFile(p, "w") as zf:
+                zf.writestr("ml-1m/users.dat", users)
+                zf.writestr("ml-1m/movies.dat", movies)
+                zf.writestr("ml-1m/ratings.dat", ratings)
+        else:
+            p = tmp_path / "ml-1m"
+            p.mkdir()
+            (p / "users.dat").write_text(users)
+            (p / "movies.dat").write_text(movies)
+            (p / "ratings.dat").write_text(ratings)
+        return str(p)
+
+    def test_zip_and_dir_parse(self, tmp_path):
+        from paddle_tpu.text.datasets import Movielens
+        ds = Movielens(data_file=self._fixture(tmp_path), mode="train")
+        te = Movielens(data_file=self._fixture(tmp_path, as_zip=True),
+                       mode="test")
+        assert len(ds) + len(te) == 4 and len(te) >= 1
+        uid, gender, age, job, mid, title, genres, score = ds[0]
+        assert gender in (0, 1) and score in (2.0, 3.0, 4.0, 5.0)
+        assert title.dtype == np.int64 and genres.dtype == np.int64
+        # title words exclude the (year); genres split on |
+        inv_t = {v: k for k, v in ds.title_dict.items()}
+        words = {inv_t[i] for i in title.tolist()}
+        assert words <= {"toy", "story", "jumanji"}
+        d2 = Movielens(data_file=self._fixture(tmp_path, as_zip=False),
+                       mode="train")
+        assert len(d2) == len(ds)
