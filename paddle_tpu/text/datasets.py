@@ -375,3 +375,81 @@ class Movielens(Dataset):
 
 
 __all__ += ["Movielens"]
+
+
+class WMT16(Dataset):
+    """WMT'16 EN-DE machine-translation pairs (reference:
+    python/paddle/text/datasets/wmt16.py — verify exact member names
+    and BPE vocab files). Parses a local tarball whose members end in
+    ``{mode}.{lang}`` (e.g. ``wmt16/train.en`` + ``wmt16/train.de``;
+    gz-compressed members are handled). Vocabularies are built from the
+    train split with the reference's special tokens — <s>, <e>, <unk>
+    at ids 0, 1, 2 — and frequency cutoff. Each sample is the seq2seq
+    triple (src_ids, trg_ids, trg_ids_next): target input starts with
+    <s>, target-next ends with <e>."""
+
+    BOS, EOS, UNK = 0, 1, 2
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en"):
+        path = _resolve(data_file, ["wmt16.tar.gz", "wmt16.tgz"],
+                        "WMT16")
+        src_lang = lang
+        trg_lang = "de" if lang == "en" else "en"
+        src_train = self._member(path, f"train.{src_lang}")
+        trg_train = self._member(path, f"train.{trg_lang}")
+        self.src_dict = self._vocab(src_train, src_dict_size)
+        self.trg_dict = self._vocab(trg_train, trg_dict_size)
+        src_lines = src_train if mode == "train" else \
+            self._member(path, f"{mode}.{src_lang}")
+        trg_lines = trg_train if mode == "train" else \
+            self._member(path, f"{mode}.{trg_lang}")
+        self.samples = []
+        for s, t in zip(src_lines, trg_lines):
+            sid = [self.src_dict.get(w, self.UNK) for w in s.split()]
+            tid = [self.trg_dict.get(w, self.UNK) for w in t.split()]
+            if not sid or not tid:
+                continue
+            self.samples.append((
+                np.asarray(sid, np.int64),
+                np.asarray([self.BOS] + tid, np.int64),
+                np.asarray(tid + [self.EOS], np.int64)))
+
+    @staticmethod
+    def _member(path, suffix):
+        with tarfile.open(path, "r:*") as tf:
+            for m in tf.getmembers():
+                name = m.name
+                if name.endswith(suffix) or name.endswith(suffix + ".gz"):
+                    data = tf.extractfile(m).read()
+                    if name.endswith(".gz"):
+                        data = gzip.decompress(data)
+                    return [ln for ln in
+                            data.decode("utf-8", "replace").splitlines()
+                            if ln.strip()]
+        raise FileNotFoundError(
+            f"WMT16: no member ending in {suffix!r} in {path!r}")
+
+    @classmethod
+    def _vocab(cls, lines, size):
+        freq: dict = {}
+        for ln in lines:
+            for w in ln.split():
+                freq[w] = freq.get(w, 0) + 1
+        words = sorted(freq, key=lambda w: (-freq[w], w))
+        if size and size > 0:
+            words = words[:max(0, size - 3)]
+        d = {"<s>": cls.BOS, "<e>": cls.EOS, "<unk>": cls.UNK}
+        for w in words:
+            if w not in d:
+                d[w] = len(d)
+        return d
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+
+__all__ += ["WMT16"]

@@ -244,3 +244,36 @@ class TestMovielens:
         d2 = Movielens(data_file=self._fixture(tmp_path, as_zip=False),
                        mode="train")
         assert len(d2) == len(ds)
+
+
+class TestWMT16:
+    def test_pairs_vocab_and_specials(self, tmp_path):
+        import io
+        from paddle_tpu.text.datasets import WMT16
+        tar = tmp_path / "wmt16.tar.gz"
+        files = {
+            "wmt16/train.en": "a cat sat\nthe dog ran\n",
+            "wmt16/train.de": "eine katze sass\nder hund lief\n",
+            "wmt16/val.en": "a dog\n",
+            "wmt16/val.de": "ein hund\n",
+        }
+        with tarfile.open(tar, "w:gz") as tf:
+            for name, txt in files.items():
+                data = txt.encode()
+                ti = tarfile.TarInfo(name)
+                ti.size = len(data)
+                tf.addfile(ti, io.BytesIO(data))
+        tr = WMT16(data_file=str(tar), mode="train")
+        assert len(tr) == 2
+        src, trg_in, trg_next = tr[0]
+        assert trg_in[0] == WMT16.BOS and trg_next[-1] == WMT16.EOS
+        assert np.array_equal(trg_in[1:], trg_next[:-1])
+        assert tr.src_dict["<s>"] == 0 and tr.trg_dict["<unk>"] == 2
+        va = WMT16(data_file=str(tar), mode="val")
+        assert len(va) == 1
+        # "ein" unseen in train.de -> <unk> in the target ids
+        src, trg_in, _ = va[0]
+        assert trg_in[1] == WMT16.UNK
+        # dict-size cutoff keeps specials + top-k
+        small = WMT16(data_file=str(tar), mode="train", src_dict_size=4)
+        assert len(small.src_dict) == 4
