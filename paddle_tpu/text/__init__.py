@@ -19,7 +19,7 @@ import numpy as np
 from ..io import Dataset
 
 __all__ = ["Vocab", "BasicTokenizer", "Imdb", "Imikolov",
-           "UCIHousing", "Conll05st", "Movielens", "WMT16",
+           "UCIHousing", "Conll05st", "Movielens", "WMT16", "WMT14",
            "ViterbiDecoder", "viterbi_decode"]
 
 
@@ -172,5 +172,5 @@ from . import datasets  # noqa: F401,E402
 # UCIHousing duplicates predated datasets.py and lacked the r4/r5
 # fixes — datasets.py is the single source of truth now)
 from .datasets import (Imdb, Imikolov, UCIHousing,  # noqa: E402
-                       Conll05st, Movielens, WMT16)
+                       Conll05st, Movielens, WMT16, WMT14)
 

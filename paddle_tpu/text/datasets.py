@@ -453,3 +453,37 @@ class WMT16(Dataset):
 
 
 __all__ += ["WMT16"]
+
+
+class WMT14(WMT16):
+    """WMT'14 EN-FR pairs (reference:
+    python/paddle/text/datasets/wmt14.py — verify member names). Same
+    local-tarball contract as WMT16 with the EN-FR language pair."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 lang="en"):
+        path = _resolve(data_file, ["wmt14.tar.gz", "wmt14.tgz"],
+                        "WMT14")
+        self._lang_pair = ("en", "fr") if lang == "en" else ("fr", "en")
+        src_lang, trg_lang = self._lang_pair
+        src_train = self._member(path, f"train.{src_lang}")
+        trg_train = self._member(path, f"train.{trg_lang}")
+        self.src_dict = self._vocab(src_train, dict_size)
+        self.trg_dict = self._vocab(trg_train, dict_size)
+        src_lines = src_train if mode == "train" else \
+            self._member(path, f"{mode}.{src_lang}")
+        trg_lines = trg_train if mode == "train" else \
+            self._member(path, f"{mode}.{trg_lang}")
+        self.samples = []
+        for s, t in zip(src_lines, trg_lines):
+            sid = [self.src_dict.get(w, self.UNK) for w in s.split()]
+            tid = [self.trg_dict.get(w, self.UNK) for w in t.split()]
+            if not sid or not tid:
+                continue
+            self.samples.append((
+                np.asarray(sid, np.int64),
+                np.asarray([self.BOS] + tid, np.int64),
+                np.asarray(tid + [self.EOS], np.int64)))
+
+
+__all__ += ["WMT14"]
