@@ -484,3 +484,137 @@ def adjust_hue(img, hue_factor):
 __all__ += ["RandomVerticalFlip", "hflip", "vflip", "crop", "center_crop",
             "pad", "rotate", "to_grayscale", "adjust_brightness",
             "adjust_contrast", "adjust_hue"]
+
+
+def _warp_inverse_nearest(hwc, inv, fill=0):
+    """Warp by a 3x3 inverse homography (dst (x,y,1) -> src), nearest
+    sampling, same canvas — the shared engine for RandomAffine /
+    RandomPerspective (reference: transforms.{RandomAffine,
+    RandomPerspective} — verify)."""
+    h, w = hwc.shape[:2]
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float64)
+    den = inv[2, 0] * xx + inv[2, 1] * yy + inv[2, 2]
+    den = np.where(np.abs(den) < 1e-12, 1e-12, den)
+    sx = (inv[0, 0] * xx + inv[0, 1] * yy + inv[0, 2]) / den
+    sy = (inv[1, 0] * xx + inv[1, 1] * yy + inv[1, 2]) / den
+    sxi = np.round(sx).astype(np.int64)
+    syi = np.round(sy).astype(np.int64)
+    valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+    out = hwc[syi.clip(0, h - 1), sxi.clip(0, w - 1)].copy()
+    out[~valid] = fill
+    return out
+
+
+class RandomAffine:
+    """Random rotation + translation + scale + shear about the image
+    center (reference: transforms.RandomAffine — verify; torchvision
+    parameter semantics: translate as width/height fractions, shear in
+    degrees). Nearest sampling, matching this module's RandomRotation."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        if interpolation != "nearest":
+            raise NotImplementedError(
+                "RandomAffine: only 'nearest' sampling is implemented")
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale_range = scale
+        if shear is None:
+            self.shear = None
+        elif np.isscalar(shear):
+            self.shear = (-shear, shear, 0.0, 0.0)
+        elif len(shear) == 2:
+            self.shear = (shear[0], shear[1], 0.0, 0.0)
+        else:
+            self.shear = tuple(shear)
+        self.fill = fill
+        self.center = center
+
+    def _matrix(self, h, w):
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * h
+        s = np.random.uniform(*self.scale_range) \
+            if self.scale_range is not None else 1.0
+        shx = shy = 0.0
+        if self.shear is not None:
+            shx = np.deg2rad(np.random.uniform(self.shear[0],
+                                               self.shear[1]))
+            shy = np.deg2rad(np.random.uniform(self.shear[2],
+                                               self.shear[3]))
+        cx, cy = ((w - 1) / 2.0, (h - 1) / 2.0) if self.center is None \
+            else self.center
+        cos, sin = np.cos(angle), np.sin(angle)
+        # y-down pixel grid: visually-CCW positive angles (matching
+        # this module's RandomRotation: rotate(90) == np.rot90(img, 1))
+        rot = np.array([[cos, sin, 0], [-sin, cos, 0], [0, 0, 1]])
+        sh = np.array([[1, np.tan(shx), 0], [np.tan(shy), 1, 0],
+                       [0, 0, 1]])
+        sc = np.diag([s, s, 1.0])
+        t_c = np.array([[1, 0, cx], [0, 1, cy], [0, 0, 1]])
+        t_ci = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]])
+        t_tr = np.array([[1, 0, tx], [0, 1, ty], [0, 0, 1]])
+        return t_tr @ t_c @ rot @ sh @ sc @ t_ci
+
+    def __call__(self, img):
+        arr = _arr(img).astype(np.float32)
+        chw, hwc = _hwc_view(arr)
+        h, w = hwc.shape[:2]
+        inv = np.linalg.inv(self._matrix(h, w))
+        return _ret(_back(_warp_inverse_nearest(hwc, inv, self.fill),
+                          chw), img)
+
+
+class RandomPerspective:
+    """Random four-corner perspective distortion (reference:
+    transforms.RandomPerspective — verify): each output corner pulls
+    inward by up to ``distortion_scale * side/2``; applied with
+    probability ``prob``. Nearest sampling."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        if interpolation != "nearest":
+            raise NotImplementedError(
+                "RandomPerspective: only 'nearest' is implemented")
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    @staticmethod
+    def _homography(src, dst):
+        """3x3 H with H @ [x_src, y_src, 1] ~ [x_dst, y_dst, 1] (DLT)."""
+        a, b = [], []
+        for (x, y), (u, v) in zip(src, dst):
+            a.append([x, y, 1, 0, 0, 0, -u * x, -u * y])
+            a.append([0, 0, 0, x, y, 1, -v * x, -v * y])
+            b += [u, v]
+        h8 = np.linalg.solve(np.asarray(a, np.float64),
+                             np.asarray(b, np.float64))
+        return np.append(h8, 1.0).reshape(3, 3)
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = _arr(img).astype(np.float32)
+        chw, hwc = _hwc_view(arr)
+        h, w = hwc.shape[:2]
+        dx, dy = self.distortion_scale * w / 2, \
+            self.distortion_scale * h / 2
+        corners = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        signs = [(1, 1), (-1, 1), (-1, -1), (1, -1)]
+        warped = [(x + sx * np.random.uniform(0, dx),
+                   y + sy * np.random.uniform(0, dy))
+                  for (x, y), (sx, sy) in zip(corners, signs)]
+        # output corner (dst) pulls its content from the perturbed
+        # source corner: inverse map dst -> src
+        inv = self._homography(corners, warped)
+        return _ret(_back(_warp_inverse_nearest(hwc, inv, self.fill),
+                          chw), img)
+
+
+__all__ += ["RandomAffine", "RandomPerspective"]

@@ -65,3 +65,55 @@ def test_fakedata_deterministic():
     a2, l2 = ds[2]
     assert np.array_equal(a1, a2) and l1 == l2
     assert a1.shape == (3, 8, 8) and 0 <= int(l1) < 5
+
+
+class TestGeometricTransforms:
+    def test_affine_identity_and_translation(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.arange(48, dtype=np.float32).reshape(4, 4, 3)
+        ident = T.RandomAffine(degrees=0)(img)
+        np.testing.assert_allclose(ident, img)
+        # pure +1px x-translation: column 0 becomes fill, content shifts
+        np.random.seed(0)
+        t = T.RandomAffine(degrees=0, translate=(0.5, 0.0), fill=-1)
+        found_shift = False
+        for _ in range(20):
+            out = t(img)
+            shift = out[0, :, 0]
+            if shift[0] == -1 and np.all(out[:, 1:, :] >= 0):
+                found_shift = True
+                break
+        assert found_shift     # some draw translates right by >=1px
+
+    def test_affine_rotation_matches_rot90(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.random.RandomState(0).rand(5, 5, 3).astype(np.float32)
+        out = T.RandomAffine(degrees=(90, 90))(img)
+        np.testing.assert_allclose(out, np.rot90(img, 1), atol=1e-5)
+
+    def test_affine_scale_keeps_center(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.zeros((5, 5, 1), np.float32)
+        img[2, 2, 0] = 7.0
+        out = T.RandomAffine(degrees=0, scale=(2.0, 2.0))(img)
+        assert out[2, 2, 0] == 7.0     # center pixel is a fixed point
+
+    def test_perspective_prob_and_identity(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.random.RandomState(1).rand(6, 6, 3).astype(np.float32)
+        assert T.RandomPerspective(prob=0.0)(img) is img
+        np.random.seed(3)
+        out = T.RandomPerspective(prob=1.0, distortion_scale=0.0)(img)
+        np.testing.assert_allclose(out, img, atol=1e-5)
+        out = T.RandomPerspective(prob=1.0, distortion_scale=0.8)(img)
+        assert out.shape == img.shape
+        assert not np.allclose(out, img)   # corners actually moved
+
+    def test_chw_layout_roundtrip(self):
+        from paddle_tpu.vision import transforms as T
+        chw = np.random.RandomState(2).rand(3, 6, 6).astype(np.float32)
+        out = T.RandomAffine(degrees=(90, 90))(chw)
+        assert out.shape == (3, 6, 6)
+        np.testing.assert_allclose(
+            out, np.rot90(chw.transpose(1, 2, 0), 1).transpose(2, 0, 1),
+            atol=1e-5)
