@@ -387,3 +387,66 @@ class DeformConv2D(_deform_layer_base()):
 
 
 __all__ += ["deform_conv2d", "DeformConv2D"]
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None,
+             scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLOv3 detection head into boxes + per-class scores
+    (reference: paddle.vision.ops.yolo_box /
+    paddle/fluid/operators/detection/yolo_box_op.* — verify).
+
+    x: (N, A*(5+C), H, W) raw head output with A = len(anchors)//2.
+    img_size: (N, 2) int (h, w) per image. Returns
+    (boxes (N, H*W*A, 4) in x1y1x2y2 image coords,
+     scores (N, H*W*A, C)). Predictions whose objectness confidence is
+    below ``conf_thresh`` are zeroed, matching the reference."""
+    def f(xv, imgv):
+        n, _, h, w = xv.shape
+        a = len(anchors) // 2
+        anc = jnp.asarray(anchors, jnp.float32).reshape(a, 2)
+        if iou_aware:
+            ioup = jax.nn.sigmoid(xv[:, :a].reshape(n, a, 1, h, w))
+            xv = xv[:, a:]
+        pred = xv.reshape(n, a, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32).reshape(1, 1, 1, w)
+        gy = jnp.arange(h, dtype=jnp.float32).reshape(1, 1, h, 1)
+        bias = 0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y - bias + gx) / w
+        cy = (jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y - bias + gy) / h
+        input_h = downsample_ratio * h
+        input_w = downsample_ratio * w
+        bw = jnp.exp(pred[:, :, 2]) * anc[:, 0].reshape(1, a, 1, 1) \
+            / input_w
+        bh = jnp.exp(pred[:, :, 3]) * anc[:, 1].reshape(1, a, 1, 1) \
+            / input_h
+        conf = jax.nn.sigmoid(pred[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1.0 - iou_aware_factor) * \
+                ioup[:, :, 0] ** iou_aware_factor
+        cls = jax.nn.sigmoid(pred[:, :, 5:])          # (n,a,C,h,w)
+        keep = (conf >= conf_thresh).astype(jnp.float32)
+        score = (conf * keep)[:, :, None] * cls       # zero below thresh
+        imgh = imgv[:, 0].astype(jnp.float32).reshape(n, 1, 1, 1)
+        imgw = imgv[:, 1].astype(jnp.float32).reshape(n, 1, 1, 1)
+        x1 = (cx - bw / 2) * imgw
+        y1 = (cy - bh / 2) * imgh
+        x2 = (cx + bw / 2) * imgw
+        y2 = (cy + bh / 2) * imgh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imgw - 1)
+            y1 = jnp.clip(y1, 0, imgh - 1)
+            x2 = jnp.clip(x2, 0, imgw - 1)
+            y2 = jnp.clip(y2, 0, imgh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)   # (n,a,h,w,4)
+        boxes = boxes * keep[..., None]
+        # reference layout: flatten (a, h, w) -> boxes (n, a*h*w, 4)
+        boxes = boxes.reshape(n, a * h * w, 4)
+        scores = jnp.moveaxis(score, 2, -1).reshape(n, a * h * w,
+                                                    class_num)
+        return boxes, scores
+    out = apply_op(f, x, img_size)
+    return out
+
+
+__all__ += ["yolo_box"]

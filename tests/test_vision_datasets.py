@@ -117,3 +117,52 @@ class TestGeometricTransforms:
         np.testing.assert_allclose(
             out, np.rot90(chw.transpose(1, 2, 0), 1).transpose(2, 0, 1),
             atol=1e-5)
+
+
+class TestYoloBox:
+    def test_decode_geometry_and_threshold(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.ops import yolo_box
+        n, a, c, h, w = 1, 2, 3, 2, 2
+        anchors = [10, 14, 23, 27]
+        x = np.zeros((n, a * (5 + c), h, w), np.float32)
+        xv = x.reshape(n, a, 5 + c, h, w)
+        # anchor 0, cell (0,0): tx=ty=0 -> sigmoid 0.5; tw=th=0 ->
+        # bw = anchor_w / input_w. objectness large -> conf ~ 1
+        xv[0, 0, 4, :, :] = -20.0          # everything low-conf...
+        xv[0, 0, 4, 0, 0] = 20.0           # ...except cell (0,0)
+        xv[0, 1, 4, :, :] = -20.0
+        xv[0, 0, 5, 0, 0] = 20.0           # class 0 prob -> 1
+        img_size = np.array([[64, 128]], np.int32)   # (h, w)
+        boxes, scores = yolo_box(
+            paddle.to_tensor(x.reshape(n, -1, h, w)),
+            paddle.to_tensor(img_size), anchors, c, 0.5,
+            downsample_ratio=32, clip_bbox=False)
+        boxes, scores = boxes.numpy(), scores.numpy()
+        assert boxes.shape == (n, a * h * w, 4)
+        assert scores.shape == (n, a * h * w, c)
+        # flat index of (anchor 0, cell (0,0)) in (a, h, w) order
+        i = 0
+        cx, cy = 0.5 / 2 * 128, 0.5 / 2 * 64     # grid 2x2 -> frac 0.25
+        bw = 10 / (32 * 2) * 128                  # anchor_w/input_w*imgw
+        bh = 14 / (32 * 2) * 64
+        np.testing.assert_allclose(
+            boxes[0, i], [cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2, cy + bh / 2], rtol=1e-4)
+        assert scores[0, i, 0] > 0.99
+        # all low-conf predictions zeroed (boxes AND scores)
+        assert np.abs(boxes[0, 1:]).sum() == 0
+        assert np.abs(scores[0, 1:]).sum() == 0
+
+    def test_clip_keeps_boxes_inside(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.ops import yolo_box
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 2 * 6, 3, 3).astype(np.float32) * 3
+        img = np.array([[32, 32], [48, 64]], np.int32)
+        boxes, _ = yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                            [8, 8, 16, 16], 1, 0.0, 16, clip_bbox=True)
+        b = boxes.numpy()
+        for i, (hh, ww) in enumerate([(32, 32), (48, 64)]):
+            assert b[i, :, 0].min() >= 0 and b[i, :, 2].max() <= ww - 1
+            assert b[i, :, 1].min() >= 0 and b[i, :, 3].max() <= hh - 1
