@@ -450,3 +450,100 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
 
 
 __all__ += ["yolo_box"]
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference:
+    paddle.vision.ops.distribute_fpn_proposals — verify):
+    level = floor(refer_level + log2(sqrt(area) / refer_scale)),
+    clipped to [min_level, max_level]. Returns (multi_rois: list of
+    (Mi, 4) per level, restore_ind (M, 1) mapping concat(multi_rois)
+    back to the input order, rois_num_per_level or None).
+
+    Host-side op (data-dependent sizes cannot live under jit — the
+    reference's GPU op is likewise a standalone kernel invoked between
+    network stages)."""
+    import numpy as np
+    rois = np.asarray(fpn_rois._value if isinstance(fpn_rois, Tensor)
+                      else fpn_rois, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    ws = np.maximum(rois[:, 2] - rois[:, 0] + off, 0.0)
+    hs = np.maximum(rois[:, 3] - rois[:, 1] + off, 0.0)
+    scale = np.sqrt(ws * hs)
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi, order = [], []
+    for l in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == l)[0]
+        multi.append(rois[idx])
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    restore = np.empty((len(rois), 1), np.int32)
+    restore[order, 0] = np.arange(len(rois), dtype=np.int32)
+    multi_t = [Tensor(jnp.asarray(m)) for m in multi]
+    restore_t = Tensor(jnp.asarray(restore))
+    nums = [Tensor(jnp.asarray(np.asarray([len(m)], np.int32)))
+            for m in multi] if rois_num is not None else None
+    return multi_t, restore_t, nums
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference:
+    paddle.vision.ops.psroi_pool / R-FCN — verify): input channels are
+    C = output_channels * k * k; output channel c at bin (i, j) AVERAGE-
+    pools input channel c*k*k + i*k + j inside that bin. x: (N, C, H, W),
+    boxes: (M, 4) x1y1x2y2 in image coords, boxes_num: (N,) rois per
+    image. Returns (M, output_channels, k, k)."""
+    k = output_size if isinstance(output_size, int) else output_size[0]
+
+    def f(xv, bv, nv):
+        n, c, hh, ww = xv.shape
+        oc = c // (k * k)
+        img_of_box = jnp.repeat(jnp.arange(n), nv, axis=0,
+                                total_repeat_length=bv.shape[0])
+
+        def one(b, img_i):
+            x1 = b[0] * spatial_scale
+            y1 = b[1] * spatial_scale
+            x2 = b[2] * spatial_scale
+            y2 = b[3] * spatial_scale
+            bw = jnp.maximum(x2 - x1, 0.1) / k
+            bh = jnp.maximum(y2 - y1, 0.1) / k
+            yy = jnp.arange(hh, dtype=jnp.float32)[:, None]
+            xx = jnp.arange(ww, dtype=jnp.float32)[None, :]
+            feat = xv[img_i]                     # (C, H, W)
+            outs = []
+            for i in range(k):
+                for j in range(k):
+                    ys, ye = y1 + i * bh, y1 + (i + 1) * bh
+                    xs, xe = x1 + j * bw, x1 + (j + 1) * bw
+                    m = ((yy >= jnp.floor(ys)) & (yy < jnp.ceil(ye)) &
+                         (xx >= jnp.floor(xs)) & (xx < jnp.ceil(xe))
+                         ).astype(xv.dtype)
+                    cnt = jnp.maximum(m.sum(), 1.0)
+                    ch = jnp.arange(oc) * (k * k) + i * k + j
+                    pooled = (feat[ch] * m).sum(axis=(-2, -1)) / cnt
+                    outs.append(pooled)
+            out = jnp.stack(outs, axis=-1).reshape(oc, k, k)
+            return out
+        return jax.vmap(one)(bv, img_of_box)
+    return apply_op(f, x, boxes, boxes_num)
+
+
+class PSRoIPool:
+    """Layer wrapper over ``psroi_pool`` (reference:
+    paddle.vision.ops.PSRoIPool — verify)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+__all__ += ["distribute_fpn_proposals", "psroi_pool", "PSRoIPool"]

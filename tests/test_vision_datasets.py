@@ -166,3 +166,54 @@ class TestYoloBox:
         for i, (hh, ww) in enumerate([(32, 32), (48, 64)]):
             assert b[i, :, 0].min() >= 0 and b[i, :, 2].max() <= ww - 1
             assert b[i, :, 1].min() >= 0 and b[i, :, 3].max() <= hh - 1
+
+
+class TestFpnAndPsRoi:
+    def test_distribute_fpn_levels_and_restore(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.ops import distribute_fpn_proposals
+        rois = np.array([
+            [0, 0, 224, 224],     # sqrt(area)=224 -> refer level 4
+            [0, 0, 56, 56],       # -> level 2
+            [0, 0, 448, 448],     # -> level 5
+            [0, 0, 112, 112],     # -> level 3
+            [0, 0, 2000, 2000],   # beyond -> clipped to max 5
+        ], np.float32)
+        multi, restore, _ = distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224)
+        sizes = [m.shape[0] for m in multi]
+        assert sizes == [1, 1, 1, 2]       # levels 2,3,4,5
+        cat = np.concatenate([m.numpy() for m in multi])
+        ri = restore.numpy().ravel()
+        np.testing.assert_allclose(cat[ri], rois)
+
+    def test_psroi_pool_position_sensitivity(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.ops import psroi_pool
+        k, oc = 2, 3
+        # channel value == its own index -> output bin (i,j) of out-chan
+        # c must equal c*k*k + i*k + j exactly (average of a constant)
+        x = np.zeros((1, oc * k * k, 4, 4), np.float32)
+        for c in range(oc * k * k):
+            x[0, c] = c
+        boxes = np.array([[0, 0, 4, 4]], np.float32)
+        out = psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)), k)
+        o = out.numpy()
+        assert o.shape == (1, oc, k, k)
+        for c in range(oc):
+            for i in range(k):
+                for j in range(k):
+                    assert o[0, c, i, j] == c * k * k + i * k + j
+
+    def test_psroi_pool_multi_image_routing(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.ops import psroi_pool
+        x = np.zeros((2, 4, 4, 4), np.float32)
+        x[0] = 1.0
+        x[1] = 5.0
+        boxes = np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32)
+        out = psroi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1, 1], np.int32)), 2)
+        o = out.numpy()
+        assert np.all(o[0] == 1.0) and np.all(o[1] == 5.0)
