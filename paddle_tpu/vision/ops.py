@@ -547,3 +547,147 @@ class PSRoIPool:
 
 
 __all__ += ["distribute_fpn_proposals", "psroi_pool", "PSRoIPool"]
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference: paddle.vision.ops.yolo_loss /
+    detection/yolov3_loss_op.* — verify label-smooth constants).
+
+    x: (N, A*(5+C), H, W) head output for THIS scale (A =
+    len(anchor_mask)); gt_box (N, B, 4) normalized center-xywh;
+    gt_label (N, B) int; padded gts have w*h == 0. Per the reference:
+    each gt is assigned to its best shape-IoU anchor over ALL anchors —
+    the gt trains this head only if that anchor is in ``anchor_mask``;
+    x/y/obj/cls use sigmoid cross-entropy, w/h use L1, box losses are
+    weighted by (2 - gw*gh); negatives whose decoded-box IoU with any
+    gt exceeds ``ignore_thresh`` are excluded from objectness loss.
+    Returns per-image loss (N,)."""
+    anc = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask = list(anchor_mask)
+    a = len(mask)
+
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    def f(xv, gb, gl, gs):
+        n, _, h, w = xv.shape
+        input_w = downsample_ratio * w
+        input_h = downsample_ratio * h
+        pred = xv.reshape(n, a, 5 + class_num, h, w)
+        anc_all = jnp.asarray(anc)                      # (Atot, 2)
+        anc_used = jnp.asarray(anc[mask])               # (a, 2)
+
+        gw, gh = gb[..., 2], gb[..., 3]                 # (n, B)
+        valid = (gw * gh > 0)
+        # shape-only IoU vs every anchor (normalized to input size)
+        aw = anc_all[:, 0] / input_w                    # (Atot,)
+        ah = anc_all[:, 1] / input_h
+        inter = jnp.minimum(gw[..., None], aw) * \
+            jnp.minimum(gh[..., None], ah)
+        union = gw[..., None] * gh[..., None] + aw * ah - inter
+        best = jnp.argmax(inter / (union + 1e-9), axis=-1)  # (n, B)
+        # slot in this head (or -1)
+        slot = jnp.full_like(best, -1)
+        for s, m in enumerate(mask):
+            slot = jnp.where(best == m, s, slot)
+        assigned = valid & (slot >= 0)
+
+        gi = jnp.clip((gb[..., 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+        tx = gb[..., 0] * w - gi
+        ty = gb[..., 1] * h - gj
+        safe_slot = jnp.clip(slot, 0, a - 1)
+        tw = jnp.log(jnp.maximum(
+            gw * input_w / anc_used[safe_slot, 0], 1e-9))
+        th = jnp.log(jnp.maximum(
+            gh * input_h / anc_used[safe_slot, 1], 1e-9))
+        box_w = 2.0 - gw * gh
+
+        # scatter per-gt targets onto the (a, h, w) grid (last write
+        # wins on collisions, matching a sequential assignment)
+        zero = jnp.zeros((n, a, h, w), jnp.float32)
+        bidx = jnp.arange(n)[:, None] * 0 + jnp.arange(n)[:, None]
+
+        def put(base, val):
+            return base.at[bidx, safe_slot, gj, gi].set(
+                jnp.where(assigned, val, base[bidx, safe_slot, gj, gi]))
+        obj_t = put(zero, jnp.where(assigned, gs, 0.0))
+        tx_t = put(zero, tx)
+        ty_t = put(zero, ty)
+        tw_t = put(zero, tw)
+        th_t = put(zero, th)
+        bw_t = put(zero, box_w)
+        cls_t = jnp.zeros((n, a, h, w, class_num), jnp.float32)
+        pos_lab = 1.0 - 1.0 / class_num if use_label_smooth and \
+            class_num > 1 else 1.0
+        neg_lab = 1.0 / class_num if use_label_smooth and \
+            class_num > 1 else 0.0
+        safe_lab = jnp.clip(gl, 0, class_num - 1)
+        cls_t = cls_t.at[bidx, safe_slot, gj, gi, safe_lab].set(
+            jnp.where(assigned, pos_lab, 0.0))
+        pos_mask = (obj_t > 0).astype(jnp.float32)
+
+        # decode predictions for the ignore test (like yolo_box)
+        gxg = jnp.arange(w, dtype=jnp.float32).reshape(1, 1, 1, w)
+        gyg = jnp.arange(h, dtype=jnp.float32).reshape(1, 1, h, 1)
+        bias = 0.5 * (scale_x_y - 1.0)
+        px = (jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y - bias + gxg) / w
+        py = (jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y - bias + gyg) / h
+        pw = jnp.exp(jnp.clip(pred[:, :, 2], -10, 10)) * \
+            anc_used[:, 0].reshape(1, a, 1, 1) / input_w
+        ph = jnp.exp(jnp.clip(pred[:, :, 3], -10, 10)) * \
+            anc_used[:, 1].reshape(1, a, 1, 1) / input_h
+
+        def iou_vs_gts(px, py, pw, ph, gb, valid):
+            # (n,a,h,w) vs (n,B): max IoU over valid gts
+            px1, px2 = px - pw / 2, px + pw / 2
+            py1, py2 = py - ph / 2, py + ph / 2
+            gx1 = (gb[..., 0] - gb[..., 2] / 2)
+            gx2 = (gb[..., 0] + gb[..., 2] / 2)
+            gy1 = (gb[..., 1] - gb[..., 3] / 2)
+            gy2 = (gb[..., 1] + gb[..., 3] / 2)
+            sh = (slice(None), None, None, None, None)
+            ix = jnp.maximum(
+                jnp.minimum(px2[..., None], gx2[:, None, None, None]) -
+                jnp.maximum(px1[..., None], gx1[:, None, None, None]),
+                0)
+            iy = jnp.maximum(
+                jnp.minimum(py2[..., None], gy2[:, None, None, None]) -
+                jnp.maximum(py1[..., None], gy1[:, None, None, None]),
+                0)
+            inter = ix * iy
+            union = (pw * ph)[..., None] + \
+                (gb[..., 2] * gb[..., 3])[:, None, None, None] - inter
+            iou = inter / (union + 1e-9)
+            iou = jnp.where(valid[:, None, None, None], iou, 0.0)
+            return iou.max(axis=-1)
+        best_iou = iou_vs_gts(px, py, pw, ph, gb, valid)
+        ignore = ((best_iou > ignore_thresh) & (pos_mask < 0.5)
+                  ).astype(jnp.float32)
+
+        lx = bce(pred[:, :, 0], tx_t) * bw_t * pos_mask
+        ly = bce(pred[:, :, 1], ty_t) * bw_t * pos_mask
+        lw = jnp.abs(pred[:, :, 2] - tw_t) * bw_t * pos_mask
+        lh = jnp.abs(pred[:, :, 3] - th_t) * bw_t * pos_mask
+        lobj = bce(pred[:, :, 4], obj_t) * \
+            jnp.where(pos_mask > 0, obj_t, 1.0 - ignore)
+        cls_target = jnp.where(pos_mask[..., None] > 0,
+                               jnp.where(cls_t > 0, cls_t, neg_lab),
+                               0.0)
+        lcls = bce(jnp.moveaxis(pred[:, :, 5:], 2, -1), cls_target) * \
+            pos_mask[..., None]
+        per_img = (lx + ly + lw + lh + lobj).sum(axis=(1, 2, 3)) + \
+            lcls.sum(axis=(1, 2, 3, 4))
+        return per_img
+
+    if gt_score is None:
+        gl_arr = gt_label._value if isinstance(gt_label, Tensor) \
+            else jnp.asarray(gt_label)
+        gt_score = Tensor(jnp.ones(gl_arr.shape, jnp.float32))
+    return apply_op(f, x, gt_box, gt_label, gt_score)
+
+
+__all__ += ["yolo_loss"]
