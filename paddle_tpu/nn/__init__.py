@@ -17,6 +17,7 @@ from ..param_attr import ParamAttr            # noqa: F401
 from . import common, conv, norm, pooling, loss, transformer, rnn  # noqa
 from . import decode  # noqa
 from . import utils  # noqa
+from . import quant  # noqa
 
 # grad-clip classes live on the optimizer module; paddle exposes them
 # under paddle.nn as well (reference: python/paddle/nn/clip.py — verify)

