@@ -691,3 +691,84 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
 
 
 __all__ += ["yolo_loss"]
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference:
+    paddle.vision.ops.generate_proposals /
+    detection/generate_proposals_v2_op.* — verify). Per image: top
+    ``pre_nms_top_n`` objectness scores, center-size delta decode
+    against anchors (with variances), clip to image, drop boxes smaller
+    than ``min_size`` (scaled), greedy NMS, keep ``post_nms_top_n``.
+
+    scores (N, A, H, W); bbox_deltas (N, 4A, H, W); img_size (N, 2)
+    (h, w); anchors / variances (..., 4) flattened to (A*H*W, 4).
+    Host-side op: proposal counts are data-dependent (the reference's
+    GPU kernel likewise returns a LoD)."""
+    import numpy as np
+
+    def _np(t):
+        return np.asarray(t._value if isinstance(t, Tensor) else t)
+
+    sc = _np(scores).astype(np.float32)
+    bd = _np(bbox_deltas).astype(np.float32)
+    im = _np(img_size).astype(np.float32)
+    anc = _np(anchors).astype(np.float32).reshape(-1, 4)
+    var = _np(variances).astype(np.float32).reshape(-1, 4)
+    n, a, h, w = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_probs, nums = [], [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)        # (H*W*A,)
+        d = bd[i].reshape(a, 4, h, w).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s_i, d_i = s[order], d[order]
+        anc_i, var_i = anc[order], var[order]
+        aw = anc_i[:, 2] - anc_i[:, 0] + off
+        ah = anc_i[:, 3] - anc_i[:, 1] + off
+        acx = anc_i[:, 0] + aw * 0.5
+        acy = anc_i[:, 1] + ah * 0.5
+        cx = var_i[:, 0] * d_i[:, 0] * aw + acx
+        cy = var_i[:, 1] * d_i[:, 1] * ah + acy
+        bw = aw * np.exp(np.minimum(var_i[:, 2] * d_i[:, 2], 10.0))
+        bh = ah * np.exp(np.minimum(var_i[:, 3] * d_i[:, 3], 10.0))
+        x1 = cx - bw * 0.5
+        y1 = cy - bh * 0.5
+        x2 = cx + bw * 0.5 - off
+        y2 = cy + bh * 0.5 - off
+        ih, iw = im[i, 0], im[i, 1]
+        x1 = np.clip(x1, 0, iw - off)
+        y1 = np.clip(y1, 0, ih - off)
+        x2 = np.clip(x2, 0, iw - off)
+        y2 = np.clip(y2, 0, ih - off)
+        keep = ((x2 - x1 + off) >= min_size) & \
+            ((y2 - y1 + off) >= min_size)
+        boxes = np.stack([x1, y1, x2, y2], axis=1)[keep]
+        s_i = s_i[keep]
+        if len(boxes):
+            kept = nms(Tensor(jnp.asarray(boxes)),
+                       iou_threshold=nms_thresh,
+                       scores=Tensor(jnp.asarray(s_i)))
+            kept = np.asarray(kept._value)
+            kept = kept[kept >= 0][:post_nms_top_n]
+            boxes, s_i = boxes[kept], s_i[kept]
+        all_rois.append(boxes)
+        all_probs.append(s_i.reshape(-1, 1))
+        nums.append(len(boxes))
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, axis=0)
+                              if all_rois else np.zeros((0, 4))))
+    probs = Tensor(jnp.asarray(np.concatenate(all_probs, axis=0)
+                               if all_probs else np.zeros((0, 1))))
+    if return_rois_num:
+        return rois, probs, Tensor(jnp.asarray(np.asarray(nums,
+                                                          np.int32)))
+    return rois, probs
+
+
+__all__ += ["generate_proposals"]

@@ -295,3 +295,46 @@ class TestYoloLoss:
         # near-gt case ignores the confident cell -> strictly less
         # objectness penalty from that cell
         assert l_near[0] < l_far[0]
+
+
+class TestGenerateProposals:
+    def test_decode_clip_minsize_nms(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.ops import generate_proposals
+        # one image, 2x anchors on a 1x1 grid: a big and a tiny anchor
+        n, a, h, w = 1, 2, 1, 1
+        scores = np.array([[[[2.0]], [[1.0]]]], np.float32)  # (1,2,1,1)
+        deltas = np.zeros((1, 4 * a, 1, 1), np.float32)
+        anchors = np.array([[0, 0, 20, 20], [0, 0, 1, 1]], np.float32)
+        variances = np.ones_like(anchors)
+        rois, probs, num = generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[16, 16]], np.float32)),
+            paddle.to_tensor(anchors), paddle.to_tensor(variances),
+            min_size=4.0, return_rois_num=True)
+        r = rois.numpy()
+        # tiny anchor dropped by min_size; big one clipped to image
+        assert num.numpy().tolist() == [1]
+        np.testing.assert_allclose(r[0], [0, 0, 16, 16])
+        assert probs.numpy()[0, 0] == 2.0
+
+    def test_nms_suppresses_and_delta_moves(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.ops import generate_proposals
+        n, a = 1, 3
+        scores = np.array([[[[3.0]], [[2.0]], [[1.0]]]], np.float32)
+        deltas = np.zeros((1, 4 * a, 1, 1), np.float32)
+        deltas[0, 8] = 0.5      # anchor 2: dx=0.5 -> center shifts
+        anchors = np.array([[0, 0, 10, 10], [0, 0, 10, 10],
+                            [40, 40, 44, 44]], np.float32)
+        variances = np.ones_like(anchors)
+        rois, probs = generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[64, 64]], np.float32)),
+            paddle.to_tensor(anchors), paddle.to_tensor(variances),
+            min_size=1.0, nms_thresh=0.5)
+        r = rois.numpy()
+        # duplicate anchor suppressed -> 2 rois; anchor-2 center moved
+        # by dx * width = 0.5 * 4 = 2 px
+        assert r.shape[0] == 2
+        np.testing.assert_allclose(r[1], [42, 40, 46, 44])
