@@ -618,3 +618,169 @@ class RandomPerspective:
 
 
 __all__ += ["RandomAffine", "RandomPerspective"]
+
+
+# --------------------------------------------------------------------------
+# AutoAugment (reference: transforms.AutoAugment, ImageNet policy —
+# verify magnitude tables). Operates on HWC uint8-range float arrays;
+# geometric ops ride _warp_inverse_nearest, pixel ops are numpy.
+# --------------------------------------------------------------------------
+
+def _aa_affine(hwc, mat, fill):
+    return _warp_inverse_nearest(hwc, np.linalg.inv(mat), fill)
+
+
+def _aa_blend(a, b, alpha):
+    return a + (b - a) * alpha
+
+
+def _aa_apply(name, hwc, mag, fill=128):
+    h, w = hwc.shape[:2]
+    cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+    t_c = np.array([[1, 0, cx], [0, 1, cy], [0, 0, 1.]])
+    t_ci = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1.]])
+    x = hwc.astype(np.float32)
+    if name == "shearX":
+        m = np.array([[1, mag, 0], [0, 1, 0], [0, 0, 1.]])
+        return _aa_affine(x, t_c @ m @ t_ci, fill)
+    if name == "shearY":
+        m = np.array([[1, 0, 0], [mag, 1, 0], [0, 0, 1.]])
+        return _aa_affine(x, t_c @ m @ t_ci, fill)
+    if name == "translateX":
+        m = np.array([[1, 0, mag * w], [0, 1, 0], [0, 0, 1.]])
+        return _aa_affine(x, m, fill)
+    if name == "translateY":
+        m = np.array([[1, 0, 0], [0, 1, mag * h], [0, 0, 1.]])
+        return _aa_affine(x, m, fill)
+    if name == "rotate":
+        rad = np.deg2rad(mag)
+        cos, sin = np.cos(rad), np.sin(rad)
+        m = np.array([[cos, sin, 0], [-sin, cos, 0], [0, 0, 1.]])
+        return _aa_affine(x, t_c @ m @ t_ci, fill)
+    if name == "invert":
+        return 255.0 - x
+    if name == "solarize":
+        return np.where(x >= mag, 255.0 - x, x)
+    if name == "posterize":
+        bits = int(mag)
+        shift = 8 - bits
+        q = (x.astype(np.uint8) >> shift) << shift
+        return q.astype(np.float32)
+    if name == "autocontrast":
+        lo = x.min(axis=(0, 1), keepdims=True)
+        hi = x.max(axis=(0, 1), keepdims=True)
+        scale = 255.0 / np.maximum(hi - lo, 1e-6)
+        return np.where(hi > lo, (x - lo) * scale, x)
+    if name == "equalize":
+        out = np.empty_like(x)
+        for c in range(x.shape[2]):
+            ch = x[:, :, c].astype(np.uint8)
+            hist = np.bincount(ch.ravel(), minlength=256)
+            nz = hist[hist > 0]
+            if len(nz) <= 1:
+                out[:, :, c] = ch
+                continue
+            step = (hist.sum() - nz[-1]) // 255
+            if step == 0:
+                out[:, :, c] = ch
+                continue
+            lut = (np.cumsum(hist) - hist) // step
+            out[:, :, c] = np.clip(lut[ch], 0, 255)
+        return out.astype(np.float32)
+    if name == "contrast":
+        mean = x.mean()
+        return _aa_blend(np.full_like(x, mean), x, mag)
+    if name == "color":
+        gray = x @ np.array([0.299, 0.587, 0.114], np.float32) \
+            if x.shape[2] == 3 else x.mean(axis=2)
+        return _aa_blend(gray[..., None].repeat(x.shape[2], 2), x, mag)
+    if name == "brightness":
+        return _aa_blend(np.zeros_like(x), x, mag)
+    if name == "sharpness":
+        k = np.array([[1, 1, 1], [1, 5, 1], [1, 1, 1]], np.float32) / 13
+        pad = np.pad(x, ((1, 1), (1, 1), (0, 0)), mode="edge")
+        sm = sum(k[i, j] * pad[i:i + x.shape[0], j:j + x.shape[1]]
+                 for i in range(3) for j in range(3))
+        out = _aa_blend(sm, x, mag)
+        out[0], out[-1] = x[0], x[-1]       # PIL keeps the border
+        out[:, 0], out[:, -1] = x[:, 0], x[:, -1]
+        return out
+    raise ValueError(f"unknown AutoAugment op {name!r}")
+
+
+# (op, prob, magnitude-bin 0..9) pairs — the published ImageNet policy
+_IMAGENET_POLICY = [
+    (("posterize", 0.4, 8), ("rotate", 0.6, 9)),
+    (("solarize", 0.6, 5), ("autocontrast", 0.6, 5)),
+    (("equalize", 0.8, 8), ("equalize", 0.6, 3)),
+    (("posterize", 0.6, 7), ("posterize", 0.6, 6)),
+    (("equalize", 0.4, 7), ("solarize", 0.2, 4)),
+    (("equalize", 0.4, 4), ("rotate", 0.8, 8)),
+    (("solarize", 0.6, 3), ("equalize", 0.6, 7)),
+    (("posterize", 0.8, 5), ("equalize", 1.0, 2)),
+    (("rotate", 0.2, 3), ("solarize", 0.6, 8)),
+    (("equalize", 0.6, 8), ("posterize", 0.4, 6)),
+    (("rotate", 0.8, 8), ("color", 0.4, 0)),
+    (("rotate", 0.4, 9), ("equalize", 0.6, 2)),
+    (("equalize", 0.0, 7), ("equalize", 0.8, 8)),
+    (("invert", 0.6, 4), ("equalize", 1.0, 8)),
+    (("color", 0.6, 4), ("contrast", 1.0, 8)),
+    (("rotate", 0.8, 8), ("color", 1.0, 2)),
+    (("color", 0.8, 8), ("solarize", 0.8, 7)),
+    (("sharpness", 0.4, 7), ("invert", 0.6, 8)),
+    (("shearX", 0.6, 5), ("equalize", 1.0, 9)),
+    (("color", 0.4, 0), ("equalize", 0.6, 3)),
+    (("equalize", 0.4, 7), ("solarize", 0.2, 4)),
+    (("solarize", 0.6, 5), ("autocontrast", 0.6, 5)),
+    (("invert", 0.6, 4), ("equalize", 1.0, 8)),
+    (("color", 0.6, 4), ("contrast", 1.0, 8)),
+    (("equalize", 0.8, 8), ("equalize", 0.6, 3)),
+]
+
+_AA_RANGES = {
+    "shearX": np.linspace(0, 0.3, 10),
+    "shearY": np.linspace(0, 0.3, 10),
+    "translateX": np.linspace(0, 150.0 / 331.0, 10),
+    "translateY": np.linspace(0, 150.0 / 331.0, 10),
+    "rotate": np.linspace(0, 30, 10),
+    "solarize": np.linspace(256, 0, 10),
+    "posterize": np.round(np.linspace(8, 4, 10)),
+    "contrast": 1.0 + np.linspace(0, 0.9, 10),
+    "color": 1.0 + np.linspace(0, 0.9, 10),
+    "brightness": 1.0 + np.linspace(0, 0.9, 10),
+    "sharpness": 1.0 + np.linspace(0, 0.9, 10),
+    "autocontrast": np.zeros(10),
+    "equalize": np.zeros(10),
+    "invert": np.zeros(10),
+}
+_AA_SIGNED = {"shearX", "shearY", "translateX", "translateY", "rotate"}
+
+
+class AutoAugment:
+    """AutoAugment with the published ImageNet policy (reference:
+    transforms.AutoAugment — verify): per call, one random sub-policy's
+    two (op, prob, magnitude) steps are applied. Magnitudes of the
+    geometric ops are sign-randomized as in the paper."""
+
+    def __init__(self, policy="imagenet", fill=128):
+        if policy != "imagenet":
+            raise NotImplementedError(
+                f"AutoAugment(policy={policy!r}): only 'imagenet'")
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = _arr(img).astype(np.float32)
+        chw, hwc = _hwc_view(arr)
+        sub = _IMAGENET_POLICY[np.random.randint(len(_IMAGENET_POLICY))]
+        for op, prob, bin_ in sub:
+            if np.random.rand() > prob:
+                continue
+            mag = float(_AA_RANGES[op][bin_])
+            if op in _AA_SIGNED and np.random.rand() < 0.5:
+                mag = -mag
+            hwc = _aa_apply(op, hwc, mag, self.fill)
+        out = np.clip(hwc, 0, 255)
+        return _ret(_back(out, chw), img)
+
+
+__all__ += ["AutoAugment"]

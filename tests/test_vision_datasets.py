@@ -338,3 +338,47 @@ class TestGenerateProposals:
         # by dx * width = 0.5 * 4 = 2 px
         assert r.shape[0] == 2
         np.testing.assert_allclose(r[1], [42, 40, 46, 44])
+
+
+class TestAutoAugment:
+    def test_runs_and_preserves_shape_range(self):
+        from paddle_tpu.vision import transforms as T
+        rs = np.random.RandomState(0)
+        img = (rs.rand(8, 8, 3) * 255).astype(np.float32)
+        aa = T.AutoAugment()
+        np.random.seed(0)
+        outs = [aa(img) for _ in range(10)]
+        for o in outs:
+            assert o.shape == img.shape
+            assert o.min() >= 0 and o.max() <= 255
+        # at least one sub-policy draw changes the image
+        assert any(not np.allclose(o, img) for o in outs)
+
+    def test_individual_ops_semantics(self):
+        from paddle_tpu.vision.transforms import _aa_apply
+        img = np.arange(27, dtype=np.float32).reshape(3, 3, 3)
+        np.testing.assert_allclose(_aa_apply("invert", img, 0),
+                                   255.0 - img)
+        # solarize threshold 10: values >= 10 inverted
+        sol = _aa_apply("solarize", img, 10)
+        assert sol[0, 0, 0] == img[0, 0, 0]          # 0 < 10 unchanged
+        assert sol[2, 2, 2] == 255.0 - img[2, 2, 2]  # 26 inverted
+        # posterize to 1 bit: only values >= 128 keep the top bit
+        post = _aa_apply("posterize", np.full((2, 2, 3), 200.0), 1)
+        assert np.all(post == 128.0)
+        # autocontrast stretches to the full range
+        ac = _aa_apply("autocontrast", img, 0)
+        assert ac.min() == 0 and ac.max() == 255
+        # contrast magnitude 1.0 is identity
+        np.testing.assert_allclose(_aa_apply("contrast", img, 1.0), img,
+                                   atol=1e-4)
+        # brightness 0 is black
+        np.testing.assert_allclose(_aa_apply("brightness", img, 0.0),
+                                   np.zeros_like(img))
+        # rotate 90 == rot90 (shared warp convention)
+        np.testing.assert_allclose(_aa_apply("rotate", img, 90.0),
+                                   np.rot90(img, 1), atol=1e-4)
+        # equalize of a constant image is itself
+        const = np.full((4, 4, 3), 7.0, np.float32)
+        np.testing.assert_allclose(_aa_apply("equalize", const, 0),
+                                   const)
