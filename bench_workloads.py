@@ -371,8 +371,34 @@ def sdxl_unet():
     return out
 
 
+def llama_serve():
+    """Continuous-batching serving throughput (paddle_tpu/serving/):
+    mixed-length staggered request stream through the slot-pool engine
+    vs static-batch generate() — the serving analogue of the training
+    workloads' tok/s. TINY runs the same machinery on llama-tiny."""
+    from bench import _bench_continuous_decode
+    from paddle_tpu.models.llama import LlamaConfig, llama_tiny_config
+
+    if TINY:
+        cfg = llama_tiny_config(tensor_parallel=False)
+        r = _bench_continuous_decode(cfg, num_slots=2, decode_block=4,
+                                     long_new=12, short_new=4)
+    else:
+        # the 0.27B bench config: serving throughput at a real size
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_hidden_layers=16, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=1024,
+            tensor_parallel=False)
+        r = _bench_continuous_decode(cfg, num_slots=8, decode_block=8)
+    return {"workload": ("llama_serve_tiny_smoke" if TINY
+                         else "llama_serve_continuous"),
+            "tokens_per_sec": r["decode_tokens_per_sec"], **r}
+
+
 WORKLOADS = {"resnet50": resnet50, "bert_base": bert_base,
-             "ernie_moe": ernie_moe, "sdxl_unet": sdxl_unet}
+             "ernie_moe": ernie_moe, "sdxl_unet": sdxl_unet,
+             "llama_serve": llama_serve}
 
 
 if __name__ == "__main__":

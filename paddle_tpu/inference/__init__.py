@@ -254,7 +254,10 @@ class Predictor:
 
 def export_decoder(model, path: str, batch: int, prompt_len: int,
                    max_len: int, temperature: float = 0.0,
-                   top_k: int = 0, top_p: float = 1.0):
+                   top_k: int = 0, top_p: float = 1.0,
+                   engine_slots: Optional[int] = None,
+                   engine_decode_block: int = 8,
+                   engine_prompt_buckets: Sequence[int] = (16, 32)):
     """AOT-export the autoregressive serving path of a causal LM: TWO
     StableHLO programs — prefill (prompt → first token + KV cache) and
     decode step (token, cache, pos → next token, cache) — plus weights
@@ -266,7 +269,13 @@ def export_decoder(model, path: str, batch: int, prompt_len: int,
     as GenerationMixin.generate is exported twice — once specialized to
     the prompt block at pos=0 (prefill, cache zero-initialized inside),
     once to a single token — so in-process and served decoding share one
-    implementation."""
+    implementation.
+
+    ``engine_slots``: additionally export the continuous-batching
+    engine's programs (the slot-pool decode block over
+    ``engine_slots`` × ``max_len`` caches, plus one prefill per prompt
+    bucket) so ``GenerationPredictor.serve()`` runs the SAME serving
+    engine from the artifact alone — see ``paddle_tpu.serving``."""
     from ..models.generation import build_decode_step
     from ..tensor import Tensor
 
@@ -307,6 +316,49 @@ def export_decoder(model, path: str, batch: int, prompt_len: int,
         "gen_config": {"batch": batch, "prompt_len": prompt_len,
                        "max_len": max_len, **sample_kwargs},
     }
+    if engine_slots is not None:
+        from ..serving.engine import (build_slot_block_fn,
+                                      build_slot_prefill_fn,
+                                      init_slot_state)
+        pool0 = model.init_kv_cache(engine_slots, max_len)
+        pflat, ptree = jax.tree.flatten(
+            pool0, is_leaf=lambda x: isinstance(x, Tensor))
+        eng_holder = {"tree": ptree}
+        # per-slot sampling rides the state arrays — the exported block
+        # serves every sampling config, so sample_kwargs=None here
+        eng_pure = build_decode_step(model, None, eng_holder)
+        pool_specs = tuple(jax.ShapeDtypeStruct(c._value.shape,
+                                                c._value.dtype)
+                           for c in pflat)
+        row_specs = tuple(((1,) + s.shape[1:], s.dtype)
+                          for s in pool_specs)
+        state_specs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            init_slot_state(engine_slots))
+        block_fn = build_slot_block_fn(eng_pure, engine_decode_block)
+        exp_block = jax.export.export(jax.jit(block_fn))(
+            pspecs, bspecs, pool_specs, state_specs)
+        prefills = {}
+        for lb in sorted(set(int(b) for b in engine_prompt_buckets)):
+            pre = build_slot_prefill_fn(eng_pure, row_specs)
+            prefills[lb] = jax.export.export(jax.jit(pre))(
+                pspecs, bspecs,
+                jax.ShapeDtypeStruct((1, lb), jnp.int32),
+                jax.ShapeDtypeStruct((1,), jnp.int32),
+                jax.ShapeDtypeStruct((2,), jnp.uint32),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.float32)).serialize()
+        blob["engine"] = {
+            "block": exp_block.serialize(),
+            "prefill": prefills,
+            "pool_specs": [(tuple(s.shape), str(np.dtype(s.dtype)))
+                           for s in pool_specs],
+            "config": {"num_slots": engine_slots, "max_len": max_len,
+                       "decode_block": engine_decode_block,
+                       "prompt_buckets": sorted(
+                           int(b) for b in engine_prompt_buckets)},
+        }
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     out = path + ".pdgen"
     with open(out, "wb") as f:
@@ -328,6 +380,8 @@ class GenerationPredictor:
         self._params = [jnp.asarray(v) for v in blob["params"]]
         self._buffers = [jnp.asarray(v) for v in blob["buffers"]]
         self.gen_config = blob["gen_config"]
+        self._engine_blob = blob if "engine" in blob else None
+        self._server = None
 
     def generate(self, input_ids: np.ndarray, max_new_tokens: int = 20,
                  seed: int = 0) -> np.ndarray:
@@ -360,6 +414,33 @@ class GenerationPredictor:
             toks.append(tok)
         gen = jnp.stack(toks, axis=1)
         return np.asarray(jnp.concatenate([ids, gen], axis=1))
+
+    def serve(self, requests, run: bool = True):
+        """Continuous-batching serving from the artifact alone: builds
+        the SAME ``serving.Server`` loop over the exported slot-pool
+        engine programs (requires ``export_decoder(...,
+        engine_slots=N)``). ``requests``: iterable of dicts with keys
+        matching :meth:`serving.Server.submit` (``prompt`` required).
+        Returns the Server (``run=False``) or its results dict."""
+        if self._engine_blob is None:
+            raise ValueError(
+                "this artifact has no engine programs; re-export with "
+                "export_decoder(..., engine_slots=N)")
+        from ..serving import ContinuousBatchingEngine, Server
+        from ..serving.engine import ArtifactStepBackend
+        if self._server is None:
+            backend = ArtifactStepBackend(self._engine_blob)
+            engine = ContinuousBatchingEngine(
+                backend=backend,
+                prompt_buckets=self._engine_blob["engine"]["config"]
+                ["prompt_buckets"])
+            self._server = Server(engine)
+        server = self._server
+        for req in requests:
+            server.submit(**dict(req))
+        if not run:
+            return server
+        return server.run_until_idle()
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -24,7 +24,8 @@ import numpy as np
 from .. import framework
 from ..tensor import Tensor
 
-__all__ = ["GenerationMixin", "sample_logits", "build_decode_step"]
+__all__ = ["GenerationMixin", "sample_logits", "build_decode_step",
+           "forward_accepts_pad"]
 
 
 def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
@@ -34,17 +35,29 @@ def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
         return jnp.argmax(logits, axis=-1)
     logits = logits / jnp.asarray(temperature, logits.dtype)
     v = logits.shape[-1]
-    if top_k and 0 < top_k < v:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+    want_k = bool(top_k) and 0 < top_k < v
+    if want_k and top_p >= 1.0:
+        # only the kth value is needed: lax.top_k (O(V·k) selection)
+        # instead of a full O(V log V) sort
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
+    elif top_p < 1.0:
+        # ONE descending sorted pass serves both filters: the top-k
+        # threshold is sorted[k-1], and masking values < kth inside the
+        # sorted array equals re-sorting the filtered logits (the kept
+        # prefix is unchanged, the dropped tail becomes -inf)
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        if want_k:
+            kth = sorted_desc[..., top_k - 1][..., None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+            sorted_desc = jnp.where(sorted_desc < kth, -jnp.inf,
+                                    sorted_desc)
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         # keep the smallest set with cumulative prob >= top_p (always
         # keep the best token)
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1)
 
@@ -61,8 +74,18 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
     ``pad`` (b,) int32: per-row LEFT-padding counts for ragged batches
     (reference decoding handles padded batches — SURVEY §3.5). Rows'
     RoPE positions are shifted back by their pad count and cache slots
-    below ``pad`` are masked out of every later attention."""
+    below ``pad`` are masked out of every later attention.
+
+    ``posv`` may also be a (b,) vector — per-row write offsets for the
+    continuous-batching slot pool (serving/): each row advances its own
+    timeline, so one compiled step serves slots at arbitrary decode
+    depths. Per-row writes vmap the dynamic_update_slice over the batch
+    dim; the causal mask broadcasts per row."""
     b, s, h, d = qv.shape
+    posv = jnp.asarray(posv, jnp.int32)
+    per_row = posv.ndim == 1                  # (b,) slot-pool positions
+    if per_row and pad is None:
+        pad = jnp.zeros((b,), jnp.int32)
     if cos is not None:
         if pad is None:
             from ..ops.pallas.fused import fused_rope
@@ -74,8 +97,9 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
         else:
             # per-row positions: real-token index = slot - pad  (left
             # padding keeps real tokens contiguous at the end)
+            p2 = posv[:, None] if per_row else posv
             positions = jnp.clip(
-                posv + jnp.arange(s)[None, :] - pad[:, None], 0, None)
+                p2 + jnp.arange(s)[None, :] - pad[:, None], 0, None)
             c = cos[positions].astype(qv.dtype)      # (b, s, d)
             sn = sin[positions].astype(qv.dtype)
 
@@ -84,21 +108,38 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
                 rot = jnp.concatenate([-x2, x1], axis=-1)
                 return x * c[:, :, None, :] + rot * sn[:, :, None, :]
             qv, kv_ = rope(qv), rope(kv_)
-    ck = jax.lax.dynamic_update_slice(ckv, kv_.astype(ckv.dtype),
-                                      (0, posv, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
-                                      (0, posv, 0, 0))
+    if per_row:
+        def upd(cachev, blockv):
+            return jax.vmap(
+                lambda cr, xr, p: jax.lax.dynamic_update_slice(
+                    cr, xr, (p, 0, 0)))(cachev,
+                                        blockv.astype(cachev.dtype),
+                                        posv)
+        ck = upd(ckv, kv_)
+        cv = upd(cvv, vv)
+    else:
+        ck = jax.lax.dynamic_update_slice(ckv, kv_.astype(ckv.dtype),
+                                          (0, posv, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
+                                          (0, posv, 0, 0))
     kvh = ck.shape[2]
     g = h // kvh
     qg = qv.reshape(b, s, kvh, g, d).astype(jnp.float32)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg,
                         ck.astype(jnp.float32)) * scale
     t_idx = jnp.arange(ck.shape[1])
-    q_idx = posv + jnp.arange(s)
-    mask = t_idx[None, :] <= q_idx[:, None]            # (s, T) causal
-    if window is not None:                     # sliding window: last W
-        mask = mask & (t_idx[None, :] > q_idx[:, None] - int(window))
-    mask = mask[None]                                  # (1|b, s, T)
+    if per_row:
+        q_idx = posv[:, None] + jnp.arange(s)[None, :]     # (b, s)
+        mask = t_idx[None, None, :] <= q_idx[:, :, None]   # (b, s, T)
+        if window is not None:
+            mask = mask & (t_idx[None, None, :]
+                           > q_idx[:, :, None] - int(window))
+    else:
+        q_idx = posv + jnp.arange(s)
+        mask = t_idx[None, :] <= q_idx[:, None]        # (s, T) causal
+        if window is not None:                 # sliding window: last W
+            mask = mask & (t_idx[None, :] > q_idx[:, None] - int(window))
+        mask = mask[None]                              # (1|b, s, T)
     if pad is not None:                        # padded slots never attend
         mask = mask & (t_idx[None, None, :] >= pad[:, None, None])
     scores = jnp.where(mask[:, None, None], scores,
@@ -106,6 +147,18 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
     probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
     out = jnp.einsum("bkgst,btkd->bskgd", probs, cv)
     return out.reshape(b, s, h, d).astype(qv.dtype), ck, cv
+
+
+def forward_accepts_pad(cls) -> bool:
+    """Whether ``cls.forward`` takes per-row ``pad`` counts (ragged /
+    slot-pool decode). The inspect.signature probe is cached per class —
+    it previously ran on every ragged generate() call."""
+    cached = cls.__dict__.get("_fwd_accepts_pad")
+    if cached is None:
+        import inspect
+        cached = "pad" in inspect.signature(cls.forward).parameters
+        cls._fwd_accepts_pad = cached   # per-class; subclasses re-probe
+    return cached
 
 
 def build_decode_step(model, sample_kwargs, tree_holder):
@@ -296,7 +349,8 @@ class GenerationMixin:
                  eos_token_id: Optional[int] = None, seed: int = 0,
                  max_length: Optional[int] = None, num_beams: int = 1,
                  length_penalty: float = 0.0, attention_mask=None,
-                 use_scan_decode: Optional[bool] = None):
+                 use_scan_decode: Optional[bool] = None,
+                 eos_check_every: int = 8):
         """Greedy (temperature<=0 / do_sample=False), sampled, or
         beam-search (num_beams>1) decoding with a preallocated KV cache
         and one jitted decode step.
@@ -306,6 +360,14 @@ class GenerationMixin:
         masking make batched ragged decode match per-sequence decode
         exactly (reference: PaddleNLP padded-batch decoding — verify).
 
+        ``eos_check_every``: the eager loop's all-rows-finished exit
+        needs a device→host sync (``bool(finished.all())``); checking
+        only every N steps keeps dispatch pipelined. The output is
+        identical either way — the return is ALWAYS (b, s+new) with
+        finished rows eos-padded (an early exit pads the remaining
+        columns in one shot instead of decoding them) — at most N-1
+        extra masked decode steps run after the last row finishes.
+
         Returns (b, s+new) int Tensor of prompt + generated ids (rows
         that hit ``eos_token_id`` are padded with eos)."""
         ids = input_ids if isinstance(input_ids, Tensor) \
@@ -313,9 +375,7 @@ class GenerationMixin:
         b, s = ids.shape
         pad = None
         if attention_mask is not None:
-            import inspect
-            if "pad" not in inspect.signature(
-                    type(self).forward).parameters:
+            if not forward_accepts_pad(type(self)):
                 raise ValueError(
                     f"{type(self).__name__}.forward does not accept "
                     "per-row pad counts — ragged (attention_mask) "
@@ -415,7 +475,20 @@ class GenerationMixin:
                 tok = jnp.where(finished, eos_token_id, tok)
                 finished = finished | (tok == eos_token_id)
             out_tokens.append(tok)
-            if eos_token_id is not None and bool(finished.all()):
+            # bool(finished.all()) forces a device→host round-trip that
+            # stalls the dispatch pipeline — poll it only every
+            # eos_check_every steps (output semantics are unchanged:
+            # finished rows already pad with eos)
+            if eos_token_id is not None and \
+                    i % max(1, eos_check_every) == 0 and \
+                    bool(finished.all()):
                 break
         gen = jnp.stack(out_tokens, axis=1)
+        if len(out_tokens) < max_new:
+            # early eos exit: the contract is a STATIC (b, s+new) shape
+            # with finished rows eos-padded — emit the skipped columns
+            # directly instead of decoding them
+            gen = jnp.concatenate(
+                [gen, jnp.full((b, max_new - len(out_tokens)),
+                               eos_token_id, gen.dtype)], axis=1)
         return Tensor(jnp.concatenate([ids_arr, gen], axis=1))

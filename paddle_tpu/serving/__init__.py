@@ -1,0 +1,18 @@
+"""Continuous-batching serving engine (slot-pool in-graph decode).
+
+``ContinuousBatchingEngine`` keeps S sequence slots alive inside ONE
+jitted decode program; ``Scheduler`` admits ragged requests into free
+slots; ``Server`` is the loop + metrics. Greedy streams are
+bit-identical to per-request ``generate()`` calls while sustaining
+strictly higher aggregate tokens/s on mixed-length traffic. The AOT
+path (``inference.export_decoder(engine_slots=...)`` +
+``GenerationPredictor.serve``) serves the same engine from the
+serialized artifact alone."""
+from .engine import (ArtifactStepBackend, ContinuousBatchingEngine,
+                     ModelStepBackend, slot_sample_logits)
+from .scheduler import Request, Scheduler
+from .server import Server
+
+__all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
+           "ArtifactStepBackend", "Request", "Scheduler", "Server",
+           "slot_sample_logits"]

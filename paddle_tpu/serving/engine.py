@@ -1,0 +1,445 @@
+"""Continuous-batching decode engine: a fixed pool of S sequence slots
+kept alive inside ONE jitted step.
+
+Reference parity: the reference serving stack's fused_multi_transformer
+decode loop + PaddleNLP's dynamic-batching inference server (SURVEY §2.1
+Inference, §3.5 AnalysisPredictor — verify); the design is the
+vLLM-style continuous batching discipline restated under the repo's
+static-shape rules.
+
+TPU-native design: the KV cache is preallocated at
+``(S, max_len, kv_heads, head_dim)`` and never reshapes — a retiring
+request frees its SLOT, not its memory. Per-slot ``pos``/``pad``/
+``live``/``eos``/``remaining``/rng-key/sampling-param state rides
+in-graph as (S,) arrays, so ONE compiled program (a ``lax.scan`` of the
+shared decode step over ``decode_block`` tokens) serves every mix of
+request depths, greedy/sampled traffic, and admission pattern — zero
+recompiles across the stream. Admission reuses the existing shared
+prefill/decode step from ``models/generation`` at batch 1 (prompt
+left-padded to a bucket length), then splices the prefilled row into
+the pool with ``lax.dynamic_update_slice`` on the batch dim while the
+other slots' cache rows stay untouched (prefill-insert). The defining
+invariant: a continuously-batched stream of ragged greedy requests is
+bit-identical to per-request ``generate()`` calls.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
+           "ArtifactStepBackend", "slot_sample_logits", "init_slot_state",
+           "build_slot_block_fn", "build_slot_prefill_fn"]
+
+
+def slot_sample_logits(logits, keys, temperature, top_k, top_p):
+    """Per-slot sampling over (S, V) logits (or log-probs — per-row
+    shifts cancel in every branch): ``temperature``/``top_k``/``top_p``
+    are (S,) arrays so one compiled program serves mixed greedy/sampled
+    traffic. Greedy rows (temperature <= 0) take argmax; sampled rows
+    share ONE descending sort for both the top-k threshold and the
+    top-p cutoff, then draw categorically with per-row keys."""
+    S, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy = temperature <= 0.0
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    t = jnp.where(greedy, jnp.float32(1.0),
+                  temperature.astype(jnp.float32))
+    scaled = logits / t[:, None]
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    k = jnp.clip(top_k.astype(jnp.int32), 0, V)
+    use_k = (k > 0) & (k < V)
+    kth = jnp.take_along_axis(sorted_desc,
+                              jnp.maximum(k - 1, 0)[:, None], axis=-1)
+    kth = jnp.where(use_k[:, None], kth, -jnp.inf)
+    filt = jnp.where(scaled < kth, -jnp.inf, scaled)
+    # masking below-kth values inside the sorted array == re-sorting the
+    # filtered row (kept prefix unchanged, dropped tail -> -inf)
+    sorted_f = jnp.where(sorted_desc < kth, -jnp.inf, sorted_desc)
+    probs = jax.nn.softmax(sorted_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.clip(
+        jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True), 0, V - 1)
+    cutoff = jnp.take_along_axis(sorted_f, cutoff_idx, axis=-1)
+    cutoff = jnp.where((top_p < 1.0)[:, None], cutoff, -jnp.inf)
+    filt = jnp.where(filt < cutoff, -jnp.inf, filt)
+    sampled = jax.vmap(
+        lambda kk, row: jax.random.categorical(kk, row))(keys, filt)
+    return jnp.where(greedy, greedy_tok, sampled.astype(jnp.int32))
+
+
+def init_slot_state(num_slots: int) -> Dict[str, jnp.ndarray]:
+    """Fresh all-slots-free in-graph state pytree."""
+    S = num_slots
+    return {
+        "tok": jnp.zeros((S,), jnp.int32),
+        "pos": jnp.zeros((S,), jnp.int32),
+        "pad": jnp.zeros((S,), jnp.int32),
+        "live": jnp.zeros((S,), bool),
+        "eos": jnp.full((S,), -1, jnp.int32),
+        "remaining": jnp.zeros((S,), jnp.int32),
+        "key": jnp.zeros((S, 2), jnp.uint32),
+        "temp": jnp.zeros((S,), jnp.float32),
+        "topk": jnp.zeros((S,), jnp.int32),
+        "topp": jnp.ones((S,), jnp.float32),
+    }
+
+
+def build_slot_block_fn(pure, block: int, trace_counter=None):
+    """The engine's ONE decode program: ``lax.scan`` of the shared step
+    over ``block`` tokens with per-slot positions. Each scan iteration:
+    per-slot key split -> forward (vector ``pos``, per-slot ``pad``) ->
+    per-slot sampling -> in-graph eos/budget retirement (a finished
+    slot's ``live`` drops and its pos/tok freeze — it is masked junk
+    until the host refills it between blocks). Emits the (block, S)
+    token matrix plus per-step live-slot counts (the occupancy/tok-s
+    numerators), so the host syncs ONCE per block."""
+
+    def block_fn(pv, bv, cache_flat, state):
+        if trace_counter is not None:       # runs only while tracing
+            trace_counter[0] += 1
+
+        def body(carry, _):
+            cf, st = carry
+            sp = jax.vmap(jax.random.split)(st["key"])     # (S, 2, 2)
+            new_key, sub = sp[:, 0], sp[:, 1]
+            logp, cf = pure(pv, bv, st["tok"][:, None], cf, st["pos"],
+                            None, st["pad"])
+            nxt = slot_sample_logits(logp, sub, st["temp"], st["topk"],
+                                     st["topp"])
+            live = st["live"]
+            hit = live & (st["eos"] >= 0) & (nxt == st["eos"])
+            rem = jnp.where(live, st["remaining"] - 1, st["remaining"])
+            rem = jnp.where(hit, 0, rem)
+            st2 = dict(st, tok=jnp.where(live, nxt, st["tok"]),
+                       pos=st["pos"] + live.astype(jnp.int32),
+                       remaining=rem, key=new_key,
+                       live=live & (rem > 0))
+            # ``live`` (the start-of-step mask) marks which rows of the
+            # token matrix are real emissions — an eos retirement zeroes
+            # ``remaining``, so the host must count emissions from this
+            # mask, not from remaining deltas
+            return (cf, st2), (nxt, live)
+
+        (cache_flat, state), (toks, lives) = jax.lax.scan(
+            body, (cache_flat, state), None, length=block)
+        return cache_flat, state, toks, lives
+
+    return block_fn
+
+
+def build_slot_prefill_fn(pure, row_specs):
+    """Batch-1 prefill of a prompt bucket into a fresh full-length cache
+    row (the row is spliced into the pool by the admit program). Reuses
+    the SAME shared step as ``generate()`` — prompt left-padded to the
+    bucket length, per-row pad counts mask the filler — so slot decode
+    is bit-identical to a standalone ``generate()`` call. The first
+    token is sampled in-graph with the request's own params (one
+    dispatch per admission, not two)."""
+
+    def prefill_fn(pv, bv, ids, pad, key, temp, topk, topp):
+        zero = tuple(jnp.zeros(shape, dtype) for shape, dtype in row_specs)
+        logp, row = pure(pv, bv, ids, zero, jnp.asarray(0, jnp.int32),
+                         None, pad)
+        tok0 = slot_sample_logits(logp, key[None], temp[None],
+                                  topk[None], topp[None])[0]
+        return tok0, row
+
+    return prefill_fn
+
+
+def _admit_fn(cache_flat, state, row_flat, slot, tok0, pos0, pad0, rem0,
+              eos0, temp0, topk0, topp0, key0):
+    """Splice a prefilled row into the pool (dynamic_update_slice on the
+    batch dim — other slots' rows untouched) and arm the slot's state.
+    ``slot`` is traced, so ONE compiled program serves every admission."""
+    new_cache = tuple(
+        jax.lax.dynamic_update_slice(c, r.astype(c.dtype),
+                                     (slot,) + (0,) * (c.ndim - 1))
+        for c, r in zip(cache_flat, row_flat))
+
+    def set1(a, v):
+        return a.at[slot].set(jnp.asarray(v, a.dtype))
+
+    new_state = dict(
+        state, tok=set1(state["tok"], tok0),
+        pos=set1(state["pos"], pos0), pad=set1(state["pad"], pad0),
+        live=set1(state["live"], rem0 > 0),
+        eos=set1(state["eos"], eos0),
+        remaining=set1(state["remaining"], rem0),
+        key=state["key"].at[slot].set(key0),
+        temp=set1(state["temp"], temp0),
+        topk=set1(state["topk"], topk0),
+        topp=set1(state["topp"], topp0))
+    return new_cache, new_state
+
+
+class ModelStepBackend:
+    """In-process backend: jits the slot block + per-bucket prefills
+    over a live model (the same pure step ``generate()`` uses)."""
+
+    def __init__(self, model, num_slots: int, max_len: int,
+                 decode_block: int):
+        from ..models.generation import (build_decode_step,
+                                         forward_accepts_pad)
+        from ..tensor import Tensor
+        if not forward_accepts_pad(type(model)):
+            raise ValueError(
+                f"{type(model).__name__}.forward does not accept per-row "
+                "pad counts — the slot pool needs ragged decode support")
+        self.num_slots, self.max_len = num_slots, max_len
+        self.block_size = decode_block
+        tree_holder = {"tree": None}
+        self._pure = build_decode_step(model, None, tree_holder)
+        cache0 = model.init_kv_cache(num_slots, max_len)
+        flat, tree = jax.tree.flatten(
+            cache0, is_leaf=lambda x: isinstance(x, Tensor))
+        tree_holder["tree"] = tree
+        self.pool_specs = tuple((c._value.shape, c._value.dtype)
+                                for c in flat)
+        self.row_specs = tuple(((1,) + shape[1:], dtype)
+                               for shape, dtype in self.pool_specs)
+        self._pv = [p._value for _, p in model.named_parameters()]
+        self._bv = [b._value for _, b in model.named_buffers()]
+        self.decode_traces = [0]
+        self._block_jit = jax.jit(
+            build_slot_block_fn(self._pure, decode_block,
+                                self.decode_traces),
+            donate_argnums=(2, 3))
+        self._prefill_jits: Dict[int, callable] = {}
+
+    def pool_cache(self):
+        return tuple(jnp.zeros(shape, dtype)
+                     for shape, dtype in self.pool_specs)
+
+    def decode_block(self, cache_flat, state):
+        return self._block_jit(self._pv, self._bv, cache_flat, state)
+
+    def prefill(self, bucket_len, ids, pad, key, temp, topk, topp):
+        fn = self._prefill_jits.get(bucket_len)
+        if fn is None:
+            fn = jax.jit(build_slot_prefill_fn(self._pure, self.row_specs))
+            self._prefill_jits[bucket_len] = fn
+        return fn(self._pv, self._bv, ids, pad, key, temp, topk, topp)
+
+
+class ArtifactStepBackend:
+    """AOT backend: the SAME engine programs, deserialized from an
+    ``export_decoder(..., engine_slots=...)`` artifact — no model code
+    or tracing needed on the serving host (reference: AnalysisPredictor
+    serving from the saved program alone)."""
+
+    def __init__(self, blob):
+        eng = blob["engine"]
+        cfgs = eng["config"]
+        self.num_slots = cfgs["num_slots"]
+        self.max_len = cfgs["max_len"]
+        self.block_size = cfgs["decode_block"]
+        self.pool_specs = tuple((tuple(shape), np.dtype(dtype))
+                                for shape, dtype in eng["pool_specs"])
+        self._block = jax.export.deserialize(eng["block"])
+        self._prefills = {int(k): jax.export.deserialize(v)
+                          for k, v in eng["prefill"].items()}
+        self._pv = [jnp.asarray(v) for v in blob["params"]]
+        self._bv = [jnp.asarray(v) for v in blob["buffers"]]
+        self.decode_traces = [1]     # one AOT-compiled decode program
+
+    def pool_cache(self):
+        return tuple(jnp.zeros(shape, dtype)
+                     for shape, dtype in self.pool_specs)
+
+    def decode_block(self, cache_flat, state):
+        return self._block.call(self._pv, self._bv, cache_flat, state)
+
+    def prefill(self, bucket_len, ids, pad, key, temp, topk, topp):
+        fn = self._prefills.get(int(bucket_len))
+        if fn is None:
+            raise ValueError(
+                f"prompt bucket {bucket_len} was not exported; available: "
+                f"{sorted(self._prefills)} — re-export with it in "
+                "engine_prompt_buckets")
+        return fn.call(self._pv, self._bv, ids, pad, key, temp, topk,
+                       topp)
+
+
+@dataclass
+class _SlotRun:
+    """Host-side bookkeeping for one in-flight request."""
+    request: object
+    tokens: List[int] = field(default_factory=list)
+    t_admit: float = 0.0
+    t_done: float = 0.0
+
+
+class ContinuousBatchingEngine:
+    """Slot-pool decode engine over a step backend. The host syncs with
+    the device once per ``decode_block`` tokens: it reads the (block, S)
+    token matrix plus the post-block ``remaining`` counters, harvests
+    retired requests, and refills free slots — the decode program itself
+    is compiled exactly once for the engine's lifetime."""
+
+    def __init__(self, model=None, num_slots: int = 4, max_len: int = 256,
+                 decode_block: int = 8,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 backend=None):
+        if backend is None:
+            if model is None:
+                raise ValueError("pass a model or a step backend")
+            backend = ModelStepBackend(model, num_slots, max_len,
+                                       decode_block)
+        self.backend = backend
+        self.num_slots = backend.num_slots
+        self.max_len = backend.max_len
+        self.decode_block = backend.block_size
+        self.prompt_buckets = tuple(sorted(prompt_buckets)) \
+            if prompt_buckets else None
+        self._admit_jit = jax.jit(_admit_fn, donate_argnums=(0, 1))
+        self.reset()
+
+    # -- lifecycle ---------------------------------------------------------
+    def reset(self):
+        """Free every slot and zero the counters (compiled programs are
+        kept — repeat streams never recompile)."""
+        self._cache = self.backend.pool_cache()
+        self._state = init_slot_state(self.num_slots)
+        self._slots: List[Optional[_SlotRun]] = [None] * self.num_slots
+        self._remaining_host = np.zeros((self.num_slots,), np.int64)
+        self._finished: List[_SlotRun] = []
+        self.steps = 0                # engine decode steps executed
+        self.tokens_emitted = 0       # useful tokens (incl. prefill's)
+        self.decode_tokens = 0        # live-slot decode steps only
+        self.slot_steps = 0           # S * steps (occupancy denominator)
+
+    # -- introspection -----------------------------------------------------
+    def free_slot_count(self) -> int:
+        return sum(1 for s in self._slots if s is None)
+
+    def has_live(self) -> bool:
+        return any(s is not None for s in self._slots)
+
+    def occupancy(self) -> float:
+        """Fraction of decode-block slot-steps that emitted a token
+        (prefill tokens live outside the pool and don't count here)."""
+        return self.decode_tokens / self.slot_steps if self.slot_steps \
+            else 0.0
+
+    def decode_compile_count(self) -> int:
+        """Number of times the decode-block program was traced/compiled
+        — the static-shape invariant holds iff this stays 1."""
+        return self.backend.decode_traces[0]
+
+    def bucket_len(self, prompt_len: int) -> int:
+        if self.prompt_buckets is None:
+            return prompt_len
+        for b in self.prompt_buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest bucket "
+            f"{self.prompt_buckets[-1]}")
+
+    def validate_request(self, prompt_len: int, max_new_tokens: int):
+        """Raise ValueError if the request can never fit a slot — run
+        at submit time so a bad request is rejected at the door instead
+        of aborting the serving loop mid-stream at admission."""
+        if prompt_len <= 0:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens}; must be >= 1")
+        lb = self.bucket_len(prompt_len)
+        if lb + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt bucket ({lb}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the slot capacity "
+                f"({self.max_len}); raise max_len or shorten the request")
+
+    # -- admission ---------------------------------------------------------
+    def admit(self, request) -> bool:
+        """Prefill the request's prompt (batch-1, left-padded to its
+        bucket) and splice the row into a free slot. Returns True if the
+        request already finished at admission (max_new==1 or eos on the
+        first token) — it then never occupies a slot."""
+        from ..profiler import RecordEvent
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        L = int(prompt.shape[0])
+        self.validate_request(L, request.max_new_tokens)
+        Lb = self.bucket_len(L)
+        slot = next((i for i, s in enumerate(self._slots) if s is None),
+                    None)
+        if slot is None:
+            raise RuntimeError("no free slot (scheduler bug)")
+        ids = np.zeros((1, Lb), np.int32)
+        ids[0, Lb - L:] = prompt
+        pad0 = Lb - L
+        key = jax.random.PRNGKey(request.seed)
+        key, sub = jax.random.split(key)      # generate()'s key schedule
+        temp = jnp.float32(request.temperature)   # <= 0 means greedy
+        topk = jnp.int32(request.top_k)
+        topp = jnp.float32(request.top_p)
+        with RecordEvent("serving.prefill"):
+            tok0_dev, row = self.backend.prefill(
+                Lb, jnp.asarray(ids), jnp.asarray([pad0], jnp.int32),
+                sub, temp, topk, topp)
+        tok0 = int(tok0_dev)
+        run = _SlotRun(request, tokens=[tok0], t_admit=time.perf_counter())
+        self.tokens_emitted += 1
+        eos = request.eos_token_id
+        rem0 = request.max_new_tokens - 1
+        if eos is not None and tok0 == eos:
+            rem0 = 0
+        if rem0 <= 0:
+            run.t_done = time.perf_counter()
+            self._finished.append(run)
+            return True
+        with RecordEvent("serving.admit"):
+            self._cache, self._state = self._admit_jit(
+                self._cache, self._state, row, jnp.int32(slot),
+                jnp.int32(tok0), jnp.int32(Lb), jnp.int32(pad0),
+                jnp.int32(rem0),
+                jnp.int32(-1 if eos is None else eos),
+                temp, topk, topp, key)
+        self._slots[slot] = run
+        self._remaining_host[slot] = rem0
+        return False
+
+    # -- decode ------------------------------------------------------------
+    def step_block(self):
+        """Run one compiled decode block over the pool, then sync ONCE:
+        pull the token matrix + remaining counters, credit each live
+        slot its emitted tokens, retire finished slots."""
+        from ..profiler import RecordEvent
+        if not self.has_live():
+            return
+        with RecordEvent("serving.decode_block"):
+            self._cache, self._state, toks, lives = \
+                self.backend.decode_block(self._cache, self._state)
+        toks_np = np.asarray(toks)                  # ONE host sync/block
+        lives_np = np.asarray(lives)                # (block, S)
+        rem_np = np.asarray(self._state["remaining"])
+        self.steps += self.decode_block
+        self.slot_steps += self.decode_block * self.num_slots
+        self.decode_tokens += int(lives_np.sum())
+        self.tokens_emitted += int(lives_np.sum())
+        now = time.perf_counter()
+        for slot, run in enumerate(self._slots):
+            if run is None:
+                continue
+            # live is monotone within a block (True rows are a prefix)
+            n = int(lives_np[:, slot].sum())
+            if n > 0:
+                run.tokens.extend(int(t) for t in toks_np[:n, slot])
+            self._remaining_host[slot] = rem_np[slot]
+            if rem_np[slot] == 0:
+                run.t_done = now
+                self._finished.append(run)
+                self._slots[slot] = None
+
+    def drain_finished(self) -> List[_SlotRun]:
+        done, self._finished = self._finished, []
+        return done

@@ -1,0 +1,84 @@
+"""Host-side request scheduler for the continuous-batching engine.
+
+Reference parity: the reference serving frontend's dynamic batching
+queue (SURVEY §2.1 Inference — verify). Admission is FIFO over an
+arrival-ordered queue with a max-wait batching knob: the scheduler can
+hold admissions until ``min_admit`` requests are queued (amortizing
+prefill dispatches) but never longer than ``max_wait_steps`` engine
+blocks past the oldest request's arrival — and it always releases when
+the engine would otherwise sit idle."""
+from __future__ import annotations
+
+import bisect
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["Request", "Scheduler"]
+
+
+@dataclass
+class Request:
+    """One generation request. ``temperature <= 0`` decodes greedily;
+    per-request sampling params ride the engine's per-slot state arrays,
+    so mixed greedy/sampled traffic shares one compiled program.
+    ``arrival_step``: engine-block clock tick at which the request
+    becomes visible (deterministic staggered-arrival testing)."""
+    request_id: int
+    prompt: np.ndarray
+    max_new_tokens: int = 20
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: Optional[int] = None
+    seed: int = 0
+    arrival_step: int = 0
+    t_submit: float = 0.0
+
+
+class Scheduler:
+    """FIFO admission queue + batching gate."""
+
+    def __init__(self, max_wait_steps: int = 0, min_admit: int = 1):
+        if min_admit < 1:
+            raise ValueError(f"min_admit={min_admit}; must be >= 1")
+        self.max_wait_steps = max_wait_steps
+        self.min_admit = min_admit
+        self._queue: List[Request] = []
+
+    def submit(self, request: Request):
+        # keep the queue sorted by arrival tick; insort_right preserves
+        # FIFO within a tick and costs O(log Q) per submit instead of a
+        # full re-sort (the north star is heavy traffic)
+        bisect.insort(self._queue, request,
+                      key=lambda r: r.arrival_step)
+
+    def pending(self) -> int:
+        return len(self._queue)
+
+    def next_arrival(self) -> Optional[int]:
+        return self._queue[0].arrival_step if self._queue else None
+
+    def pop_ready(self, now: int, free_slots: int,
+                  engine_idle: bool) -> List[Request]:
+        """Requests to admit this tick. The batching gate holds until
+        ``min_admit`` requests are visible OR the oldest visible request
+        has waited ``max_wait_steps`` ticks — unless the engine is idle
+        (no live slots), where holding would only add latency."""
+        if free_slots <= 0 or not self._queue:
+            return []
+        # the queue is arrival-sorted: visible requests are a prefix
+        n_visible = bisect.bisect_right(self._queue, now,
+                                        key=lambda r: r.arrival_step)
+        if n_visible == 0:
+            return []
+        oldest_wait = now - self._queue[0].arrival_step
+        gate_open = (n_visible >= self.min_admit
+                     or oldest_wait >= self.max_wait_steps
+                     or engine_idle)
+        if not gate_open:
+            return []
+        take = self._queue[:min(free_slots, n_visible)]
+        del self._queue[:len(take)]
+        return take

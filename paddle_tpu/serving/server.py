@@ -1,0 +1,104 @@
+"""Serving loop: Scheduler + ContinuousBatchingEngine + metrics.
+
+One iteration of the loop = one tick of the engine-block clock: admit
+whatever the scheduler releases into free slots, run one compiled
+decode block over the pool, harvest retired requests. Per-request
+latency and engine-level tokens/s / slot-occupancy counters are emitted
+as profiler RecordEvent spans (chrome-trace) and summarized by
+``stats()`` — the serving analogue of the training loop's MFU line."""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .engine import ContinuousBatchingEngine
+from .scheduler import Request, Scheduler
+
+__all__ = ["Server"]
+
+
+class Server:
+    """Continuous-batching server over an engine. ``submit()`` requests
+    (optionally with future ``arrival_step`` ticks), then
+    ``run_until_idle()`` — results match per-request ``generate()``:
+    prompt + generated ids, rows that hit eos padded with eos to
+    ``max_new_tokens`` (greedy traffic is bit-identical)."""
+
+    def __init__(self, engine: ContinuousBatchingEngine,
+                 scheduler: Optional[Scheduler] = None):
+        self.engine = engine
+        self.scheduler = scheduler or Scheduler()
+        self.results: Dict[int, np.ndarray] = {}
+        self.latencies: Dict[int, float] = {}
+        self._next_id = 0
+        self._clock = 0
+        self._wall = 0.0
+
+    def submit(self, prompt, max_new_tokens: int = 20,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, eos_token_id: Optional[int] = None,
+               seed: int = 0, arrival_step: int = 0) -> int:
+        """Queue one request; returns its id (key into ``results``).
+        Capacity is validated HERE — a request that can never fit a
+        slot is rejected at the door, not mid-stream at admission."""
+        prompt = np.asarray(prompt, np.int32)
+        self.engine.validate_request(int(prompt.size), max_new_tokens)
+        rid = self._next_id
+        self._next_id += 1
+        self.scheduler.submit(Request(
+            request_id=rid, prompt=np.asarray(prompt, np.int32),
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+            seed=seed, arrival_step=arrival_step,
+            t_submit=time.perf_counter()))
+        return rid
+
+    def _harvest(self):
+        now = time.perf_counter()
+        for run in self.engine.drain_finished():
+            req = run.request
+            toks = np.asarray(run.tokens, np.int32)
+            if len(toks) < req.max_new_tokens:
+                # retired early at eos: pad to max_new (generate parity)
+                toks = np.concatenate([toks, np.full(
+                    (req.max_new_tokens - len(toks),),
+                    req.eos_token_id, np.int32)])
+            self.results[req.request_id] = np.concatenate(
+                [np.asarray(req.prompt, np.int32).reshape(-1), toks])
+            self.latencies[req.request_id] = now - req.t_submit
+
+    def run_until_idle(self) -> Dict[int, np.ndarray]:
+        """Drive the loop until the queue is empty and every slot is
+        free; returns ``results``."""
+        t0 = time.perf_counter()
+        while self.scheduler.pending() or self.engine.has_live():
+            admitted = self.scheduler.pop_ready(
+                self._clock, self.engine.free_slot_count(),
+                engine_idle=not self.engine.has_live())
+            for req in admitted:
+                self.engine.admit(req)
+            if self.engine.has_live():
+                self.engine.step_block()
+            self._clock += 1
+            self._harvest()
+        self._wall += time.perf_counter() - t0
+        return self.results
+
+    def stats(self) -> dict:
+        lat = list(self.latencies.values())
+        eng = self.engine
+        return {
+            "requests_completed": len(self.results),
+            "tokens_emitted": eng.tokens_emitted,
+            "decode_steps": eng.steps,
+            "slot_occupancy": round(eng.occupancy(), 4),
+            "wall_s": round(self._wall, 4),
+            "tokens_per_sec": round(eng.tokens_emitted / self._wall, 1)
+            if self._wall else 0.0,
+            "decode_compile_count": eng.decode_compile_count(),
+            "latency_avg_s": round(float(np.mean(lat)), 4) if lat else 0.0,
+            "latency_p95_s": round(float(np.percentile(lat, 95)), 4)
+            if lat else 0.0,
+        }

@@ -11,7 +11,8 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-NAMES = ["resnet50", "bert_base", "ernie_moe", "sdxl_unet"]
+NAMES = ["resnet50", "bert_base", "ernie_moe", "sdxl_unet",
+         "llama_serve"]
 
 
 def test_workload_tiny_all():
@@ -40,5 +41,8 @@ def test_workload_tiny_all():
         assert r["workload"].startswith(name.split("_")[0][:6])
         if name == "sdxl_unet":
             assert r["infer_step_ms"] > 0 and r["train_step_ms"] > 0
+        elif name == "llama_serve":
+            assert r["tokens_per_sec"] > 0
+            assert r["decode_compile_count"] == 1
         else:
             assert r["step_ms"] > 0
