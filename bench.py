@@ -34,6 +34,7 @@ TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
 # BENCH_JSON emission preserves earlier stages if the child dies
 TPU_DEADLINE_S = float(os.environ.get("BENCH_TPU_DEADLINE_S", "1100"))
 CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_DEADLINE_S", "420"))
+COMMS_DEADLINE_S = float(os.environ.get("BENCH_COMMS_DEADLINE_S", "240"))
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
 PROBE_DEADLINE_S = float(os.environ.get("BENCH_PROBE_DEADLINE_S", "90"))
@@ -395,10 +396,6 @@ def _child_tpu():
                 sel["remat"] = "selective"
                 big = sel
         _emit(small, big, None, errors)
-        # r5 window-1 lesson: stages leak HBM into their successors —
-        # big-splash and decode both hit runtime RESOURCE_EXHAUSTED with
-        # three stages' buffers resident, and the OOM crashes degraded
-        # the tunnel's compile service for every child after (the r02
         # sdpa kernel A/B on the headline shape: PROFILE_r03 charges the
         # equal-heads jax_flash route 20.5% of self-time plus a 5.7%
         # HBM-bound broadcast_in_dim in its bwd; splash (block-sparse
@@ -540,8 +537,14 @@ def _run_child(mode: str, deadline: float):
     The child emits BENCH_JSON after every completed stage — the LAST
     line wins, and a deadline kill still salvages the partial result."""
     env = dict(os.environ)
-    if mode == "--child-cpu":
+    if mode in ("--child-cpu", "--child-comms"):
         env["JAX_PLATFORMS"] = "cpu"
+    if mode == "--child-comms":
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     stdout, stderr, rc = "", "", "killed"
     # deadline → SIGINT first (KeyboardInterrupt lets the axon client
     # release its exclusive chip claim; a hard kill mid-compile wedges
@@ -617,6 +620,38 @@ def _last_measured_tpu():
         return None
 
 
+def _child_comms():
+    """comms stage: the hierarchical/quantized collective microbench
+    (distributed/collectives/) over 8 simulated CPU devices. The round
+    owns one chip, so there is no real multi-chip ICI to time — the
+    stage pins wire-format bytes, algorithmic bandwidth and the
+    quantized-vs-fp32 error contract every round, and becomes the comm
+    headline the day a multi-chip window exists."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.distributed.collectives import run_comms_bench
+    out = run_comms_bench(
+        size_mb=float(os.environ.get("BENCH_COMMS_MB", "2")))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_comms(result, budget_s=None):
+    """Merge the comms stage into the headline JSON (its own child so a
+    wedged collective can never cost the training headline). The stage
+    is strictly additive: with the wall budget nearly spent it is
+    SKIPPED rather than risking the outer `timeout` killing the parent
+    before the already-measured result prints."""
+    deadline = COMMS_DEADLINE_S if budget_s is None \
+        else min(COMMS_DEADLINE_S, budget_s - 15)
+    if deadline < 30:
+        result["comms"] = {"skipped": "wall budget exhausted"}
+        return result
+    comms, err = _run_child("--child-comms", deadline)
+    result["comms"] = comms if comms is not None \
+        else {"error": (err or "")[:300]}
+    return result
+
+
 def _child_probe():
     """Tiny tunnel-health check: init backend + one 256x256 matmul."""
     import jax
@@ -637,6 +672,9 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-probe":
         _child_probe()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-comms":
+        _child_comms()
         return
 
     errors = []
@@ -709,7 +747,7 @@ def _main_measured(errors):
                 break
             result, err = _run_child("--child-tpu", child_deadline)
             if result is not None:
-                print(json.dumps(result))
+                print(json.dumps(_attach_comms(result, remaining())))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
             time.sleep(5)
@@ -728,7 +766,7 @@ def _main_measured(errors):
             # every probe/contact this round, timestamped, with outcomes
             # — the wedge-is-environmental evidence chain (VERDICT r4 #1)
             result["tunnel_log"] = "TUNNEL_r05.json"
-        print(json.dumps(result))
+        print(json.dumps(_attach_comms(result, remaining())))
         return
     # last resort: still one JSON line, rc 0, explicit marker
     print(json.dumps({

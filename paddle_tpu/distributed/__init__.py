@@ -24,6 +24,9 @@ dist_to_static = to_static  # back-compat alias
 from . import fleet                                               # noqa
 from . import checkpoint                                          # noqa
 from . import sharding                                            # noqa
+# hierarchical/quantized collectives + gradient bucketing (in-graph
+# data plane; the eager control plane above is .communication)
+from . import collectives                                         # noqa
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa
 from .launch_utils import spawn                                   # noqa
 # rendezvous KV store (C++ libptcore server/client; reference:

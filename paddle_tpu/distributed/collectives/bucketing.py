@@ -1,0 +1,233 @@
+"""Gradient bucketing: coalesce many small syncs into few big ones.
+
+The reference's C++ Reducer concatenates gradients into ~25 MB fusion
+buffers and all-reduces buffer-at-a-time so communication of bucket k
+overlaps the backward math producing bucket k+1. On TPU the same shape
+pays off twice: one collective per bucket instead of per tensor (XLA
+dispatch + replica-group setup amortized), and the buckets give XLA's
+latency-hiding scheduler clean units to overlap.
+
+Two consumers:
+
+- :class:`BucketedGradSync` — IN-GRAPH hook for the optimizer's
+  functional update (``optimizer._grad_sync``). Inside ``shard_map``
+  it buckets, runs one (hierarchical/quantized, per config) all-reduce
+  per bucket, means, and splits back. Anywhere the axes are not bound
+  (plain GSPMD jit, eager) it is an exact no-op — GSPMD already owns
+  the sync there, so attaching the hook can never double-reduce.
+- :func:`bucketed_allreduce_gradients` — EAGER drop-in used by
+  ``fleet.utils.fused_allreduce_gradients``: one store/multihost
+  all-reduce per bucket instead of per parameter. On the TCPStore
+  control-plane transport that collapses O(params) rendezvous rounds
+  into O(buckets).
+
+With compress off and fp32 gradients, both preserve values exactly vs
+the unbucketed path: same summands, same per-element reduction —
+concatenation never reassociates a single element's sum. (bf16 grads
+are upcast to fp32 for the fused wire, i.e. at least as accurate;
+``compress="int8"`` trades the documented quantization error.)
+
+Everything is OFF by default: wire-up happens only when
+``CollectiveConfig.bucketed_grad_sync`` is set (or
+``PT_COLLECTIVES_BUCKETED_SYNC=1``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["build_buckets", "BucketedGradSync",
+           "bucketed_allreduce_gradients", "attach_grad_sync"]
+
+
+def build_buckets(named_sizes: Sequence[Tuple[str, int]],
+                  bucket_bytes: int = 25 << 20,
+                  elem_bytes: int = 4) -> List[List[str]]:
+    """Greedy size-targeted bucketing, order-preserving.
+
+    ``named_sizes``: (name, element_count) in sync order (reverse
+    creation order approximates backward completion order, as in the
+    reference Reducer). A tensor larger than the target gets its own
+    bucket; buckets are never empty."""
+    target = max(int(bucket_bytes), 1)
+    buckets: List[List[str]] = []
+    cur: List[str] = []
+    cur_bytes = 0
+    for name, n in named_sizes:
+        nbytes = int(n) * elem_bytes
+        if cur and cur_bytes + nbytes > target:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(name)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def _axes_bound(axes) -> bool:
+    """True iff EVERY axis name is bound in the current trace (i.e. we
+    are inside shard_map over them); False iff NONE is. A partial
+    binding raises: syncing over a subset the caller didn't get —
+    or silently skipping the sync — would both train replicas apart.
+    Probing is trace-time-deterministic so the try/except bakes no
+    data dependence into the jaxpr."""
+    names = tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
+    bound = []
+    for a in names:
+        try:
+            jax.lax.axis_index(a)
+            bound.append(a)
+        except NameError:
+            pass
+    if bound and len(bound) != len(names):
+        raise ValueError(
+            f"BucketedGradSync over axes {names}: only {tuple(bound)} "
+            f"are bound in this shard_map — attach the hook with the "
+            f"axes the step actually maps over")
+    return bool(bound)
+
+
+class BucketedGradSync:
+    """In-graph gradient sync: mean-all-reduce over mesh ``axes`` in
+    size-targeted buckets. Attach via :func:`attach_grad_sync`; the
+    optimizer calls it as ``grads = hook(grads)`` at the top of
+    ``functional_update`` (before clipping, matching DDP semantics)."""
+
+    def __init__(self, axes=("dp",), bucket_bytes: Optional[int] = None,
+                 compress: Optional[str] = "__config__",
+                 hierarchy: Optional[str] = None,
+                 mesh=None):
+        from . import collective_config
+        cfg = collective_config()
+        self.axes = tuple(axes) if isinstance(axes, (tuple, list)) \
+            else (axes,)
+        self.bucket_bytes = int(bucket_bytes if bucket_bytes is not None
+                                else cfg.bucket_bytes)
+        self.compress = cfg.compress if compress == "__config__" \
+            else compress
+        self.error_bound = cfg.error_bound
+        self.hierarchy = hierarchy
+        self.mesh = mesh
+
+    def _plan(self):
+        from .hierarchical import plan_hierarchy
+        return plan_hierarchy(self.axes, self.mesh, self.hierarchy)
+
+    def __call__(self, grads: Dict[str, jnp.ndarray]) -> Dict:
+        if not grads or not _axes_bound(self.axes):
+            return grads          # GSPMD/eager: sync is not ours to do
+        from ...profiler import RecordEvent
+        from .hierarchical import hier_all_reduce
+        from .quantized import quantized_all_reduce
+        plan = self._plan()
+        # the mean divisor comes from the BOUND axes, not the plan: a
+        # shard_map step without a registered mesh would plan flat with
+        # total_size=1 and silently turn mean into sum. psum of the
+        # literal 1 folds to the static axis-size product at trace time.
+        n = jax.lax.psum(1, tuple(self.axes))
+        names = [k for k, g in grads.items()
+                 if g is not None and int(np.prod(g.shape)) > 0]
+        sizes = [(k, int(np.prod(grads[k].shape))) for k in names]
+        out = dict(grads)
+        with RecordEvent(f"collectives::grad_sync[{plan.mode}"
+                         f"{',int8' if self.compress == 'int8' else ''}"
+                         f",buckets]"):
+            for bucket in build_buckets(sizes, self.bucket_bytes):
+                with jax.named_scope("collectives.grad_bucket"):
+                    flats = [grads[k].reshape(-1).astype(jnp.float32)
+                             for k in bucket]
+                    fused = flats[0] if len(flats) == 1 \
+                        else jnp.concatenate(flats)
+                    if self.compress == "int8":
+                        if self.error_bound is not None:
+                            # budgeted mode: compute the quantized
+                            # result AND its runtime bound, fall back
+                            # to the fp32 reduction for any bucket
+                            # whose bound exceeds the budget (costs
+                            # both reductions for that bucket — the
+                            # price of a hard guarantee)
+                            q, b = quantized_all_reduce(
+                                fused, plan, return_error_bound=True)
+                            f = hier_all_reduce(fused, plan)
+                            fused = jnp.where(b <= self.error_bound,
+                                              q, f)
+                        else:
+                            fused = quantized_all_reduce(fused, plan)
+                    else:
+                        fused = hier_all_reduce(fused, plan)
+                    fused = fused / n
+                    off = 0
+                    for k in bucket:
+                        g = grads[k]
+                        sz = int(np.prod(g.shape))
+                        out[k] = jax.lax.dynamic_slice(
+                            fused, (off,), (sz,)).reshape(g.shape) \
+                            .astype(g.dtype)
+                        off += sz
+        return out
+
+
+def attach_grad_sync(optimizer, axes=("dp",), **kw):
+    """Install a :class:`BucketedGradSync` as the optimizer's functional
+    grad hook. Returns the hook (or None when the config flag is off
+    and ``force`` was not passed)."""
+    force = kw.pop("force", False)
+    from . import collective_config
+    if not (force or collective_config().bucketed_grad_sync):
+        # flag off: also clear stale wiring from an earlier flag-on
+        # call (re-sharding must not keep syncing over the old axis);
+        # a user's custom non-bucketed hook is left alone
+        if isinstance(getattr(optimizer, "_grad_sync", None),
+                      BucketedGradSync):
+            optimizer._grad_sync = None
+        return None
+    hook = BucketedGradSync(axes=axes, **kw)
+    optimizer._grad_sync = hook
+    return hook
+
+
+def bucketed_allreduce_gradients(parameter_list, group=None,
+                                 bucket_bytes: Optional[int] = None):
+    """Eager bucketed mean-all-reduce of ``p.grad`` across the data-
+    parallel group — the coalesced form of fleet's
+    ``fused_allreduce_gradients``. One flattened fp32 all_reduce per
+    size-targeted bucket; values bit-match the per-tensor path."""
+    from ...tensor import Tensor
+    from .. import communication as C
+    from ...profiler import RecordEvent
+    from . import collective_config
+
+    if bucket_bytes is None:
+        bucket_bytes = collective_config().bucket_bytes
+    params = [p for p in parameter_list
+              if isinstance(p, Tensor) and p.grad is not None
+              and int(np.prod(p.grad.shape)) > 0]
+    if not params:
+        return
+    if group is not None and getattr(group, "nranks", 0):
+        n = group.nranks
+    else:
+        from ..parallel import ParallelEnv
+        n = max(ParallelEnv().world_size, 1)
+    sizes = [(i, int(np.prod(p.grad.shape)))
+             for i, p in enumerate(params)]
+    with RecordEvent("collectives::grad_sync[eager,buckets]"):
+        for bucket in build_buckets(sizes, bucket_bytes):
+            grads = [params[i].grad for i in bucket]
+            fused = Tensor(jnp.concatenate(
+                [g._value.reshape(-1).astype(jnp.float32)
+                 for g in grads]))
+            C.all_reduce(fused, op=C.ReduceOp.SUM, group=group)
+            flat = fused._value / n if n > 1 else fused._value
+            off = 0
+            for i in bucket:
+                g = params[i].grad
+                sz = int(np.prod(g.shape))
+                g._update_value(
+                    flat[off:off + sz].reshape(g.shape)
+                    .astype(g._value.dtype))
+                off += sz

@@ -1,0 +1,133 @@
+"""Comms microbenchmark: bytes, algorithmic bandwidth, quant error.
+
+Runs each collective over a mesh (2 x n/2 when >= 4 devices, else
+flat), timed over a replicated payload, and reports per collective:
+
+- ``bytes_moved``: algorithmic wire bytes per device for a ring
+  realization (the standard NCCL-tests accounting): all-reduce
+  ``2*(n-1)/n * S``, reduce-scatter / all-gather ``(n-1)/n * S``;
+  the int8 all-reduce scales by the compressed element size.
+- ``algbw_gbps``: ``bytes_moved / time`` — comparable across
+  collectives and devices counts (the "as fast as the hardware
+  allows" number to track per round).
+- quantized-vs-fp32 ``max_error`` plus the documented ``error_bound``
+  it must sit under, and an exactness check on constant input.
+
+On CPU-simulated devices the absolute times are meaningless for ICI
+but the stage proves the code path end-to-end and pins the error
+contract; on real multi-chip it becomes the comm headline. Wired into
+``bench.py`` as the ``comms`` stage.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["run_comms_bench"]
+
+
+def _build_mesh():
+    import jax
+    from jax.sharding import Mesh
+    devs = jax.devices()
+    n = len(devs)
+    if n >= 4:
+        outer = 2
+        arr = np.array(devs[: (n // outer) * outer]).reshape(
+            outer, n // outer)
+        return Mesh(arr, ("dp", "mp"))
+    return Mesh(np.array(devs), ("mp",))
+
+
+def _timeit(fn, *args, iters=3):
+    fn(*args)                                     # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    return out, (time.perf_counter() - t0) / iters
+
+
+def run_comms_bench(size_mb: float = 4.0, iters: int = 3,
+                    mesh=None) -> dict:
+    """Returns a JSON-able dict for the bench ``comms`` stage."""
+    import jax.numpy as jnp
+    from . import all_reduce, all_gather, reduce_scatter
+    from .hierarchical import plan_hierarchy
+    from .quantized import int8_error_bound
+
+    mesh = mesh if mesh is not None else _build_mesh()
+    axes = tuple(a for a, s in zip(mesh.axis_names, mesh.devices.shape)
+                 if s > 1) or mesh.axis_names
+    plan = plan_hierarchy(axes, mesh)
+    n = max(plan.total_size, 1)
+    elems = max(int(size_mb * 1e6) // 4 // n * n, n)   # divisible by n
+    rs = np.random.RandomState(0)
+    # integer-valued fp32: hierarchical vs flat sums stay exact, so the
+    # quant error measured below is pure quantization, not reassoc
+    data = rs.randint(-64, 64, size=(n, elems)).astype(np.float32)
+    x = jnp.asarray(data)
+    size_bytes = elems * 4
+
+    out = {"devices": n, "axes": list(plan.axes), "mode": plan.mode,
+           "payload_mb": round(size_bytes / 1e6, 3)}
+
+    def entry(t, bytes_moved):
+        return {"time_ms": round(t * 1e3, 3),
+                "bytes_moved": int(bytes_moved),
+                "algbw_gbps": round(bytes_moved / max(t, 1e-9) / 1e9,
+                                    3)}
+
+    ring = (n - 1) / n * size_bytes
+    ar, t = _timeit(lambda v: all_reduce(v, axes, mesh, compress=None),
+                    x, iters=iters)
+    out["all_reduce"] = entry(t, 2 * ring)
+    ref = np.asarray(ar)
+
+    _, t = _timeit(lambda v: reduce_scatter(v, axes, mesh),
+                   x, iters=iters)
+    out["reduce_scatter"] = entry(t, ring)
+
+    shard = jnp.asarray(data[:, : elems // n])
+    _, t = _timeit(lambda v: all_gather(v, axes, mesh), shard,
+                   iters=iters)
+    # per-device shard s: each device receives (n-1)*s of new bytes
+    out["all_gather"] = entry(t, (n - 1) * (elems // n) * 4)
+
+    # quantized A/B. Bytes are charged for the IMPLEMENTED gather-based
+    # algorithm, not an idealized quantized ring: per device,
+    # flat = (n-1) * S_q (full-payload code gather);
+    # hier = (I-1)*S_q  phase-1 inner gather
+    #      + 2*(O-1)/O * S/I  fp32 outer all-reduce
+    #      + (I-1)*S_q/I  phase-2 inner chunk gather
+    # with S_q = S * (1 + 4/bucket)/4. The per-hop compression is
+    # 4 -> (1+4/bucket) bytes/elem; end-to-end the win only
+    # materializes on the hierarchical path (~1.4x at 2x4).
+    from . import collective_config
+    bucket = collective_config().quant_bucket_size
+    qar, t = _timeit(lambda v: all_reduce(v, axes, mesh,
+                                          compress="int8"), x,
+                     iters=iters)
+    q_per = (1.0 + 4.0 / bucket) / 4.0
+    if plan.flat:
+        qbytes = (n - 1) * size_bytes * q_per
+    else:
+        inner = plan.inner_size
+        outer = n // inner
+        qbytes = ((inner - 1) * size_bytes * q_per
+                  + 2 * (outer - 1) / outer * (size_bytes / inner)
+                  + (inner - 1) * size_bytes * q_per / inner)
+    q = entry(t, qbytes)
+    err = float(np.max(np.abs(np.asarray(qar) - ref)))
+    bound = float(int8_error_bound(np.abs(data).max(), n,
+                                   bucket_absmax_out=np.abs(ref).max()))
+    q["max_error"] = err
+    q["error_bound"] = bound
+    q["within_bound"] = bool(err <= bound)
+    # constant input must round-trip exactly
+    const = jnp.full((n, 4 * bucket), 3.25, jnp.float32)
+    qc = np.asarray(all_reduce(const, axes, mesh, compress="int8"))
+    q["constant_exact"] = bool(np.all(qc == 3.25 * n))
+    out["all_reduce_int8"] = q
+    out["quant_vs_fp32_max_error"] = err
+    return out

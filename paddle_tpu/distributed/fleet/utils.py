@@ -15,11 +15,16 @@ __all__ = ["fused_allreduce_gradients", "recompute", "recompute_sequential"]
 from .utils_recompute import recompute, recompute_sequential  # noqa: F401
 
 
-def fused_allreduce_gradients(parameter_list, hcg=None, group=None):
+def fused_allreduce_gradients(parameter_list, hcg=None, group=None,
+                              bucket_bytes=None):
     """All-reduce (mean) every parameter's gradient across the data-
     parallel group (reference: fused_allreduce_gradients — the bucketed
-    NCCL allreduce the C++ Reducer performs; here one host-level
-    all_reduce per grad — the jitted path needs none of this)."""
+    NCCL allreduce the C++ Reducer performs; the jitted path needs none
+    of this). Default: one host-level all_reduce per grad. With
+    ``bucket_bytes`` set — or the collectives config flag
+    ``bucketed_grad_sync`` on — gradients coalesce into size-targeted
+    fusion buffers and sync one bucket at a time (same values, O(params)
+    -> O(buckets) rendezvous rounds on the store transport)."""
     from .. import communication as C
 
     if hcg is not None and group is None:
@@ -29,6 +34,12 @@ def fused_allreduce_gradients(parameter_list, hcg=None, group=None):
                 group = get()
             except Exception:
                 group = None
+    from ..collectives import collective_config
+    if bucket_bytes is not None or \
+            collective_config().bucketed_grad_sync:
+        from ..collectives import bucketed_allreduce_gradients
+        return bucketed_allreduce_gradients(
+            parameter_list, group=group, bucket_bytes=bucket_bytes)
     n = None
     for p in parameter_list:
         if not isinstance(p, Tensor) or p.grad is None:

@@ -125,13 +125,43 @@ class DataParallel(Layer):
         self._layers = layers
         self.add_sublayer("_dp_inner", layers)
         self._data_parallel_mode = True
+        # reference comm_buffer_size is in MB — it sizes the fusion
+        # buffers of the explicit (eager / shard_map) sync path below;
+        # the jitted GSPMD path ignores it
+        self._comm_buffer_bytes = int(comm_buffer_size) << 20
+        self._dp_group = group
+        self._no_sync = False
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
+    def sync_gradients(self, parameters=None):
+        """Explicit bucketed mean-all-reduce of gradients across the
+        dp group — for MANUAL eager loops ported from the reference
+        (backward() ... sync_gradients() ... opt.step()). Buckets are
+        sized by ``comm_buffer_size``; inside ``no_sync()`` this is a
+        no-op, mirroring the reference Reducer. The jitted TrainStep
+        path needs none of this (GSPMD emits the fused all-reduce)."""
+        if self._no_sync:
+            return
+        from .collectives import bucketed_allreduce_gradients
+        params = list(parameters if parameters is not None
+                      else self._layers.parameters())
+        bucketed_allreduce_gradients(
+            params, group=self._dp_group,
+            bucket_bytes=self._comm_buffer_bytes)
+
     def no_sync(self):
         import contextlib
-        return contextlib.nullcontext()
+
+        @contextlib.contextmanager
+        def ctx():
+            prev, self._no_sync = self._no_sync, True
+            try:
+                yield
+            finally:
+                self._no_sync = prev
+        return ctx()
 
     def scale_loss(self, loss):
         return loss

@@ -129,6 +129,11 @@ def group_sharded_parallel(model: Optional[Layer], optimizer: Optimizer,
     optimizer._slot_constrain = constrainer
     if level in ("os_g", "p_g_os"):
         optimizer._grad_constrain = constrainer
+    # opt-in bucketed/quantized grad sync for shard_map-driven steps
+    # (CollectiveConfig.bucketed_grad_sync, default off; a no-op under
+    # plain GSPMD jit where the axis is unbound)
+    from ..collectives import attach_grad_sync
+    attach_grad_sync(optimizer, axes=(axis,))
     if level == "p_g_os" and model is not None:
         mesh = get_current_mesh()
         for _, p in model.named_parameters():

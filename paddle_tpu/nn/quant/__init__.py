@@ -27,7 +27,10 @@ def _bits(algo):
 def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
     """Per-output-channel absmax symmetric quantization of a (in, out)
     weight. Returns (int8 quantized weight, float scale per out
-    channel). int4 packs two nibbles per int8 byte like the reference."""
+    channel). int4 packs two nibbles per int8 byte like the reference;
+    an odd row count is padded for packing, and the original count is
+    carried on the returned tensor (``_orig_in_features``) so the
+    round-trip can slice the pad back off."""
     bits = _bits(algo)
     qmax = 2 ** (bits - 1) - 1
 
@@ -42,14 +45,23 @@ def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
             q = ((even.astype(jnp.uint8) & 0xF) |
                  ((odd.astype(jnp.uint8) & 0xF) << 4)).astype(jnp.int8)
         return q, scale
+    rows = int(x.shape[0])
     qw, scale = apply_op(f, x)
+    qw._orig_in_features = rows
     return qw, scale
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8",
-                      out_dtype="float32"):
+                      out_dtype="float32", in_features=None):
+    """Inverse of :func:`weight_quantize`. For int4 the unpacked row
+    count is ``2 * packed`` minus any packing pad: pass
+    ``in_features`` explicitly, or it is read off the
+    ``_orig_in_features`` tag weight_quantize leaves on the tensor
+    (odd in_features would otherwise come back one row too long)."""
     bits = _bits(algo)
     qmax = 2 ** (bits - 1) - 1
+    if in_features is None:
+        in_features = getattr(x, "_orig_in_features", None)
 
     def f(q, s):
         if bits == 4:
@@ -61,6 +73,8 @@ def weight_dequantize(x, scale, algo="weight_only_int8",
             full = jnp.zeros((n2, q.shape[1]), jnp.int8)
             full = full.at[::2].set(lo).at[1::2].set(hi)
             q = full
+            if in_features is not None and in_features < n2:
+                q = q[:in_features]
         return (q.astype(jnp.float32) * s / qmax).astype(out_dtype)
     return apply_op(f, x, scale)
 
@@ -68,10 +82,34 @@ def weight_dequantize(x, scale, algo="weight_only_int8",
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
     """y = x @ dequant(weight) + bias. The dequant multiply stays
-    inside the jitted program so XLA fuses it into the gemm."""
+    inside the jitted program so XLA fuses it into the gemm. For int4
+    the activation's feature dim fixes the true row count, so weights
+    with odd in_features multiply correctly even when the packing tag
+    was lost (e.g. a checkpoint round-trip)."""
     algo = "weight_only_int4" if weight_dtype == "int4" \
         else "weight_only_int8"
-    w = weight_dequantize(weight, weight_scale, algo=algo)
+    in_f = None
+    if weight_dtype == "int4":
+        in_f = int(x.shape[-1])
+        tag = getattr(weight, "_orig_in_features", None)
+        packed = int(weight.shape[0])
+        # inference must not quietly slice a mismatched weight — that
+        # would turn a wiring bug from a loud dot_general shape error
+        # into silently wrong output. Without the tag the nibble
+        # packing still fixes ceil(in_features/2) == packed rows (only
+        # the parity of the last row is ambiguous).
+        if tag is not None and int(tag) != in_f:
+            raise ValueError(
+                f"weight_only_linear: activation has {in_f} features "
+                f"but the int4 weight was quantized from "
+                f"in_features={int(tag)}")
+        if (in_f + 1) // 2 != packed:
+            raise ValueError(
+                f"weight_only_linear: activation has {in_f} features "
+                f"but the packed int4 weight has {packed} rows "
+                f"(expects {(in_f + 1) // 2})")
+    w = weight_dequantize(weight, weight_scale, algo=algo,
+                          in_features=in_f)
 
     def f(xv, wv, *b):
         y = xv.astype(jnp.float32) @ wv

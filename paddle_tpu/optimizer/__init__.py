@@ -97,6 +97,11 @@ class Optimizer:
         # paddle_tpu.distributed.sharding.group_sharded_parallel
         self._slot_constrain = None   # (array, pname, slot) -> sharded
         self._grad_constrain = None
+        # explicit gradient-sync hook ({name: grad} -> {name: grad}),
+        # set by paddle_tpu.distributed.collectives.attach_grad_sync;
+        # runs FIRST in functional_update (sync before clip, the DDP
+        # order). Identity when unset or when no mesh axis is bound.
+        self._grad_sync = None
         names, seen = [], set()
         for i, p in enumerate(self._param_list):
             base = p.name or f"param_{i}"
@@ -315,6 +320,8 @@ class Optimizer:
                           lr_value):
         """Pure: (params, grads, state, lr) -> (new_params, new_state).
         Used inside jitted train steps."""
+        if self._grad_sync is not None:
+            grads = self._grad_sync(grads)
         if self._grad_constrain is not None:
             grads = {n: self._grad_constrain(g, n)
                      for n, g in grads.items()}

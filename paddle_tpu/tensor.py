@@ -132,7 +132,10 @@ class Tensor:
                  "_partial_axes",
                  # static-graph mode: producer record (paddle_tpu.static)
                  # + static.gradients() marker (targets, wrt)
-                 "_static_src", "_static_grad", "__weakref__")
+                 "_static_src", "_static_grad",
+                 # nn.quant int4 packing: original (pre-pad) row count a
+                 # packed weight unpacks back to (odd in_features)
+                 "_orig_in_features", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True,
                  name: Optional[str] = None):

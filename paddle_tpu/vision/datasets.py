@@ -181,8 +181,12 @@ class Flowers(Dataset):
         self.transform = transform
         self.backend = backend
         labels = sio.loadmat(label_file)["labels"].ravel()
-        key = {"train": "trnid", "valid": "validid",
-               "test": "tstid"}.get(mode, "trnid")
+        # the reference loader deliberately SWAPS the .mat splits:
+        # 'train' uses the large tstid set (6149 images), 'test' the
+        # small trnid set (1020) — python/paddle/vision/datasets/
+        # flowers.py
+        key = {"train": "tstid", "valid": "validid",
+               "test": "trnid"}.get(mode, "tstid")
         setid = sio.loadmat(setid_file)
         if key not in setid and key == "validid":
             key = "valid"          # both spellings appear in the wild
@@ -216,7 +220,8 @@ class Flowers(Dataset):
             img = np.asarray(img, np.uint8)
         if self.transform is not None:
             img = self.transform(img)
-        label = np.int64(self.labels[n - 1])
+        # imagelabels.mat is 1-based; a 102-class head needs 0..101
+        label = np.int64(self.labels[n - 1] - 1)
         return img, label
 
     def __getstate__(self):

@@ -708,8 +708,19 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     scores (N, A, H, W); bbox_deltas (N, 4A, H, W); img_size (N, 2)
     (h, w); anchors / variances (..., 4) flattened to (A*H*W, 4).
     Host-side op: proposal counts are data-dependent (the reference's
-    GPU kernel likewise returns a LoD)."""
+    GPU kernel likewise returns a LoD).
+
+    Reference behaviors kept: ``min_size`` is clamped to >= 1.0; with
+    ``pixel_offset=True`` boxes whose CENTER falls outside the image
+    are dropped too; adaptive-threshold NMS (``eta != 1.0``) is not
+    implemented and raises rather than silently running plain NMS."""
     import numpy as np
+
+    if eta != 1.0:
+        raise NotImplementedError(
+            f"generate_proposals: adaptive-threshold NMS (eta={eta}) "
+            "is not implemented; use eta=1.0")
+    min_size = max(float(min_size), 1.0)
 
     def _np(t):
         return np.asarray(t._value if isinstance(t, Tensor) else t)
@@ -743,12 +754,24 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         x2 = cx + bw * 0.5 - off
         y2 = cy + bh * 0.5 - off
         ih, iw = im[i, 0], im[i, 1]
+        if pixel_offset:
+            # reference FilterBoxes: with the pixel-offset convention a
+            # box whose center exceeds the image extent is dropped
+            # (cx <= im_w && cy <= im_h). Checked on the DECODED box:
+            # post-clip the centers are always inside, which would make
+            # the filter dead code — here it actually drops proposals
+            # decoded past the edge instead of keeping border slivers.
+            bcx = (x1 + x2 + off) / 2.0
+            bcy = (y1 + y2 + off) / 2.0
+            center_in = (bcx <= iw) & (bcy <= ih)
+        else:
+            center_in = True
         x1 = np.clip(x1, 0, iw - off)
         y1 = np.clip(y1, 0, ih - off)
         x2 = np.clip(x2, 0, iw - off)
         y2 = np.clip(y2, 0, ih - off)
         keep = ((x2 - x1 + off) >= min_size) & \
-            ((y2 - y1 + off) >= min_size)
+            ((y2 - y1 + off) >= min_size) & center_in
         boxes = np.stack([x1, y1, x2, y2], axis=1)[keep]
         s_i = s_i[keep]
         if len(boxes):

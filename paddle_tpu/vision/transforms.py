@@ -745,22 +745,32 @@ _AA_RANGES = {
     "rotate": np.linspace(0, 30, 10),
     "solarize": np.linspace(256, 0, 10),
     "posterize": np.round(np.linspace(8, 4, 10)),
-    "contrast": 1.0 + np.linspace(0, 0.9, 10),
-    "color": 1.0 + np.linspace(0, 0.9, 10),
-    "brightness": 1.0 + np.linspace(0, 0.9, 10),
-    "sharpness": 1.0 + np.linspace(0, 0.9, 10),
+    # enhancement ops: the table stores the DEVIATION from identity;
+    # __call__ sign-randomizes it and applies factor 1.0 + signed_mag
+    # (published policy / torchvision behavior — so color/contrast/
+    # brightness/sharpness can also darken/desaturate/blur)
+    "contrast": np.linspace(0, 0.9, 10),
+    "color": np.linspace(0, 0.9, 10),
+    "brightness": np.linspace(0, 0.9, 10),
+    "sharpness": np.linspace(0, 0.9, 10),
     "autocontrast": np.zeros(10),
     "equalize": np.zeros(10),
     "invert": np.zeros(10),
 }
-_AA_SIGNED = {"shearX", "shearY", "translateX", "translateY", "rotate"}
+_AA_SIGNED = {"shearX", "shearY", "translateX", "translateY", "rotate",
+              "color", "contrast", "brightness", "sharpness"}
+# enhancement ops whose signed magnitude is a deviation from the
+# identity factor 1.0
+_AA_ENHANCE = {"color", "contrast", "brightness", "sharpness"}
 
 
 class AutoAugment:
     """AutoAugment with the published ImageNet policy (reference:
     transforms.AutoAugment — verify): per call, one random sub-policy's
     two (op, prob, magnitude) steps are applied. Magnitudes of the
-    geometric ops are sign-randomized as in the paper."""
+    geometric AND enhancement ops are sign-randomized as in the paper;
+    enhancement factors apply as 1.0 +/- mag, so color/contrast/
+    brightness/sharpness can also desaturate/darken/blur."""
 
     def __init__(self, policy="imagenet", fill=128):
         if policy != "imagenet":
@@ -778,6 +788,8 @@ class AutoAugment:
             mag = float(_AA_RANGES[op][bin_])
             if op in _AA_SIGNED and np.random.rand() < 0.5:
                 mag = -mag
+            if op in _AA_ENHANCE:
+                mag = 1.0 + mag
             hwc = _aa_apply(op, hwc, mag, self.fill)
         out = np.clip(hwc, 0, 255)
         return _ret(_back(out, chw), img)

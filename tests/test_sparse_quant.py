@@ -191,6 +191,45 @@ class TestWeightOnlyQuant:
             rel = np.abs(y.numpy() - ref).max() / np.abs(ref).max()
             assert rel < tol
 
+    def test_int4_odd_in_features_roundtrip(self):
+        # regression: the packing pad row must not survive dequantize —
+        # a (2k+1, out) weight used to come back (2k+2, out) and break
+        # the weight_only_linear matmul
+        from paddle_tpu.nn.quant import (weight_quantize,
+                                         weight_dequantize,
+                                         weight_only_linear)
+        rs = np.random.RandomState(2)
+        w = paddle.to_tensor(rs.randn(15, 8).astype(np.float32))
+        x = paddle.to_tensor(rs.randn(4, 15).astype(np.float32))
+        qw, sc = weight_quantize(w, algo="weight_only_int4")
+        assert qw.shape[0] == 8              # ceil(15/2) packed rows
+        wd = weight_dequantize(qw, sc, algo="weight_only_int4")
+        assert tuple(wd.shape) == (15, 8)
+        ref = x.numpy() @ w.numpy()
+        y = weight_only_linear(x, qw, weight_scale=sc,
+                               weight_dtype="int4")
+        assert np.abs(y.numpy() - ref).max() / np.abs(ref).max() < 0.35
+        # the tag is also optional: explicit in_features and the
+        # activation-shape inference in weight_only_linear both work
+        qw2 = paddle.to_tensor(qw.numpy())   # tag lost
+        wd2 = weight_dequantize(qw2, sc, algo="weight_only_int4",
+                                in_features=15)
+        np.testing.assert_array_equal(wd2.numpy(), wd.numpy())
+        y2 = weight_only_linear(x, qw2, weight_scale=sc,
+                                weight_dtype="int4")
+        np.testing.assert_allclose(y2.numpy(), y.numpy())
+        # a feature-dim mismatch must stay a LOUD error, not a silent
+        # truncation via the x-shape inference
+        bad_x = paddle.to_tensor(rs.randn(4, 13).astype(np.float32))
+        with pytest.raises(ValueError, match="in_features"):
+            weight_only_linear(bad_x, qw, weight_scale=sc,
+                               weight_dtype="int4")
+        # ...even when the tag was lost: the packed row count still
+        # pins ceil(in_features/2)
+        with pytest.raises(ValueError, match="packed"):
+            weight_only_linear(bad_x, qw2, weight_scale=sc,
+                               weight_dtype="int4")
+
     def test_bias_and_llm_int8(self):
         from paddle_tpu.nn.quant import (weight_quantize,
                                          weight_only_linear,

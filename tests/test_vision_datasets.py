@@ -30,27 +30,29 @@ class TestFlowers:
         return str(tgz), str(labels), str(setid)
 
     def test_splits_labels_and_decode(self, fixture_files):
+        # reference semantics: 'train' is the (large) tstid split,
+        # 'test' the trnid split, and labels come back 0-based
         from paddle_tpu.vision.datasets import Flowers
         tgz, labels, setid = fixture_files
         tr = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
                      mode="train")
-        assert len(tr) == 2
-        img, lab = tr[0]                  # image_00001, label 3
+        assert len(tr) == 1               # tstid = [3]
+        img, lab = tr[0]                  # image_00003, label 2 -> 1
         assert img.shape == (8, 8, 3) and img.dtype == np.uint8
-        assert int(img[0, 0, 0]) == 40 and int(lab) == 3
-        img, lab = tr[1]                  # image_00004, label 3
-        assert int(img[0, 0, 0]) == 160 and int(lab) == 3
+        assert int(img[0, 0, 0]) == 120 and int(lab) == 1
         te = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
                      mode="test")
-        assert len(te) == 1
-        _, lab = te[0]
-        assert int(lab) == 2
+        assert len(te) == 2               # trnid = [1, 4]
+        img, lab = te[0]                  # image_00001, label 3 -> 2
+        assert int(img[0, 0, 0]) == 40 and int(lab) == 2
+        img, lab = te[1]                  # image_00004, label 3 -> 2
+        assert int(img[0, 0, 0]) == 160 and int(lab) == 2
         # pil backend + transform hook
         va = Flowers(data_file=tgz, label_file=labels, setid_file=setid,
                      mode="valid", backend="pil",
                      transform=lambda im: np.asarray(im, np.float32) / 255)
-        img, lab = va[0]
-        assert img.dtype == np.float32 and int(lab) == 1
+        img, lab = va[0]                  # image_00002, label 1 -> 0
+        assert img.dtype == np.float32 and int(lab) == 0
 
     def test_missing_files_raise(self, tmp_path):
         from paddle_tpu.vision.datasets import Flowers
@@ -318,6 +320,37 @@ class TestGenerateProposals:
         np.testing.assert_allclose(r[0], [0, 0, 16, 16])
         assert probs.numpy()[0, 0] == 2.0
 
+    def test_min_size_clamped_eta_rejected_center_filter(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.ops import generate_proposals
+        n, a = 1, 2
+        scores = np.array([[[[2.0]], [[1.0]]]], np.float32)
+        deltas = np.zeros((1, 4 * a, 1, 1), np.float32)
+        # 16x16 image. anchor 1: 0.5px-wide sliver, survives ONLY if
+        # min_size=0.1 escapes the >=1.0 clamp. anchor 2: center x=26
+        # outside the image — survives clipping as [8,0,16,14] unless
+        # the pixel_offset center filter drops it.
+        anchors = np.array([[0, 0, 0.5, 8], [8, 0, 44, 14]], np.float32)
+        variances = np.ones_like(anchors)
+        args = (paddle.to_tensor(scores), paddle.to_tensor(deltas),
+                paddle.to_tensor(np.array([[16, 16]], np.float32)),
+                paddle.to_tensor(anchors), paddle.to_tensor(variances))
+        with pytest.raises(NotImplementedError, match="eta"):
+            generate_proposals(*args, eta=0.9)
+        # min_size clamp: only the clipped big box stays
+        rois, _, num = generate_proposals(*args, min_size=0.1,
+                                          return_rois_num=True)
+        assert num.numpy().tolist() == [1]
+        np.testing.assert_allclose(rois.numpy()[0], [8, 0, 16, 14])
+        # pixel_offset center filter: the out-of-center box disappears;
+        # the sliver (width 1.5 under the +1 convention, center inside)
+        # stays
+        rois, _, num = generate_proposals(*args, min_size=0.1,
+                                          pixel_offset=True,
+                                          return_rois_num=True)
+        assert num.numpy().tolist() == [1]
+        assert rois.numpy()[0, 2] < 1.0      # it is the sliver box
+
     def test_nms_suppresses_and_delta_moves(self):
         import paddle_tpu as paddle
         from paddle_tpu.vision.ops import generate_proposals
@@ -353,6 +386,21 @@ class TestAutoAugment:
             assert o.min() >= 0 and o.max() <= 255
         # at least one sub-policy draw changes the image
         assert any(not np.allclose(o, img) for o in outs)
+
+    def test_enhancement_ops_signed_around_identity(self):
+        # the policy stores enhancement magnitudes as deviations and
+        # applies 1.0 +/- mag: with the sign draw forced negative, a
+        # "brightness" step must DARKEN (factor < 1), which the old
+        # 1.0+linspace tables could never produce
+        from paddle_tpu.vision import transforms as T
+        assert T._AA_ENHANCE <= T._AA_SIGNED
+        for op in T._AA_ENHANCE:
+            mags = np.asarray(T._AA_RANGES[op])
+            assert mags[0] == 0.0 and mags[-1] <= 0.9   # deviations
+        img = np.full((4, 4, 3), 100.0, np.float32)
+        darker = T._aa_apply("brightness", img,
+                             1.0 - float(T._AA_RANGES["brightness"][9]))
+        assert darker.max() < 100.0
 
     def test_individual_ops_semantics(self):
         from paddle_tpu.vision.transforms import _aa_apply
