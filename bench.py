@@ -35,6 +35,7 @@ TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
 TPU_DEADLINE_S = float(os.environ.get("BENCH_TPU_DEADLINE_S", "1100"))
 CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_DEADLINE_S", "420"))
 COMMS_DEADLINE_S = float(os.environ.get("BENCH_COMMS_DEADLINE_S", "240"))
+PASSES_DEADLINE_S = float(os.environ.get("BENCH_PASSES_DEADLINE_S", "240"))
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
 PROBE_DEADLINE_S = float(os.environ.get("BENCH_PROBE_DEADLINE_S", "90"))
@@ -537,7 +538,7 @@ def _run_child(mode: str, deadline: float):
     The child emits BENCH_JSON after every completed stage — the LAST
     line wins, and a deadline kill still salvages the partial result."""
     env = dict(os.environ)
-    if mode in ("--child-cpu", "--child-comms"):
+    if mode in ("--child-cpu", "--child-comms", "--child-passes"):
         env["JAX_PLATFORMS"] = "cpu"
     if mode == "--child-comms":
         flags = env.get("XLA_FLAGS", "")
@@ -635,21 +636,46 @@ def _child_comms():
     print("BENCH_JSON " + json.dumps(out), flush=True)
 
 
-def _attach_comms(result, budget_s=None):
-    """Merge the comms stage into the headline JSON (its own child so a
-    wedged collective can never cost the training headline). The stage
-    is strictly additive: with the wall budget nearly spent it is
-    SKIPPED rather than risking the outer `timeout` killing the parent
-    before the already-measured result prints."""
-    deadline = COMMS_DEADLINE_S if budget_s is None \
-        else min(COMMS_DEADLINE_S, budget_s - 15)
+def _attach_stage(result, key, mode, deadline_s, budget_s=None):
+    """Merge an auxiliary child stage into the headline JSON (own child
+    so a wedged stage can never cost the training headline). Strictly
+    additive: with the wall budget nearly spent the stage is SKIPPED
+    rather than risking the outer `timeout` killing the parent before
+    the already-measured result prints."""
+    deadline = deadline_s if budget_s is None \
+        else min(deadline_s, budget_s - 15)
     if deadline < 30:
-        result["comms"] = {"skipped": "wall budget exhausted"}
+        result[key] = {"skipped": "wall budget exhausted"}
         return result
-    comms, err = _run_child("--child-comms", deadline)
-    result["comms"] = comms if comms is not None \
-        else {"error": (err or "")[:300]}
+    out, err = _run_child(mode, deadline)
+    result[key] = out if out is not None else {"error": (err or "")[:300]}
     return result
+
+
+def _attach_comms(result, budget_s=None):
+    return _attach_stage(result, "comms", "--child-comms",
+                         COMMS_DEADLINE_S, budget_s)
+
+
+def _child_passes():
+    """passes stage: the jaxpr fusion-pass pipeline microbench
+    (passes/microbench.py) on the CPU backend. Pins eqn-count
+    reduction, compile-time delta and step-time A/B of the
+    cascaded-reduction fusion every round — non-null like the comms
+    stage; the on-chip HBM win rides the same flag (PT_FUSION_PASSES)
+    when a TPU window exists."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.passes.microbench import run_passes_bench
+    out = run_passes_bench(
+        rows=int(os.environ.get("BENCH_PASSES_ROWS", "256")),
+        vocab=int(os.environ.get("BENCH_PASSES_VOCAB", "2048")))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_passes(result, budget_s=None):
+    return _attach_stage(result, "passes", "--child-passes",
+                         PASSES_DEADLINE_S, budget_s)
 
 
 def _child_probe():
@@ -675,6 +701,9 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-comms":
         _child_comms()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-passes":
+        _child_passes()
         return
 
     errors = []
@@ -747,7 +776,8 @@ def _main_measured(errors):
                 break
             result, err = _run_child("--child-tpu", child_deadline)
             if result is not None:
-                print(json.dumps(_attach_comms(result, remaining())))
+                result = _attach_comms(result, remaining())
+                print(json.dumps(_attach_passes(result, remaining())))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
             time.sleep(5)
@@ -766,7 +796,8 @@ def _main_measured(errors):
             # every probe/contact this round, timestamped, with outcomes
             # — the wedge-is-environmental evidence chain (VERDICT r4 #1)
             result["tunnel_log"] = "TUNNEL_r05.json"
-        print(json.dumps(_attach_comms(result, remaining())))
+        result = _attach_comms(result, remaining())
+        print(json.dumps(_attach_passes(result, remaining())))
         return
     # last resort: still one JSON line, rc 0, explicit marker
     print(json.dumps({

@@ -50,11 +50,8 @@ __all__ = [
 ]
 
 
-def _env_flag(name: str, default: bool = False) -> bool:
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    return v.strip().lower() not in ("", "0", "false", "off", "no")
+from ...utils.flags import env_flag as _env_flag  # noqa: E402
+# (shared falsy spellings with PT_FUSION_PASSES — utils.flags.env_flag)
 
 
 @dataclasses.dataclass

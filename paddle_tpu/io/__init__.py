@@ -514,11 +514,18 @@ def device_prefetch(loader, size=2, sharding=None):
         return _jax.tree.map(_put, batch,
                              is_leaf=lambda x: isinstance(x, _T))
 
+    if size <= 0:
+        # no prefetch: transfer-and-yield lockstep
+        for batch in loader:
+            yield _transfer(batch)
+        return
     queue = _c.deque()
     for batch in loader:
-        queue.append(_transfer(batch))
-        if len(queue) > size:
+        # drain BEFORE transferring: at most ``size`` batches are ever
+        # in flight (append-then-check kept size+1 device buffers live)
+        if len(queue) >= size:
             yield queue.popleft()
+        queue.append(_transfer(batch))
     while queue:
         yield queue.popleft()
 

@@ -79,16 +79,72 @@ def _collect_layers(obj) -> list[Layer]:
     return layers
 
 
+class _PassesJit:
+    """jit-equivalent wrapper that traces the pure step function, runs
+    the jaxpr pass pipeline on it, and compiles the TRANSFORMED program
+    — so what XLA sees is the post-fusion jaxpr, not the traced one.
+    One (shapes, dtypes) signature -> one transformed executable;
+    ``pass_stats`` holds the last trace's before/after program_stats and
+    the PassManager's per-pass eqn counts."""
+
+    _trace_seq = 0          # class-wide: orders traces across instances
+
+    def __init__(self, pure: Callable, passes):
+        self._pure = pure
+        self._passes = list(passes)
+        self._compiled: dict = {}
+        self.pass_stats = None
+
+    def __call__(self, *flat):
+        key = tuple((tuple(jnp.shape(v)), str(jnp.result_type(v)))
+                    for v in flat)
+        entry = self._compiled.get(key)
+        if entry is None:
+            from ..passes import PassManager, program_stats
+            closed = jax.make_jaxpr(self._pure)(*flat)
+            pm = PassManager(self._passes)
+            before = program_stats(closed)
+            closed = pm.run(closed)
+            _PassesJit._trace_seq += 1
+            self.pass_stats = {"before": before,
+                               "after": program_stats(closed),
+                               "per_pass": pm.last_stats,
+                               "trace_seq": _PassesJit._trace_seq}
+
+            def run_transformed(*args, _c=closed):
+                return tuple(jax.core.eval_jaxpr(_c.jaxpr, _c.consts,
+                                                 *args))
+            entry = jax.jit(run_transformed)
+            self._compiled[key] = entry
+        return entry(*flat)
+
+
 class StaticFunction:
     """Callable that runs `fn` as one compiled XLA program."""
 
     def __init__(self, fn: Callable, layers: Optional[list] = None,
-                 input_spec=None, backend=None, **kwargs):
+                 input_spec=None, backend=None, passes=None, **kwargs):
         self._fn = fn
         self._layers = layers if layers is not None else _collect_layers(fn)
         self._input_spec = input_spec
+        self._passes = list(passes) if passes else None
         self._cache: dict = {}
         functools.update_wrapper(self, fn, updated=[])
+
+    @property
+    def pass_stats(self):
+        """Before/after program stats of the most recent passes trace
+        (None until the first compiled call, or without passes=)."""
+        latest = None
+        for entry in self._cache.values():
+            if isinstance(entry, tuple) and isinstance(entry[0],
+                                                       _PassesJit):
+                s = entry[0].pass_stats
+                if s is not None and (latest is None
+                                      or s["trace_seq"]
+                                      > latest["trace_seq"]):
+                    latest = s
+        return latest
 
     # paddle API surface
     @property
@@ -154,6 +210,8 @@ class StaticFunction:
                 for t, v in saved:
                     t._value = v
 
+        if self._passes:
+            return _PassesJit(pure, self._passes), holder
         return jax.jit(pure), holder
 
     def _try_dy2static(self, static_key):
@@ -171,7 +229,8 @@ class StaticFunction:
         new_fn = dy2static.convert_function(self._fn)
         if new_fn is None:
             return None
-        sub = StaticFunction(new_fn, layers=self._layers)
+        sub = StaticFunction(new_fn, layers=self._layers,
+                             passes=self._passes)
         self._dy2static_sub = sub   # introspection (tests/debugging)
 
         def run(*a, **k):
@@ -321,26 +380,44 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
+              backend=None, full_graph=True, passes=None, **kwargs):
     """Decorator/wrapper compiling a Layer or function into one XLA
     program. ``full_graph=True`` (default) is whole-graph jax tracing;
     ``full_graph=False`` routes through the bytecode-level SOT executor
     (reference: to_static's SOT default with graph breaks —
     python/paddle/jit/api.py — verify): Python control flow over tensor
     DATA is allowed and splits the program at graph breaks instead of
-    raising a tracer error."""
+    raising a tracer error.
+
+    ``passes``: optional sequence of jaxpr passes (see
+    ``paddle_tpu.passes.default_pipeline``) run on the traced program
+    before compilation — the TRANSFORMED jaxpr is what jit compiles
+    (reference: build_strategy.build_cinn_pass / the PIR PassManager
+    hook on to_static — verify). Inspect ``.pass_stats`` on the result
+    for before/after equation counts. Passes apply to fully-compiled
+    signatures (including dy2static-converted ones); graph-break spans
+    and eager fallbacks run untransformed. Incompatible with
+    ``full_graph=False`` (the SOT executor has no whole-program jaxpr
+    to transform) — that combination raises rather than silently
+    dropping the pipeline."""
     def decorate(obj):
         if not full_graph:
+            if passes:
+                raise ValueError(
+                    "to_static(passes=...) requires full_graph=True: "
+                    "the SOT executor compiles opcode-level spans, not "
+                    "one whole-program jaxpr the pass pipeline could "
+                    "transform")
             if isinstance(obj, Layer):
                 obj.forward = SotFunction(obj.forward)
                 return obj
             return SotFunction(obj)
         if isinstance(obj, Layer):
             static = StaticFunction(obj.forward, layers=[obj],
-                                    input_spec=input_spec)
+                                    input_spec=input_spec, passes=passes)
             obj.forward = static
             return obj
-        return StaticFunction(obj, input_spec=input_spec)
+        return StaticFunction(obj, input_spec=input_spec, passes=passes)
     if function is not None:
         return decorate(function)
     return decorate

@@ -556,7 +556,15 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             return logits, new_cache
         if labels is None:
             return logits
-        loss = F.cross_entropy(logits, labels, reduction="mean")
+        # unfused-head loss: flatten to (tokens, vocab) so the CE sees
+        # one row axis; with PT_FUSION_PASSES=1 (default off)
+        # F.cross_entropy routes these rows through the one-pass
+        # softmax-xent kernel (ops/pallas/xent) — the (tokens, vocab)
+        # log-prob/one-hot intermediates are never materialized
+        from ..ops.manipulation import reshape
+        vocab = logits.shape[-1]
+        loss = F.cross_entropy(reshape(logits, (-1, vocab)),
+                               reshape(labels, (-1,)), reduction="mean")
         return loss, logits
 
     def num_params(self):

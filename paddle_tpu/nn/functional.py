@@ -250,15 +250,19 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
                name=None):
+    """One-pass mean/var with fp32 accumulation: low-precision inputs
+    upcast for the statistics and downcast before the affine (the
+    rms_norm convention). Shifted moments read x once without the
+    E[x^2]-E[x]^2 cancellation — see ops.pallas.fused.layer_norm_one_pass
+    (shared with the fusion pass's rewrite)."""
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     ndim = len(tuple(normalized_shape))
 
     def f(v, *wb):
+        from ..ops.pallas.fused import layer_norm_one_pass
         axes = tuple(range(v.ndim - ndim, v.ndim))
-        mean = jnp.mean(v, axis=axes, keepdims=True)
-        var = jnp.var(v, axis=axes, keepdims=True)
-        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        out = layer_norm_one_pass(v, epsilon, axes)
         i = 0
         if weight is not None:
             out = out * wb[i]
@@ -877,32 +881,71 @@ def _reduce(loss, reduction):
 def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
+    """Hard-label path gathers the target log-prob with take_along_axis
+    — the old one_hot × log_softmax contraction allocated an extra
+    (N, nclass) one-hot on top of logp. Label smoothing reduces to
+    ``(1-eps)·nll - eps·mean_c(logp)`` (same algebra as the smoothed
+    one-hot contraction, no one-hot needed). With ``PT_FUSION_PASSES=1``
+    (default off) the last-axis softmax path routes to the one-pass
+    Pallas/scan kernel (ops.pallas.xent) and the (N, nclass) log-prob
+    tensor itself is never materialized either — the Llama pretrain
+    loss rides this flag."""
     def f(logits, lab, *w):
         from ..amp import black_cast
         logits = black_cast(logits, op_name="cross_entropy")
         nclass = logits.shape[axis]
-        if use_softmax:
-            logp = jax.nn.log_softmax(logits, axis=axis)
-        else:
-            logp = jnp.log(jnp.maximum(logits, 1e-30))
         if soft_label or (lab.ndim == logits.ndim and
                           lab.shape == logits.shape):
+            if use_softmax:
+                logp = jax.nn.log_softmax(logits, axis=axis)
+            else:
+                logp = jnp.log(jnp.maximum(logits, 1e-30))
             soft = lab.astype(logp.dtype)
             if label_smoothing > 0:
                 soft = soft * (1 - label_smoothing) + label_smoothing / nclass
             loss = -jnp.sum(soft * logp, axis=axis)
             return _reduce(loss, reduction)
         lab_i = lab.astype(jnp.int32)
-        if lab_i.ndim == logp.ndim:
+        if lab_i.ndim == logits.ndim:
             lab_i = jnp.squeeze(lab_i, axis)
-        onehot = jax.nn.one_hot(lab_i, nclass, axis=axis, dtype=logp.dtype)
-        if label_smoothing > 0:
-            onehot = onehot * (1 - label_smoothing) + label_smoothing / nclass
-        loss = -jnp.sum(onehot * logp, axis=axis)
+        safe = jnp.clip(lab_i, 0, nclass - 1)
+        from ..passes import fusion_enabled
+        if (use_softmax and fusion_enabled()
+                and axis in (-1, logits.ndim - 1)):
+            # fused one-pass kernel: per-row nll + lse, fp32 accumulate
+            from ..ops.pallas.xent import softmax_xent_rows
+            x2 = logits.reshape((-1, nclass))
+            nll2, lse2 = softmax_xent_rows(x2, safe.reshape((-1,)))
+            loss = nll2.reshape(lab_i.shape)
+            if label_smoothing > 0:
+                # mean_c(logp) = mean_c(logits) - lse: no logp tensor
+                mean_logit = jnp.mean(
+                    logits.astype(jnp.float32), axis=axis)
+                lse = lse2.reshape(lab_i.shape)
+                loss = (1 - label_smoothing) * loss \
+                    + label_smoothing * (lse - mean_logit)
+            # the kernel accumulates fp32; match the unfused path's
+            # dtype so the flag stays observationally transparent
+            loss = loss.astype(logits.dtype)
+        else:
+            if use_softmax:
+                logp = jax.nn.log_softmax(logits, axis=axis)
+            else:
+                logp = jnp.log(jnp.maximum(logits, 1e-30))
+            idx = jnp.expand_dims(safe, axis if axis >= 0 else logp.ndim
+                                  + axis)
+            nll = -jnp.squeeze(
+                jnp.take_along_axis(logp, idx, axis=axis),
+                axis if axis >= 0 else logp.ndim + axis)
+            if label_smoothing > 0:
+                loss = (1 - label_smoothing) * nll \
+                    - label_smoothing * jnp.mean(logp, axis=axis)
+            else:
+                loss = nll
         valid = (lab_i != ignore_index)
         loss = jnp.where(valid, loss, 0.0)
         if w:
-            wt = jnp.take(w[0], jnp.clip(lab_i, 0, nclass - 1))
+            wt = jnp.take(w[0], safe)
             loss = loss * wt
             if reduction == "mean":
                 denom = jnp.sum(jnp.where(valid, wt, 0.0))

@@ -2,3 +2,4 @@
 kernels: paddle/phi/kernels/fusion/, flash_attn — verify). Each kernel has an
 XLA fallback used on CPU / when shapes don't fit the kernel grid."""
 from . import flash_attention  # noqa: F401
+from . import xent             # noqa: F401

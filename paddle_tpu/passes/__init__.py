@@ -25,7 +25,16 @@ from jax.extend.core import (ClosedJaxpr, Jaxpr, JaxprEqn,
                              Literal, Var)
 
 __all__ = ["PassManager", "apply_passes", "dce_pass", "fold_constants",
-           "program_stats", "fuse_conv_bn"]
+           "program_stats", "fuse_conv_bn", "default_pipeline",
+           "cse_pass", "fusion_pass", "inline_pjit", "fusion_enabled"]
+
+
+def fusion_enabled() -> bool:
+    """Default-off kill switch for the reduction-fusion fast paths
+    (``PT_FUSION_PASSES=1`` turns them on). Read at call/trace time so
+    tests and benches can A/B without re-importing."""
+    from ..utils.flags import env_flag
+    return env_flag("PT_FUSION_PASSES")
 
 
 # ---------------------------------------------------------------------------
@@ -33,22 +42,49 @@ __all__ = ["PassManager", "apply_passes", "dce_pass", "fold_constants",
 # ---------------------------------------------------------------------------
 
 class PassManager:
-    """Ordered pass pipeline (reference: pir::PassManager — verify)."""
+    """Ordered pass pipeline (reference: pir::PassManager — verify).
+    Each pass runs under a ``RecordEvent("pass:<name>")`` profiler span;
+    per-pass eqn counts land in ``self.last_stats``."""
 
     def __init__(self, passes: Sequence[Callable] = ()):
         self._passes: List[Callable] = list(passes)
+        self.last_stats: List[dict] = []
 
     def add_pass(self, p: Callable):
         self._passes.append(p)
         return self
 
+    @staticmethod
+    def _name(p) -> str:
+        return getattr(p, "pass_name", getattr(p, "__name__",
+                                               type(p).__name__))
+
     def run(self, closed: ClosedJaxpr) -> ClosedJaxpr:
+        from ..profiler import RecordEvent
+        self.last_stats = []
         for p in self._passes:
-            closed = p(closed)
+            before = len(closed.jaxpr.eqns)
+            with RecordEvent(f"pass:{self._name(p)}"):
+                closed = p(closed)
+            self.last_stats.append({"pass": self._name(p),
+                                    "eqns_before": before,
+                                    "eqns_after": len(closed.jaxpr.eqns)})
         return closed
 
     def __call__(self, closed: ClosedJaxpr) -> ClosedJaxpr:
         return self.run(closed)
+
+
+def default_pipeline() -> List[Callable]:
+    """The standard optimization pipeline, outermost-enabling first:
+    inline pjit bodies (expose library-fn internals), fold constants
+    (turn shape-arithmetic into literals the matchers can pin), CSE
+    (canonicalize duplicate chains into graph identities), reduction
+    fusion, then DCE to sweep the dead interiors."""
+    from .cse import cse_pass
+    from .fusion import fusion_pass
+    from .patterns import inline_pjit
+    return [inline_pjit, fold_constants, cse_pass, fusion_pass, dce_pass]
 
 
 def apply_passes(fn: Callable, *example_args, passes: Sequence[Callable]):
@@ -63,15 +99,19 @@ def apply_passes(fn: Callable, *example_args, passes: Sequence[Callable]):
     return transformed
 
 
-def _rebuild(closed: ClosedJaxpr, eqns: List[JaxprEqn]) -> ClosedJaxpr:
+def _rebuild(closed: ClosedJaxpr, eqns: List[JaxprEqn],
+             constvars=None, consts=None) -> ClosedJaxpr:
     jaxpr = closed.jaxpr
     # propagate the source jaxpr's debug_info: constructing a Jaxpr
     # without one is deprecated (and was the suite's loudest warning)
-    new_jaxpr = Jaxpr(constvars=jaxpr.constvars, invars=jaxpr.invars,
+    new_jaxpr = Jaxpr(constvars=jaxpr.constvars if constvars is None
+                      else constvars,
+                      invars=jaxpr.invars,
                       outvars=jaxpr.outvars, eqns=eqns,
                       effects=jaxpr.effects,
                       debug_info=jaxpr.debug_info)
-    return ClosedJaxpr(new_jaxpr, closed.consts)
+    return ClosedJaxpr(new_jaxpr,
+                       closed.consts if consts is None else consts)
 
 
 # ---------------------------------------------------------------------------
@@ -105,39 +145,55 @@ _FOLDABLE = {"sin", "cos", "exp", "log", "sqrt", "rsqrt", "tanh", "neg",
 
 def fold_constants(closed: ClosedJaxpr) -> ClosedJaxpr:
     """Constant folding: evaluate foldable equations whose inputs are
-    all literals/consts at pass time and splice the results in as
-    literals (reference: pir constant_folding_pass — verify)."""
+    all literals/consts at pass time (reference: pir
+    constant_folding_pass — verify).
+
+    Scalar folded values splice back in as Literals. Non-scalar folded
+    values (and any folded value that feeds a jaxpr outvar, where a
+    Literal is not a legal binder) splice back in as CONSTVARS — the
+    folded eqn's outvar simply moves to the constvar list with its
+    computed value, so every downstream reference stays valid. The old
+    implementation dropped the producing eqn but left non-scalar uses
+    pointing at a var nothing produced."""
     jaxpr = closed.jaxpr
-    const_of = dict(zip(jaxpr.constvars, closed.consts))
-    known = dict(const_of)
+    known = dict(zip(jaxpr.constvars, closed.consts))
+    folded = {}                     # Var (eqn outvar) -> computed value
     new_eqns: List[JaxprEqn] = []
     for eqn in jaxpr.eqns:
         if (eqn.primitive.name in _FOLDABLE and not eqn.effects
                 and len(eqn.outvars) == 1
                 and all(isinstance(i, Literal) or i in known
-                        for i in eqn.invars)):
-            vals = [i.val if isinstance(i, Literal) else known[i]
+                        or i in folded for i in eqn.invars)):
+            vals = [i.val if isinstance(i, Literal)
+                    else known[i] if i in known else folded[i]
                     for i in eqn.invars]
             out = eqn.primitive.bind(*vals, **eqn.params)
-            known[eqn.outvars[0]] = out
+            folded[eqn.outvars[0]] = out
             continue
-        # replace known inputs with literals
+        # scalar known values become inline Literals
         new_invars = [
-            Literal(known[i], i.aval)
-            if isinstance(i, Var) and i in known and not i.aval.shape
+            Literal(known[i] if i in known else folded[i], i.aval)
+            if (isinstance(i, Var) and (i in known or i in folded)
+                and not i.aval.shape)
             else i
             for i in eqn.invars]
         new_eqns.append(eqn.replace(invars=new_invars))
-    # outvars that became known constants need a passthrough eqn; keep
-    # it simple: only fold when every outvar is still produced
-    produced = {o for e in new_eqns for o in e.outvars}
-    produced.update(jaxpr.constvars)
-    produced.update(jaxpr.invars)
-    if any(isinstance(o, Var) and o not in produced and o in known
-           for o in jaxpr.outvars):
-        # an output folded away entirely — bail to the safe jaxpr
-        return dce_pass(closed)
-    return dce_pass(_rebuild(closed, new_eqns))
+    # NOTE: even with nothing folded, new_eqns may carry scalar
+    # constvar->Literal substitutions the fusion matchers depend on
+    # (Lit patterns only match Literal atoms) — always rebuild.
+    # Folded vars still referenced (non-scalar uses, or outvars — a
+    # jaxpr output must stay a var) re-bind as constvars
+    still_used = {i for e in new_eqns for i in e.invars
+                  if isinstance(i, Var)}
+    out_set = {o for o in jaxpr.outvars if isinstance(o, Var)}
+    new_constvars = list(jaxpr.constvars)
+    new_consts = list(closed.consts)
+    for v, val in folded.items():
+        if v in still_used or v in out_set:
+            new_constvars.append(v)
+            new_consts.append(val)
+    return dce_pass(_rebuild(closed, new_eqns, constvars=new_constvars,
+                             consts=new_consts))
 
 
 def program_stats(closed: ClosedJaxpr) -> dict:
@@ -194,3 +250,10 @@ def fuse_conv_bn(model):
             walk(s)
     walk(model)
     return model
+
+
+# re-exported pipeline passes (import last: cse/fusion pull in patterns,
+# which lazily imports this module's _rebuild)
+from .cse import cse_pass            # noqa: E402,F401
+from .fusion import fusion_pass      # noqa: E402,F401
+from .patterns import inline_pjit    # noqa: E402,F401

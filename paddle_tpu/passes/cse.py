@@ -1,0 +1,92 @@
+"""Common-subexpression elimination over jaxprs.
+
+Reference parity: the PIR common_subexpression_elimination_pass
+(paddle/fluid/pir/transforms/ — verify). XLA runs its own CSE after
+lowering, but running it at the jaxpr level (a) shrinks the program XLA
+must lower (compile time), and (b) is what makes the fusion pass's
+pattern matching work at all: the naive two-pass layer_norm computes
+``mean(x)`` and ``x - mean`` twice (once for the output, once inside
+var), and the reduction-fusion patterns assert via capture identity
+that both uses read the SAME equation — CSE canonicalizes the duplicate
+chains into one, turning a textual duplicate into a graph identity.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from jax.extend.core import ClosedJaxpr, Literal, Var
+
+__all__ = ["cse_pass"]
+
+
+def _atom_key(atom, subst):
+    if isinstance(atom, Var):
+        atom = subst.get(atom, atom)
+        return ("v", id(atom))
+    # Literal: key by value so e.g. two `div ... 8.0` eqns unify
+    try:
+        v = np.asarray(atom.val)
+        return ("l", str(v.dtype), v.shape, v.tobytes())
+    except (TypeError, ValueError):
+        return ("l?", id(atom))
+
+
+def _params_key(params):
+    items = []
+    for k in sorted(params):
+        v = params[k]
+        try:
+            hash(v)
+        except TypeError:
+            # unhashable param (jaxpr body, callables): identity — two
+            # separately-traced pjit bodies never unify, which is safe
+            # (missed CSE, never wrong CSE)
+            v = id(v)
+        items.append((k, v))
+    return tuple(items)
+
+
+def cse_pass(closed: ClosedJaxpr) -> ClosedJaxpr:
+    """Deduplicate structurally identical effect-free equations; later
+    duplicates' outputs are substituted with the first occurrence's."""
+    from . import _rebuild
+    jaxpr = closed.jaxpr
+    seen: Dict[tuple, List[Var]] = {}
+    subst: Dict[Var, Var] = {}
+    new_eqns = []
+    for eqn in jaxpr.eqns:
+        new_invars = [subst.get(i, i) if isinstance(i, Var) else i
+                      for i in eqn.invars]
+        if eqn.effects:
+            new_eqns.append(eqn.replace(invars=new_invars))
+            continue
+        try:
+            key = (eqn.primitive.name, _params_key(eqn.params),
+                   tuple(_atom_key(i, subst) for i in eqn.invars))
+        except Exception:
+            new_eqns.append(eqn.replace(invars=new_invars))
+            continue
+        prev = seen.get(key)
+        if prev is not None:
+            for old, new in zip(eqn.outvars, prev):
+                if isinstance(old, Var):
+                    subst[old] = new
+            continue
+        seen[key] = list(eqn.outvars)
+        new_eqns.append(eqn.replace(invars=new_invars))
+    if not subst:
+        return closed
+    new_outvars = [subst.get(o, o) if isinstance(o, Var) else o
+                   for o in jaxpr.outvars]
+    out = _rebuild(closed, new_eqns)
+    if new_outvars != list(jaxpr.outvars):
+        from jax.extend.core import Jaxpr
+        j = out.jaxpr
+        out = ClosedJaxpr(
+            Jaxpr(constvars=j.constvars, invars=j.invars,
+                  outvars=new_outvars, eqns=j.eqns, effects=j.effects,
+                  debug_info=j.debug_info),
+            out.consts)
+    return out

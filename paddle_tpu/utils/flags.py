@@ -5,7 +5,18 @@ from __future__ import annotations
 import os
 from typing import Any
 
-__all__ = ["define_flag", "get_flags", "set_flags", "FLAGS"]
+__all__ = ["define_flag", "get_flags", "set_flags", "FLAGS", "env_flag"]
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Read a PT_* boolean env toggle with uniform falsy spellings
+    ('', '0', 'false', 'off', 'no' — case/whitespace-insensitive).
+    Shared by PT_FUSION_PASSES and the collectives flags so toggle
+    semantics never drift between subsystems."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("", "0", "false", "off", "no")
 
 _REGISTRY: dict[str, Any] = {}
 

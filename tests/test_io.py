@@ -122,6 +122,35 @@ class TestDevicePrefetch:
             seen.extend(int(v) for v in yb.numpy())
         assert seen == list(range(12))
 
+    def test_prefetch_honors_size_exactly(self, monkeypatch):
+        """At most ``size`` batches may be in flight (transferred but
+        not yet yielded) — the old append-then-check kept size+1 device
+        buffers live."""
+        import numpy as np
+        import jax
+        from paddle_tpu import io
+
+        size = 2
+        state = {"transferred": 0, "yielded": 0, "max_in_flight": 0}
+        real_put = jax.device_put
+
+        def counting_put(v, *a, **k):
+            state["transferred"] += 1
+            state["max_in_flight"] = max(
+                state["max_in_flight"],
+                state["transferred"] - state["yielded"])
+            return real_put(v, *a, **k)
+
+        monkeypatch.setattr(jax, "device_put", counting_put)
+        batches = [np.full((2,), i, "float32") for i in range(8)]
+        out = []
+        for b in io.device_prefetch(iter(batches), size=size):
+            state["yielded"] += 1
+            out.append(float(b[0]))
+        assert out == [float(i) for i in range(8)]      # order preserved
+        assert state["transferred"] == 8
+        assert state["max_in_flight"] <= size, state
+
     def test_prefetch_with_sharding(self):
         import jax
         import numpy as np
@@ -233,3 +262,13 @@ class TestNoPerStepSync:
         hist = eng.fit(self._ds(), epochs=2, batch_size=8, verbose=0)
         assert len(hist["loss"]) == 8
         assert all(np.isfinite(v) for v in hist["loss"])
+
+
+def test_prefetch_size_zero_passthrough():
+    """size=0 means 'no prefetch': lockstep transfer+yield (the
+    drain-before-transfer reorder used to pop an empty deque)."""
+    import numpy as np
+    from paddle_tpu import io
+    batches = [np.full((2,), i, "float32") for i in range(4)]
+    out = [float(b[0]) for b in io.device_prefetch(iter(batches), size=0)]
+    assert out == [0.0, 1.0, 2.0, 3.0]
