@@ -231,7 +231,113 @@ def _bench_continuous_decode(model_cfg, num_slots=4, decode_block=8,
         "decode_slots": num_slots,
         "decode_slot_occupancy": stats["slot_occupancy"],
         "decode_compile_count": stats["decode_compile_count"],
+        # time-to-first-token percentiles over the timed stream — the
+        # user-facing latency half of the serving headline (tokens/s
+        # alone hides admission queueing + prefill stalls)
+        "decode_ttft_p50_ms": round(stats["ttft_p50_s"] * 1000, 2),
+        "decode_ttft_p95_ms": round(stats["ttft_p95_s"] * 1000, 2),
     }
+
+
+def _bench_paged_serving(model_cfg, num_slots=4, block_size=16,
+                         decode_block=8, prefix_len=96, tail_len=8,
+                         requests=6, max_new=16):
+    """Paged-KV serving A/B on a shared-prefix workload: every request
+    repeats one system prompt with a distinct tail (the prefix cache's
+    target case). Measures (a) prefix-cache hit rate + per-slot KV HBM
+    vs the dense engine, and (b) chunked-vs-whole prefill interference:
+    max per-tick latency with a per-tick prefill token budget (chunks
+    interleave with decode) against unbudgeted whole-prompt prefill —
+    chunking bounds the decode-latency spike a long prompt causes."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    from paddle_tpu.serving import (ContinuousBatchingEngine, Scheduler,
+                                    Server)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(model_cfg)
+    rs = np.random.RandomState(0)
+    prefix = rs.randint(0, model_cfg.vocab_size,
+                        (prefix_len,)).astype(np.int32)
+    prompts = [np.concatenate([prefix, rs.randint(
+        0, model_cfg.vocab_size, (tail_len,)).astype(np.int32)])
+        for _ in range(requests)]
+    max_len = block_size * (
+        -(-(prefix_len + tail_len + max_new) // block_size))
+    chunk = block_size
+    # size the arena for the workload, not the worst case: the shared
+    # prefix blocks exist ONCE, each slot only adds its tail + decode
+    # blocks (+1 trash, +2 slack) — this is where the HBM-per-slot
+    # reduction vs the dense (num_slots * max_len) layout comes from;
+    # a transient shortage just re-queues the request
+    per_req = -(-(prefix_len + tail_len + max_new - 1) // block_size)
+    shared_blocks = prefix_len // block_size
+    num_blocks = 1 + per_req + (num_slots - 1) * (
+        per_req - shared_blocks) + 2
+
+    engine = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_len=max_len,
+        decode_block=decode_block, paged=True, block_size=block_size,
+        num_blocks=num_blocks, prefill_chunk=chunk)
+
+    def run(budget):
+        engine.reset()
+        srv = Server(engine, Scheduler(prefill_token_budget=budget))
+        for i, p in enumerate(prompts):
+            # staggered arrivals: later prompts prefill WHILE earlier
+            # requests decode — the interference case
+            srv.submit(p, max_new_tokens=max_new, arrival_step=3 * i)
+        srv.run_until_idle()
+        return srv
+
+    run(chunk)                              # compile warmup
+    srv_chunked = run(chunk)
+    st_chunked = srv_chunked.stats()
+    srv_whole = run(None)
+    st_whole = srv_whole.stats()
+
+    dense_bytes = (2 * model_cfg.num_hidden_layers * max_len
+                   * model_cfg.num_key_value_heads
+                   * (model_cfg.hidden_size
+                      // model_cfg.num_attention_heads) * 4)
+
+    out = {
+        "serving_paged_prefix_hit_rate":
+            st_chunked["prefix_cache_hit_rate"],
+        "serving_paged_kv_bytes_per_slot":
+            st_chunked["kv_bytes_per_slot"],
+        "serving_dense_kv_bytes_per_slot": dense_bytes,
+        "serving_paged_tokens_per_sec": st_chunked["tokens_per_sec"],
+        "serving_paged_max_tick_ms_chunked":
+            round(st_chunked["max_tick_s"] * 1000, 2),
+        "serving_paged_max_tick_ms_whole":
+            round(st_whole["max_tick_s"] * 1000, 2),
+        "serving_paged_ttft_p95_ms_chunked":
+            round(st_chunked["ttft_p95_s"] * 1000, 2),
+        "serving_paged_ttft_p95_ms_whole":
+            round(st_whole["ttft_p95_s"] * 1000, 2),
+        "serving_paged_compile_counts": [
+            st_chunked["decode_compile_count"],
+            engine.prefill_compile_count()],
+    }
+
+    # int8 KV point: measured dequant error of a served stream must sit
+    # under the runtime-queryable bound (the EQuARX contract applied to
+    # the cache)
+    engine8 = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_len=max_len,
+        decode_block=decode_block, paged=True, block_size=block_size,
+        num_blocks=num_blocks,       # same arena size as the fp32 A/B
+        prefill_chunk=chunk, kv_int8=True)
+    srv8 = Server(engine8, Scheduler(prefill_token_budget=chunk))
+    for p in prompts[:2]:
+        srv8.submit(p, max_new_tokens=max_new)
+    srv8.run_until_idle()
+    out["serving_paged_kv_int8_bytes_per_slot"] = \
+        engine8.backend.kv_bytes_per_slot()
+    out["serving_paged_kv_int8_error_bound"] = \
+        round(engine8.kv_error_bound(), 6)
+    return out
 
 
 def _child_tpu():
@@ -452,6 +558,13 @@ def _child_tpu():
             errors.append(err)
         decode.update(serve if serve is not None
                       else {"decode_tokens_per_sec": None})
+        _release_hbm()
+        paged, err = _staged(lambda: _bench_paged_serving(cfg_small),
+                             "serving-paged")
+        if err:
+            errors.append(err)
+        decode.update(paged if paged is not None
+                      else {"serving_paged_prefix_hit_rate": None})
         _emit(small, big, decode, errors)
         if small is None and big is None:
             raise RuntimeError("every config failed: " + "; ".join(errors))
@@ -485,14 +598,21 @@ def _child_cpu():
     # headroom only shows once compute matters.
     try:
         from paddle_tpu.models.llama import LlamaConfig
-        decode = _bench_continuous_decode(LlamaConfig(
+        serve_cfg = LlamaConfig(
             vocab_size=512, hidden_size=256, intermediate_size=768,
             num_hidden_layers=4, num_attention_heads=8,
             num_key_value_heads=8, max_position_embeddings=256,
-            tensor_parallel=False))
+            tensor_parallel=False)
+        decode = _bench_continuous_decode(serve_cfg)
     except Exception as e:
         decode = {"decode_tokens_per_sec": None,
                   "decode_error": f"{type(e).__name__}: {e}"[:300]}
+    try:
+        decode.update(_bench_paged_serving(serve_cfg))
+    except Exception as e:
+        decode.update({"serving_paged_prefix_hit_rate": None,
+                       "serving_paged_error":
+                       f"{type(e).__name__}: {e}"[:300]})
 
     cfg = llama_tiny_config(tensor_parallel=False)
     smoke = _bench_train(cfg, batch=2, seq=64, steps=4, warmup=1, peak=1e12)

@@ -113,7 +113,6 @@ def quantized_all_reduce(x, plan: HierarchyPlan,
     with jax.named_scope(f"collectives.quantized_all_reduce[{plan.mode}]"):
         flat = x.reshape(-1).astype(jnp.float32)
         size = flat.size
-        n = plan.total_size
         if plan.flat:
             flat, _ = pad_to_multiple(flat, bucket_size)
             red, s_in_max = _gather_dequant_sum(flat, plan.axes,
@@ -139,7 +138,6 @@ def quantized_all_reduce(x, plan: HierarchyPlan,
             # small fp32 all-reduce across the outer level (1/inner of
             # the payload; crosses the slow links)
             own = jax.lax.psum(own, plan.outer)
-            n = plan.total_size  # contributions summed into each elem
             # phase 2: quantized all-gather back within the inner level
             q2, s_out = _quantize(own, bucket_size)
             qg = jax.lax.all_gather(q2, plan.inner)
@@ -154,6 +152,13 @@ def quantized_all_reduce(x, plan: HierarchyPlan,
         s_in = jnp.max(s_in_max)          # scales ARE bucket absmaxes
         if not plan.flat:
             s_in = jax.lax.pmax(s_in, plan.outer)
+        # n comes from the BOUND axes, not the plan: a bare shard_map
+        # with no registered mesh plans flat with total_size=1, which
+        # understated the bound ~n-fold and let BucketedGradSync's
+        # error_bound hard-guarantee mode keep over-budget buckets.
+        # psum of the literal 1 folds to the static axis-size product
+        # at trace time (same idiom as bucketing.BucketedGradSync).
+        n = jax.lax.psum(1, plan.axes)
         bound = int8_error_bound(s_in, n,
                                  bucket_absmax_out=jnp.max(s_out))
         return out, bound

@@ -141,9 +141,9 @@ class MoELayer(Layer):
         # r3 buf.at[slot].set path as the parity reference.
         # PT_MOE_GATHER=pallas additionally routes the gathers through
         # the Pallas scalar-prefetch kernel (ops/pallas/moe_dispatch).
-        import os
+        from ....utils.flags import env_str
         self.dispatch_mode = (dispatch_mode
-                              or os.environ.get("PT_MOE_DISPATCH", "gather"))
+                              or env_str("PT_MOE_DISPATCH", "gather"))
         if gate is None:
             gate = GShardGate(d_model, num_expert, topk=top_k,
                               capacity_factor=capacity_factor)

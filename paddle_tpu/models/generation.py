@@ -63,7 +63,8 @@ def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
 
 
 def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
-                     sin=None, window=None, pad=None):
+                     sin=None, window=None, pad=None, block_table=None,
+                     kv_scales=None):
     """KV-cache attention step (pure jax), shared by every causal LM:
     optional RoPE at offset ``posv`` (cos=None skips it — e.g. GPT's
     learned positions), k/v written into the preallocated cache with
@@ -80,9 +81,29 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
     continuous-batching slot pool (serving/): each row advances its own
     timeline, so one compiled step serves slots at arbitrary decode
     depths. Per-row writes vmap the dynamic_update_slice over the batch
-    dim; the causal mask broadcasts per row."""
+    dim; the causal mask broadcasts per row.
+
+    ``block_table`` (b, max_blocks) int32 switches to the PAGED layout:
+    ``ckv``/``cvv`` are shared ``(num_blocks, block_size, kvh, d)``
+    arenas, row r's timeline position t lives at arena block
+    ``block_table[r, t // block_size]`` offset ``t % block_size``.
+    Writes scatter into the arena (positions past the table width are
+    routed to the reserved trash block 0); reads either run the Pallas
+    paged-attention kernel (TPU, s=1) or gather the table into the
+    dense timeline order and run the IDENTICAL einsum/mask/softmax
+    sequence as the dense path — paged greedy decode is bit-identical
+    to dense. Prompts are unpadded in paged mode (``pad`` ignored,
+    positions start at 0). With ``kv_scales=(sk, sv)`` the arenas hold
+    int8 codes and the scales arrays ``(num_blocks, block_size, kvh)``
+    per-vector absmaxes (EQuARX recipe; returns 5-tuple
+    ``(out, ck, cv, sk, sv)`` instead of 3)."""
     b, s, h, d = qv.shape
     posv = jnp.asarray(posv, jnp.int32)
+    paged = block_table is not None
+    if paged:
+        if posv.ndim == 0:          # paged timelines are always per-row
+            posv = jnp.broadcast_to(posv, (b,))
+        pad = None
     per_row = posv.ndim == 1                  # (b,) slot-pool positions
     if per_row and pad is None:
         pad = jnp.zeros((b,), jnp.int32)
@@ -108,7 +129,41 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
                 rot = jnp.concatenate([-x2, x1], axis=-1)
                 return x * c[:, :, None, :] + rot * sn[:, :, None, :]
             qv, kv_ = rope(qv), rope(kv_)
-    if per_row:
+    if paged:
+        from ..ops.pallas import paged_attention as _pa
+        bs_blk, mb = ckv.shape[1], block_table.shape[1]
+        tpos = posv[:, None] + jnp.arange(s)[None, :]        # (b, s)
+        blk_idx = tpos // bs_blk
+        # chunked-prefill pad columns / dead slots can aim past the
+        # table width — route those writes to the trash block 0, never
+        # out of bounds or into another slot's blocks
+        oob = blk_idx >= mb
+        blk = jnp.where(
+            oob, 0, jnp.take_along_axis(
+                block_table, jnp.clip(blk_idx, 0, mb - 1), axis=1))
+        off = jnp.where(oob, 0, tpos % bs_blk)
+        if kv_scales is not None:                    # int8 KV arenas
+            kq, ks = _pa.quantize_kv(kv_)
+            vq, vs = _pa.quantize_kv(vv)
+            ck = ckv.at[blk, off].set(kq.astype(ckv.dtype))
+            cv = cvv.at[blk, off].set(vq.astype(cvv.dtype))
+            sk = kv_scales[0].at[blk, off].set(ks)
+            sv = kv_scales[1].at[blk, off].set(vs)
+            k_read = _pa.dequantize_kv(_pa.paged_gather(ck, block_table),
+                                       _pa.paged_gather(sk, block_table))
+            v_read = _pa.dequantize_kv(_pa.paged_gather(cv, block_table),
+                                       _pa.paged_gather(sv, block_table))
+        else:
+            ck = ckv.at[blk, off].set(kv_.astype(ckv.dtype))
+            cv = cvv.at[blk, off].set(vv.astype(cvv.dtype))
+            if s == 1 and window is None and _pa._kernel_ok(ck):
+                out = _pa.paged_attention_decode(
+                    qv[:, 0], ck, cv, block_table, posv + 1,
+                    scale=scale)
+                return out[:, None].astype(qv.dtype), ck, cv
+            k_read = _pa.paged_gather(ck, block_table)
+            v_read = _pa.paged_gather(cv, block_table)
+    elif per_row:
         def upd(cachev, blockv):
             return jax.vmap(
                 lambda cr, xr, p: jax.lax.dynamic_update_slice(
@@ -117,17 +172,19 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
                                         posv)
         ck = upd(ckv, kv_)
         cv = upd(cvv, vv)
+        k_read, v_read = ck, cv
     else:
         ck = jax.lax.dynamic_update_slice(ckv, kv_.astype(ckv.dtype),
                                           (0, posv, 0, 0))
         cv = jax.lax.dynamic_update_slice(cvv, vv.astype(cvv.dtype),
                                           (0, posv, 0, 0))
-    kvh = ck.shape[2]
+        k_read, v_read = ck, cv
+    kvh = k_read.shape[2]
     g = h // kvh
     qg = qv.reshape(b, s, kvh, g, d).astype(jnp.float32)
     scores = jnp.einsum("bskgd,btkd->bkgst", qg,
-                        ck.astype(jnp.float32)) * scale
-    t_idx = jnp.arange(ck.shape[1])
+                        k_read.astype(jnp.float32)) * scale
+    t_idx = jnp.arange(k_read.shape[1])
     if per_row:
         q_idx = posv[:, None] + jnp.arange(s)[None, :]     # (b, s)
         mask = t_idx[None, None, :] <= q_idx[:, :, None]   # (b, s, T)
@@ -144,9 +201,12 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
         mask = mask & (t_idx[None, None, :] >= pad[:, None, None])
     scores = jnp.where(mask[:, None, None], scores,
                        jnp.float32(-1e30))
-    probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-    out = jnp.einsum("bkgst,btkd->bskgd", probs, cv)
-    return out.reshape(b, s, h, d).astype(qv.dtype), ck, cv
+    probs = jax.nn.softmax(scores, axis=-1).astype(v_read.dtype)
+    out = jnp.einsum("bkgst,btkd->bskgd", probs, v_read)
+    out = out.reshape(b, s, h, d).astype(qv.dtype)
+    if paged and kv_scales is not None:
+        return out, ck, cv, sk, sv
+    return out, ck, cv
 
 
 def forward_accepts_pad(cls) -> bool:
@@ -161,6 +221,19 @@ def forward_accepts_pad(cls) -> bool:
     return cached
 
 
+def forward_accepts_block_table(cls) -> bool:
+    """Whether ``cls.forward`` threads a paged-KV ``block_table``
+    through to ``cached_attention`` (the serving engine's paged mode
+    needs it). Cached per class like :func:`forward_accepts_pad`."""
+    cached = cls.__dict__.get("_fwd_accepts_block_table")
+    if cached is None:
+        import inspect
+        cached = "block_table" in inspect.signature(
+            cls.forward).parameters
+        cls._fwd_accepts_block_table = cached
+    return cached
+
+
 def build_decode_step(model, sample_kwargs, tree_holder):
     """The shared pure step: (params, bufs, token_block, cache_flat,
     pos, key) → (next_token, new_cache_flat). Serves prefill (block of
@@ -172,7 +245,8 @@ def build_decode_step(model, sample_kwargs, tree_holder):
     ptensors = [p for _, p in model.named_parameters()]
     btensors = [b for _, b in model.named_buffers()]
 
-    def pure(pv, bv, token, cache_flat, pos, key=None, pad=None):
+    def pure(pv, bv, token, cache_flat, pos, key=None, pad=None,
+             block_table=None, last_index=None):
         saved = [(t, t._value) for t in ptensors + btensors]
         was_training = model.training
         try:
@@ -184,10 +258,19 @@ def build_decode_step(model, sample_kwargs, tree_holder):
             cache = jax.tree.unflatten(tree_holder["tree"], [
                 Tensor(c) for c in cache_flat])
             kw = {} if pad is None else {"pad": Tensor(pad)}
+            if block_table is not None:     # paged-KV serving mode
+                kw["block_table"] = Tensor(block_table)
             with framework.functional_mode(), framework.no_grad_guard():
                 logits, new_cache = model.forward(
                     Tensor(token), cache=cache, pos=Tensor(pos), **kw)
-            lv = logits._value[:, -1, :].astype(jnp.float32)
+            if last_index is None:
+                lv = logits._value[:, -1, :]
+            else:
+                # chunked prefill: the last REAL token of a right-
+                # padded chunk sits at a traced index, not at -1
+                lv = jax.lax.dynamic_slice_in_dim(
+                    logits._value, last_index, 1, axis=1)[:, 0, :]
+            lv = lv.astype(jnp.float32)
             new_flat = [c._value for c in jax.tree.leaves(
                 new_cache, is_leaf=lambda x: isinstance(x, Tensor))]
             if sample_kwargs is None:      # beam head: full log-probs

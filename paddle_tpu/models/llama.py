@@ -173,12 +173,15 @@ class LlamaAttention(nn.Layer):
             self.o_proj.weight._sharding_spec = P("mp", None)
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None, pos=None,
-                pad=None):
+                pad=None, block_table=None):
         """cache=(k_cache, v_cache) of (b, max_len, kv_heads, head_dim)
         with ``pos`` the write offset → returns (out, new_cache): the
         autoregressive decode path (reference: fused_multi_transformer's
         cache_kv / PaddleNLP gen_cache — verify). ``pad`` (b,): per-row
-        left-pad counts for ragged batched decode."""
+        left-pad counts for ragged batched decode. ``block_table``
+        (b, max_blocks): paged-KV mode — ``cache`` is then the shared
+        block arenas, 2-tuple (k, v) or 4-tuple (k, v, k_scales,
+        v_scales) for the int8 arena."""
         b, s, _ = x.shape
         q = reshape(self.q_proj(x), (b, s, self.num_heads, self.head_dim))
         k = reshape(self.k_proj(x), (b, s, self.num_kv_heads, self.head_dim))
@@ -190,11 +193,31 @@ class LlamaAttention(nn.Layer):
                     "attention_mask=...) — the KV-cache path takes "
                     "per-row pad counts, not a dense attn_mask")
             from .generation import cached_attention
-            ck, cv = cache
             fn = functools.partial(
                 cached_attention, cos=cos, sin=sin,
                 scale=1.0 / math.sqrt(self.head_dim),
                 window=self.config.sliding_window)
+            if block_table is not None:
+                if len(cache) == 4:         # int8 arena + scales
+                    ck, cv, sk, sv = cache
+                    out, nck, ncv, nsk, nsv = apply_op(
+                        lambda qv, kv_, vv, ckv, cvv, skv, svv, posv, \
+                        btv: fn(qv, kv_, vv, ckv, cvv, posv,
+                                block_table=btv, kv_scales=(skv, svv)),
+                        q, k, v, ck, cv, sk, sv, pos, block_table)
+                    new_cache = (nck, ncv, nsk, nsv)
+                else:
+                    ck, cv = cache
+                    out, nck, ncv = apply_op(
+                        lambda qv, kv_, vv, ckv, cvv, posv, btv: fn(
+                            qv, kv_, vv, ckv, cvv, posv,
+                            block_table=btv),
+                        q, k, v, ck, cv, pos, block_table)
+                    new_cache = (nck, ncv)
+                out = reshape(out, (b, s,
+                                    self.num_heads * self.head_dim))
+                return self.o_proj(out), new_cache
+            ck, cv = cache
             if pad is not None:
                 out, nck, ncv = apply_op(
                     lambda qv, kv_, vv, ckv, cvv, posv, padv: fn(
@@ -257,11 +280,12 @@ class LlamaDecoderLayer(nn.Layer):
         self._seq_parallel = config.sequence_parallel
 
     def forward(self, x, cos, sin, attn_mask=None, cache=None, pos=None,
-                pad=None):
+                pad=None, block_table=None):
         if cache is not None:
             a, new_cache = self.self_attn(self.input_layernorm(x), cos,
                                           sin, attn_mask, cache=cache,
-                                          pos=pos, pad=pad)
+                                          pos=pos, pad=pad,
+                                          block_table=block_table)
             h = x + a
             return h + self.mlp(self.post_attention_layernorm(h)), \
                 new_cache
@@ -461,7 +485,7 @@ class LlamaModel(nn.Layer):
         self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
     def forward(self, input_ids, attn_mask=None, cache=None, pos=None,
-                pad=None):
+                pad=None, block_table=None):
         x = self.embed_tokens(input_ids)
         cos, sin = self.rope_cos._value, self.rope_sin._value
         if cache is not None:
@@ -474,7 +498,7 @@ class LlamaModel(nn.Layer):
             new_cache = []
             for layer, layer_cache in zip(self.layers, cache):
                 x, nc = layer(x, cos, sin, attn_mask, cache=layer_cache,
-                              pos=pos, pad=pad)
+                              pos=pos, pad=pad, block_table=block_table)
                 new_cache.append(nc)
             return self.norm(x), new_cache
         if isinstance(self.layers, LlamaDecoderStack):
@@ -507,8 +531,29 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         return [(Tensor(jnp.zeros(shape, dt)), Tensor(jnp.zeros(shape, dt)))
                 for _ in range(c.num_hidden_layers)]
 
+    def init_paged_kv_cache(self, num_blocks: int, block_size: int,
+                            kv_int8: bool = False, dtype=None):
+        """Paged-KV arenas for the serving engine: per layer a shared
+        ``(num_blocks, block_size, kv_heads, head_dim)`` (k, v) pair —
+        block 0 is the reserved trash block — or, with ``kv_int8``, the
+        int8 code arenas plus ``(num_blocks, block_size, kv_heads)``
+        fp32 per-vector absmax scales (4-tuple per layer)."""
+        c = self.config
+        head_dim = c.hidden_size // c.num_attention_heads
+        shape = (num_blocks, block_size, c.num_key_value_heads, head_dim)
+        if kv_int8:
+            sshape = shape[:-1]
+            return [(Tensor(jnp.zeros(shape, jnp.int8)),
+                     Tensor(jnp.zeros(shape, jnp.int8)),
+                     Tensor(jnp.zeros(sshape, jnp.float32)),
+                     Tensor(jnp.zeros(sshape, jnp.float32)))
+                    for _ in range(c.num_hidden_layers)]
+        dt = jnp.dtype(dtype or c.dtype)
+        return [(Tensor(jnp.zeros(shape, dt)), Tensor(jnp.zeros(shape, dt)))
+                for _ in range(c.num_hidden_layers)]
+
     def forward(self, input_ids, labels=None, attn_mask=None, cache=None,
-                pos=None, pad=None):
+                pos=None, pad=None, block_table=None):
         """Causal LM forward. labels given → (loss, logits); NOTE: with
         ``config.fused_head_ce`` (default) the logits slot is ``None`` —
         the fused head never materializes them. Set
@@ -517,7 +562,8 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         ``pad`` (b,): per-row left-pad counts on the KV-cache path."""
         if cache is not None:
             h, new_cache = self.llama(input_ids, attn_mask, cache=cache,
-                                      pos=pos, pad=pad)
+                                      pos=pos, pad=pad,
+                                      block_table=block_table)
         else:
             h = self.llama(input_ids, attn_mask)
         c = self.config

@@ -1,5 +1,6 @@
 """Pallas/Mosaic TPU kernels for the fused hot set (reference's CUDA fused
 kernels: paddle/phi/kernels/fusion/, flash_attn — verify). Each kernel has an
 XLA fallback used on CPU / when shapes don't fit the kernel grid."""
-from . import flash_attention  # noqa: F401
-from . import xent             # noqa: F401
+from . import flash_attention   # noqa: F401
+from . import paged_attention   # noqa: F401
+from . import xent              # noqa: F401

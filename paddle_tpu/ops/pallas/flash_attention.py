@@ -13,11 +13,12 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ...utils.flags import env_int, env_str
 
 
 def _xla_sdpa(q, k, v, mask=None, is_causal=False, dropout_p=0.0,
@@ -371,8 +372,8 @@ def _jax_flash_blocks(jfa, sq, sk):
     tile — FLASH_BLOCKS_r03.json records the on-chip sweep; 512 wins.
     Env overrides: PT_JAX_FLASH_BLOCK (kv block), PT_JAX_FLASH_BLOCK_Q.
     Returns None (= kernel default) when the sequence doesn't tile."""
-    pref = int(os.environ.get("PT_JAX_FLASH_BLOCK", "512"))
-    pref_q = int(os.environ.get("PT_JAX_FLASH_BLOCK_Q", str(pref)))
+    pref = env_int("PT_JAX_FLASH_BLOCK", 512)
+    pref_q = env_int("PT_JAX_FLASH_BLOCK_Q", pref)
     bq = _pick_block(sq, min(pref_q, sq))
     bk = _pick_block(sk, min(pref, sk))
     if bq is None or bk is None or (bq <= 128 and bk <= 128):
@@ -445,7 +446,7 @@ def _splash_attention(q, k, v, is_causal, scale, window=None):
     # tiling PROFILE_r03 measured at 53% of step time on the jax flash
     # kernel; hand it 512-class tiles when the sequence tiles
     # (PT_SPLASH_BLOCK overrides, 0 = kernel defaults)
-    pref = int(os.environ.get("PT_SPLASH_BLOCK", "512"))
+    pref = env_int("PT_SPLASH_BLOCK", 512)
     blocks = None
     bq = _pick_block(sq, min(pref, sq)) if pref else None
     bk = _pick_block(sk, min(pref, sk)) if pref else None
@@ -497,7 +498,7 @@ def sdpa(q, k, v, mask=None, is_causal=False, dropout_p=0.0, scale=None,
         # PT_SDPA_PREFER overrides the equal-heads route for on-chip
         # A/B ("splash" | "jax_flash" | "fused"); GQA/window always
         # prefer splash (the only kernel that avoids K/V repeat)
-        prefer = os.environ.get("PT_SDPA_PREFER", "")
+        prefer = env_str("PT_SDPA_PREFER")
         try:
             if gqa or window is not None or prefer == "splash":
                 out = _splash_attention(q, k, v, is_causal, scale, window)

@@ -31,11 +31,11 @@ The gather itself has two implementations, selectable via
 """
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from ...utils.flags import env_int, env_str
 
 __all__ = ["gather_rows", "moe_dispatch", "moe_combine",
            "build_index_maps"]
@@ -91,7 +91,7 @@ def _pallas_ok(d: int, dtype) -> bool:
 
 
 def _gather_impl() -> str:
-    return os.environ.get("PT_MOE_GATHER", "jnp")
+    return env_str("PT_MOE_GATHER", "jnp")
 
 
 def _gather_rows_jnp(x, idx):
@@ -206,7 +206,7 @@ def gather_rows(x, idx):
         return _gather_rows_pallas(x, idx)
     if impl == "pallas_mr" and _pallas_ok(x.shape[-1], x.dtype):
         return _gather_rows_pallas_mr(
-            x, idx, int(os.environ.get("PT_MOE_GATHER_ROWS", "8")))
+            x, idx, env_int("PT_MOE_GATHER_ROWS", 8))
     return _gather_rows_jnp(x, idx)
 
 

@@ -10,9 +10,11 @@ path (``inference.export_decoder(engine_slots=...)`` +
 serialized artifact alone."""
 from .engine import (ArtifactStepBackend, ContinuousBatchingEngine,
                      ModelStepBackend, slot_sample_logits)
+from .paging import BlockManager, PagedEngine, PagedModelStepBackend
 from .scheduler import Request, Scheduler
 from .server import Server
 
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
-           "ArtifactStepBackend", "Request", "Scheduler", "Server",
+           "ArtifactStepBackend", "BlockManager", "PagedEngine",
+           "PagedModelStepBackend", "Request", "Scheduler", "Server",
            "slot_sample_logits"]

@@ -34,7 +34,8 @@ import numpy as np
 
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
            "ArtifactStepBackend", "slot_sample_logits", "init_slot_state",
-           "build_slot_block_fn", "build_slot_prefill_fn"]
+           "build_slot_block_fn", "build_slot_prefill_fn",
+           "build_paged_chunk_fn"]
 
 
 def slot_sample_logits(logits, keys, temperature, top_k, top_p):
@@ -90,7 +91,8 @@ def init_slot_state(num_slots: int) -> Dict[str, jnp.ndarray]:
     }
 
 
-def build_slot_block_fn(pure, block: int, trace_counter=None):
+def build_slot_block_fn(pure, block: int, trace_counter=None,
+                        paged: bool = False):
     """The engine's ONE decode program: ``lax.scan`` of the shared step
     over ``block`` tokens with per-slot positions. Each scan iteration:
     per-slot key split -> forward (vector ``pos``, per-slot ``pad``) ->
@@ -98,7 +100,13 @@ def build_slot_block_fn(pure, block: int, trace_counter=None):
     slot's ``live`` drops and its pos/tok freeze — it is masked junk
     until the host refills it between blocks). Emits the (block, S)
     token matrix plus per-step live-slot counts (the occupancy/tok-s
-    numerators), so the host syncs ONCE per block."""
+    numerators), so the host syncs ONCE per block.
+
+    ``paged``: the state carries a per-slot block ``table`` and the
+    cache is the shared block arena; dead slots' tables are redirected
+    to the trash block 0 IN-GRAPH, so a retired slot whose blocks the
+    host has already handed to another request can never scatter junk
+    into them mid-block."""
 
     def block_fn(pv, bv, cache_flat, state):
         if trace_counter is not None:       # runs only while tracing
@@ -108,8 +116,13 @@ def build_slot_block_fn(pure, block: int, trace_counter=None):
             cf, st = carry
             sp = jax.vmap(jax.random.split)(st["key"])     # (S, 2, 2)
             new_key, sub = sp[:, 0], sp[:, 1]
-            logp, cf = pure(pv, bv, st["tok"][:, None], cf, st["pos"],
-                            None, st["pad"])
+            if paged:
+                tbl = jnp.where(st["live"][:, None], st["table"], 0)
+                logp, cf = pure(pv, bv, st["tok"][:, None], cf,
+                                st["pos"], None, None, tbl)
+            else:
+                logp, cf = pure(pv, bv, st["tok"][:, None], cf,
+                                st["pos"], None, st["pad"])
             nxt = slot_sample_logits(logp, sub, st["temp"], st["topk"],
                                      st["topp"])
             live = st["live"]
@@ -153,6 +166,31 @@ def build_slot_prefill_fn(pure, row_specs):
     return prefill_fn
 
 
+def build_paged_chunk_fn(pure, chunk: int, trace_counter=None):
+    """ONE chunked-prefill program for every prompt of every length:
+    a fixed ``(1, chunk)`` right-padded token window written straight
+    into the paged arena through the request's block table (pad columns
+    carry junk K/V that decode overwrites before it can ever be
+    attended — writes past the table width land in the trash block).
+    The candidate first token is sampled in-graph from the last REAL
+    column with the request's own params; the host uses it only on the
+    final chunk. Unlike the dense engine's per-bucket prefill jits,
+    this compiles exactly once."""
+
+    def chunk_fn(pv, bv, ids, cache_flat, table, start_pos, n_valid,
+                 key, temp, topk, topp):
+        if trace_counter is not None:       # runs only while tracing
+            trace_counter[0] += 1
+        logp, cache_flat = pure(
+            pv, bv, ids, cache_flat, jnp.reshape(start_pos, (1,)),
+            None, None, table, n_valid - 1)
+        tok0 = slot_sample_logits(logp, key[None], temp[None],
+                                  topk[None], topp[None])[0]
+        return tok0, cache_flat
+
+    return chunk_fn
+
+
 def _admit_fn(cache_flat, state, row_flat, slot, tok0, pos0, pad0, rem0,
               eos0, temp0, topk0, topp0, key0):
     """Splice a prefilled row into the pool (dynamic_update_slice on the
@@ -179,7 +217,23 @@ def _admit_fn(cache_flat, state, row_flat, slot, tok0, pos0, pad0, rem0,
     return new_cache, new_state
 
 
-class ModelStepBackend:
+class _StepBackendCommon:
+    """Shared slot-state/accounting helpers for every step backend
+    (in-process, paged, AOT) — keyed off ``num_slots``/``pool_specs``
+    which each backend sets up."""
+
+    def init_state(self):
+        return init_slot_state(self.num_slots)
+
+    def kv_bytes_per_slot(self) -> int:
+        """HBM bytes of KV cache per slot (the paged backend's arena is
+        shared, so its per-slot figure shrinks with block count)."""
+        total = sum(int(np.prod(shape)) * np.dtype(dtype).itemsize
+                    for shape, dtype in self.pool_specs)
+        return total // self.num_slots
+
+
+class ModelStepBackend(_StepBackendCommon):
     """In-process backend: jits the slot block + per-bucket prefills
     over a live model (the same pure step ``generate()`` uses)."""
 
@@ -228,7 +282,7 @@ class ModelStepBackend:
         return fn(self._pv, self._bv, ids, pad, key, temp, topk, topp)
 
 
-class ArtifactStepBackend:
+class ArtifactStepBackend(_StepBackendCommon):
     """AOT backend: the SAME engine programs, deserialized from an
     ``export_decoder(..., engine_slots=...)`` artifact — no model code
     or tracing needed on the serving host (reference: AnalysisPredictor
@@ -269,11 +323,15 @@ class ArtifactStepBackend:
 
 @dataclass
 class _SlotRun:
-    """Host-side bookkeeping for one in-flight request."""
+    """Host-side bookkeeping for one in-flight request. ``t_admit`` is
+    the moment the first token existed (prefill completion) — the TTFT
+    timestamp. ``block_ids``: the paged engine's arena blocks to
+    release at retirement (None on the dense engine)."""
     request: object
     tokens: List[int] = field(default_factory=list)
     t_admit: float = 0.0
     t_done: float = 0.0
+    block_ids: Optional[List[int]] = None
 
 
 class ContinuousBatchingEngine:
@@ -281,12 +339,37 @@ class ContinuousBatchingEngine:
     the device once per ``decode_block`` tokens: it reads the (block, S)
     token matrix plus the post-block ``remaining`` counters, harvests
     retired requests, and refills free slots — the decode program itself
-    is compiled exactly once for the engine's lifetime."""
+    is compiled exactly once for the engine's lifetime.
+
+    ``paged=True`` (or ``PT_SERVING_PAGED=1``) constructs the
+    block-paged variant (``serving.paging.PagedEngine``): shared KV
+    arena + per-slot block tables, ref-counted prefix reuse, chunked
+    prefill — see that module for the paged-only knobs."""
+
+    def __new__(cls, *args, **kw):
+        if cls is ContinuousBatchingEngine:
+            paged = kw.get("paged")
+            backend = kw.get("backend") if len(args) < 6 else args[5]
+            if paged is None:
+                from ..utils.flags import env_flag
+                from .paging import PagedModelStepBackend
+                if isinstance(backend, PagedModelStepBackend):
+                    paged = True     # a paged backend IS the decision
+                elif backend is None:
+                    paged = env_flag("PT_SERVING_PAGED")
+                # an explicit non-paged backend (e.g. the AOT
+                # ArtifactStepBackend in GenerationPredictor) is never
+                # rerouted by the env flag — paged export is a ROADMAP
+                # follow-up
+            if paged:
+                from .paging import PagedEngine
+                return object.__new__(PagedEngine)
+        return object.__new__(cls)
 
     def __init__(self, model=None, num_slots: int = 4, max_len: int = 256,
                  decode_block: int = 8,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 backend=None):
+                 backend=None, *, paged: Optional[bool] = None):
         if backend is None:
             if model is None:
                 raise ValueError("pass a model or a step backend")
@@ -306,8 +389,9 @@ class ContinuousBatchingEngine:
         """Free every slot and zero the counters (compiled programs are
         kept — repeat streams never recompile)."""
         self._cache = self.backend.pool_cache()
-        self._state = init_slot_state(self.num_slots)
+        self._state = self.backend.init_state()
         self._slots: List[Optional[_SlotRun]] = [None] * self.num_slots
+        self._prefill_slots: set = set()   # paged: mid-prefill slots
         self._remaining_host = np.zeros((self.num_slots,), np.int64)
         self._finished: List[_SlotRun] = []
         self.steps = 0                # engine decode steps executed
@@ -321,6 +405,13 @@ class ContinuousBatchingEngine:
 
     def has_live(self) -> bool:
         return any(s is not None for s in self._slots)
+
+    def has_decoding(self) -> bool:
+        """Any slot past prefill (worth running a decode block for) —
+        differs from :meth:`has_live` only on the paged engine, where a
+        slot can be occupied but still mid-chunked-prefill."""
+        return any(s is not None and i not in self._prefill_slots
+                   for i, s in enumerate(self._slots))
 
     def occupancy(self) -> float:
         """Fraction of decode-block slot-steps that emitted a token
@@ -408,13 +499,20 @@ class ContinuousBatchingEngine:
         self._remaining_host[slot] = rem0
         return False
 
+    def try_admit(self, request) -> bool:
+        """Admit if resources allow; False means "retry later" (the
+        paged engine's block pool can be exhausted even with a free
+        slot — the dense engine always admits into a free slot)."""
+        self.admit(request)
+        return True
+
     # -- decode ------------------------------------------------------------
     def step_block(self):
         """Run one compiled decode block over the pool, then sync ONCE:
         pull the token matrix + remaining counters, credit each live
         slot its emitted tokens, retire finished slots."""
         from ..profiler import RecordEvent
-        if not self.has_live():
+        if not self.has_decoding():
             return
         with RecordEvent("serving.decode_block"):
             self._cache, self._state, toks, lives = \
@@ -428,17 +526,22 @@ class ContinuousBatchingEngine:
         self.tokens_emitted += int(lives_np.sum())
         now = time.perf_counter()
         for slot, run in enumerate(self._slots):
-            if run is None:
-                continue
+            if run is None or slot in self._prefill_slots:
+                continue     # mid-prefill slots are not decoding yet
             # live is monotone within a block (True rows are a prefix)
             n = int(lives_np[:, slot].sum())
             if n > 0:
                 run.tokens.extend(int(t) for t in toks_np[:n, slot])
             self._remaining_host[slot] = rem_np[slot]
             if rem_np[slot] == 0:
-                run.t_done = now
-                self._finished.append(run)
-                self._slots[slot] = None
+                self._retire(slot, run, now)
+
+    def _retire(self, slot, run, now):
+        """Move a finished slot to the harvest list (the paged engine
+        also releases the slot's arena blocks here)."""
+        run.t_done = now
+        self._finished.append(run)
+        self._slots[slot] = None
 
     def drain_finished(self) -> List[_SlotRun]:
         done, self._finished = self._finished, []

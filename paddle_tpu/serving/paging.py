@@ -1,0 +1,525 @@
+"""Block-paged KV cache for the continuous-batching engine: shared
+arena + per-slot block tables, ref-counted prefix reuse, chunked
+prefill.
+
+The dense engine (engine.py) preallocates one ``(max_len, kvh, d)`` KV
+row per slot, so HBM is sized for the worst-case sequence and a shared
+system prompt is recomputed and stored per request. Paged mode replaces
+the per-slot rows with ONE ``(num_blocks, block_size, kvh, d)`` arena
+per layer plus an in-graph ``(S, max_blocks)`` block table riding the
+slot state (vLLM's PagedAttention restated under the repo's
+static-shape rules — the table is state, the arena never reshapes):
+
+- ``BlockManager`` (host): free list + refcounts + a rolling-hash
+  prefix index. Full prompt blocks are keyed by the chain digest of
+  their token contents; a later request with the same prefix maps the
+  SAME arena blocks into its table and skips recomputing them. A
+  retired request's registered blocks stay cached (refcount 0, LRU)
+  until the pool needs them, so a hot system prompt survives across
+  requests. Hash collisions are detected by comparing the stored token
+  tuple and fall back to recompute. Block 0 is the reserved trash
+  block: dead slots' in-graph writes are redirected there, so a block
+  the host has re-allocated mid-stream can never be corrupted.
+- Chunked prefill: prompts are processed through ONE compiled
+  ``(1, prefill_chunk)`` program (engine.build_paged_chunk_fn) in
+  chunks interleaved with decode blocks, paced by the scheduler's
+  per-tick prefill token budget — a long prompt no longer stalls every
+  in-flight decode for its whole length, it steals at most
+  ``budget`` tokens of prefill per tick. The dense engine's per-bucket
+  prefill jits collapse to one program.
+- Attention runs the Pallas paged-attention kernel on TPU and the
+  gathered-dense reference off-TPU (ops/pallas/paged_attention.py);
+  greedy paged streams are bit-identical to the dense fp32 engine and
+  to per-request ``generate()``.
+- ``kv_int8=True`` stores the arena as int8 codes + per-vector fp32
+  absmax scales (the EQuARX recipe from
+  ``distributed/collectives/quantized.py``; ~3.9x less KV HBM); the
+  worst-case dequant error is runtime-queryable via
+  :meth:`PagedEngine.kv_error_bound`.
+
+Everything is default-off: construct ``ContinuousBatchingEngine(...,
+paged=True)`` or set ``PT_SERVING_PAGED=1`` (``PT_SERVING_KV_INT8=1``
+for the int8 arena).
+"""
+from __future__ import annotations
+
+import hashlib
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.flags import env_flag, env_int
+from .engine import (ContinuousBatchingEngine, ModelStepBackend, _SlotRun,
+                     build_paged_chunk_fn, build_slot_block_fn,
+                     init_slot_state)
+
+__all__ = ["BlockManager", "PagedModelStepBackend", "PagedEngine"]
+
+TRASH_BLOCK = 0
+
+
+def _sha1_chain(parent_digest: bytes, tokens: Tuple[int, ...]) -> bytes:
+    """Rolling block hash: H(parent_digest || token bytes). Chaining
+    makes the key position-dependent — block j only matches block j of
+    an identical prefix, never a same-content block elsewhere."""
+    h = hashlib.sha1(parent_digest)
+    h.update(np.asarray(tokens, np.int32).tobytes())
+    return h.digest()
+
+
+class BlockManager:
+    """Host-side arena bookkeeping: free list, per-block refcounts,
+    rolling-hash prefix index with LRU retention of released registered
+    blocks. Pure python — it runs once per admission/retirement, never
+    inside the compiled stream."""
+
+    def __init__(self, num_blocks: int, block_size: int, hash_fn=None):
+        if num_blocks < 2:
+            raise ValueError(f"num_blocks={num_blocks}: need at least "
+                             "the trash block plus one usable block")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.hash_fn = hash_fn or _sha1_chain
+        self.reset()
+
+    def reset(self):
+        self._free: List[int] = list(range(1, self.num_blocks))
+        self._ref: Dict[int, int] = {}          # allocated -> refcount
+        self._index: Dict[bytes, Tuple[int, Tuple[int, ...]]] = {}
+        self._digest_of: Dict[int, bytes] = {}  # registered blocks
+        self._cached: "OrderedDict[int, None]" = OrderedDict()
+        self.lookups = 0
+        self.hit_blocks = 0
+
+    # -- capacity ----------------------------------------------------------
+    def available(self) -> int:
+        return len(self._free) + len(self._cached)
+
+    def allocate(self, n: int) -> Optional[List[int]]:
+        """n fresh blocks at refcount 1, evicting LRU cached prefix
+        blocks if the free list runs short; None if the pool can't
+        cover the request (caller re-queues)."""
+        if self.available() < n:
+            return None
+        out = []
+        for _ in range(n):
+            if self._free:
+                b = self._free.pop()
+            else:                      # evict the LRU cached prefix
+                b, _ = self._cached.popitem(last=False)
+                del self._index[self._digest_of.pop(b)]
+            self._ref[b] = 1
+            out.append(b)
+        return out
+
+    # -- prefix sharing ----------------------------------------------------
+    def _shareable_blocks(self, prompt) -> int:
+        # whole blocks only, and never the one holding the LAST prompt
+        # token — at least one token must prefill so the first-token
+        # logits exist
+        return (len(prompt) - 1) // self.block_size
+
+    def match_prefix(self, prompt) -> List[int]:
+        """Longest chain of indexed blocks matching the prompt's full
+        prefix blocks; each match is ref-acquired for the caller. A
+        digest hit whose stored tokens differ (hash collision) stops
+        the chain — the caller just recomputes from there."""
+        bs = self.block_size
+        self.lookups += 1
+        blocks: List[int] = []
+        parent = b""
+        for j in range(self._shareable_blocks(prompt)):
+            chunk = tuple(int(t) for t in prompt[j * bs:(j + 1) * bs])
+            digest = self.hash_fn(parent, chunk)
+            entry = self._index.get(digest)
+            if entry is None or entry[1] != chunk:
+                break
+            blocks.append(entry[0])
+            parent = digest
+        for b in blocks:
+            self._acquire(b)
+        self.hit_blocks += len(blocks)
+        return blocks
+
+    def _acquire(self, block_id: int):
+        r = self._ref.get(block_id, 0)
+        if r == 0:                    # resurrect from the LRU cache
+            del self._cached[block_id]
+        self._ref[block_id] = r + 1
+
+    def register_prefix(self, prompt, block_ids: Sequence[int]):
+        """Index the prompt's full prefix blocks (now filled) so later
+        requests can share them. Blocks that were themselves matched
+        from the index re-derive the same digests — no-ops."""
+        bs = self.block_size
+        parent = b""
+        for j in range(self._shareable_blocks(prompt)):
+            chunk = tuple(int(t) for t in prompt[j * bs:(j + 1) * bs])
+            digest = self.hash_fn(parent, chunk)
+            bid = block_ids[j]
+            if digest not in self._index and bid not in self._digest_of:
+                self._index[digest] = (bid, chunk)
+                self._digest_of[bid] = digest
+            parent = digest
+
+    def release(self, block_ids: Sequence[int]):
+        """Drop one reference per block. At refcount 0 a registered
+        block parks in the LRU cache (still matchable); an unregistered
+        one returns to the free list. Releasing an unheld block is a
+        hard error — the double-free guard."""
+        for bid in block_ids:
+            r = self._ref.get(bid)
+            if not r:
+                raise RuntimeError(f"double free of arena block {bid}")
+            if r > 1:
+                self._ref[bid] = r - 1
+            else:
+                del self._ref[bid]
+                if bid in self._digest_of:
+                    self._cached[bid] = None
+                else:
+                    self._free.append(bid)
+
+
+class PagedModelStepBackend(ModelStepBackend):
+    """Paged twin of ModelStepBackend: the pool cache is the shared
+    block arena, the decode program threads the in-state block table
+    through the forward, and prefill is ONE fixed-shape chunk program
+    instead of per-bucket jits."""
+
+    def __init__(self, model, num_slots: int, max_len: int,
+                 decode_block: int, block_size: int, num_blocks: int,
+                 kv_int8: bool, prefill_chunk: int):
+        from ..models.generation import (build_decode_step,
+                                         forward_accepts_block_table,
+                                         forward_accepts_pad)
+        from ..tensor import Tensor
+        if not forward_accepts_pad(type(model)):
+            raise ValueError(
+                f"{type(model).__name__}.forward does not accept per-row "
+                "pad counts — the slot pool needs ragged decode support")
+        if not forward_accepts_block_table(type(model)):
+            raise ValueError(
+                f"{type(model).__name__}.forward does not accept a "
+                "block_table — paged KV needs it threaded to "
+                "cached_attention (see models/llama.py)")
+        if max_len % block_size != 0:
+            raise ValueError(f"max_len={max_len} must be a multiple of "
+                             f"block_size={block_size}")
+        self.num_slots, self.max_len = num_slots, max_len
+        self.block_size = decode_block
+        self.kv_block_size = block_size
+        self.num_kv_blocks = num_blocks
+        self.max_blocks = max_len // block_size
+        self.kv_int8 = kv_int8
+        self.prefill_chunk_len = prefill_chunk
+        tree_holder = {"tree": None}
+        self._pure = build_decode_step(model, None, tree_holder)
+        cache0 = model.init_paged_kv_cache(num_blocks, block_size,
+                                           kv_int8=kv_int8)
+        flat, tree = jax.tree.flatten(
+            cache0, is_leaf=lambda x: isinstance(x, Tensor))
+        tree_holder["tree"] = tree
+        self.pool_specs = tuple((c._value.shape, c._value.dtype)
+                                for c in flat)
+        self._pv = [p._value for _, p in model.named_parameters()]
+        self._bv = [b._value for _, b in model.named_buffers()]
+        self.decode_traces = [0]
+        self.prefill_traces = [0]
+        self._block_jit = jax.jit(
+            build_slot_block_fn(self._pure, decode_block,
+                                self.decode_traces, paged=True),
+            donate_argnums=(2, 3))
+        self._chunk_jit = jax.jit(
+            build_paged_chunk_fn(self._pure, prefill_chunk,
+                                 self.prefill_traces),
+            donate_argnums=(3,))
+
+    def init_state(self):
+        state = init_slot_state(self.num_slots)
+        state["table"] = jnp.zeros((self.num_slots, self.max_blocks),
+                                   jnp.int32)        # all-trash tables
+        return state
+
+    def prefill_chunk(self, ids, cache_flat, table_row, start_pos,
+                      n_valid, key, temp, topk, topp):
+        return self._chunk_jit(self._pv, self._bv, ids, cache_flat,
+                               table_row, start_pos, n_valid, key, temp,
+                               topk, topp)
+
+    def prefill(self, *a, **kw):
+        raise RuntimeError("the paged backend prefills in chunks — use "
+                           "prefill_chunk (engine.admit drives it)")
+
+
+def _arm_fn(state, slot, table_row, tok0, pos0, rem0, eos0, temp0,
+            topk0, topp0, key0):
+    """Turn a slot live after its chunked prefill finished: the arena
+    already holds the prompt's K/V, so arming is a pure state update
+    (the paged analogue of engine._admit_fn without the row splice).
+    ``slot`` is traced — one compiled program serves every arming."""
+
+    def set1(a, v):
+        return a.at[slot].set(jnp.asarray(v, a.dtype))
+
+    return dict(
+        state, tok=set1(state["tok"], tok0),
+        pos=set1(state["pos"], pos0),
+        pad=set1(state["pad"], 0),        # paged prompts are unpadded
+        live=set1(state["live"], rem0 > 0),
+        eos=set1(state["eos"], eos0),
+        remaining=set1(state["remaining"], rem0),
+        key=state["key"].at[slot].set(key0),
+        temp=set1(state["temp"], temp0),
+        topk=set1(state["topk"], topk0),
+        topp=set1(state["topp"], topp0),
+        table=state["table"].at[slot].set(table_row))
+
+
+@dataclass
+class _PrefillJob:
+    """One admitted request still streaming its prompt into the arena
+    (``done`` counts tokens already resident, including the shared
+    prefix it skipped)."""
+    run: _SlotRun
+    slot: int
+    prompt: np.ndarray
+    done: int
+    table_row: np.ndarray          # (max_blocks,) int32
+    key: jnp.ndarray               # post-split state key
+    sub: jnp.ndarray               # prefill sampling key
+    temp: jnp.ndarray
+    topk: jnp.ndarray
+    topp: jnp.ndarray
+    tok0: Optional[int] = None
+
+
+class PagedEngine(ContinuousBatchingEngine):
+    """Paged-KV continuous batching. Same Server/Scheduler contract as
+    the dense engine; differences:
+
+    - ``admit()`` only reserves blocks and queues a prefill job; the
+      prompt streams into the arena via :meth:`prefill_tick` (chunk
+      programs), and the slot arms when its last chunk lands.
+    - ``try_admit()`` can return False (block pool exhausted) — the
+      Server re-queues and retries after retirements free blocks.
+    - prompts are UNPADDED (no buckets): position 0 is token 0, which
+      is what makes whole prefix blocks shareable across requests.
+    """
+
+    def __init__(self, model=None, num_slots: int = 4,
+                 max_len: int = 256, decode_block: int = 8,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 backend=None, *, paged: bool = True,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 kv_int8: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None,
+                 hash_fn=None):
+        if prompt_buckets is not None:
+            raise ValueError(
+                "paged mode takes no prompt_buckets: prompts are "
+                "unpadded and prefilled in fixed-size chunks")
+        if backend is not None:
+            # the backend already baked these in — a silently ignored
+            # kv_int8=True (fp32 arena, bound 0.0) or num_blocks would
+            # be a misconfiguration, not a preference
+            given = {k: v for k, v in (("block_size", block_size),
+                                       ("num_blocks", num_blocks),
+                                       ("kv_int8", kv_int8),
+                                       ("prefill_chunk", prefill_chunk))
+                     if v is not None}
+            if given:
+                raise ValueError(
+                    f"{sorted(given)} cannot be set alongside an "
+                    "explicit backend — they are baked into it at "
+                    "construction")
+        if block_size is None:
+            block_size = env_int("PT_SERVING_BLOCK_SIZE", 16)
+        if num_blocks is None:
+            # full dense capacity + trash by default — HBM savings come
+            # from passing a smaller pool (plus sharing); correctness
+            # never depends on the pool being oversized
+            num_blocks = 1 + num_slots * (max_len // block_size)
+        if kv_int8 is None:
+            kv_int8 = env_flag("PT_SERVING_KV_INT8")
+        if prefill_chunk is None:
+            prefill_chunk = env_int("PT_SERVING_PREFILL_CHUNK",
+                                    2 * block_size)
+        if backend is None:
+            if model is None:
+                raise ValueError("pass a model or a paged step backend")
+            backend = PagedModelStepBackend(
+                model, num_slots, max_len, decode_block, block_size,
+                num_blocks, bool(kv_int8), prefill_chunk)
+        self.kv_block_size = backend.kv_block_size
+        self.num_kv_blocks = backend.num_kv_blocks
+        self.max_blocks = backend.max_blocks
+        self.kv_int8 = backend.kv_int8
+        self.prefill_chunk_len = backend.prefill_chunk_len
+        self.manager = BlockManager(self.num_kv_blocks,
+                                    self.kv_block_size, hash_fn)
+        self._arm_jit = jax.jit(_arm_fn, donate_argnums=(0,))
+        super().__init__(backend=backend)
+
+    # -- lifecycle ---------------------------------------------------------
+    def reset(self):
+        super().reset()
+        self.manager.reset()
+        self._jobs: List[_PrefillJob] = []
+        self.prompt_tokens = 0         # all prompt tokens submitted
+        self.shared_tokens = 0         # skipped via prefix reuse
+        self.prefilled_tokens = 0      # actually computed
+        self.prefill_chunks = 0        # chunk programs dispatched
+
+    # -- introspection -----------------------------------------------------
+    def prefix_cache_hit_rate(self) -> float:
+        """Fraction of submitted prompt tokens served from shared
+        prefix blocks instead of recomputed."""
+        return self.shared_tokens / self.prompt_tokens \
+            if self.prompt_tokens else 0.0
+
+    def prefill_compile_count(self) -> int:
+        return self.backend.prefill_traces[0]
+
+    def kv_error_bound(self) -> float:
+        """Runtime worst-case |dequantized - fp32| over the int8 arena
+        (0.0 in fp32 mode): the EQuARX single-quantization bound from
+        the largest live absmax scale."""
+        if not self.kv_int8:
+            return 0.0
+        from ..ops.pallas.paged_attention import kv_int8_error_bound
+        worst = 0.0
+        for (shape, dtype), buf in zip(self.backend.pool_specs,
+                                       self._cache):
+            if np.dtype(dtype) == np.float32 and len(shape) == 3:
+                worst = max(worst, float(jnp.max(buf)))
+        return float(kv_int8_error_bound(worst))
+
+    def blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        # positions written: prompt [0, L) plus generated tokens at
+        # [L, L+max_new-1) — the final sampled token is never written
+        return -(-(prompt_len + max(max_new_tokens - 1, 0))
+                 // self.kv_block_size)
+
+    def bucket_len(self, prompt_len: int) -> int:
+        return prompt_len            # unpadded prompts, no buckets
+
+    def validate_request(self, prompt_len: int, max_new_tokens: int):
+        super().validate_request(prompt_len, max_new_tokens)
+        need = self.blocks_needed(prompt_len, max_new_tokens)
+        if need > self.num_kv_blocks - 1:
+            raise ValueError(
+                f"request needs {need} KV blocks but the arena only "
+                f"has {self.num_kv_blocks - 1}; raise num_blocks or "
+                "shorten the request")
+
+    # -- admission ---------------------------------------------------------
+    def try_admit(self, request) -> bool:
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        L = int(prompt.shape[0])
+        self.validate_request(L, request.max_new_tokens)
+        slot = next((i for i, s in enumerate(self._slots) if s is None),
+                    None)
+        if slot is None:
+            raise RuntimeError("no free slot (scheduler bug)")
+        shared = self.manager.match_prefix(prompt)
+        total = self.blocks_needed(L, request.max_new_tokens)
+        fresh = self.manager.allocate(total - len(shared))
+        if fresh is None:            # pool exhausted: retry later
+            self.manager.release(shared)
+            return False
+        block_ids = shared + fresh
+        table_row = np.zeros((self.max_blocks,), np.int32)
+        table_row[:len(block_ids)] = block_ids
+        key = jax.random.PRNGKey(request.seed)
+        key, sub = jax.random.split(key)   # generate()'s key schedule
+        run = _SlotRun(request, block_ids=block_ids)
+        self._slots[slot] = run
+        self._prefill_slots.add(slot)
+        n_shared = len(shared) * self.kv_block_size
+        self.prompt_tokens += L
+        self.shared_tokens += n_shared
+        self._jobs.append(_PrefillJob(
+            run=run, slot=slot, prompt=prompt, done=n_shared,
+            table_row=table_row, key=key, sub=sub,
+            temp=jnp.float32(request.temperature),
+            topk=jnp.int32(request.top_k),
+            topp=jnp.float32(request.top_p)))
+        return True
+
+    def admit(self, request) -> bool:
+        if not self.try_admit(request):
+            raise RuntimeError(
+                "KV block pool exhausted; use try_admit/Server (which "
+                "re-queue) or raise num_blocks")
+        return False
+
+    # -- chunked prefill ---------------------------------------------------
+    def prefill_tick(self, token_budget: Optional[int] = None) -> int:
+        """Advance pending prefill jobs by up to ``token_budget`` prompt
+        tokens (always at least one chunk when work is pending, so a
+        tiny budget still progresses). Jobs run FIFO; a finished job
+        arms its slot (or retires immediately on eos/max_new==1)."""
+        from ..profiler import RecordEvent
+        spent = 0
+        C = self.prefill_chunk_len
+        while self._jobs and (token_budget is None or spent == 0
+                              or spent < token_budget):
+            job = self._jobs[0]
+            L = len(job.prompt)
+            n = min(C, L - job.done)
+            ids = np.zeros((1, C), np.int32)
+            ids[0, :n] = job.prompt[job.done:job.done + n]
+            with RecordEvent("serving.prefill_chunk"):
+                tok0_dev, self._cache = self.backend.prefill_chunk(
+                    jnp.asarray(ids), self._cache,
+                    jnp.asarray(job.table_row[None]),
+                    jnp.asarray(job.done, jnp.int32),
+                    jnp.asarray(n, jnp.int32),
+                    job.sub, job.temp, job.topk, job.topp)
+            job.done += n
+            spent += n
+            self.prefill_chunks += 1
+            self.prefilled_tokens += n
+            if job.done >= L:
+                self._jobs.pop(0)
+                self._finish_prefill(job, tok0_dev)
+        return spent
+
+    def _finish_prefill(self, job: _PrefillJob, tok0_dev):
+        req = job.run.request
+        tok0 = int(tok0_dev)
+        now = time.perf_counter()
+        job.run.tokens = [tok0]
+        job.run.t_admit = now               # TTFT timestamp
+        self.tokens_emitted += 1
+        # the prompt's full blocks are resident now — index them so the
+        # NEXT request with this prefix skips the compute
+        self.manager.register_prefix(job.prompt, job.run.block_ids)
+        eos = req.eos_token_id
+        rem0 = req.max_new_tokens - 1
+        if eos is not None and tok0 == eos:
+            rem0 = 0
+        self._prefill_slots.discard(job.slot)
+        if rem0 <= 0:                # finished at admission
+            self._retire(job.slot, job.run, now)
+            return
+        L = len(job.prompt)
+        self._state = self._arm_jit(
+            self._state, jnp.int32(job.slot),
+            jnp.asarray(job.table_row), jnp.int32(tok0), jnp.int32(L),
+            jnp.int32(rem0), jnp.int32(-1 if eos is None else eos),
+            job.temp, job.topk, job.topp, job.key)
+        self._remaining_host[job.slot] = rem0
+
+    def _retire(self, slot, run, now):
+        super()._retire(slot, run, now)
+        if run.block_ids is not None:
+            self.manager.release(run.block_ids)
+            run.block_ids = None     # the no-double-free invariant

@@ -38,13 +38,27 @@ class Request:
 
 
 class Scheduler:
-    """FIFO admission queue + batching gate."""
+    """FIFO admission queue + batching gate + prefill pacing.
 
-    def __init__(self, max_wait_steps: int = 0, min_admit: int = 1):
+    ``prefill_token_budget``: per-tick cap on admitted PROMPT tokens —
+    the chunked-prefill pacing knob. A long prompt admitted into the
+    paged engine prefills in chunks paced by this same budget
+    (engine.prefill_tick), so one tick never steals more than ~budget
+    tokens of prefill from the in-flight decode — that bounds the
+    decode-latency spike a long prompt used to cause. At least one
+    request always passes when the gate is open (no starvation)."""
+
+    def __init__(self, max_wait_steps: int = 0, min_admit: int = 1,
+                 prefill_token_budget: Optional[int] = None):
         if min_admit < 1:
             raise ValueError(f"min_admit={min_admit}; must be >= 1")
+        if prefill_token_budget is not None and prefill_token_budget < 1:
+            raise ValueError(
+                f"prefill_token_budget={prefill_token_budget}; must be "
+                ">= 1 (None disables pacing)")
         self.max_wait_steps = max_wait_steps
         self.min_admit = min_admit
+        self.prefill_token_budget = prefill_token_budget
         self._queue: List[Request] = []
 
     def submit(self, request: Request):
@@ -54,18 +68,27 @@ class Scheduler:
         bisect.insort(self._queue, request,
                       key=lambda r: r.arrival_step)
 
+    def requeue(self, request: Request):
+        """Put a popped request back at the FRONT of its arrival tick
+        (the engine deferred it — e.g. the paged block pool was
+        exhausted); insort_left lands it before same-tick peers."""
+        bisect.insort_left(self._queue, request,
+                           key=lambda r: r.arrival_step)
+
     def pending(self) -> int:
         return len(self._queue)
 
     def next_arrival(self) -> Optional[int]:
         return self._queue[0].arrival_step if self._queue else None
 
-    def pop_ready(self, now: int, free_slots: int,
-                  engine_idle: bool) -> List[Request]:
+    def pop_ready(self, now: int, free_slots: int, engine_idle: bool,
+                  token_budget: Optional[int] = None) -> List[Request]:
         """Requests to admit this tick. The batching gate holds until
         ``min_admit`` requests are visible OR the oldest visible request
         has waited ``max_wait_steps`` ticks — unless the engine is idle
-        (no live slots), where holding would only add latency."""
+        (no live slots), where holding would only add latency. The
+        released prefix is additionally cut at the prefill token budget
+        (argument, else the scheduler's own; first request exempt)."""
         if free_slots <= 0 or not self._queue:
             return []
         # the queue is arrival-sorted: visible requests are a prefix
@@ -79,6 +102,16 @@ class Scheduler:
                      or engine_idle)
         if not gate_open:
             return []
-        take = self._queue[:min(free_slots, n_visible)]
+        if token_budget is None:
+            token_budget = self.prefill_token_budget
+        take: List[Request] = []
+        tokens = 0
+        for r in self._queue[:min(free_slots, n_visible)]:
+            t = int(np.asarray(r.prompt).size)
+            if take and token_budget is not None \
+                    and tokens + t > token_budget:
+                break
+            take.append(r)
+            tokens += t
         del self._queue[:len(take)]
         return take

@@ -32,6 +32,8 @@ class Server:
         self.scheduler = scheduler or Scheduler()
         self.results: Dict[int, np.ndarray] = {}
         self.latencies: Dict[int, float] = {}
+        self.ttft: Dict[int, float] = {}       # submit -> first token
+        self.tick_seconds: list = []           # per-tick wall times
         self._next_id = 0
         self._clock = 0
         self._wall = 0.0
@@ -68,28 +70,47 @@ class Server:
             self.results[req.request_id] = np.concatenate(
                 [np.asarray(req.prompt, np.int32).reshape(-1), toks])
             self.latencies[req.request_id] = now - req.t_submit
+            self.ttft[req.request_id] = run.t_admit - req.t_submit
 
     def run_until_idle(self) -> Dict[int, np.ndarray]:
         """Drive the loop until the queue is empty and every slot is
-        free; returns ``results``."""
+        free; returns ``results``. One tick = admit what the scheduler
+        releases (requests the engine defers — paged block pool
+        exhausted — re-queue), advance chunked prefills within the
+        scheduler's prefill token budget, run one decode block, harvest.
+        Per-tick wall times land in ``tick_seconds`` — the max is the
+        decode-interference figure chunked prefill exists to bound."""
         t0 = time.perf_counter()
         while self.scheduler.pending() or self.engine.has_live():
+            t_tick = time.perf_counter()
             admitted = self.scheduler.pop_ready(
                 self._clock, self.engine.free_slot_count(),
                 engine_idle=not self.engine.has_live())
-            for req in admitted:
-                self.engine.admit(req)
-            if self.engine.has_live():
+            for i, req in enumerate(admitted):
+                if not self.engine.try_admit(req):
+                    # re-queue in reverse: requeue() front-inserts per
+                    # arrival tick, so forward order would flip
+                    # same-tick FIFO and let peers overtake the oldest
+                    for r in reversed(admitted[i:]):
+                        self.scheduler.requeue(r)
+                    break
+            prefill_tick = getattr(self.engine, "prefill_tick", None)
+            if prefill_tick is not None:
+                prefill_tick(self.scheduler.prefill_token_budget)
+            if self.engine.has_decoding():
                 self.engine.step_block()
             self._clock += 1
             self._harvest()
+            self.tick_seconds.append(time.perf_counter() - t_tick)
         self._wall += time.perf_counter() - t0
         return self.results
 
     def stats(self) -> dict:
         lat = list(self.latencies.values())
+        ttft = list(self.ttft.values())
+        ticks = self.tick_seconds
         eng = self.engine
-        return {
+        out = {
             "requests_completed": len(self.results),
             "tokens_emitted": eng.tokens_emitted,
             "decode_steps": eng.steps,
@@ -101,4 +122,16 @@ class Server:
             "latency_avg_s": round(float(np.mean(lat)), 4) if lat else 0.0,
             "latency_p95_s": round(float(np.percentile(lat, 95)), 4)
             if lat else 0.0,
+            "ttft_p50_s": round(float(np.percentile(ttft, 50)), 4)
+            if ttft else 0.0,
+            "ttft_p95_s": round(float(np.percentile(ttft, 95)), 4)
+            if ttft else 0.0,
+            "max_tick_s": round(max(ticks), 4) if ticks else 0.0,
+            "p95_tick_s": round(float(np.percentile(ticks, 95)), 4)
+            if ticks else 0.0,
         }
+        hit_rate = getattr(eng, "prefix_cache_hit_rate", None)
+        if hit_rate is not None:               # paged engine extras
+            out["prefix_cache_hit_rate"] = round(hit_rate(), 4)
+            out["kv_bytes_per_slot"] = eng.backend.kv_bytes_per_slot()
+        return out

@@ -5,18 +5,36 @@ from __future__ import annotations
 import os
 from typing import Any
 
-__all__ = ["define_flag", "get_flags", "set_flags", "FLAGS", "env_flag"]
+__all__ = ["define_flag", "get_flags", "set_flags", "FLAGS", "env_flag",
+           "env_int", "env_str"]
 
 
 def env_flag(name: str, default: bool = False) -> bool:
     """Read a PT_* boolean env toggle with uniform falsy spellings
     ('', '0', 'false', 'off', 'no' — case/whitespace-insensitive).
-    Shared by PT_FUSION_PASSES and the collectives flags so toggle
-    semantics never drift between subsystems."""
+    Shared by PT_FUSION_PASSES, the collectives flags and the serving
+    flags so toggle semantics never drift between subsystems."""
     v = os.environ.get(name)
     if v is None:
         return default
     return v.strip().lower() not in ("", "0", "false", "off", "no")
+
+
+def env_int(name: str, default: int) -> int:
+    """Read a PT_* integer env knob. Empty/whitespace values fall back
+    to the default instead of raising mid-import (a stray `export
+    PT_X=` in a session script must not take the whole package down);
+    a malformed non-empty value still raises loudly."""
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    return int(v.strip())
+
+
+def env_str(name: str, default: str = "") -> str:
+    """Read a PT_* string env knob (stripped)."""
+    v = os.environ.get(name)
+    return default if v is None else v.strip()
 
 _REGISTRY: dict[str, Any] = {}
 

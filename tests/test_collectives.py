@@ -451,3 +451,89 @@ class TestProfilerSpans:
         ev = prof._drain_events()
         names = {e["name"] for e in ev}
         assert any(n.startswith("collectives::all_reduce") for n in names)
+
+
+class TestBareShardMapErrorBound:
+    """Regression (ROADMAP open item, PR 2 code): the runtime bound of
+    quantized_all_reduce derived n from plan.total_size, which is 1 for
+    a plan built with no registered mesh (bare shard_map) — the bound
+    was understated ~n-fold, so BucketedGradSync's error_bound
+    hard-guarantee mode could keep over-budget buckets. n must come
+    from psum(1, axes) like bucketing.py's mean divisor."""
+
+    def _bare_mesh(self):
+        from jax.sharding import Mesh
+        return Mesh(np.array(jax.devices()[:8]), ("r",))
+
+    def _host_expected_bound(self, per_dev, bucket=512):
+        # replicate the wire format on host: quantize each contribution,
+        # fp32-accumulate the dequants, re-quantize the reduction — the
+        # documented two-phase bound with the TRUE n=8
+        from paddle_tpu.distributed.collectives.hierarchical import \
+            pad_to_multiple
+        from paddle_tpu.distributed.collectives.quantized import (
+            _dequantize, _quantize, int8_error_bound)
+        qs = [_quantize(pad_to_multiple(
+            jnp.asarray(x).reshape(-1), bucket)[0], bucket)
+            for x in per_dev]
+        s_in = float(max(jnp.max(s) for _, s in qs))
+        acc = sum(jnp.sum(_dequantize(q[None], s[None]), axis=0)
+                  for q, s in qs)
+        _, s_out = _quantize(acc.reshape(-1), bucket)
+        n = len(per_dev)
+        return (float(int8_error_bound(s_in, n,
+                                       bucket_absmax_out=jnp.max(s_out))),
+                float(int8_error_bound(s_in, 1,
+                                       bucket_absmax_out=jnp.max(s_out))))
+
+    def test_bound_counts_bound_ranks_not_plan_size(self):
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.distributed.collectives.hierarchical import \
+            HierarchyPlan
+        from paddle_tpu.distributed.collectives.quantized import \
+            quantized_all_reduce
+        mesh = self._bare_mesh()
+        # EXACTLY what plan_hierarchy returns with no mesh registered:
+        # flat, total_size=1 — the bug's trigger
+        plan = HierarchyPlan(("r",), None, None, 1, 1)
+        rs = np.random.RandomState(11)
+        x = rs.randn(8, 777).astype(np.float32)
+
+        def inner(xl):
+            return quantized_all_reduce(jnp.squeeze(xl, 0), plan,
+                                        return_error_bound=True)
+        out, bound = shard_map(
+            inner, mesh=mesh, in_specs=(P("r"),),
+            out_specs=(P(), P()), check_rep=False)(jnp.asarray(x))
+        err = np.abs(np.asarray(out) - x.sum(axis=0)).max()
+        expected_n8, wrong_n1 = self._host_expected_bound(list(x))
+        assert err <= float(bound)                 # contract holds
+        np.testing.assert_allclose(float(bound), expected_n8,
+                                   rtol=1e-6)      # n is REALLY 8
+        assert float(bound) > 2 * wrong_n1         # not the n=1 bound
+
+    def test_hard_guarantee_rejects_over_budget_under_bare_shard_map(
+            self):
+        # budget just under the true bound: with the fix the hook must
+        # fall back to the exact fp32 reduction; pre-fix the ~8x
+        # understated bound sat far below the budget and the quantized
+        # (lossy) bucket was kept
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = self._bare_mesh()
+        rs = np.random.RandomState(12)
+        x = rs.randn(8, 777).astype(np.float32)
+        expected_n8, wrong_n1 = self._host_expected_bound(list(x))
+        budget = 0.9 * expected_n8
+        assert budget > 2 * wrong_n1     # pre-fix bound passes budget
+        hook = BucketedGradSync(axes=("r",), compress="int8", mesh=None)
+        hook.error_bound = budget
+
+        def inner(g):
+            return hook({"w": jnp.squeeze(g, 0)})["w"]
+        out = shard_map(inner, mesh=mesh, in_specs=(P("r"),),
+                        out_specs=P(), check_rep=False)(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(out),
+                                   x.sum(axis=0) / 8, rtol=1e-6,
+                                   atol=1e-6)      # exact fp32 fallback

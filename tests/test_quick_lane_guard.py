@@ -1,0 +1,62 @@
+"""Lane guard for the paged-serving/pallas additions (same contract as
+test_session_tools: tooling breaks must surface as test failures, not
+as silently-skipped coverage). Pins that
+
+- every serving + paged-pallas test is COLLECTED by the quick lane
+  (``-m 'not slow'``) — a stray ``slow`` mark or import error would
+  otherwise drop the tier-1 bit-identity pins without failing CI;
+- the interpret-mode pallas tests declare the pallas import guard so
+  they SKIP (not error) on builds without Pallas;
+- on the CPU lane the paged read takes the bit-identical reference
+  path, never the kernel.
+"""
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py"]
+
+REQUIRED_NODES = [
+    "test_serving_paged.py::TestPagedBitExactness::"
+    "test_greedy_ragged_stream_bit_exact_one_compile",
+    "test_serving_paged.py::TestPagedKernel::"
+    "test_interpret_kernel_matches_reference",
+    "test_serving_paged.py::TestInt8KV::"
+    "test_write_path_error_within_runtime_bound",
+    "test_serving.py::TestContinuousBatching::"
+    "test_greedy_bit_exact_on_ragged_stream_one_compile",
+]
+
+
+def test_serving_tests_collected_in_quick_lane():
+    p = subprocess.run(
+        [sys.executable, "-m", "pytest", "--collect-only", "-q",
+         "-m", "not slow", "-p", "no:cacheprovider", *GUARDED_FILES],
+        capture_output=True, text=True, timeout=300, cwd=ROOT,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    # returncode 0 == zero collection errors (pytest exits 2 on any)
+    assert p.returncode == 0, (p.stdout[-1500:], p.stderr[-800:])
+    for node in REQUIRED_NODES:
+        assert node in p.stdout, f"quick lane lost {node}"
+
+
+def test_interpret_tests_guard_pallas_import():
+    # the kernel tests must skip cleanly on a build without Pallas:
+    # the class exercising interpret mode has to declare importorskip
+    src = open(os.path.join(ROOT, "tests", "test_serving_paged.py")).read()
+    kernel_tests = src.split("class TestPagedKernel")[1]
+    assert 'importorskip("jax.experimental.pallas")' in kernel_tests
+
+
+def test_cpu_lane_never_dispatches_paged_kernel():
+    import paddle_tpu.ops.pallas.fused as fused
+    from paddle_tpu.ops.pallas.paged_attention import _kernel_ok
+    if jax.default_backend() != "cpu":
+        return                       # on-hardware lane: kernel allowed
+    assert not fused._FORCE_INTERPRET     # test isolation sanity
+    assert not _kernel_ok(jnp.zeros((4, 8, 2, 16), jnp.float32))
