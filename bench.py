@@ -340,6 +340,78 @@ def _bench_paged_serving(model_cfg, num_slots=4, block_size=16,
     return out
 
 
+def _bench_resilience(model_cfg, num_slots=4, decode_block=8,
+                      requests=10, max_new=24, fault_rate=0.01):
+    """Resilience A/B: the same request stream clean vs with a
+    ``fault_rate`` injected step-failure probability (the
+    ``serving.step_block`` site, seeded — the schedule is identical
+    every round). Reports the throughput + p95 latency cost of riding
+    the retry/backoff path and the resilience counters, so a policy
+    regression (e.g. retries stopping masking transient faults, or the
+    breaker tripping on background noise) shows up as a number."""
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    ResilienceConfig, Server)
+    from paddle_tpu.utils import faults
+
+    paddle.seed(0)
+    from paddle_tpu.models.llama import LlamaForCausalLM
+    model = LlamaForCausalLM(model_cfg)
+    rs = np.random.RandomState(0)
+    lens = [4 + (i % 3) * 6 for i in range(requests)]
+    prompts = [rs.randint(0, model_cfg.vocab_size, (l,)).astype(np.int32)
+               for l in lens]
+    engine = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_len=16 + max_new,
+        decode_block=decode_block, prompt_buckets=(16,))
+    res_cfg = ResilienceConfig(retry_attempts=3, retry_backoff_s=0.002,
+                               breaker_threshold=32)
+
+    def run():
+        engine.reset()
+        srv = Server(engine, resilience=res_cfg)
+        for i, p in enumerate(prompts):
+            srv.submit(p, max_new_tokens=max_new, arrival_step=i)
+        srv.run_until_idle()
+        return srv
+
+    run()                                   # compile warmup
+    t0 = time.perf_counter()
+    srv_clean = run()
+    dt_clean = time.perf_counter() - t0
+    st_clean = srv_clean.stats()
+
+    faults.configure(f"serving.step_block:p={fault_rate}", seed=0)
+    try:
+        t0 = time.perf_counter()
+        srv_faulty = run()
+        dt_faulty = time.perf_counter() - t0
+    finally:
+        faults.clear()
+    st_faulty = srv_faulty.stats()
+    useful = requests * max_new
+    return {
+        "serving_resilience_tokens_per_sec_clean":
+            round(useful / dt_clean, 1),
+        "serving_resilience_tokens_per_sec_faulty":
+            round(useful / dt_faulty, 1),
+        "serving_resilience_p95_latency_ms_clean":
+            round(st_clean["latency_p95_s"] * 1000, 2),
+        "serving_resilience_p95_latency_ms_faulty":
+            round(st_faulty["latency_p95_s"] * 1000, 2),
+        "serving_resilience_fault_rate": fault_rate,
+        "serving_resilience_step_failures": st_faulty["step_failures"],
+        "serving_resilience_retries": st_faulty["retries"],
+        "serving_resilience_requests_failed":
+            st_faulty["requests_failed"],
+        "serving_resilience_completed_faulty":
+            st_faulty["requests_completed"],
+        # the clean pass pins the inertness contract in the bench too
+        "serving_resilience_clean_counters_zero":
+            st_clean["step_failures"] == 0 == st_clean["retries"],
+    }
+
+
 def _child_tpu():
     """Runs under the default (axon TPU) platform. Benches a 0.2B config
     and the largest Llama that fits one chip in bf16, reports the Pallas
@@ -565,6 +637,14 @@ def _child_tpu():
             errors.append(err)
         decode.update(paged if paged is not None
                       else {"serving_paged_prefix_hit_rate": None})
+        _release_hbm()
+        resil, err = _staged(lambda: _bench_resilience(cfg_small),
+                             "serving-resilience")
+        if err:
+            errors.append(err)
+        decode.update(resil if resil is not None
+                      else {"serving_resilience_tokens_per_sec_faulty":
+                            None})
         _emit(small, big, decode, errors)
         if small is None and big is None:
             raise RuntimeError("every config failed: " + "; ".join(errors))
@@ -612,6 +692,12 @@ def _child_cpu():
     except Exception as e:
         decode.update({"serving_paged_prefix_hit_rate": None,
                        "serving_paged_error":
+                       f"{type(e).__name__}: {e}"[:300]})
+    try:
+        decode.update(_bench_resilience(serve_cfg))
+    except Exception as e:
+        decode.update({"serving_resilience_tokens_per_sec_faulty": None,
+                       "serving_resilience_error":
                        f"{type(e).__name__}: {e}"[:300]})
 
     cfg = llama_tiny_config(tensor_parallel=False)

@@ -31,7 +31,37 @@ import numpy as np
 from ..tensor import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
-           "AsyncSaveHandle"]
+           "AsyncSaveHandle", "atomic_write", "atomic_savez",
+           "atomic_json_dump"]
+
+
+def atomic_write(path: str, write_fn, mode: str = "wb"):
+    """Crash-safe file write: ``write_fn(f)`` goes to a same-directory
+    temp file which is fsynced and ``os.replace``d over ``path`` — a
+    reader (or a restart) sees either the old complete file or the new
+    complete file, never a torn write. Shared by checkpoint shards,
+    metadata, and the serving engine's snapshot files."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, mode) as f:
+            write_fn(f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
+
+
+def atomic_savez(path: str, arrays: dict):
+    """``np.savez`` through :func:`atomic_write` (npz is self-contained,
+    so tmp+rename makes the whole checkpoint piece atomic)."""
+    atomic_write(path, lambda f: np.savez(f, **arrays))
+
+
+def atomic_json_dump(path: str, obj):
+    atomic_write(path, lambda f: json.dump(obj, f), mode="w")
 
 
 def _leaf_items(state_dict, prefix=""):
@@ -212,10 +242,18 @@ def save_state_dict(state_dict, path, process_group=None,
                 for f in futures:
                     f.result()
             else:
-                np.savez(shard_file, **arrays)
+                atomic_savez(shard_file, arrays)
             if pidx == coordinator_rank:
-                with open(os.path.join(path, "metadata.json"), "w") as f:
-                    json.dump(merged, f)
+                # metadata lands last and atomically: WITHIN THIS
+                # PROCESS its presence is the commit point — a crash
+                # mid-save leaves the previous complete checkpoint or
+                # no new metadata, never a torn file. Multi-host npz
+                # saves keep the pre-existing contract (ranks write
+                # shards independently, no cross-host barrier before
+                # this write); the tensorstore backend's creation
+                # barrier, or a launcher-level barrier, orders hosts
+                atomic_json_dump(os.path.join(path, "metadata.json"),
+                                 merged)
         except BaseException as e:     # surfaced via handle.result()
             if handle is not None:
                 handle._error = e

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..tensor import Tensor
+from ..utils.flags import env_float, env_int, env_str
 
 __all__ = ["ReduceOp", "Group", "all_reduce", "all_gather",
            "all_gather_object", "reduce_scatter", "broadcast", "scatter",
@@ -85,11 +86,11 @@ _NEXT_GID = [1]
 
 
 def _my_rank() -> int:
-    return int(os.environ.get("PADDLE_TRAINER_ID", jax.process_index()))
+    return env_int("PADDLE_TRAINER_ID", jax.process_index())
 
 
 def _world_size() -> int:
-    return int(os.environ.get("PADDLE_TRAINERS_NUM", jax.process_count()))
+    return env_int("PADDLE_TRAINERS_NUM", jax.process_count())
 
 
 def _world():
@@ -213,7 +214,7 @@ def _get_store():
     with _STORE_LOCK:
         if _STORE is not None:
             return _STORE
-        master = os.environ.get("PADDLE_MASTER")
+        master = env_str("PADDLE_MASTER", "") or None
         if _single_process() or not master:
             if not _single_process():
                 raise RuntimeError(
@@ -392,7 +393,7 @@ def _warn_if_bulk(value, op_name):
     - ``PT_EAGER_COLLECTIVE_GUARD``: ``warn`` (default, once per op
       name), ``error`` (raise RuntimeError), or ``off``.
     """
-    mode = os.environ.get("PT_EAGER_COLLECTIVE_GUARD", "warn")
+    mode = env_str("PT_EAGER_COLLECTIVE_GUARD", "warn")
     if mode == "off":
         return
     try:
@@ -400,9 +401,8 @@ def _warn_if_bulk(value, op_name):
     except Exception:
         return
     try:
-        limit_mb = float(os.environ.get("PT_EAGER_COLLECTIVE_WARN_MB",
-                                        "1"))
-    except ValueError:
+        limit_mb = env_float("PT_EAGER_COLLECTIVE_WARN_MB", 1.0)
+    except ValueError:      # guard path: malformed knob must not raise
         limit_mb = 1.0
     if nbytes <= limit_mb * 1e6:
         return

@@ -18,6 +18,8 @@ import os
 import time
 from typing import Optional
 
+from ..utils.flags import env_int
+
 __all__ = ["program_fingerprint", "check_program_consistency",
            "ConsistencyError"]
 
@@ -46,9 +48,9 @@ def check_program_consistency(fingerprint: str, store=None,
     Raises ConsistencyError naming the diverging ranks instead of letting
     the job hang in a collective."""
     if rank is None:
-        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        rank = env_int("PADDLE_TRAINER_ID", 0)
     if world_size is None:
-        world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        world_size = env_int("PADDLE_TRAINERS_NUM", 1)
     if world_size <= 1:
         return True
     if store is None:

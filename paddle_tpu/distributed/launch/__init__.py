@@ -21,6 +21,7 @@ import time
 from typing import List, Optional
 
 from ..launch_utils import find_free_port
+from ...utils.flags import env_int
 
 __all__ = ["LaunchConfig", "launch_pod", "main"]
 
@@ -147,7 +148,7 @@ def main(argv=None):
                     "(one worker process per TPU host)")
     parser.add_argument("--nnodes", type=int, default=1)
     parser.add_argument("--node_rank", type=int,
-                        default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+                        default=env_int("PADDLE_NODE_RANK", 0))
     parser.add_argument("--nproc_per_node", type=int, default=1)
     parser.add_argument("--master", type=str, default=None,
                         help="host:port of the rank-0 rendezvous store")

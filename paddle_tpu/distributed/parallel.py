@@ -16,6 +16,7 @@ import jax
 import numpy as np
 
 from ..nn.layer import Layer
+from ..utils.flags import env_int, env_str
 from ..tensor import Tensor
 
 __all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
@@ -31,8 +32,7 @@ def init_parallel_env():
     global _INITIALIZED
     if _INITIALIZED:
         return ParallelEnv()
-    n = int(os.environ.get("PADDLE_TRAINERS_NUM",
-                           os.environ.get("JAX_NUM_PROCESSES", "1")))
+    n = env_int("PADDLE_TRAINERS_NUM", env_int("JAX_NUM_PROCESSES", 1))
     # probe the coordination client WITHOUT touching the backend:
     # jax.process_count() would initialize XLA and make the subsequent
     # jax.distributed.initialize() unconditionally raise (found by the
@@ -43,10 +43,9 @@ def init_parallel_env():
     except Exception:
         already = False   # probe unavailable: let initialize() decide
     if n > 1 and not already:
-        coord = os.environ.get("PADDLE_MASTER",
-                               os.environ.get("JAX_COORDINATOR_ADDRESS"))
-        pid = int(os.environ.get("PADDLE_TRAINER_ID",
-                                 os.environ.get("JAX_PROCESS_ID", "0")))
+        coord = env_str("PADDLE_MASTER", "") \
+            or env_str("JAX_COORDINATOR_ADDRESS", "") or None
+        pid = env_int("PADDLE_TRAINER_ID", env_int("JAX_PROCESS_ID", 0))
         try:
             jax.distributed.initialize(coordinator_address=coord,
                                        num_processes=n, process_id=pid)
@@ -101,11 +100,11 @@ class ParallelEnv:
 
     @property
     def current_endpoint(self):
-        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+        return env_str("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
 
     @property
     def trainer_endpoints(self):
-        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        eps = env_str("PADDLE_TRAINER_ENDPOINTS", "")
         return eps.split(",") if eps else []
 
 

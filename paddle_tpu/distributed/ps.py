@@ -53,6 +53,7 @@ from typing import Optional
 import numpy as np
 
 from . import rpc
+from ..utils.flags import env_int, env_str
 
 __all__ = ["init_server", "run_server", "init_worker", "stop_worker",
            "create_table", "pull_sparse", "push_sparse", "save_table",
@@ -525,7 +526,7 @@ def barrier_worker():
 # ---------------------------------------------------------------------------
 
 def is_server() -> bool:
-    return os.environ.get("TRAINING_ROLE", "TRAINER").upper() == "PSERVER"
+    return env_str("TRAINING_ROLE", "TRAINER").upper() == "PSERVER"
 
 
 def is_worker() -> bool:
@@ -533,11 +534,11 @@ def is_worker() -> bool:
 
 
 def server_num() -> int:
-    return int(os.environ.get("PADDLE_PSERVER_NUM", 1))
+    return env_int("PADDLE_PSERVER_NUM", 1)
 
 
 def worker_num() -> int:
-    return int(os.environ.get("PADDLE_TRAINER_NUM", 1))
+    return env_int("PADDLE_TRAINER_NUM", 1)
 
 
 def _rpc_world():
@@ -561,7 +562,7 @@ def _join(name, role_idx, as_server):
 
 def init_server(name: Optional[str] = None):
     """Join the PS cluster as a server (reference fleet.init_server)."""
-    idx = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    idx = env_int("PADDLE_TRAINER_ID", 0)
     _join(name or _server_name(idx), idx, as_server=True)
 
 
@@ -579,7 +580,7 @@ def init_worker(name: Optional[str] = None, mode: str = "sync",
     ``mode`` selects the communicator: "sync" (blocking pushes),
     "async" (merge+background-send), or "geo" (GeoSGD local training
     with delta sync every ``geo_step`` pushes)."""
-    idx = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    idx = env_int("PADDLE_TRAINER_ID", 0)
     _join(name or f"trainer:{idx}", idx, as_server=False)
     set_training_mode(mode, geo_step=geo_step,
                       async_interval=async_interval)

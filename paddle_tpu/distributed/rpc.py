@@ -23,6 +23,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Optional
 
+from ..utils.flags import env_int, env_str
+
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
 
@@ -78,7 +80,7 @@ class _Agent:
         self._srv.bind(("0.0.0.0", 0))
         self._srv.listen(64)
         self.port = self._srv.getsockname()[1]
-        self.ip = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
+        self.ip = env_str("PADDLE_LOCAL_IP", "127.0.0.1")
         self._stop = threading.Event()
         self._conns: dict[str, socket.socket] = {}
         self._locks: dict[str, threading.Lock] = {}
@@ -268,9 +270,9 @@ def init_rpc(name: str, rank: int = None, world_size: int = None,
         if master_endpoint is not None:
             # explicit argument overrides any inherited env default
             os.environ["PADDLE_MASTER"] = master_endpoint
-        rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        rank = env_int("PADDLE_TRAINER_ID", 0) if rank is None \
             else int(rank)
-        world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        world_size = env_int("PADDLE_TRAINERS_NUM", 1) \
             if world_size is None else int(world_size)
         agent = _Agent(name, rank, world_size)
         store = _store()

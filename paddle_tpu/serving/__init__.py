@@ -11,10 +11,12 @@ serialized artifact alone."""
 from .engine import (ArtifactStepBackend, ContinuousBatchingEngine,
                      ModelStepBackend, slot_sample_logits)
 from .paging import BlockManager, PagedEngine, PagedModelStepBackend
+from .resilience import RequestFailure, ResilienceConfig
 from .scheduler import Request, Scheduler
 from .server import Server
 
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
            "ArtifactStepBackend", "BlockManager", "PagedEngine",
-           "PagedModelStepBackend", "Request", "Scheduler", "Server",
+           "PagedModelStepBackend", "Request", "RequestFailure",
+           "ResilienceConfig", "Scheduler", "Server",
            "slot_sample_logits"]

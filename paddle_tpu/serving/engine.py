@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import faults
+
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
            "ArtifactStepBackend", "slot_sample_logits", "init_slot_state",
            "build_slot_block_fn", "build_slot_prefill_fn",
@@ -106,7 +108,14 @@ def build_slot_block_fn(pure, block: int, trace_counter=None,
     cache is the shared block arena; dead slots' tables are redirected
     to the trash block 0 IN-GRAPH, so a retired slot whose blocks the
     host has already handed to another request can never scatter junk
-    into them mid-block."""
+    into them mid-block.
+
+    Besides tokens and live masks the block also emits per-step (S,)
+    ``ok`` flags — True iff the row's log-probs held no NaN (the logit
+    sentinel the resilience layer uses to quarantine a poisoned slot
+    without touching its neighbours). The flags are a side output of
+    the SAME single compiled program; healthy streams are bit-identical
+    with or without the sentinel reading them."""
 
     def block_fn(pv, bv, cache_flat, state):
         if trace_counter is not None:       # runs only while tracing
@@ -123,6 +132,10 @@ def build_slot_block_fn(pure, block: int, trace_counter=None,
             else:
                 logp, cf = pure(pv, bv, st["tok"][:, None], cf,
                                 st["pos"], None, st["pad"])
+            # NaN (not -inf: log-probs legitimately underflow) marks a
+            # poisoned row — numerically impossible from finite
+            # weights/cache, so a False flag means corrupted state
+            ok = ~jnp.any(jnp.isnan(logp), axis=-1)
             nxt = slot_sample_logits(logp, sub, st["temp"], st["topk"],
                                      st["topp"])
             live = st["live"]
@@ -137,11 +150,11 @@ def build_slot_block_fn(pure, block: int, trace_counter=None,
             # token matrix are real emissions — an eos retirement zeroes
             # ``remaining``, so the host must count emissions from this
             # mask, not from remaining deltas
-            return (cf, st2), (nxt, live)
+            return (cf, st2), (nxt, live, ok)
 
-        (cache_flat, state), (toks, lives) = jax.lax.scan(
+        (cache_flat, state), (toks, lives, oks) = jax.lax.scan(
             body, (cache_flat, state), None, length=block)
-        return cache_flat, state, toks, lives
+        return cache_flat, state, toks, lives, oks
 
     return block_fn
 
@@ -189,6 +202,16 @@ def build_paged_chunk_fn(pure, chunk: int, trace_counter=None):
         return tok0, cache_flat
 
     return chunk_fn
+
+
+def _cancel_fn(state, slot):
+    """Kill one slot in-graph (deadline/poison cancellation): ``live``
+    drops and ``remaining`` zeroes, so the next decode block treats the
+    row as retired junk (and, paged, redirects its table to the trash
+    block). One compiled program serves every cancellation."""
+    return dict(state,
+                live=state["live"].at[slot].set(False),
+                remaining=state["remaining"].at[slot].set(0))
 
 
 def _admit_fn(cache_flat, state, row_flat, slot, tok0, pos0, pad0, rem0,
@@ -332,6 +355,10 @@ class _SlotRun:
     t_admit: float = 0.0
     t_done: float = 0.0
     block_ids: Optional[List[int]] = None
+    # set when the request was cancelled/quarantined instead of
+    # completing ("timeout", "poisoned", "circuit_open", ...); the
+    # Server records a RequestFailure in results instead of tokens
+    failure: Optional[str] = None
 
 
 class ContinuousBatchingEngine:
@@ -382,6 +409,10 @@ class ContinuousBatchingEngine:
         self.prompt_buckets = tuple(sorted(prompt_buckets)) \
             if prompt_buckets else None
         self._admit_jit = jax.jit(_admit_fn, donate_argnums=(0, 1))
+        self._cancel_jit = jax.jit(_cancel_fn, donate_argnums=(0,))
+        # host-side gate on the in-graph NaN flags (the flags are
+        # always computed — same single compiled program either way)
+        self.nan_sentinel = True
         self.reset()
 
     # -- lifecycle ---------------------------------------------------------
@@ -394,6 +425,7 @@ class ContinuousBatchingEngine:
         self._prefill_slots: set = set()   # paged: mid-prefill slots
         self._remaining_host = np.zeros((self.num_slots,), np.int64)
         self._finished: List[_SlotRun] = []
+        self._pending_block = None     # dispatched, not yet harvested
         self.steps = 0                # engine decode steps executed
         self.tokens_emitted = 0       # useful tokens (incl. prefill's)
         self.decode_tokens = 0        # live-slot decode steps only
@@ -507,21 +539,47 @@ class ContinuousBatchingEngine:
         return True
 
     # -- decode ------------------------------------------------------------
+    def has_pending_harvest(self) -> bool:
+        """A decode block was dispatched but its host transfer failed —
+        the next :meth:`step_block` retries just the harvest."""
+        return self._pending_block is not None
+
     def step_block(self):
         """Run one compiled decode block over the pool, then sync ONCE:
         pull the token matrix + remaining counters, credit each live
-        slot its emitted tokens, retire finished slots."""
+        slot its emitted tokens, retire finished slots.
+
+        Failure semantics (fault sites / resilience): the
+        ``serving.step_block`` site raises BEFORE the device dispatch
+        (state untouched — a retry re-runs the identical block), and
+        ``serving.harvest`` raises between dispatch and the host
+        transfer; the dispatched outputs park in ``_pending_block`` so
+        a retry harvests them without re-stepping (no token is ever
+        decoded twice or dropped). A slot whose log-probs went NaN is
+        quarantined alone via :meth:`cancel_slot` — the other rows'
+        streams are untouched (bit-identical, pinned in tests)."""
         from ..profiler import RecordEvent
-        if not self.has_decoding():
-            return
-        with RecordEvent("serving.decode_block"):
-            self._cache, self._state, toks, lives = \
-                self.backend.decode_block(self._cache, self._state)
+        if self._pending_block is None:
+            if not self.has_decoding():
+                return
+            if faults.should_fire("serving.poison"):
+                self._poison_live_slot()
+            faults.fault_point("serving.step_block")
+            with RecordEvent("serving.decode_block"):
+                out = self.backend.decode_block(self._cache, self._state)
+            self._cache, self._state = out[0], out[1]
+            # old AOT artifacts predate the ok flags: pad with None
+            self._pending_block = tuple(out[2:]) \
+                if len(out) > 4 else (out[2], out[3], None)
+            self.steps += self.decode_block
+            self.slot_steps += self.decode_block * self.num_slots
+        faults.fault_point("serving.harvest")
+        toks, lives, oks = self._pending_block
         toks_np = np.asarray(toks)                  # ONE host sync/block
         lives_np = np.asarray(lives)                # (block, S)
+        oks_np = None if oks is None else np.asarray(oks)
         rem_np = np.asarray(self._state["remaining"])
-        self.steps += self.decode_block
-        self.slot_steps += self.decode_block * self.num_slots
+        self._pending_block = None
         self.decode_tokens += int(lives_np.sum())
         self.tokens_emitted += int(lives_np.sum())
         now = time.perf_counter()
@@ -532,9 +590,58 @@ class ContinuousBatchingEngine:
             n = int(lives_np[:, slot].sum())
             if n > 0:
                 run.tokens.extend(int(t) for t in toks_np[:n, slot])
+            if self.nan_sentinel and oks_np is not None and n > 0 \
+                    and not bool(oks_np[:n, slot].all()):
+                self.cancel_slot(slot, "poisoned")
+                continue
             self._remaining_host[slot] = rem_np[slot]
             if rem_np[slot] == 0:
                 self._retire(slot, run, now)
+
+    # -- cancellation / quarantine ----------------------------------------
+    def live_runs(self):
+        """Host bookkeeping of every occupied slot: [(slot, _SlotRun)]
+        (mid-prefill slots included) — the resilience layer's deadline
+        scan."""
+        return [(i, r) for i, r in enumerate(self._slots)
+                if r is not None]
+
+    def cancel_slot(self, slot: int, reason: str) -> bool:
+        """Cancel the request in ``slot`` mid-flight: kill the slot
+        in-graph (live drops before the next decode block), release its
+        resources (paged: arena blocks at correct refcounts, pending
+        prefill job dropped), and surface the run through
+        ``drain_finished`` with ``failure=reason`` so the Server records
+        a RequestFailure instead of hanging the stream."""
+        run = self._slots[slot]
+        if run is None:
+            return False
+        run.failure = reason
+        if slot in self._prefill_slots:
+            self._prefill_slots.discard(slot)
+            self._abort_prefill(slot)   # paged: drop the pending job
+        else:
+            self._state = self._cancel_jit(self._state, jnp.int32(slot))
+        self._retire(slot, run, time.perf_counter())
+        self._remaining_host[slot] = 0
+        return True
+
+    def _abort_prefill(self, slot):
+        """Dense admission is synchronous — nothing to abort."""
+
+    def _poison_live_slot(self):
+        """Fault action for the ``serving.poison`` site: corrupt the
+        FIRST decoding slot's KV cache row with NaN so its next logits
+        trip the sentinel. Only that slot's row is touched — the
+        quarantine-blast-radius invariant the chaos tests pin."""
+        for slot, run in enumerate(self._slots):
+            if run is not None and slot not in self._prefill_slots:
+                self._cache = tuple(
+                    c.at[slot].set(jnp.nan)
+                    if jnp.issubdtype(c.dtype, jnp.floating) else c
+                    for c in self._cache)
+                return slot
+        return None
 
     def _retire(self, slot, run, now):
         """Move a finished slot to the harvest list (the paged engine
@@ -546,3 +653,119 @@ class ContinuousBatchingEngine:
     def drain_finished(self) -> List[_SlotRun]:
         done, self._finished = self._finished, []
         return done
+
+    # -- crash-safe snapshot / restore -------------------------------------
+    def _run_meta(self, run: _SlotRun) -> dict:
+        from .resilience import request_to_meta
+        return {"request": request_to_meta(run.request),
+                "tokens": [int(t) for t in run.tokens],
+                "t_admit": run.t_admit, "t_done": run.t_done,
+                "failure": run.failure,
+                "block_ids": None if run.block_ids is None
+                else [int(b) for b in run.block_ids]}
+
+    def _run_from_meta(self, meta: dict, prompt) -> _SlotRun:
+        from .resilience import request_from_meta
+        return _SlotRun(request=request_from_meta(meta["request"], prompt),
+                        tokens=list(meta["tokens"]),
+                        t_admit=meta["t_admit"], t_done=meta["t_done"],
+                        failure=meta["failure"],
+                        block_ids=None if meta["block_ids"] is None
+                        else list(meta["block_ids"]))
+
+    def snapshot_state(self):
+        """(meta dict, host-array dict) capturing everything needed to
+        resume every in-flight stream: the KV cache, the in-graph slot
+        state (positions, rng keys, sampling params — and the paged
+        block tables riding it), and the host bookkeeping. Taken at a
+        tick boundary (the only host-consistent point); a restored
+        engine finishes each stream bit-identical to an uninterrupted
+        run because the decode program is a pure function of exactly
+        this state."""
+        if self._pending_block is not None:
+            raise RuntimeError(
+                "snapshot only at a tick boundary — a dispatched decode "
+                "block is awaiting harvest (call step_block first)")
+        arrays = {}
+        for i, c in enumerate(self._cache):
+            arrays[f"cache_{i}"] = np.asarray(c)
+        for k, v in self._state.items():
+            arrays[f"state_{k}"] = np.asarray(v)
+        slots_meta = []
+        for i, run in enumerate(self._slots):
+            if run is None:
+                slots_meta.append(None)
+                continue
+            arrays[f"slot{i}_prompt"] = np.asarray(
+                run.request.prompt, np.int32).reshape(-1)
+            slots_meta.append(self._run_meta(run))
+        fin_meta = []
+        for j, run in enumerate(self._finished):
+            arrays[f"fin{j}_prompt"] = np.asarray(
+                run.request.prompt, np.int32).reshape(-1)
+            fin_meta.append(self._run_meta(run))
+        meta = {
+            "engine_class": type(self).__name__,
+            "num_slots": self.num_slots, "max_len": self.max_len,
+            "decode_block": self.decode_block,
+            "pool_specs": [[list(s), str(np.dtype(d))]
+                           for s, d in self.backend.pool_specs],
+            "remaining": [int(r) for r in self._remaining_host],
+            "prefill_slots": sorted(self._prefill_slots),
+            "slots": slots_meta, "finished": fin_meta,
+            "counters": {"steps": self.steps,
+                         "tokens_emitted": self.tokens_emitted,
+                         "decode_tokens": self.decode_tokens,
+                         "slot_steps": self.slot_steps},
+        }
+        return meta, arrays
+
+    def restore_state(self, meta: dict, arrays: dict):
+        """Inverse of :meth:`snapshot_state`, into a freshly
+        constructed engine of the SAME configuration (same model/
+        backend shapes — validated against ``pool_specs``). Compiled
+        programs are rebuilt lazily by the new process; only state is
+        restored."""
+        want = [[list(s), str(np.dtype(d))]
+                for s, d in self.backend.pool_specs]
+        if meta["pool_specs"] != want:
+            raise ValueError(
+                "snapshot pool_specs do not match this engine — restore "
+                "needs the same model config / slots / max_len / paging "
+                f"layout (saved {meta['pool_specs'][:2]}..., engine "
+                f"{want[:2]}...)")
+        if meta["engine_class"] != type(self).__name__:
+            raise ValueError(
+                f"snapshot was taken by {meta['engine_class']}, this "
+                f"engine is {type(self).__name__} (dense/paged mismatch)")
+        self.reset()
+        self._cache = tuple(jnp.asarray(arrays[f"cache_{i}"])
+                            for i in range(len(self.backend.pool_specs)))
+        self._state = {k: jnp.asarray(arrays[f"state_{k}"])
+                       for k in self.backend.init_state()}
+        self._slots = [
+            None if m is None
+            else self._run_from_meta(m, arrays[f"slot{i}_prompt"])
+            for i, m in enumerate(meta["slots"])]
+        self._finished = [
+            self._run_from_meta(m, arrays[f"fin{j}_prompt"])
+            for j, m in enumerate(meta["finished"])]
+        self._prefill_slots = set(meta["prefill_slots"])
+        self._remaining_host = np.asarray(meta["remaining"], np.int64)
+        c = meta["counters"]
+        self.steps = c["steps"]
+        self.tokens_emitted = c["tokens_emitted"]
+        self.decode_tokens = c["decode_tokens"]
+        self.slot_steps = c["slot_steps"]
+
+    def snapshot(self, path: str):
+        """Write a crash-safe engine snapshot (single npz file, atomic
+        tmp+rename via the checkpoint write helpers)."""
+        from .resilience import save_snapshot
+        meta, arrays = self.snapshot_state()
+        save_snapshot(path, {"engine": meta}, arrays)
+
+    def restore(self, path: str):
+        from .resilience import load_snapshot
+        meta, arrays = load_snapshot(path)
+        self.restore_state(meta["engine"], arrays)

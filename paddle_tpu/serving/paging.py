@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import faults
 from ..utils.flags import env_flag, env_int
 from .engine import (ContinuousBatchingEngine, ModelStepBackend, _SlotRun,
                      build_paged_chunk_fn, build_slot_block_fn,
@@ -100,10 +101,20 @@ class BlockManager:
     def available(self) -> int:
         return len(self._free) + len(self._cached)
 
+    def usable_blocks(self) -> int:
+        """Pool capacity excluding the reserved trash block — the
+        admission-validation bound (a request needing more than this
+        can NEVER be admitted, no matter what retires)."""
+        return self.num_blocks - 1
+
     def allocate(self, n: int) -> Optional[List[int]]:
         """n fresh blocks at refcount 1, evicting LRU cached prefix
         blocks if the free list runs short; None if the pool can't
-        cover the request (caller re-queues)."""
+        cover the request (caller re-queues). The ``serving.allocate``
+        fault site deterministically simulates transient exhaustion
+        (returns None with the pool untouched)."""
+        if faults.should_fire("serving.allocate"):
+            return None
         if self.available() < n:
             return None
         out = []
@@ -184,6 +195,44 @@ class BlockManager:
                     self._cached[bid] = None
                 else:
                     self._free.append(bid)
+
+    # -- invariants --------------------------------------------------------
+    def assert_consistent(self):
+        """Hard-check the arena accounting invariants (paging test
+        teardowns + the chaos suite call this after every stream):
+
+        - free + referenced + LRU-retained partition the usable pool
+          exactly (every non-trash block in exactly ONE set);
+        - every refcount >= 1 (zeroes must leave the map);
+        - the prefix index and the registered-block map are mutual
+          inverses, retained blocks are all registered, and no free
+          block is still registered.
+        """
+        free, ref = set(self._free), set(self._ref)
+        cached, reg = set(self._cached), set(self._digest_of)
+        assert len(self._free) == len(free), \
+            f"duplicate ids in free list: {sorted(self._free)}"
+        assert not (free & ref), f"free AND referenced: {free & ref}"
+        assert not (free & cached), f"free AND retained: {free & cached}"
+        assert not (ref & cached), \
+            f"referenced AND retained: {ref & cached}"
+        universe = free | ref | cached
+        assert TRASH_BLOCK not in universe, "trash block was allocated"
+        want = set(range(1, self.num_blocks))
+        assert universe == want, (
+            f"block accounting leak: missing {sorted(want - universe)}, "
+            f"unknown {sorted(universe - want)}")
+        bad_refs = {b: r for b, r in self._ref.items() if r < 1}
+        assert not bad_refs, f"non-positive refcounts: {bad_refs}"
+        assert cached <= reg, \
+            f"retained but unregistered: {cached - reg}"
+        assert not (free & reg), \
+            f"free but still registered: {free & reg}"
+        assert len(self._index) == len(reg), \
+            "prefix index and registered-block map out of sync"
+        for digest, (bid, _) in self._index.items():
+            assert self._digest_of.get(bid) == digest, \
+                f"index entry for block {bid} disagrees with digest map"
 
 
 class PagedModelStepBackend(ModelStepBackend):
@@ -413,11 +462,17 @@ class PagedEngine(ContinuousBatchingEngine):
     def validate_request(self, prompt_len: int, max_new_tokens: int):
         super().validate_request(prompt_len, max_new_tokens)
         need = self.blocks_needed(prompt_len, max_new_tokens)
-        if need > self.num_kv_blocks - 1:
+        # the MANAGER is the source of truth, not the engine's
+        # num_kv_blocks attribute: allocate() draws from the manager,
+        # so validating against a stale attribute let an impossible
+        # request through the door and into run_until_idle's re-queue
+        # path forever (the PR-5 livelock fix; regression-pinned with a
+        # tiny pool in tests/test_resilience.py)
+        pool = self.manager.usable_blocks()
+        if need > pool:
             raise ValueError(
                 f"request needs {need} KV blocks but the arena only "
-                f"has {self.num_kv_blocks - 1}; raise num_blocks or "
-                "shorten the request")
+                f"has {pool}; raise num_blocks or shorten the request")
 
     # -- admission ---------------------------------------------------------
     def try_admit(self, request) -> bool:
@@ -471,6 +526,9 @@ class PagedEngine(ContinuousBatchingEngine):
         C = self.prefill_chunk_len
         while self._jobs and (token_budget is None or spent == 0
                               or spent < token_budget):
+            # fires BEFORE the chunk dispatch: the job's cursor hasn't
+            # advanced, so a retry re-dispatches the identical chunk
+            faults.fault_point("serving.prefill_tick")
             job = self._jobs[0]
             L = len(job.prompt)
             n = min(C, L - job.done)
@@ -523,3 +581,103 @@ class PagedEngine(ContinuousBatchingEngine):
         if run.block_ids is not None:
             self.manager.release(run.block_ids)
             run.block_ids = None     # the no-double-free invariant
+
+    # -- resilience hooks --------------------------------------------------
+    def _abort_prefill(self, slot):
+        """Cancel a mid-prefill request: drop its pending job (the
+        chunk loop never sees it again); its blocks release through the
+        shared ``_retire`` path. The slot never armed, so there is no
+        in-graph state to kill."""
+        self._jobs = [j for j in self._jobs if j.slot != slot]
+
+    def _poison_live_slot(self):
+        """Paged poison: NaN the arena block holding the victim's
+        position ``pos-1``. That block is always (a) within the slot's
+        attended range, so the sentinel trips on the very next step,
+        and (b) a FRESH block owned only by this slot — its index
+        ``(pos-1)//bs >= (L-1)//bs`` sits past both the shared-prefix
+        and the registered range, so no other slot (and no future
+        prefix match) can ever read the poison."""
+        for slot, run in enumerate(self._slots):
+            if run is not None and slot not in self._prefill_slots:
+                L = int(np.asarray(run.request.prompt).reshape(-1)
+                        .shape[0])
+                pos = L + len(run.tokens) - 1
+                blk = run.block_ids[(pos - 1) // self.kv_block_size]
+                self._cache = tuple(
+                    c.at[blk].set(jnp.nan)
+                    if jnp.issubdtype(c.dtype, jnp.floating) else c
+                    for c in self._cache)
+                return slot
+        return None
+
+    # -- snapshot / restore ------------------------------------------------
+    def snapshot_state(self):
+        meta, arrays = super().snapshot_state()
+        m = self.manager
+        meta["manager"] = {
+            "num_blocks": m.num_blocks, "block_size": m.block_size,
+            "free": list(m._free),
+            "ref": [[int(b), int(r)] for b, r in m._ref.items()],
+            "digest_of": [[int(b), d.hex()]
+                          for b, d in m._digest_of.items()],
+            "index": [[d.hex(), int(bid), [int(t) for t in chunk]]
+                      for d, (bid, chunk) in m._index.items()],
+            "cached": [int(b) for b in m._cached],   # LRU order
+            "lookups": m.lookups, "hit_blocks": m.hit_blocks,
+        }
+        jobs_meta = []
+        for j, job in enumerate(self._jobs):
+            arrays[f"job{j}_prompt"] = np.asarray(job.prompt, np.int32)
+            arrays[f"job{j}_table"] = np.asarray(job.table_row, np.int32)
+            arrays[f"job{j}_key"] = np.asarray(job.key)
+            arrays[f"job{j}_sub"] = np.asarray(job.sub)
+            jobs_meta.append({
+                "slot": job.slot, "done": job.done,
+                "temp": float(job.temp), "topk": int(job.topk),
+                "topp": float(job.topp), "tok0": job.tok0})
+        meta["jobs"] = jobs_meta
+        meta["paged_counters"] = {
+            "prompt_tokens": self.prompt_tokens,
+            "shared_tokens": self.shared_tokens,
+            "prefilled_tokens": self.prefilled_tokens,
+            "prefill_chunks": self.prefill_chunks}
+        return meta, arrays
+
+    def restore_state(self, meta, arrays):
+        super().restore_state(meta, arrays)
+        mm = meta["manager"]
+        m = self.manager
+        if (mm["num_blocks"], mm["block_size"]) != (m.num_blocks,
+                                                   m.block_size):
+            raise ValueError(
+                f"snapshot arena {mm['num_blocks']}x{mm['block_size']} "
+                f"does not match this engine's "
+                f"{m.num_blocks}x{m.block_size}")
+        m._free = list(mm["free"])
+        m._ref = {int(b): int(r) for b, r in mm["ref"]}
+        m._digest_of = {int(b): bytes.fromhex(d)
+                        for b, d in mm["digest_of"]}
+        m._index = {bytes.fromhex(d): (int(bid), tuple(chunk))
+                    for d, bid, chunk in mm["index"]}
+        m._cached = OrderedDict((int(b), None) for b in mm["cached"])
+        m.lookups, m.hit_blocks = mm["lookups"], mm["hit_blocks"]
+        m.assert_consistent()
+        self._jobs = []
+        for j, jm in enumerate(meta["jobs"]):
+            run = self._slots[jm["slot"]]
+            self._jobs.append(_PrefillJob(
+                run=run, slot=jm["slot"],
+                prompt=np.asarray(arrays[f"job{j}_prompt"], np.int32),
+                done=jm["done"],
+                table_row=np.asarray(arrays[f"job{j}_table"], np.int32),
+                key=jnp.asarray(arrays[f"job{j}_key"]),
+                sub=jnp.asarray(arrays[f"job{j}_sub"]),
+                temp=jnp.float32(jm["temp"]),
+                topk=jnp.int32(jm["topk"]),
+                topp=jnp.float32(jm["topp"]), tok0=jm["tok0"]))
+        pc = meta["paged_counters"]
+        self.prompt_tokens = pc["prompt_tokens"]
+        self.shared_tokens = pc["shared_tokens"]
+        self.prefilled_tokens = pc["prefilled_tokens"]
+        self.prefill_chunks = pc["prefill_chunks"]

@@ -24,7 +24,14 @@ class Request:
     per-request sampling params ride the engine's per-slot state arrays,
     so mixed greedy/sampled traffic shares one compiled program.
     ``arrival_step``: engine-block clock tick at which the request
-    becomes visible (deterministic staggered-arrival testing)."""
+    becomes visible (deterministic staggered-arrival testing).
+
+    ``deadline_ticks`` / ``deadline_s``: per-request deadlines (engine
+    ticks past ``arrival_step`` / wall seconds past submit). A request
+    still queued or in flight past its deadline is CANCELLED — slot
+    freed, paged blocks released — and a ``RequestFailure`` lands in
+    ``Server.results`` instead of a silent hang (None disables; the
+    server-level ``ResilienceConfig`` supplies defaults)."""
     request_id: int
     prompt: np.ndarray
     max_new_tokens: int = 20
@@ -35,6 +42,8 @@ class Request:
     seed: int = 0
     arrival_step: int = 0
     t_submit: float = 0.0
+    deadline_ticks: Optional[int] = None
+    deadline_s: Optional[float] = None
 
 
 class Scheduler:
@@ -77,6 +86,15 @@ class Scheduler:
 
     def pending(self) -> int:
         return len(self._queue)
+
+    def drop_where(self, pred) -> List[Request]:
+        """Remove and return every queued request matching ``pred`` —
+        the deadline/queue-wait expiry and circuit-breaker drain hook
+        (arrival order of the survivors is preserved)."""
+        dropped = [r for r in self._queue if pred(r)]
+        if dropped:
+            self._queue = [r for r in self._queue if not pred(r)]
+        return dropped
 
     def next_arrival(self) -> Optional[int]:
         return self._queue[0].arrival_step if self._queue else None

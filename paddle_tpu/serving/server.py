@@ -1,11 +1,23 @@
-"""Serving loop: Scheduler + ContinuousBatchingEngine + metrics.
+"""Serving loop: Scheduler + ContinuousBatchingEngine + metrics +
+resilience policies.
 
-One iteration of the loop = one tick of the engine-block clock: admit
-whatever the scheduler releases into free slots, run one compiled
-decode block over the pool, harvest retired requests. Per-request
-latency and engine-level tokens/s / slot-occupancy counters are emitted
-as profiler RecordEvent spans (chrome-trace) and summarized by
-``stats()`` — the serving analogue of the training loop's MFU line."""
+One iteration of the loop = one tick of the engine-block clock: expire
+deadlined requests, admit whatever the scheduler releases into free
+slots, advance chunked prefills, run one compiled decode block, harvest
+retired requests. Per-request latency and engine-level tokens/s /
+slot-occupancy counters are emitted as profiler RecordEvent spans
+(chrome-trace) and summarized by ``stats()`` — the serving analogue of
+the training loop's MFU line.
+
+Failure paths are first-class (serving/resilience.py): every submitted
+request ends either in a completed output array or an explicit
+``RequestFailure`` in ``results`` — deadlines cancel (slot freed, paged
+blocks released), bounded queues shed, transient step failures retry
+with seeded exponential backoff, a circuit breaker drains after N
+consecutive failures, and a NaN-poisoned slot is quarantined alone.
+``snapshot()``/``restore()`` make the whole server crash-safe: a
+process killed between ticks resumes from the snapshot and finishes
+every stream bit-identical to an uninterrupted run."""
 from __future__ import annotations
 
 import time
@@ -13,7 +25,12 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..utils import faults
 from .engine import ContinuousBatchingEngine
+from .resilience import (RequestFailure, ResilienceConfig,
+                         ResilienceState, load_snapshot,
+                         request_from_meta, request_to_meta,
+                         save_snapshot)
 from .scheduler import Request, Scheduler
 
 __all__ = ["Server"]
@@ -21,16 +38,22 @@ __all__ = ["Server"]
 
 class Server:
     """Continuous-batching server over an engine. ``submit()`` requests
-    (optionally with future ``arrival_step`` ticks), then
-    ``run_until_idle()`` — results match per-request ``generate()``:
-    prompt + generated ids, rows that hit eos padded with eos to
-    ``max_new_tokens`` (greedy traffic is bit-identical)."""
+    (optionally with future ``arrival_step`` ticks and per-request
+    deadlines), then ``run_until_idle()`` — results match per-request
+    ``generate()``: prompt + generated ids, rows that hit eos padded
+    with eos to ``max_new_tokens`` (greedy traffic is bit-identical).
+    Failed requests surface as :class:`RequestFailure` values in
+    ``results`` instead of hanging the loop."""
 
     def __init__(self, engine: ContinuousBatchingEngine,
-                 scheduler: Optional[Scheduler] = None):
+                 scheduler: Optional[Scheduler] = None,
+                 resilience: Optional[ResilienceConfig] = None):
         self.engine = engine
         self.scheduler = scheduler or Scheduler()
-        self.results: Dict[int, np.ndarray] = {}
+        self.resilience = resilience or ResilienceConfig()
+        self._res = ResilienceState(self.resilience)
+        engine.nan_sentinel = self.resilience.nan_sentinel
+        self.results: Dict[int, object] = {}
         self.latencies: Dict[int, float] = {}
         self.ttft: Dict[int, float] = {}       # submit -> first token
         self.tick_seconds: list = []           # per-tick wall times
@@ -41,26 +64,160 @@ class Server:
     def submit(self, prompt, max_new_tokens: int = 20,
                temperature: float = 0.0, top_k: int = 0,
                top_p: float = 1.0, eos_token_id: Optional[int] = None,
-               seed: int = 0, arrival_step: int = 0) -> int:
+               seed: int = 0, arrival_step: int = 0,
+               deadline_ticks: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue one request; returns its id (key into ``results``).
         Capacity is validated HERE — a request that can never fit a
-        slot is rejected at the door, not mid-stream at admission."""
+        slot (or, paged, the block pool) is rejected at the door, not
+        re-queued forever mid-stream. With ``max_queue_depth`` set, a
+        submit beyond the cap is load-shed: the id comes back with a
+        ``RequestFailure(reason="shed")`` already recorded."""
         prompt = np.asarray(prompt, np.int32)
         self.engine.validate_request(int(prompt.size), max_new_tokens)
         rid = self._next_id
         self._next_id += 1
+        depth = self.resilience.max_queue_depth
+        if depth is not None and self.scheduler.pending() >= depth:
+            self._res.shed_requests += 1
+            self._fail(rid, "shed",
+                       f"queue depth at cap ({depth}); retry later")
+            return rid
         self.scheduler.submit(Request(
             request_id=rid, prompt=np.asarray(prompt, np.int32),
             max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
             seed=seed, arrival_step=arrival_step,
-            t_submit=time.perf_counter()))
+            t_submit=time.perf_counter(),
+            deadline_ticks=deadline_ticks, deadline_s=deadline_s))
         return rid
+
+    # -- failure plumbing --------------------------------------------------
+    def _fail(self, rid: int, reason: str, message: str = "",
+              tokens: int = 0):
+        self.results[rid] = RequestFailure(
+            request_id=rid, reason=reason, message=message,
+            tokens_emitted=tokens)
+        self._res.count_failure(reason)
+
+    def _deadline_hit(self, req: Request, now: float) -> bool:
+        cfg = self.resilience
+        dt = req.deadline_ticks if req.deadline_ticks is not None \
+            else cfg.deadline_ticks
+        if dt is not None and self._clock - req.arrival_step > dt:
+            return True
+        ds = req.deadline_s if req.deadline_s is not None \
+            else cfg.deadline_s
+        return ds is not None and now - req.t_submit > ds
+
+    def _expire(self):
+        """Cancel queued and in-flight requests past their deadline
+        (and queued ones past the max queue wait). In-flight
+        cancellation goes through ``engine.cancel_slot`` — the slot is
+        killed in-graph and paged blocks release at correct refcounts;
+        the failure surfaces through the normal harvest."""
+        now = time.perf_counter()
+        mw = self.resilience.max_queue_wait_ticks
+
+        def queued_out(r):
+            if mw is not None and self._clock - r.arrival_step > mw:
+                return True
+            return self._deadline_hit(r, now)
+
+        for r in self.scheduler.drop_where(queued_out):
+            self._fail(r.request_id, "timeout",
+                       f"expired in queue at tick {self._clock}")
+        for slot, run in self.engine.live_runs():
+            if self._deadline_hit(run.request, now):
+                self.engine.cancel_slot(slot, "timeout")
+
+    def _with_retry(self, fn) -> bool:
+        """Run ``fn`` with the transient-failure policy: seeded
+        exponential backoff between attempts; every failed attempt
+        counts toward the consecutive-failure budget that opens the
+        circuit breaker. Returns False if ``fn`` never succeeded (the
+        tick just moves on — or the breaker drains everything)."""
+        res, cfg = self._res, self.resilience
+        for attempt in range(cfg.retry_attempts + 1):
+            if res.breaker_open:
+                return False
+            try:
+                fn()
+                res.consecutive_failures = 0
+                return True
+            except res.transient as e:
+                res.step_failures += 1
+                res.consecutive_failures += 1
+                res.last_error = f"{type(e).__name__}: {e}"
+                if res.consecutive_failures >= cfg.breaker_threshold:
+                    res.breaker_open = True
+                    return False
+                if attempt < cfg.retry_attempts:
+                    res.retries += 1
+                    time.sleep(res.backoff_s(attempt))
+        return False
+
+    def _quarantine_all(self, reason: str):
+        """Circuit-breaker drain: cancel every in-flight request and
+        fail everything still queued — the server ends in a clean,
+        fully-accounted state instead of wedging on a dead device."""
+        for slot, _ in self.engine.live_runs():
+            self.engine.cancel_slot(slot, reason)
+        for r in self.scheduler.drop_where(lambda r: True):
+            self._fail(r.request_id, reason,
+                       "circuit breaker open: queue drained")
+
+    # -- the tick ----------------------------------------------------------
+    def _tick(self):
+        self._expire()
+        admitted = self.scheduler.pop_ready(
+            self._clock, self.engine.free_slot_count(),
+            engine_idle=not self.engine.has_live())
+        for i, req in enumerate(admitted):
+            if not self.engine.try_admit(req):
+                # re-queue in reverse: requeue() front-inserts per
+                # arrival tick, so forward order would flip
+                # same-tick FIFO and let peers overtake the oldest
+                for r in reversed(admitted[i:]):
+                    self.scheduler.requeue(r)
+                break
+        prefill_tick = getattr(self.engine, "prefill_tick", None)
+        if prefill_tick is not None:
+            # chunks dispatched before a mid-loop fault keep their
+            # cursors, so a retry must only get the UNSPENT part of the
+            # tick's budget — otherwise each retry re-arms a full
+            # budget and one tick can blow the decode-interference
+            # bound chunked prefill exists to enforce
+            budget = self.scheduler.prefill_token_budget
+            spent = [0]
+
+            def _prefill():
+                b = None if budget is None else budget - spent[0]
+                if b is not None and b <= 0 and spent[0] > 0:
+                    return           # budget already consumed this tick
+                # measure spend from the engine counter, not the return
+                # value — a fault raises out of prefill_tick AFTER some
+                # chunks already dispatched, and those must still count
+                before = self.engine.prefilled_tokens
+                try:
+                    prefill_tick(b)
+                finally:
+                    spent[0] += self.engine.prefilled_tokens - before
+
+            self._with_retry(_prefill)
+        if self.engine.has_decoding() or \
+                self.engine.has_pending_harvest():
+            self._with_retry(self.engine.step_block)
 
     def _harvest(self):
         now = time.perf_counter()
         for run in self.engine.drain_finished():
             req = run.request
+            if run.failure is not None:
+                self._fail(req.request_id, run.failure,
+                           f"cancelled after {len(run.tokens)} tokens",
+                           tokens=len(run.tokens))
+                continue
             toks = np.asarray(run.tokens, np.int32)
             if len(toks) < req.max_new_tokens:
                 # retired early at eos: pad to max_new (generate parity)
@@ -72,36 +229,46 @@ class Server:
             self.latencies[req.request_id] = now - req.t_submit
             self.ttft[req.request_id] = run.t_admit - req.t_submit
 
-    def run_until_idle(self) -> Dict[int, np.ndarray]:
+    def run_until_idle(self, max_ticks: Optional[int] = None
+                       ) -> Dict[int, object]:
         """Drive the loop until the queue is empty and every slot is
-        free; returns ``results``. One tick = admit what the scheduler
-        releases (requests the engine defers — paged block pool
-        exhausted — re-queue), advance chunked prefills within the
-        scheduler's prefill token budget, run one decode block, harvest.
-        Per-tick wall times land in ``tick_seconds`` — the max is the
-        decode-interference figure chunked prefill exists to bound."""
+        free; returns ``results`` (arrays for completed requests,
+        ``RequestFailure`` for shed/expired/quarantined ones). One tick
+        = expire deadlines, admit what the scheduler releases (requests
+        the engine defers — paged block pool exhausted — re-queue),
+        advance chunked prefills within the scheduler's prefill token
+        budget, run one decode block, harvest. Per-tick wall times land
+        in ``tick_seconds`` — the max is the decode-interference figure
+        chunked prefill exists to bound.
+
+        ``max_ticks``: stop after that many ticks even with work in
+        flight — the kill point for snapshot/restore tests and a hang
+        bound for chaos schedules. A tick that trips the
+        ``server.tick`` fault site is counted and skipped (requests
+        stay queued; nothing is lost)."""
         t0 = time.perf_counter()
+        ticks = 0
         while self.scheduler.pending() or self.engine.has_live():
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            if self._res.breaker_open:   # incl. restored-open circuits
+                self._quarantine_all("circuit_open")
+                self._harvest()
+                break
             t_tick = time.perf_counter()
-            admitted = self.scheduler.pop_ready(
-                self._clock, self.engine.free_slot_count(),
-                engine_idle=not self.engine.has_live())
-            for i, req in enumerate(admitted):
-                if not self.engine.try_admit(req):
-                    # re-queue in reverse: requeue() front-inserts per
-                    # arrival tick, so forward order would flip
-                    # same-tick FIFO and let peers overtake the oldest
-                    for r in reversed(admitted[i:]):
-                        self.scheduler.requeue(r)
-                    break
-            prefill_tick = getattr(self.engine, "prefill_tick", None)
-            if prefill_tick is not None:
-                prefill_tick(self.scheduler.prefill_token_budget)
-            if self.engine.has_decoding():
-                self.engine.step_block()
+            try:
+                faults.fault_point("server.tick")
+                self._tick()
+            except faults.InjectedFault:
+                self._res.tick_faults += 1
             self._clock += 1
+            ticks += 1
             self._harvest()
             self.tick_seconds.append(time.perf_counter() - t_tick)
+            if self._res.breaker_open:
+                self._quarantine_all("circuit_open")
+                self._harvest()
+                break
         self._wall += time.perf_counter() - t0
         return self.results
 
@@ -110,8 +277,10 @@ class Server:
         ttft = list(self.ttft.values())
         ticks = self.tick_seconds
         eng = self.engine
+        completed = sum(1 for v in self.results.values()
+                        if not isinstance(v, RequestFailure))
         out = {
-            "requests_completed": len(self.results),
+            "requests_completed": completed,
             "tokens_emitted": eng.tokens_emitted,
             "decode_steps": eng.steps,
             "slot_occupancy": round(eng.occupancy(), 4),
@@ -130,8 +299,84 @@ class Server:
             "p95_tick_s": round(float(np.percentile(ticks, 95)), 4)
             if ticks else 0.0,
         }
+        out.update(self._res.counters())
         hit_rate = getattr(eng, "prefix_cache_hit_rate", None)
         if hit_rate is not None:               # paged engine extras
             out["prefix_cache_hit_rate"] = round(hit_rate(), 4)
             out["kv_bytes_per_slot"] = eng.backend.kv_bytes_per_slot()
         return out
+
+    # -- crash-safe snapshot / restore -------------------------------------
+    def snapshot(self, path: str):
+        """Write server + engine state as ONE atomic npz: queue,
+        results, clocks, resilience counters, and the engine's full
+        device/host state. Taken between ticks (the engine enforces the
+        no-pending-harvest boundary)."""
+        meta, arrays = self.engine.snapshot_state()
+        res_meta = {}
+        for rid, v in self.results.items():
+            if isinstance(v, RequestFailure):
+                res_meta[str(rid)] = {
+                    "kind": "failure", "reason": v.reason,
+                    "message": v.message,
+                    "tokens_emitted": v.tokens_emitted}
+            else:
+                res_meta[str(rid)] = {"kind": "ok"}
+                arrays[f"res_{rid}"] = np.asarray(v, np.int32)
+        # deliberate direct read: a custom scheduler without a _queue
+        # list must FAIL the snapshot loudly, not silently serialize an
+        # empty queue and lose every not-yet-admitted request
+        queue = list(self.scheduler._queue)
+        qmeta = []
+        for i, r in enumerate(queue):
+            arrays[f"q{i}_prompt"] = np.asarray(r.prompt,
+                                                np.int32).reshape(-1)
+            qmeta.append(request_to_meta(r))
+        smeta = {
+            "next_id": self._next_id, "clock": self._clock,
+            "wall": self._wall,
+            "latencies": {str(k): v for k, v in self.latencies.items()},
+            "ttft": {str(k): v for k, v in self.ttft.items()},
+            "results": res_meta, "queue": qmeta,
+            "counters": self._res.counters(),
+        }
+        save_snapshot(path, {"engine": meta, "server": smeta}, arrays)
+
+    @classmethod
+    def restore(cls, path: str, engine: ContinuousBatchingEngine,
+                scheduler: Optional[Scheduler] = None,
+                resilience: Optional[ResilienceConfig] = None
+                ) -> "Server":
+        """Rebuild a server from a snapshot into a freshly constructed
+        engine of the same configuration (fresh process simulation:
+        programs recompile, state restores — then ``run_until_idle()``
+        finishes every stream bit-identical to the uninterrupted run)."""
+        meta, arrays = load_snapshot(path)
+        engine.restore_state(meta["engine"], arrays)
+        srv = cls(engine, scheduler, resilience)
+        sm = meta["server"]
+        srv._next_id = sm["next_id"]
+        srv._clock = sm["clock"]
+        srv._wall = sm["wall"]
+        srv.latencies = {int(k): v for k, v in sm["latencies"].items()}
+        srv.ttft = {int(k): v for k, v in sm["ttft"].items()}
+        for rid_s, info in sm["results"].items():
+            rid = int(rid_s)
+            if info["kind"] == "ok":
+                srv.results[rid] = np.asarray(arrays[f"res_{rid}"],
+                                              np.int32)
+            else:
+                srv.results[rid] = RequestFailure(
+                    request_id=rid, reason=info["reason"],
+                    message=info["message"],
+                    tokens_emitted=info["tokens_emitted"])
+        # the full resilience runtime state (failure counts, retry
+        # budget, breaker) survives the restore — an open circuit must
+        # stay open in the resumed process
+        srv._res.restore_counters(sm["counters"])
+        # re-submit in saved order: insort is stable, so same-tick FIFO
+        # order survives the round trip
+        for i, rm in enumerate(sm["queue"]):
+            srv.scheduler.submit(
+                request_from_meta(rm, arrays[f"q{i}_prompt"]))
+        return srv

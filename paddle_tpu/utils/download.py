@@ -14,11 +14,12 @@ import hashlib
 import os
 import shutil
 
+from .flags import env_float, env_str
+
 __all__ = ["get_weights_path_from_url", "get_path_from_url"]
 
-PT_HOME = os.environ.get(
-    "PT_HOME", os.path.join(os.path.expanduser("~"), ".cache",
-                            "paddle_tpu"))
+PT_HOME = env_str("PT_HOME") or os.path.join(
+    os.path.expanduser("~"), ".cache", "paddle_tpu")
 WEIGHTS_HOME = os.path.join(PT_HOME, "weights")
 
 
@@ -56,7 +57,7 @@ def get_path_from_url(url: str, root_dir: str | None = None,
     try:
         import urllib.request
         tmp = fullpath + ".part"
-        timeout = float(os.environ.get("PT_DOWNLOAD_TIMEOUT", "30"))
+        timeout = env_float("PT_DOWNLOAD_TIMEOUT", 30.0)
         # explicit timeout: a firewalled/blackholed egress (dropped
         # SYNs, the TPU-pod norm) must raise the clear error below, not
         # hang forever the way a timeout-less urlretrieve would

@@ -6,11 +6,11 @@ import os
 from typing import Any
 
 __all__ = ["define_flag", "get_flags", "set_flags", "FLAGS", "env_flag",
-           "env_int", "env_str"]
+           "env_bool", "env_int", "env_float", "env_str"]
 
 
-def env_flag(name: str, default: bool = False) -> bool:
-    """Read a PT_* boolean env toggle with uniform falsy spellings
+def env_bool(name: str, default: bool = False) -> bool:
+    """Read a boolean env toggle with uniform falsy spellings
     ('', '0', 'false', 'off', 'no' — case/whitespace-insensitive).
     Shared by PT_FUSION_PASSES, the collectives flags and the serving
     flags so toggle semantics never drift between subsystems."""
@@ -18,6 +18,10 @@ def env_flag(name: str, default: bool = False) -> bool:
     if v is None:
         return default
     return v.strip().lower() not in ("", "0", "false", "off", "no")
+
+
+# historical name — env_bool is the canonical spelling
+env_flag = env_bool
 
 
 def env_int(name: str, default: int) -> int:
@@ -29,6 +33,15 @@ def env_int(name: str, default: int) -> int:
     if v is None or not v.strip():
         return default
     return int(v.strip())
+
+
+def env_float(name: str, default: float) -> float:
+    """Read a float env knob (same lenient-empty / strict-malformed
+    contract as :func:`env_int`)."""
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    return float(v.strip())
 
 
 def env_str(name: str, default: str = "") -> str:

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py"]
+GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
+                 "tests/test_resilience.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -30,6 +31,14 @@ REQUIRED_NODES = [
     "test_write_path_error_within_runtime_bound",
     "test_serving.py::TestContinuousBatching::"
     "test_greedy_bit_exact_on_ragged_stream_one_compile",
+    # PR 5 resilience pins: the chaos suite, the kill/restore
+    # bit-identity contract, and the faults-disarmed inertness pin
+    "test_resilience.py::TestSnapshotRestore::"
+    "test_kill_restore_paged_bit_identical",
+    "test_resilience.py::TestChaos::"
+    "test_randomized_fault_schedules_hold_invariants",
+    "test_resilience.py::TestInertWhenDisabled::"
+    "test_disarmed_streams_bit_identical_compile_counts_pinned",
 ]
 
 

@@ -15,6 +15,9 @@ from paddle_tpu.serving import (BlockManager, ContinuousBatchingEngine,
                                 PagedEngine, Request, Scheduler, Server)
 
 
+_LIVE_MANAGERS = []      # every BlockManager the module's tests built
+
+
 @pytest.fixture(scope="module")
 def paged_setup():
     """One model + one paged engine for the whole file (reset() frees
@@ -28,7 +31,18 @@ def paged_setup():
         model, num_slots=2, max_len=64, decode_block=4, paged=True,
         block_size=8, prefill_chunk=8)
     assert isinstance(engine, PagedEngine)
+    _LIVE_MANAGERS.append(engine.manager)
     return model, cfg, engine
+
+
+@pytest.fixture(autouse=True)
+def _arena_invariants():
+    """Teardown for EVERY test in this file: the arena accounting
+    invariants must hold after each stream (PR-5 satellite — a leak
+    caught here names the test that caused it, not a later victim)."""
+    yield
+    for m in _LIVE_MANAGERS:
+        m.assert_consistent()
 
 
 def _ref(model, prompt, max_new, **kw):
@@ -194,6 +208,7 @@ class TestPrefixSharing:
         backend = engine.backend
         bad = PagedEngine(backend=backend,
                           hash_fn=lambda parent, toks: b"collide")
+        _LIVE_MANAGERS.append(bad.manager)
         rs = np.random.RandomState(5)
         pa = rs.randint(0, cfg.vocab_size, (17,)).astype(np.int32)
         pb = rs.randint(0, cfg.vocab_size, (17,)).astype(np.int32)
@@ -218,6 +233,7 @@ class TestPrefixSharing:
         tight.manager = BlockManager(6, tight.kv_block_size)
         tight.num_kv_blocks = 6
         tight.reset()
+        _LIVE_MANAGERS.append(tight.manager)
         rs = np.random.RandomState(6)
         prompts = [rs.randint(0, cfg.vocab_size, (12,)).astype(np.int32)
                    for _ in range(3)]
@@ -236,6 +252,7 @@ class TestBlockManager:
         m.release(blocks)
         with pytest.raises(RuntimeError, match="double free"):
             m.release(blocks)
+        m.assert_consistent()
 
     def test_lru_eviction_of_cached_prefixes(self):
         m = BlockManager(4, 2)           # 3 usable blocks
@@ -252,6 +269,7 @@ class TestBlockManager:
         assert sorted(got) == sorted(held)
         assert m.match_prefix(prompt) == []   # index emptied by evict
         m.release(got)
+        m.assert_consistent()
 
     def test_allocate_refuses_oversubscription(self):
         m = BlockManager(4, 2)
@@ -260,6 +278,8 @@ class TestBlockManager:
         assert m.allocate(1) is None
         m.release(held)
         assert m.allocate(1) is not None
+        m.release([b for b in m._ref])
+        m.assert_consistent()
 
 
 class TestInt8KV:
@@ -316,6 +336,7 @@ class TestInt8KV:
         e8 = ContinuousBatchingEngine(
             model, num_slots=2, max_len=64, decode_block=4, paged=True,
             block_size=8, prefill_chunk=8, kv_int8=True)
+        _LIVE_MANAGERS.append(e8.manager)
         rs = np.random.RandomState(8)
         prompts = [rs.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
                    for L in (5, 9)]
