@@ -36,6 +36,7 @@ TPU_DEADLINE_S = float(os.environ.get("BENCH_TPU_DEADLINE_S", "1100"))
 CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_DEADLINE_S", "420"))
 COMMS_DEADLINE_S = float(os.environ.get("BENCH_COMMS_DEADLINE_S", "240"))
 PASSES_DEADLINE_S = float(os.environ.get("BENCH_PASSES_DEADLINE_S", "240"))
+OBS_DEADLINE_S = float(os.environ.get("BENCH_OBS_DEADLINE_S", "240"))
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
 PROBE_DEADLINE_S = float(os.environ.get("BENCH_PROBE_DEADLINE_S", "90"))
@@ -744,7 +745,8 @@ def _run_child(mode: str, deadline: float):
     The child emits BENCH_JSON after every completed stage — the LAST
     line wins, and a deadline kill still salvages the partial result."""
     env = dict(os.environ)
-    if mode in ("--child-cpu", "--child-comms", "--child-passes"):
+    if mode in ("--child-cpu", "--child-comms", "--child-passes",
+                "--child-observability"):
         env["JAX_PLATFORMS"] = "cpu"
     if mode == "--child-comms":
         flags = env.get("XLA_FLAGS", "")
@@ -884,6 +886,27 @@ def _attach_passes(result, budget_s=None):
                          PASSES_DEADLINE_S, budget_s)
 
 
+def _child_observability():
+    """observability stage: the serving stream with metrics + request
+    tracing + flight recorder fully armed vs disarmed
+    (observability/microbench.py, CPU lane). Pins the <2%-enabled /
+    ~0%-disabled overhead contract every round, plus proof the
+    artifacts exist: metric families sampled, request/host spans and
+    tick markers in one loadable merged chrome trace."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.observability.microbench import run_observability_bench
+    out = run_observability_bench(
+        requests=int(os.environ.get("BENCH_OBS_REQUESTS", "8")),
+        max_new=int(os.environ.get("BENCH_OBS_MAX_NEW", "24")))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_observability(result, budget_s=None):
+    return _attach_stage(result, "observability", "--child-observability",
+                         OBS_DEADLINE_S, budget_s)
+
+
 def _child_probe():
     """Tiny tunnel-health check: init backend + one 256x256 matmul."""
     import jax
@@ -910,6 +933,9 @@ def main():
         return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-passes":
         _child_passes()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-observability":
+        _child_observability()
         return
 
     errors = []
@@ -983,7 +1009,9 @@ def _main_measured(errors):
             result, err = _run_child("--child-tpu", child_deadline)
             if result is not None:
                 result = _attach_comms(result, remaining())
-                print(json.dumps(_attach_passes(result, remaining())))
+                result = _attach_passes(result, remaining())
+                print(json.dumps(
+                    _attach_observability(result, remaining())))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
             time.sleep(5)
@@ -1003,7 +1031,8 @@ def _main_measured(errors):
             # — the wedge-is-environmental evidence chain (VERDICT r4 #1)
             result["tunnel_log"] = "TUNNEL_r05.json"
         result = _attach_comms(result, remaining())
-        print(json.dumps(_attach_passes(result, remaining())))
+        result = _attach_passes(result, remaining())
+        print(json.dumps(_attach_observability(result, remaining())))
         return
     # last resort: still one JSON line, rc 0, explicit marker
     print(json.dumps({

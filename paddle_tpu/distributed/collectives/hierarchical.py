@@ -208,6 +208,41 @@ def _record(name):
     return RecordEvent(name)
 
 
+_om = None        # observability.metrics, imported on first dispatch
+                  # (collectives load during package init, before
+                  # ``paddle_tpu.utils`` exists, so no top-level import)
+
+
+def _note_metrics(op: str, plan: HierarchyPlan, v, int8: bool = False):
+    """Per-call collective metrics: calls + payload bytes (labelled by
+    op and plan mode) and, for the quantized path, the runtime int8
+    error bound on this payload. The module is imported once and
+    cached; after that the disarmed path is one None test + one bool
+    check, and the absmax host sync only happens armed."""
+    global _om
+    if _om is None:
+        from ...observability import metrics as _om
+    om = _om
+    if not om.enabled():
+        return
+    mode = plan.mode + (",int8" if int8 else "")
+    om.counter("pt_collectives_calls_total",
+               "host-level collective dispatches",
+               labels=("op", "mode")).inc(op=op, mode=mode)
+    om.counter("pt_collectives_bytes_total",
+               "payload bytes handed to collectives (stacked "
+               "contributions; algorithmic wire bytes are the comms "
+               "microbench's job)",
+               labels=("op", "mode")).inc(v.nbytes, op=op, mode=mode)
+    if int8:
+        from .quantized import int8_error_bound
+        absmax = float(jnp.max(jnp.abs(v)))
+        om.gauge("pt_collectives_int8_error_bound",
+                 "worst-case |dequant - fp32| of the most recent int8 "
+                 "all-reduce payload").set(
+            float(int8_error_bound(absmax, plan.total_size)))
+
+
 @functools.lru_cache(maxsize=256)
 def _compiled(op: str, mesh: Mesh, plan: HierarchyPlan,
               bucket_size: Optional[int]):
@@ -265,6 +300,7 @@ def all_reduce(x, axes: Optional[Axes] = None, mesh: Optional[Mesh] = None,
     # bucket size only shapes the int8 program; keying the fp32 cache
     # on it would recompile identical programs on config churn
     bucket = cfg.quant_bucket_size if compress == "int8" else None
+    _note_metrics("all_reduce", plan, v, int8=compress == "int8")
     with _record(f"collectives::all_reduce[{plan.mode}"
                  f"{',int8' if compress == 'int8' else ''}]"):
         out = _compiled(op, mesh, plan, bucket)(v)
@@ -287,6 +323,7 @@ def reduce_scatter(x, axes: Optional[Axes] = None,
     if v.shape[0] != n:
         raise ValueError(
             f"reduce_scatter expects dim 0 == {n}, got {v.shape}")
+    _note_metrics("reduce_scatter", plan, v)
     with _record(f"collectives::reduce_scatter[{plan.mode}]"):
         out = _compiled("reduce_scatter", mesh, plan, None)(v)
         out.block_until_ready()
@@ -306,6 +343,7 @@ def all_gather(x, axes: Optional[Axes] = None, mesh: Optional[Mesh] = None,
         raise ValueError(
             f"all_gather expects dim 0 == {plan.total_size}, got "
             f"{v.shape}")
+    _note_metrics("all_gather", plan, v)
     with _record(f"collectives::all_gather[{plan.mode}]"):
         out = _compiled("all_gather", mesh, plan, None)(v)
         out.block_until_ready()

@@ -1,0 +1,141 @@
+"""Observability overhead bench: the serving stream with metrics +
+request tracing + flight recorder fully armed vs fully disarmed.
+
+The contract the stage pins every round: <2% tokens/s cost fully
+enabled, ~0% disabled (the disabled path is one bool check per hook).
+Each mode is timed over ``repeats`` interleaved pairs on the same
+compiled engine (reset() keeps programs). The overhead number compares
+the FASTEST-HALF MEANS of each mode: on the CPU lane a single serving
+run jitters ±20% (allocator/scheduler noise dwarfs the
+instrumentation) and that noise is one-sided — a run is only ever
+slower than the true cost — so trimming the slow tail and averaging
+the rest filters it, and is stabler than the raw min (an extreme
+statistic) or a median of per-pair deltas at the same sample count.
+The enabled pass also proves the artifacts are real: the metrics dump
+covers every instrumented subsystem present in the workload, and the
+merged chrome trace (request rows + RecordEvent host spans + tick
+markers) round-trips through ``json.load``.
+
+Wired into bench.py as the ``observability`` child stage — CPU lane,
+non-null on the fallback path like comms/passes.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+__all__ = ["run_observability_bench"]
+
+
+def run_observability_bench(requests: int = 8, max_new: int = 24,
+                            num_slots: int = 4, decode_block: int = 8,
+                            repeats: int = 10) -> dict:
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import ObservabilityConfig, metrics
+    from paddle_tpu.serving import ContinuousBatchingEngine, Server
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=768,
+        num_hidden_layers=4, num_attention_heads=8,
+        num_key_value_heads=8, max_position_embeddings=256,
+        tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    lens = [4 + (i % 3) * 6 for i in range(requests)]
+    prompts = [rs.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+               for L in lens]
+    engine = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_len=16 + max_new,
+        decode_block=decode_block, prompt_buckets=(16,))
+
+    def run(obs_on: bool):
+        metrics.enable(obs_on)
+        engine.reset()
+        # the off arm is the SHIPPED default: metrics/tracing disarmed
+        # but the flight ring recording at default capacity (flight is
+        # always-on by design) — benching flight_size=0 would pin a
+        # "disabled" number no default user actually runs
+        srv = Server(engine, observability=ObservabilityConfig(
+            trace_requests=obs_on))
+        for i, p in enumerate(prompts):
+            srv.submit(p, max_new_tokens=max_new, arrival_step=i)
+        t0 = time.perf_counter()
+        srv.run_until_idle()
+        return srv, time.perf_counter() - t0
+
+    prev_enabled = metrics.enabled()
+    try:
+        # compile warmup + burn-in: early CPU runs are 30-50% slower
+        # than steady state (allocator/cache warming), which would
+        # swamp a <2% contract — time nothing until the drift settles
+        for _ in range(3):
+            run(False)
+        offs, ons = [], []
+        srv_on, dt_best = None, float("inf")
+        for i in range(max(repeats, 4)):   # paired, interleaved
+            # alternate within-pair order so monotone drift (CPU
+            # steady-state warming) can't systematically favor
+            # whichever mode runs first
+            if i % 2 == 0:
+                _, a = run(False)
+                srv, b = run(True)
+            else:
+                srv, b = run(True)
+                _, a = run(False)
+            offs.append(a)
+            ons.append(b)
+            if b < dt_best:
+                dt_best, srv_on = b, srv
+        # fastest-half means: scheduler noise is one-sided (a run is
+        # only ever SLOWER than the true cost), so trim the slow tail
+        # of each mode and average what's left — stabler than the raw
+        # min (an extreme statistic) at the same sample count
+        k = max(1, len(offs) // 2)
+        dt_off = sum(sorted(offs)[:k]) / k
+        dt_on = sum(sorted(ons)[:k]) / k
+        overhead_pct = (dt_on - dt_off) / dt_off * 100
+
+        # artifact proof on the last enabled server: merged trace loads
+        metrics.enable(True)
+        prof = profiler.Profiler(
+            targets=[profiler.ProfilerTarget.CPU], timer_only=True)
+        with prof:
+            srv_trace, _ = run(True)
+        trace_path = os.path.join(tempfile.mkdtemp(prefix="pt_obs_"),
+                                  "serve_trace.json")
+        srv_trace.export_trace(trace_path, profiler=prof)
+        with open(trace_path) as f:
+            events = json.load(f)["traceEvents"]
+        req_spans = sum(1 for e in events
+                        if e.get("ph") == "X" and e.get("tid", 0) > 0)
+        host_spans = sum(1 for e in events
+                         if str(e.get("name", "")).startswith("serving."))
+        tick_marks = sum(1 for e in events if e.get("name") == "tick")
+        dump = metrics.dump()
+        non_empty = [k for k, v in dump.items() if v["samples"]]
+    finally:
+        metrics.enable(prev_enabled)
+
+    useful = requests * max_new
+    return {
+        "observability_tokens_per_sec_off": round(useful / dt_off, 1),
+        "observability_tokens_per_sec_on": round(useful / dt_on, 1),
+        # the <2% contract number: fastest-half means over interleaved
+        # off/on pairs (positive = enabling costs throughput)
+        "observability_overhead_pct": round(overhead_pct, 2),
+        "observability_metric_families": len(dump),
+        "observability_families_sampled": len(non_empty),
+        "observability_request_spans": req_spans,
+        "observability_host_spans": host_spans,
+        "observability_tick_marks": tick_marks,
+        "observability_trace_loadable": bool(events),
+        "observability_flight_events":
+            len((srv_on or srv_trace).flight.events()),
+    }

@@ -60,15 +60,24 @@ class PassManager:
                                                type(p).__name__))
 
     def run(self, closed: ClosedJaxpr) -> ClosedJaxpr:
+        from ..observability import metrics as om
         from ..profiler import RecordEvent
         self.last_stats = []
         for p in self._passes:
             before = len(closed.jaxpr.eqns)
             with RecordEvent(f"pass:{self._name(p)}"):
                 closed = p(closed)
+            after = len(closed.jaxpr.eqns)
             self.last_stats.append({"pass": self._name(p),
                                     "eqns_before": before,
-                                    "eqns_after": len(closed.jaxpr.eqns)})
+                                    "eqns_after": after})
+            om.counter("pt_passes_runs_total", "pass executions",
+                       labels=("pass",)).inc(**{"pass": self._name(p)})
+            if after < before:
+                om.counter("pt_passes_eqns_removed_total",
+                           "jaxpr equations removed, by pass",
+                           labels=("pass",)).inc(
+                    before - after, **{"pass": self._name(p)})
         return closed
 
     def __call__(self, closed: ClosedJaxpr) -> ClosedJaxpr:

@@ -231,6 +231,10 @@ FUSION_RULES = [
 def _record(rule_name, eqn):
     fusion_pass.last_rewrites[rule_name] = \
         fusion_pass.last_rewrites.get(rule_name, 0) + 1
+    from ..observability import metrics as om
+    om.counter("pt_passes_rewrites_total",
+               "fusion-rule rewrites applied, by rule",
+               labels=("rule",)).inc(rule=rule_name)
 
 
 _run = make_rewrite_pass(FUSION_RULES, pass_name="fusion",

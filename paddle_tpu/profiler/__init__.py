@@ -198,23 +198,33 @@ class Profiler:
         return ev
 
     # -- lifecycle ----------------------------------------------------------
-    def start(self):
-        _ACTIVE[0] = True
+    @staticmethod
+    def _recording(state) -> bool:
+        return state in (ProfilerState.RECORD,
+                         ProfilerState.RECORD_AND_RETURN)
+
+    def _arm_host_ring(self, on: bool):
+        """The host-span ring (and the native tracer) record iff the
+        scheduler state says so. ``start()`` used to set the ring
+        unconditionally — host spans recorded through CLOSED warmup
+        steps — and CLOSED→RECORD transitions in ``step()`` never
+        re-armed it; both directions are regression-pinned in
+        tests/test_observability.py."""
+        _ACTIVE[0] = on
         t = _tracer()
         if t is not None:
-            t.enable(True)
+            t.enable(on)
+
+    def start(self):
         self._state = self.scheduler(self._step) if self.scheduler else \
             ProfilerState.RECORD
-        if self._state in (ProfilerState.RECORD,
-                           ProfilerState.RECORD_AND_RETURN):
+        self._arm_host_ring(self._recording(self._state))
+        if self._recording(self._state):
             self._start_device_trace()
 
     def stop(self):
         self._stop_device_trace()
-        _ACTIVE[0] = False
-        t = _tracer()
-        if t is not None:
-            t.enable(False)
+        self._arm_host_ring(False)
         if self.on_trace_ready:
             self.on_trace_ready(self)
 
@@ -222,9 +232,8 @@ class Profiler:
         self._step += 1
         if self.scheduler:
             new_state = self.scheduler(self._step)
-            if new_state in (ProfilerState.RECORD,
-                             ProfilerState.RECORD_AND_RETURN) and \
-                    not self._jax_active:
+            self._arm_host_ring(self._recording(new_state))
+            if self._recording(new_state) and not self._jax_active:
                 self._start_device_trace()
             elif new_state == ProfilerState.CLOSED and self._jax_active:
                 self._stop_device_trace()
@@ -242,11 +251,21 @@ class Profiler:
         return False
 
     def export(self, path, format="json"):
+        # missing parent directories are created, not a crash — bench
+        # children and trace handlers export into per-run directories
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
         with open(path, "w") as f:
             json.dump({"traceEvents": self._drain_events()}, f)
+        self._last_export = path
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
-                time_unit="ms", views=None):
+                time_unit="ms", views=None, print_table=True):
+        """Aggregate the drained host spans. Returns ``(table, agg)``
+        — the rendered table plus the per-name
+        ``{"calls", "total_us"}`` dict — and only prints when
+        ``print_table`` (headless/bench callers want the numbers, not
+        stdout noise)."""
         ev = self._drain_events()
         agg: dict = {}
         for e in ev:
@@ -261,5 +280,6 @@ class Profiler:
                          f"{a['total_us'] / 1000:>12.3f}"
                          f"{a['total_us'] / 1000 / a['calls']:>12.3f}")
         table = "\n".join(lines)
-        print(table)
-        return table
+        if print_table:
+            print(table)
+        return table, agg

@@ -32,7 +32,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import metrics as _om
+from ..observability.tracing import now_us as _trace_now
 from ..utils import faults
+
+# engine metric families (no-ops until metrics.enable()/PT_METRICS)
+_M_STEPS = _om.counter("pt_engine_decode_steps_total",
+                       "decode-block steps executed")
+_M_TOKENS = _om.counter("pt_engine_tokens_emitted_total",
+                        "useful tokens emitted (prefill + decode)")
+_M_DECODE_TOKENS = _om.counter("pt_engine_decode_tokens_total",
+                               "live-slot decode tokens emitted")
+_M_COMPILES = _om.gauge("pt_engine_decode_compiles",
+                        "times the decode-block program was traced "
+                        "(static-shape invariant: stays 1)")
+_M_PREFILLS = _om.counter("pt_engine_prefills_total",
+                          "prefill dispatches (whole-prompt or chunk)")
 
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
            "ArtifactStepBackend", "slot_sample_logits", "init_slot_state",
@@ -413,6 +428,9 @@ class ContinuousBatchingEngine:
         # host-side gate on the in-graph NaN flags (the flags are
         # always computed — same single compiled program either way)
         self.nan_sentinel = True
+        # set by the Server iff request tracing is armed (None keeps
+        # the hot paths at one `is None` check)
+        self.tracer = None
         self.reset()
 
     # -- lifecycle ---------------------------------------------------------
@@ -497,6 +515,10 @@ class ContinuousBatchingEngine:
                     None)
         if slot is None:
             raise RuntimeError("no free slot (scheduler bug)")
+        tr = self.tracer
+        if tr is not None:
+            tr.span_end(request.request_id, "queue_wait")
+            t_prefill = _trace_now()
         ids = np.zeros((1, Lb), np.int32)
         ids[0, Lb - L:] = prompt
         pad0 = Lb - L
@@ -510,6 +532,11 @@ class ContinuousBatchingEngine:
                 Lb, jnp.asarray(ids), jnp.asarray([pad0], jnp.int32),
                 sub, temp, topk, topp)
         tok0 = int(tok0_dev)
+        if tr is not None:
+            tr.span_at(request.request_id, "prefill", t_prefill,
+                       tokens=L, bucket=Lb)
+        _M_PREFILLS.inc()
+        _M_TOKENS.inc()
         run = _SlotRun(request, tokens=[tok0], t_admit=time.perf_counter())
         self.tokens_emitted += 1
         eos = request.eos_token_id
@@ -527,6 +554,8 @@ class ContinuousBatchingEngine:
                 jnp.int32(rem0),
                 jnp.int32(-1 if eos is None else eos),
                 temp, topk, topp, key)
+        if tr is not None:
+            tr.span_begin(request.request_id, "decode", slot=slot)
         self._slots[slot] = run
         self._remaining_host[slot] = rem0
         return False
@@ -573,6 +602,8 @@ class ContinuousBatchingEngine:
                 if len(out) > 4 else (out[2], out[3], None)
             self.steps += self.decode_block
             self.slot_steps += self.decode_block * self.num_slots
+            _M_STEPS.inc(self.decode_block)
+            _M_COMPILES.set(self.backend.decode_traces[0])
         faults.fault_point("serving.harvest")
         toks, lives, oks = self._pending_block
         toks_np = np.asarray(toks)                  # ONE host sync/block
@@ -580,8 +611,11 @@ class ContinuousBatchingEngine:
         oks_np = None if oks is None else np.asarray(oks)
         rem_np = np.asarray(self._state["remaining"])
         self._pending_block = None
-        self.decode_tokens += int(lives_np.sum())
-        self.tokens_emitted += int(lives_np.sum())
+        emitted = int(lives_np.sum())
+        self.decode_tokens += emitted
+        self.tokens_emitted += emitted
+        _M_DECODE_TOKENS.inc(emitted)
+        _M_TOKENS.inc(emitted)
         now = time.perf_counter()
         for slot, run in enumerate(self._slots):
             if run is None or slot in self._prefill_slots:
@@ -647,6 +681,9 @@ class ContinuousBatchingEngine:
         """Move a finished slot to the harvest list (the paged engine
         also releases the slot's arena blocks here)."""
         run.t_done = now
+        if self.tracer is not None:
+            self.tracer.span_end(run.request.request_id, "decode",
+                                 tokens=len(run.tokens))
         self._finished.append(run)
         self._slots[slot] = None
 

@@ -53,15 +53,33 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import metrics as _om
+from ..observability.tracing import now_us as _trace_now
 from ..utils import faults
 from ..utils.flags import env_flag, env_int
 from .engine import (ContinuousBatchingEngine, ModelStepBackend, _SlotRun,
-                     build_paged_chunk_fn, build_slot_block_fn,
-                     init_slot_state)
+                     _M_PREFILLS, _M_TOKENS, build_paged_chunk_fn,
+                     build_slot_block_fn, init_slot_state)
 
 __all__ = ["BlockManager", "PagedModelStepBackend", "PagedEngine"]
 
 TRASH_BLOCK = 0
+
+# arena metric families (no-ops until metrics.enable()/PT_METRICS)
+_M_BLK_FREE = _om.gauge("pt_paging_blocks_free",
+                        "arena blocks on the free list")
+_M_BLK_REF = _om.gauge("pt_paging_blocks_referenced",
+                       "arena blocks held at refcount >= 1")
+_M_BLK_CACHED = _om.gauge("pt_paging_blocks_cached",
+                          "released registered blocks LRU-retained for "
+                          "prefix reuse")
+_M_PFX_LOOKUPS = _om.counter("pt_paging_prefix_lookups_total",
+                             "prefix-index lookups at admission")
+_M_PFX_HITS = _om.counter("pt_paging_prefix_hit_blocks_total",
+                          "prompt blocks served from the prefix index")
+_M_ALLOC_FAIL = _om.counter("pt_paging_allocate_failures_total",
+                            "block allocations refused (pool exhausted "
+                            "or injected fault)")
 
 
 def _sha1_chain(parent_digest: bytes, tokens: Tuple[int, ...]) -> bytes:
@@ -96,6 +114,16 @@ class BlockManager:
         self._cached: "OrderedDict[int, None]" = OrderedDict()
         self.lookups = 0
         self.hit_blocks = 0
+        self._note_pool()
+
+    def _note_pool(self):
+        """Refresh the pool-pressure gauges (one metrics-enabled check;
+        called from the host-side accounting paths only)."""
+        if not _om.enabled():
+            return
+        _M_BLK_FREE.set(len(self._free))
+        _M_BLK_REF.set(len(self._ref))
+        _M_BLK_CACHED.set(len(self._cached))
 
     # -- capacity ----------------------------------------------------------
     def available(self) -> int:
@@ -114,8 +142,10 @@ class BlockManager:
         fault site deterministically simulates transient exhaustion
         (returns None with the pool untouched)."""
         if faults.should_fire("serving.allocate"):
+            _M_ALLOC_FAIL.inc()
             return None
         if self.available() < n:
+            _M_ALLOC_FAIL.inc()
             return None
         out = []
         for _ in range(n):
@@ -126,6 +156,7 @@ class BlockManager:
                 del self._index[self._digest_of.pop(b)]
             self._ref[b] = 1
             out.append(b)
+        self._note_pool()
         return out
 
     # -- prefix sharing ----------------------------------------------------
@@ -155,6 +186,9 @@ class BlockManager:
         for b in blocks:
             self._acquire(b)
         self.hit_blocks += len(blocks)
+        _M_PFX_LOOKUPS.inc()
+        _M_PFX_HITS.inc(len(blocks))
+        self._note_pool()
         return blocks
 
     def _acquire(self, block_id: int):
@@ -195,6 +229,7 @@ class BlockManager:
                     self._cached[bid] = None
                 else:
                     self._free.append(bid)
+        self._note_pool()
 
     # -- invariants --------------------------------------------------------
     def assert_consistent(self):
@@ -490,6 +525,10 @@ class PagedEngine(ContinuousBatchingEngine):
             self.manager.release(shared)
             return False
         block_ids = shared + fresh
+        if self.tracer is not None:
+            self.tracer.span_end(request.request_id, "queue_wait",
+                                 shared_blocks=len(shared),
+                                 fresh_blocks=len(fresh))
         table_row = np.zeros((self.max_blocks,), np.int32)
         table_row[:len(block_ids)] = block_ids
         key = jax.random.PRNGKey(request.seed)
@@ -534,6 +573,8 @@ class PagedEngine(ContinuousBatchingEngine):
             n = min(C, L - job.done)
             ids = np.zeros((1, C), np.int32)
             ids[0, :n] = job.prompt[job.done:job.done + n]
+            tr = self.tracer
+            t_chunk = _trace_now() if tr is not None else 0.0
             with RecordEvent("serving.prefill_chunk"):
                 tok0_dev, self._cache = self.backend.prefill_chunk(
                     jnp.asarray(ids), self._cache,
@@ -545,6 +586,10 @@ class PagedEngine(ContinuousBatchingEngine):
             spent += n
             self.prefill_chunks += 1
             self.prefilled_tokens += n
+            _M_PREFILLS.inc()
+            if tr is not None:
+                tr.span_at(job.run.request.request_id, "prefill_chunk",
+                           t_chunk, tokens=n, done=job.done, total=L)
             if job.done >= L:
                 self._jobs.pop(0)
                 self._finish_prefill(job, tok0_dev)
@@ -557,6 +602,7 @@ class PagedEngine(ContinuousBatchingEngine):
         job.run.tokens = [tok0]
         job.run.t_admit = now               # TTFT timestamp
         self.tokens_emitted += 1
+        _M_TOKENS.inc()
         # the prompt's full blocks are resident now — index them so the
         # NEXT request with this prefix skips the compute
         self.manager.register_prefix(job.prompt, job.run.block_ids)
@@ -574,6 +620,9 @@ class PagedEngine(ContinuousBatchingEngine):
             jnp.asarray(job.table_row), jnp.int32(tok0), jnp.int32(L),
             jnp.int32(rem0), jnp.int32(-1 if eos is None else eos),
             job.temp, job.topk, job.topp, job.key)
+        if self.tracer is not None:
+            self.tracer.span_begin(req.request_id, "decode",
+                                   slot=job.slot)
         self._remaining_host[job.slot] = rem0
 
     def _retire(self, slot, run, now):

@@ -25,6 +25,10 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..observability import (FlightRecorder, ObservabilityConfig,
+                             RequestTracer)
+from ..observability import metrics as _om
+from ..observability.tracing import export_chrome_trace, now_us
 from ..utils import faults
 from .engine import ContinuousBatchingEngine
 from .resilience import (RequestFailure, ResilienceConfig,
@@ -34,6 +38,39 @@ from .resilience import (RequestFailure, ResilienceConfig,
 from .scheduler import Request, Scheduler
 
 __all__ = ["Server"]
+
+# metric families (registered at import; zero-cost until
+# metrics.enable()/PT_METRICS arms the registry)
+_M_TICKS = _om.counter("pt_server_ticks_total", "server ticks executed")
+_M_TICK_S = _om.histogram("pt_server_tick_seconds",
+                          "wall seconds per server tick")
+_M_QUEUE = _om.gauge("pt_server_queue_depth",
+                     "requests waiting in the scheduler queue")
+_M_SUBMIT = _om.counter("pt_server_requests_submitted_total",
+                        "requests submitted (accepted or shed)")
+_M_DONE = _om.counter("pt_server_requests_completed_total",
+                      "requests that completed with output tokens")
+_M_FAILED = _om.counter("pt_server_requests_failed_total",
+                        "requests ending in a RequestFailure, by reason",
+                        labels=("reason",))
+_M_SHED = _om.counter("pt_server_shed_total",
+                      "submits rejected at the queue-depth cap")
+_M_DEADLINE = _om.counter("pt_server_deadline_cancels_total",
+                          "requests cancelled past a deadline/queue wait")
+_M_DEFER = _om.counter("pt_server_admit_deferred_total",
+                       "admissions re-queued (paged block pool exhausted)")
+_M_RETRY = _om.counter("pt_server_retries_total",
+                       "transient-failure retry attempts")
+_M_STEPFAIL = _om.counter("pt_server_step_failures_total",
+                          "transient step/prefill/harvest failures")
+_M_BREAKER = _om.gauge("pt_server_breaker_open",
+                       "1 while the circuit breaker is open")
+_M_LAT = _om.histogram("pt_server_request_latency_seconds",
+                       "submit -> harvest wall time per completed request")
+_M_TTFT = _om.histogram("pt_server_ttft_seconds",
+                        "submit -> first token per completed request")
+_M_OCC = _om.gauge("pt_server_slot_occupancy",
+                   "fraction of decode slot-steps that emitted a token")
 
 
 class Server:
@@ -47,12 +84,25 @@ class Server:
 
     def __init__(self, engine: ContinuousBatchingEngine,
                  scheduler: Optional[Scheduler] = None,
-                 resilience: Optional[ResilienceConfig] = None):
+                 resilience: Optional[ResilienceConfig] = None,
+                 observability: Optional[ObservabilityConfig] = None):
         self.engine = engine
         self.scheduler = scheduler or Scheduler()
         self.resilience = resilience or ResilienceConfig()
         self._res = ResilienceState(self.resilience)
         engine.nan_sentinel = self.resilience.nan_sentinel
+        # the breaker gauge tracks THIS server from birth — without the
+        # reset, a fresh healthy server built after a drained one would
+        # inherit the process-global 1 forever
+        _M_BREAKER.set(1 if self._res.breaker_open else 0)
+        obs = observability or ObservabilityConfig()
+        self.observability = obs
+        self.tracer = RequestTracer(enabled=obs.trace_requests)
+        self.flight = FlightRecorder(capacity=obs.flight_size,
+                                     dump_dir=obs.flight_dump_dir)
+        # the engine only carries a tracer when tracing is armed, so
+        # its hot paths pay one `is None` check when it isn't
+        engine.tracer = self.tracer if self.tracer.enabled else None
         self.results: Dict[int, object] = {}
         self.latencies: Dict[int, float] = {}
         self.ttft: Dict[int, float] = {}       # submit -> first token
@@ -77,9 +127,13 @@ class Server:
         self.engine.validate_request(int(prompt.size), max_new_tokens)
         rid = self._next_id
         self._next_id += 1
+        _M_SUBMIT.inc()
+        self.tracer.start(rid)
         depth = self.resilience.max_queue_depth
         if depth is not None and self.scheduler.pending() >= depth:
             self._res.shed_requests += 1
+            _M_SHED.inc()
+            self.flight.record("shed", rid=rid, depth=depth)
             self._fail(rid, "shed",
                        f"queue depth at cap ({depth}); retry later")
             return rid
@@ -90,6 +144,7 @@ class Server:
             seed=seed, arrival_step=arrival_step,
             t_submit=time.perf_counter(),
             deadline_ticks=deadline_ticks, deadline_s=deadline_s))
+        _M_QUEUE.set(self.scheduler.pending())
         return rid
 
     # -- failure plumbing --------------------------------------------------
@@ -99,6 +154,12 @@ class Server:
             request_id=rid, reason=reason, message=message,
             tokens_emitted=tokens)
         self._res.count_failure(reason)
+        _M_FAILED.inc(reason=reason)
+        if reason == "timeout":
+            _M_DEADLINE.inc()
+        self.flight.record("request_failed", rid=rid, reason=reason,
+                           tokens=tokens)
+        self.tracer.terminal(rid, reason, tokens=tokens)
 
     def _deadline_hit(self, req: Request, now: float) -> bool:
         cfg = self.resilience
@@ -149,12 +210,29 @@ class Server:
                 res.step_failures += 1
                 res.consecutive_failures += 1
                 res.last_error = f"{type(e).__name__}: {e}"
+                _M_STEPFAIL.inc()
+                self.flight.record(
+                    "step_failure", error=res.last_error[:200],
+                    consecutive=res.consecutive_failures,
+                    clock=self._clock)
                 if res.consecutive_failures >= cfg.breaker_threshold:
                     res.breaker_open = True
+                    _M_BREAKER.set(1)
+                    self.flight.record("breaker_open", clock=self._clock,
+                                       after=res.consecutive_failures)
+                    self.tracer.server_instant(
+                        "breaker_open", clock=self._clock)
                     return False
                 if attempt < cfg.retry_attempts:
                     res.retries += 1
-                    time.sleep(res.backoff_s(attempt))
+                    _M_RETRY.inc()
+                    backoff = res.backoff_s(attempt)
+                    self.flight.record("retry", attempt=attempt,
+                                       backoff_s=round(backoff, 6),
+                                       clock=self._clock)
+                    self.tracer.server_instant("retry", attempt=attempt,
+                                               clock=self._clock)
+                    time.sleep(backoff)
         return False
 
     def _quarantine_all(self, reason: str):
@@ -178,6 +256,11 @@ class Server:
                 # re-queue in reverse: requeue() front-inserts per
                 # arrival tick, so forward order would flip
                 # same-tick FIFO and let peers overtake the oldest
+                _M_DEFER.inc(len(admitted) - i)
+                self.flight.record(
+                    "block_pool_defer", rid=req.request_id,
+                    clock=self._clock,
+                    deferred=len(admitted) - i)
                 for r in reversed(admitted[i:]):
                     self.scheduler.requeue(r)
                 break
@@ -228,6 +311,13 @@ class Server:
                 [np.asarray(req.prompt, np.int32).reshape(-1), toks])
             self.latencies[req.request_id] = now - req.t_submit
             self.ttft[req.request_id] = run.t_admit - req.t_submit
+            _M_DONE.inc()
+            _M_LAT.observe(self.latencies[req.request_id])
+            _M_TTFT.observe(self.ttft[req.request_id])
+            self.tracer.instant(req.request_id, "harvest",
+                                tokens=len(run.tokens))
+            self.tracer.terminal(req.request_id, "completed",
+                                 tokens=len(run.tokens))
 
     def run_until_idle(self, max_ticks: Optional[int] = None
                        ) -> Dict[int, object]:
@@ -252,25 +342,54 @@ class Server:
             if max_ticks is not None and ticks >= max_ticks:
                 break
             if self._res.breaker_open:   # incl. restored-open circuits
-                self._quarantine_all("circuit_open")
-                self._harvest()
+                self._circuit_open_drain()
                 break
             t_tick = time.perf_counter()
+            t_tick_us = now_us() if self.tracer.enabled else 0.0
             try:
                 faults.fault_point("server.tick")
                 self._tick()
             except faults.InjectedFault:
                 self._res.tick_faults += 1
+                self.flight.record("tick_fault", clock=self._clock)
             self._clock += 1
             ticks += 1
             self._harvest()
-            self.tick_seconds.append(time.perf_counter() - t_tick)
+            tick_s = time.perf_counter() - t_tick
+            self.tick_seconds.append(tick_s)
+            self.tracer.server_span_at("tick", t_tick_us,
+                                       clock=self._clock - 1)
+            _M_TICKS.inc()
+            _M_TICK_S.observe(tick_s)
+            _M_QUEUE.set(self.scheduler.pending())
+            _M_OCC.set(self.engine.occupancy())
+            self.flight.record(
+                "tick", clock=self._clock - 1,
+                queue=self.scheduler.pending(),
+                live=len(self.engine.live_runs()),
+                tokens=self.engine.tokens_emitted,
+                tick_ms=round(tick_s * 1000, 3))
             if self._res.breaker_open:
-                self._quarantine_all("circuit_open")
-                self._harvest()
+                self._circuit_open_drain()
                 break
         self._wall += time.perf_counter() - t0
         return self.results
+
+    def _circuit_open_drain(self):
+        """Breaker-open endgame: auto-dump the flight recorder (the
+        black box exists for exactly this moment), then drain and
+        account every in-flight/queued request as ``circuit_open``."""
+        self.flight.record("circuit_open_drain", clock=self._clock,
+                           queue=self.scheduler.pending(),
+                           live=len(self.engine.live_runs()))
+        _M_BREAKER.set(1)
+        try:
+            self.flight.dump(reason="circuit_open")
+        except OSError as e:             # diagnostics must never block
+            self.flight.record("flight_dump_failed",  # the drain
+                               error=f"{type(e).__name__}: {e}"[:200])
+        self._quarantine_all("circuit_open")
+        self._harvest()
 
     def stats(self) -> dict:
         lat = list(self.latencies.values())
@@ -306,6 +425,15 @@ class Server:
             out["kv_bytes_per_slot"] = eng.backend.kv_bytes_per_slot()
         return out
 
+    def export_trace(self, path: str, profiler=None) -> str:
+        """Write the served stream as ONE Perfetto-loadable chrome-trace
+        JSON: this server's request rows + tick markers, merged (on the
+        same perf_counter clock) with the profiler's ``RecordEvent``
+        host-span ring when a :class:`~paddle_tpu.profiler.Profiler` is
+        passed (drained destructively, like its own export)."""
+        return export_chrome_trace(path, tracer=self.tracer,
+                                   profiler=profiler)
+
     # -- crash-safe snapshot / restore -------------------------------------
     def snapshot(self, path: str):
         """Write server + engine state as ONE atomic npz: queue,
@@ -332,6 +460,10 @@ class Server:
             arrays[f"q{i}_prompt"] = np.asarray(r.prompt,
                                                 np.int32).reshape(-1)
             qmeta.append(request_to_meta(r))
+        # the snapshot event goes into the ring BEFORE the ring is
+        # captured, so the restored server's history and the sidecar
+        # agree on it (and on every seq number)
+        self.flight.record("snapshot", path=path, clock=self._clock)
         smeta = {
             "next_id": self._next_id, "clock": self._clock,
             "wall": self._wall,
@@ -339,21 +471,31 @@ class Server:
             "ttft": {str(k): v for k, v in self.ttft.items()},
             "results": res_meta, "queue": qmeta,
             "counters": self._res.counters(),
+            # the flight ring rides the snapshot (restored server keeps
+            # its pre-crash event history) AND dumps beside it for
+            # humans reading the crash site without np.load
+            "flight": self.flight.to_meta(),
         }
+        self.flight.dump(path + ".flight.json", reason="snapshot")
         save_snapshot(path, {"engine": meta, "server": smeta}, arrays)
 
     @classmethod
     def restore(cls, path: str, engine: ContinuousBatchingEngine,
                 scheduler: Optional[Scheduler] = None,
-                resilience: Optional[ResilienceConfig] = None
+                resilience: Optional[ResilienceConfig] = None,
+                observability: Optional[ObservabilityConfig] = None
                 ) -> "Server":
         """Rebuild a server from a snapshot into a freshly constructed
         engine of the same configuration (fresh process simulation:
         programs recompile, state restores — then ``run_until_idle()``
-        finishes every stream bit-identical to the uninterrupted run)."""
+        finishes every stream bit-identical to the uninterrupted run).
+        Pass the original ``observability`` config to keep tracing
+        armed and the flight ring at its configured capacity — the
+        saved ring rehydrates into THIS server's ring, so restoring
+        with a smaller capacity keeps only the newest events that fit."""
         meta, arrays = load_snapshot(path)
         engine.restore_state(meta["engine"], arrays)
-        srv = cls(engine, scheduler, resilience)
+        srv = cls(engine, scheduler, resilience, observability)
         sm = meta["server"]
         srv._next_id = sm["next_id"]
         srv._clock = sm["clock"]
@@ -374,9 +516,25 @@ class Server:
         # budget, breaker) survives the restore — an open circuit must
         # stay open in the resumed process
         srv._res.restore_counters(sm["counters"])
+        _M_BREAKER.set(1 if srv._res.breaker_open else 0)
+        if "flight" in sm:       # pre-observability snapshots lack it
+            srv.flight.restore_meta(sm["flight"])
+        srv.flight.record("restored", path=path, clock=srv._clock)
         # re-submit in saved order: insort is stable, so same-tick FIFO
-        # order survives the round trip
+        # order survives the round trip. Carried-over requests also
+        # (re)enter the tracer here — scheduler.submit bypasses
+        # Server.submit, so without this every resumed request would
+        # silently miss its trace (and its exactly-one terminal span)
         for i, rm in enumerate(sm["queue"]):
-            srv.scheduler.submit(
-                request_from_meta(rm, arrays[f"q{i}_prompt"]))
+            req = request_from_meta(rm, arrays[f"q{i}_prompt"])
+            srv.scheduler.submit(req)
+            srv.tracer.start(req.request_id)
+        for slot, run in engine.live_runs():
+            rid = run.request.request_id
+            srv.tracer.start(rid)
+            srv.tracer.span_end(rid, "queue_wait", restored=True)
+            # mid-prefill paged slots re-open this span at
+            # _finish_prefill; for decoding slots it is simply resumed
+            srv.tracer.span_begin(rid, "decode", slot=slot,
+                                  restored=True)
         return srv

@@ -40,8 +40,16 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..observability import metrics as _om
+
 __all__ = ["InjectedFault", "configure", "clear", "active",
            "should_fire", "fault_point", "site_stats", "injected"]
+
+# registered up front so the catalog shows the family even before the
+# first fire; inc() only ever runs on the (rare) armed-and-fired path,
+# so the disarmed zero-overhead contract is untouched
+_M_FIRES = _om.counter("pt_fault_fires_total",
+                       "injected fault fires by site", labels=("site",))
 
 
 class InjectedFault(RuntimeError):
@@ -72,6 +80,7 @@ class _Site:
                or (self.p > 0.0 and self.rng.random_sample() < self.p))
         if hit:
             self.fires += 1
+            _M_FIRES.inc(site=self.name)
         return hit
 
 

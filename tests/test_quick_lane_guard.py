@@ -20,7 +20,7 @@ import jax.numpy as jnp
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
-                 "tests/test_resilience.py"]
+                 "tests/test_resilience.py", "tests/test_observability.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -39,6 +39,17 @@ REQUIRED_NODES = [
     "test_randomized_fault_schedules_hold_invariants",
     "test_resilience.py::TestInertWhenDisabled::"
     "test_disarmed_streams_bit_identical_compile_counts_pinned",
+    # PR 6 observability pins: trace completeness under chaos, the
+    # merged Perfetto artifact, the circuit-open flight dump, and the
+    # profiler scheduler-gating regression
+    "test_observability.py::TestRequestTraces::"
+    "test_chaos_schedule_every_request_one_terminal",
+    "test_observability.py::TestMergedChromeTrace::"
+    "test_single_served_batch_trace_has_all_streams",
+    "test_observability.py::TestFlightRecorder::"
+    "test_dumps_on_circuit_open",
+    "test_observability.py::TestProfilerSchedulerGating::"
+    "test_closed_scheduler_keeps_host_ring_silent",
 ]
 
 
