@@ -37,6 +37,8 @@ CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_DEADLINE_S", "420"))
 COMMS_DEADLINE_S = float(os.environ.get("BENCH_COMMS_DEADLINE_S", "240"))
 PASSES_DEADLINE_S = float(os.environ.get("BENCH_PASSES_DEADLINE_S", "240"))
 OBS_DEADLINE_S = float(os.environ.get("BENCH_OBS_DEADLINE_S", "240"))
+SERVING_TP_DEADLINE_S = float(
+    os.environ.get("BENCH_SERVING_TP_DEADLINE_S", "300"))
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
 PROBE_DEADLINE_S = float(os.environ.get("BENCH_PROBE_DEADLINE_S", "90"))
@@ -474,6 +476,8 @@ def _child_tpu():
             "vs_baseline": round(head["mfu"] / 0.45, 4),
             "mfu": head["mfu"],
             "chip": gen,
+            "device_kind": dev.device_kind,
+            "device_count": jax.device_count(),
             "sdpa_dispatch": fa.sdpa_last_dispatch(),
             "config_small": small,
             "config_big": big,
@@ -646,6 +650,16 @@ def _child_tpu():
         decode.update(resil if resil is not None
                       else {"serving_resilience_tokens_per_sec_faulty":
                             None})
+        _release_hbm()
+        # tensor-parallel decode over the window's REAL chips: the
+        # microbench itself records a skip when the window owns one
+        # chip (the usual case) — the key stays on the record either way
+        from paddle_tpu.serving.microbench import run_serving_tp_bench
+        tp, err = _staged(run_serving_tp_bench, "serving-tp")
+        if err:
+            errors.append(err)
+        decode.update(tp if tp is not None
+                      else {"serving_tp_bit_identical": None})
         _emit(small, big, decode, errors)
         if small is None and big is None:
             raise RuntimeError("every config failed: " + "; ".join(errors))
@@ -732,6 +746,8 @@ def _child_cpu():
         "unit": "tokens/s",
         "vs_baseline": 0.0,
         "chip": "cpu",
+        "device_kind": jax.devices()[0].device_kind,
+        "device_count": jax.device_count(),
         "aot_step_flops": float(cost.get("flops", -1.0)),
         "aot_bytes_accessed": float(cost.get("bytes accessed", -1.0)),
         **decode,
@@ -746,9 +762,10 @@ def _run_child(mode: str, deadline: float):
     line wins, and a deadline kill still salvages the partial result."""
     env = dict(os.environ)
     if mode in ("--child-cpu", "--child-comms", "--child-passes",
-                "--child-observability"):
+                "--child-observability", "--child-serving-tp"):
         env["JAX_PLATFORMS"] = "cpu"
-    if mode == "--child-comms":
+    if mode in ("--child-comms", "--child-serving-tp"):
+        # simulated 2x4 mesh on the CPU lane
         flags = env.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             env["XLA_FLAGS"] = (
@@ -907,6 +924,59 @@ def _attach_observability(result, budget_s=None):
                          OBS_DEADLINE_S, budget_s)
 
 
+def _child_serving_tp():
+    """serving-tp stage: the slot-pool decode block sharded over a
+    simulated 2x4 CPU mesh (serving/microbench.py) — pins exact-mode
+    bit-identity, 1-chip vs sharded tokens/s, collective bytes/calls
+    per decode step from the metrics registry, and the int8-hop error
+    bound every round. The real multi-chip decode win rides the same
+    TPConfig when a multi-chip window exists."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.serving.microbench import run_serving_tp_bench
+    out = run_serving_tp_bench(
+        requests=int(os.environ.get("BENCH_SERVING_TP_REQUESTS", "6")),
+        max_new=int(os.environ.get("BENCH_SERVING_TP_MAX_NEW", "16")))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_serving_tp(result, budget_s=None):
+    return _attach_stage(result, "serving-tp", "--child-serving-tp",
+                         SERVING_TP_DEADLINE_S, budget_s)
+
+
+def _provenance():
+    """Stamp for every bench artifact: which software stack and source
+    rev produced it — so a committed BENCH_*.json is attributable (the
+    r0x files predate this stamp; absence of the stamp marks them
+    stale). Versions come from package metadata (the parent never
+    initializes a jax backend); device kind/count ride the child
+    results, where the backend actually lives."""
+    import importlib.metadata as md
+    def _v(pkg):
+        try:
+            return md.version(pkg)
+        except md.PackageNotFoundError:
+            return None
+    try:
+        rev = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except (OSError, subprocess.TimeoutExpired):
+        rev = None
+    return {"jax_version": _v("jax"), "jaxlib_version": _v("jaxlib"),
+            "git_rev": rev or None,
+            "bench_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())}
+
+
+def _emit_final(result):
+    """The parent's ONE final JSON line, provenance-stamped."""
+    result.update(_provenance())
+    print(json.dumps(result))
+
+
 def _child_probe():
     """Tiny tunnel-health check: init backend + one 256x256 matmul."""
     import jax
@@ -937,6 +1007,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-observability":
         _child_observability()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-tp":
+        _child_serving_tp()
+        return
 
     errors = []
     try:
@@ -944,13 +1017,13 @@ def main():
     except KeyboardInterrupt:
         # the session scripts deadline-SIGINT the whole process group;
         # the one-JSON-line/rc-0 contract must survive that path too
-        print(json.dumps({
+        _emit_final({
             "metric": "llama_pretrain_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
             "tpu_unavailable": True, "interrupted": True,
             "tpu_errors": _err_slots(errors),
             "last_measured_tpu": _last_measured_tpu(),
-        }))
+        })
 
 
 def _err_slots(errors):
@@ -1010,8 +1083,8 @@ def _main_measured(errors):
             if result is not None:
                 result = _attach_comms(result, remaining())
                 result = _attach_passes(result, remaining())
-                print(json.dumps(
-                    _attach_observability(result, remaining())))
+                result = _attach_observability(result, remaining())
+                _emit_final(_attach_serving_tp(result, remaining()))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
             time.sleep(5)
@@ -1032,16 +1105,17 @@ def _main_measured(errors):
             result["tunnel_log"] = "TUNNEL_r05.json"
         result = _attach_comms(result, remaining())
         result = _attach_passes(result, remaining())
-        print(json.dumps(_attach_observability(result, remaining())))
+        result = _attach_observability(result, remaining())
+        _emit_final(_attach_serving_tp(result, remaining()))
         return
     # last resort: still one JSON line, rc 0, explicit marker
-    print(json.dumps({
+    _emit_final({
         "metric": "llama_pretrain_tokens_per_sec_per_chip",
         "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
         "tpu_unavailable": True, "cpu_fallback_failed": True,
         "tpu_errors": _err_slots(errors),
         "cpu_error": (err or "")[:500],
-    }))
+    })
 
 
 if __name__ == "__main__":

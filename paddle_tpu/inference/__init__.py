@@ -354,10 +354,16 @@ def export_decoder(model, path: str, batch: int, prompt_len: int,
             "prefill": prefills,
             "pool_specs": [(tuple(s.shape), str(np.dtype(s.dtype)))
                            for s in pool_specs],
+            # the decode block emits (cache, state, toks, lives, oks)
+            # since the NaN-sentinel — record the arity so a serving
+            # host can tell whether the artifact carries the flags
+            # (pre-sentinel 4-output artifacts load fine: the engine
+            # pads the missing flags with None)
             "config": {"num_slots": engine_slots, "max_len": max_len,
                        "decode_block": engine_decode_block,
                        "prompt_buckets": sorted(
-                           int(b) for b in engine_prompt_buckets)},
+                           int(b) for b in engine_prompt_buckets),
+                       "block_outputs": 5},
         }
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     out = path + ".pdgen"

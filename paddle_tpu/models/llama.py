@@ -24,6 +24,7 @@ from .. import nn
 from ..nn import functional as F
 from ..ops.creation import arange, zeros
 from ..ops.manipulation import concat, reshape, transpose
+from ..utils import tp_hooks as serving_tp
 from ..tensor import Tensor, apply_op
 from .generation import GenerationMixin
 
@@ -183,9 +184,13 @@ class LlamaAttention(nn.Layer):
         block arenas, 2-tuple (k, v) or 4-tuple (k, v, k_scales,
         v_scales) for the int8 arena."""
         b, s, _ = x.shape
-        q = reshape(self.q_proj(x), (b, s, self.num_heads, self.head_dim))
-        k = reshape(self.k_proj(x), (b, s, self.num_kv_heads, self.head_dim))
-        v = reshape(self.v_proj(x), (b, s, self.num_kv_heads, self.head_dim))
+        # head counts come from the projection widths (-1), not the
+        # config: under tensor-parallel serving (serving/tp.py) the
+        # q/k/v weights are column-sharded and each device sees only
+        # its contiguous group of heads
+        q = reshape(self.q_proj(x), (b, s, -1, self.head_dim))
+        k = reshape(self.k_proj(x), (b, s, -1, self.head_dim))
+        v = reshape(self.v_proj(x), (b, s, -1, self.head_dim))
         if cache is not None:
             if attn_mask is not None:
                 raise ValueError(
@@ -214,9 +219,11 @@ class LlamaAttention(nn.Layer):
                             block_table=btv),
                         q, k, v, ck, cv, pos, block_table)
                     new_cache = (nck, ncv)
-                out = reshape(out, (b, s,
-                                    self.num_heads * self.head_dim))
-                return self.o_proj(out), new_cache
+                out = reshape(out, (b, s, -1))
+                out = serving_tp.maybe_gather(
+                    out, self.num_heads * self.head_dim)
+                out = serving_tp.maybe_reduce(self.o_proj(out))
+                return out, new_cache
             ck, cv = cache
             if pad is not None:
                 out, nck, ncv = apply_op(
@@ -225,8 +232,11 @@ class LlamaAttention(nn.Layer):
                     q, k, v, ck, cv, pos, pad)
             else:
                 out, nck, ncv = apply_op(fn, q, k, v, ck, cv, pos)
-            out = reshape(out, (b, s, self.num_heads * self.head_dim))
-            return self.o_proj(out), (nck, ncv)
+            out = reshape(out, (b, s, -1))
+            out = serving_tp.maybe_gather(out,
+                                          self.num_heads * self.head_dim)
+            out = serving_tp.maybe_reduce(self.o_proj(out))
+            return out, (nck, ncv)
         q, k = apply_op(lambda qv, kv_: _apply_rope(qv, kv_, cos, sin), q, k)
         out = None
         cfg = self.config
@@ -256,6 +266,7 @@ class LlamaMLP(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         h, ff = config.hidden_size, config.intermediate_size
+        self._ff = ff
         self.gate_proj = nn.Linear(h, ff, bias_attr=False)
         self.up_proj = nn.Linear(h, ff, bias_attr=False)
         self.down_proj = nn.Linear(ff, h, bias_attr=False)
@@ -265,7 +276,13 @@ class LlamaMLP(nn.Layer):
             self.down_proj.weight._sharding_spec = P("mp", None)
 
     def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        act = F.silu(self.gate_proj(x)) * self.up_proj(x)
+        # tensor-parallel serving hooks (no-ops outside a sharded
+        # serving trace): exact mode gathers the column-sharded
+        # activation in front of the replicated down_proj; psum mode
+        # all-reduces the row-parallel partial sums instead
+        act = serving_tp.maybe_gather(act, self._ff)
+        return serving_tp.maybe_reduce(self.down_proj(act))
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -599,6 +616,11 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             logits = matmul(h, self.llama.embed_tokens.weight,
                             transpose_y=True)
         if cache is not None:
+            # tensor-parallel serving: the vocab-sharded lm_head shards
+            # gather into full logits through the collectives all-gather
+            # path (no-op outside a sharded serving trace / tied-embed)
+            logits = serving_tp.maybe_gather_logits(logits,
+                                                    c.vocab_size)
             return logits, new_cache
         if labels is None:
             return logits

@@ -14,9 +14,12 @@ from .paging import BlockManager, PagedEngine, PagedModelStepBackend
 from .resilience import RequestFailure, ResilienceConfig
 from .scheduler import Request, Scheduler
 from .server import Server
+from .tp import (ShardedModelStepBackend, ShardedPagedStepBackend,
+                 TPConfig)
 
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
            "ArtifactStepBackend", "BlockManager", "PagedEngine",
            "PagedModelStepBackend", "Request", "RequestFailure",
            "ResilienceConfig", "Scheduler", "Server",
-           "slot_sample_logits"]
+           "ShardedModelStepBackend", "ShardedPagedStepBackend",
+           "TPConfig", "slot_sample_logits"]

@@ -332,6 +332,11 @@ class ArtifactStepBackend(_StepBackendCommon):
         self.num_slots = cfgs["num_slots"]
         self.max_len = cfgs["max_len"]
         self.block_size = cfgs["decode_block"]
+        # pre-NaN-sentinel artifacts exported a 4-output decode block
+        # (no per-step ok flags); the engine pads the missing flags
+        # with None so both generations serve — new exports record
+        # block_outputs=5
+        self.carries_nan_flags = cfgs.get("block_outputs", 4) >= 5
         self.pool_specs = tuple((tuple(shape), np.dtype(dtype))
                                 for shape, dtype in eng["pool_specs"])
         self._block = jax.export.deserialize(eng["block"])
@@ -411,12 +416,24 @@ class ContinuousBatchingEngine:
     def __init__(self, model=None, num_slots: int = 4, max_len: int = 256,
                  decode_block: int = 8,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 backend=None, *, paged: Optional[bool] = None):
+                 backend=None, *, paged: Optional[bool] = None,
+                 tp=None):
         if backend is None:
             if model is None:
                 raise ValueError("pass a model or a step backend")
-            backend = ModelStepBackend(model, num_slots, max_len,
-                                       decode_block)
+            from .tp import resolve_tp_config
+            tp_cfg = resolve_tp_config(tp)
+            if tp_cfg is not None:
+                # tensor-parallel serving: the SAME decode/prefill
+                # programs, sharded over a mesh (serving/tp.py). An
+                # explicitly passed backend is never rerouted by the
+                # PT_SERVING_TP env flag — same contract as paged.
+                from .tp import ShardedModelStepBackend
+                backend = ShardedModelStepBackend(
+                    model, num_slots, max_len, decode_block, tp_cfg)
+            else:
+                backend = ModelStepBackend(model, num_slots, max_len,
+                                           decode_block)
         self.backend = backend
         self.num_slots = backend.num_slots
         self.max_len = backend.max_len
@@ -473,6 +490,20 @@ class ContinuousBatchingEngine:
         """Number of times the decode-block program was traced/compiled
         — the static-shape invariant holds iff this stays 1."""
         return self.backend.decode_traces[0]
+
+    def tp_degree(self) -> int:
+        """Devices the decode block is sharded over (1 = TP off)."""
+        return getattr(self.backend, "tp_degree", 1)
+
+    def tp_int8_error_bound(self) -> float:
+        """Runtime worst-case elementwise error of the tensor-parallel
+        int8 hidden-state all-reduce, probed against the LIVE cache and
+        slot state (0.0 unless a psum-mode TP backend with the int8 hop
+        is armed — see serving/tp.py)."""
+        fn = getattr(self.backend, "tp_int8_error_bound", None)
+        if fn is None:
+            return 0.0
+        return fn(self._cache, self._state)
 
     def bucket_len(self, prompt_len: int) -> int:
         if self.prompt_buckets is None:
@@ -780,6 +811,9 @@ class ContinuousBatchingEngine:
                             for i in range(len(self.backend.pool_specs)))
         self._state = {k: jnp.asarray(arrays[f"state_{k}"])
                        for k in self.backend.init_state()}
+        commit = getattr(self.backend, "commit_arrays", None)
+        if commit is not None:        # TP backends re-shard onto the mesh
+            self._cache, self._state = commit(self._cache, self._state)
         self._slots = [
             None if m is None
             else self._run_from_meta(m, arrays[f"slot{i}_prompt"])

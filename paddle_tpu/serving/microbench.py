@@ -1,0 +1,133 @@
+"""Tensor-parallel serving bench: the slot-pool decode block sharded
+over a device mesh (serving/tp.py) A/B'd against the 1-chip engine.
+
+What the stage pins every round:
+
+- **bit-identity**: the exact-mode sharded greedy stream must equal the
+  1-chip stream token-for-token (the TP correctness contract);
+- **tokens/s** for both engines — on the CPU lane the "mesh" is
+  ``--xla_force_host_platform_device_count`` simulated devices sharing
+  one socket, so the sharded number is a plumbing-overhead record, not
+  a speedup claim (the speedup exists where the shards are real chips);
+- **collective traffic**: logical payload bytes and collective calls
+  per decode step, read back from the ``pt_collectives_*`` metrics the
+  sharded backend notes per dispatched block;
+- **int8 hop**: the psum-mode hidden-state all-reduce compressed with
+  the EQuARX wire format, with its runtime-queryable error bound.
+
+Wired into bench.py as the ``serving-tp`` child stage (CPU lane,
+non-null on the fallback path like comms/passes/observability; the TPU
+child runs it too when its window owns more than one chip).
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+__all__ = ["run_serving_tp_bench"]
+
+
+def run_serving_tp_bench(requests: int = 6, max_new: int = 16,
+                         num_slots: int = 2, decode_block: int = 4
+                         ) -> dict:
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.mesh import build_device_mesh
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import metrics
+    from paddle_tpu.serving import (ContinuousBatchingEngine, Server,
+                                    TPConfig)
+
+    n_dev = jax.device_count()
+    if n_dev < 2:
+        return {"serving_tp_devices": n_dev,
+                "serving_tp_skipped": "needs >= 2 devices "
+                "(simulated or real) to shard the decode block"}
+    # widest 2-level mesh the device count allows: 2 x (n/2) exercises
+    # the hierarchical inner/outer plan; an odd count falls back flat
+    if n_dev % 2 == 0:
+        mesh = build_device_mesh({"dp": 2, "mp": n_dev // 2})
+        axes = ("dp", "mp")
+    else:
+        mesh = build_device_mesh({"dp": 1, "mp": n_dev},
+                                 allow_subset=True)
+        axes = ("mp",)
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=768,
+        num_hidden_layers=4, num_attention_heads=8,
+        num_key_value_heads=8, max_position_embeddings=256)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          (4 + (i % 3) * 6,)).astype(np.int32)
+               for i in range(requests)]
+
+    one = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_len=16 + max_new,
+        decode_block=decode_block, prompt_buckets=(16,))
+    tp = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_len=16 + max_new,
+        decode_block=decode_block, prompt_buckets=(16,),
+        tp=TPConfig(axes=axes, mesh=mesh))
+
+    def run(engine):
+        engine.reset()
+        srv = Server(engine)
+        rids = [srv.submit(p, max_new_tokens=max_new, arrival_step=i)
+                for i, p in enumerate(prompts)]
+        t0 = time.perf_counter()
+        res = srv.run_until_idle()
+        return [res[r] for r in rids], time.perf_counter() - t0
+
+    run(one), run(tp)                       # compile warmup
+    ref, dt_one = run(one)
+
+    prev_enabled = metrics.enabled()
+    metrics.enable(True)
+    try:
+        bytes_c = metrics.counter(
+            "pt_collectives_bytes_total",
+            "payload bytes handed to collectives",
+            labels=("op", "mode"))
+        calls_c = metrics.counter(
+            "pt_collectives_calls_total",
+            "host-level collective dispatches", labels=("op", "mode"))
+        b0 = bytes_c.value(op="tp_block", mode="tp_graph")
+        c0 = calls_c.value(op="tp_block", mode="tp_graph")
+        got, dt_tp = run(tp)
+        steps = tp.steps           # run() resets the engine counters
+        bytes_step = (bytes_c.value(op="tp_block", mode="tp_graph")
+                      - b0) / max(steps, 1)
+        calls_step = (calls_c.value(op="tp_block", mode="tp_graph")
+                      - c0) / max(steps, 1)
+    finally:
+        metrics.enable(prev_enabled)
+    identical = all(np.array_equal(a, b) for a, b in zip(ref, got))
+
+    # the int8 hop only exists in psum mode (exact mode has no
+    # reduction to compress) — one short stream + the runtime bound
+    p8 = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_len=16 + max_new,
+        decode_block=decode_block, prompt_buckets=(16,),
+        tp=TPConfig(axes=axes, mode="psum", int8=True, mesh=mesh))
+    s8 = Server(p8)
+    s8.submit(prompts[0], max_new_tokens=max_new)
+    s8.run_until_idle()
+    int8_bound = p8.tp_int8_error_bound()
+
+    useful = requests * max_new
+    return {
+        "serving_tp_devices": tp.tp_degree(),
+        "serving_tp_axes": "x".join(str(mesh.shape[a]) for a in axes),
+        "serving_tp_bit_identical": bool(identical),
+        "serving_tp_tokens_per_sec_1chip": round(useful / dt_one, 1),
+        "serving_tp_tokens_per_sec_mesh": round(useful / dt_tp, 1),
+        "serving_tp_collective_bytes_per_step": int(bytes_step),
+        "serving_tp_collective_calls_per_step": round(calls_step, 2),
+        "serving_tp_int8_error_bound": float(int8_bound),
+        "serving_tp_decode_compiles": tp.decode_compile_count(),
+    }

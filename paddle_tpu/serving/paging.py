@@ -404,7 +404,7 @@ class PagedEngine(ContinuousBatchingEngine):
                  num_blocks: Optional[int] = None,
                  kv_int8: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
-                 hash_fn=None):
+                 hash_fn=None, tp=None):
         if prompt_buckets is not None:
             raise ValueError(
                 "paged mode takes no prompt_buckets: prompts are "
@@ -438,9 +438,21 @@ class PagedEngine(ContinuousBatchingEngine):
         if backend is None:
             if model is None:
                 raise ValueError("pass a model or a paged step backend")
-            backend = PagedModelStepBackend(
-                model, num_slots, max_len, decode_block, block_size,
-                num_blocks, bool(kv_int8), prefill_chunk)
+            from .tp import resolve_tp_config
+            tp_cfg = resolve_tp_config(tp)
+            if tp_cfg is not None:
+                # tensor-parallel paged serving: the shared KV arena
+                # shards its kv-head dim over the mesh (serving/tp.py);
+                # an explicit backend is never rerouted by the env flag
+                from .tp import ShardedPagedStepBackend
+                backend = ShardedPagedStepBackend(
+                    model, num_slots, max_len, decode_block,
+                    block_size, num_blocks, bool(kv_int8),
+                    prefill_chunk, tp_cfg)
+            else:
+                backend = PagedModelStepBackend(
+                    model, num_slots, max_len, decode_block, block_size,
+                    num_blocks, bool(kv_int8), prefill_chunk)
         self.kv_block_size = backend.kv_block_size
         self.num_kv_blocks = backend.num_kv_blocks
         self.max_blocks = backend.max_blocks

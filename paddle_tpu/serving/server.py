@@ -419,6 +419,8 @@ class Server:
             if ticks else 0.0,
         }
         out.update(self._res.counters())
+        if eng.tp_degree() > 1:                # tensor-parallel extras
+            out["tp_degree"] = eng.tp_degree()
         hit_rate = getattr(eng, "prefix_cache_hit_rate", None)
         if hit_rate is not None:               # paged engine extras
             out["prefix_cache_hit_rate"] = round(hit_rate(), 4)

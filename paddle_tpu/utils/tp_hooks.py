@@ -1,0 +1,116 @@
+"""Trace-time tensor-parallel hooks for the serving decode block.
+
+The model's forward asks "am I sharded, and where do the gathers/
+reduces go" through these functions. They are no-ops (one module-global
+check at TRACE time, zero runtime cost) outside a sharded serving
+trace, so the same model code serves 1-chip and TP.
+
+This module lives in ``utils`` — NOT in ``serving`` — on purpose:
+``models/llama.py`` calls the hooks from its forward, and importing
+them from the serving package would pull the whole serving stack
+(engine/paging/server/resilience/observability) into every
+training-only model import AND invert the layering that
+``serving/engine.py`` keeps one-directional by importing models lazily.
+Everything heavy (collectives, tensor wrappers) is imported lazily
+inside the active path; the module itself depends only on the stdlib
+and jax.numpy. ``serving/tp.py`` owns arming: it pushes a
+:class:`TPSpec` around every sharded trace via :func:`active`.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["TPSpec", "current_tp", "active", "maybe_gather",
+           "maybe_gather_logits", "maybe_reduce"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TPSpec:
+    """What a sharded serving trace needs to know: the hierarchical
+    collective plan over the TP mesh axes, the total shard degree, the
+    weight layout (``"exact"`` | ``"psum"``), and whether the psum-mode
+    hidden-state all-reduce rides the int8 wire format."""
+    plan: object          # distributed.collectives.HierarchyPlan
+    degree: int
+    mode: str
+    int8: bool
+
+
+_ACTIVE: List[TPSpec] = []
+_BOUND_SINK: Optional[list] = None   # armed by tp.py's int8 bound probe
+
+
+def current_tp() -> Optional[TPSpec]:
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+@contextlib.contextmanager
+def active(spec: TPSpec):
+    _ACTIVE.append(spec)
+    try:
+        yield
+    finally:
+        _ACTIVE.pop()
+
+
+def _gather_last_dim(x, plan):
+    """All-gather shards of the LAST dim (chunks in linear-index order
+    over the plan axes — matching the P(..., axes) weight layout).
+    Pure data movement: bit-exact."""
+    from ..distributed.collectives.hierarchical import hier_all_gather
+    x = jnp.moveaxis(x, -1, 0)
+    x = hier_all_gather(x, plan)
+    return jnp.moveaxis(x, 0, -1)
+
+
+def maybe_gather(t, full_width: int):
+    """Exact-mode gather in front of a replicated row-parallel weight
+    (attention heads before o_proj, MLP activation before down_proj).
+    No-op when TP is off, the tensor is already full width (layer not
+    sharded), or mode is psum (row-parallel follows instead)."""
+    spec = current_tp()
+    if spec is None or spec.mode != "exact" or \
+            t.shape[-1] == full_width:
+        return t
+    from ..tensor import apply_op
+    return apply_op(lambda v: _gather_last_dim(v, spec.plan), t)
+
+
+def maybe_gather_logits(t, vocab_size: int):
+    """The final-logits all-gather (both modes): vocab-sharded lm_head
+    shards -> full logits through the hierarchical collectives path."""
+    spec = current_tp()
+    if spec is None or t.shape[-1] == vocab_size:
+        return t
+    from ..tensor import apply_op
+    return apply_op(lambda v: _gather_last_dim(v, spec.plan), t)
+
+
+def maybe_reduce(t):
+    """Psum-mode hidden-state all-reduce behind a row-sharded weight
+    (o_proj / down_proj partial sums). With ``int8`` the payload rides
+    the EQuARX wire format; the bound probe (``_BOUND_SINK`` armed by
+    serving/tp.py) additionally collects the runtime error bound of
+    every hop."""
+    spec = current_tp()
+    if spec is None or spec.mode != "psum":
+        return t
+    from ..distributed.collectives.hierarchical import hier_all_reduce
+    from ..distributed.collectives.quantized import quantized_all_reduce
+    from ..tensor import apply_op
+
+    def red(v):
+        if not spec.int8:
+            return hier_all_reduce(v, spec.plan)
+        if _BOUND_SINK is not None:
+            out, bound = quantized_all_reduce(v, spec.plan,
+                                              return_error_bound=True)
+            _BOUND_SINK.append(bound)
+            return out
+        return quantized_all_reduce(v, spec.plan)
+
+    return apply_op(red, t)

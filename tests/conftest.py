@@ -113,8 +113,38 @@ def pytest_collection_modifyitems(config, items):
 
 
 def pytest_report_header(config):
+    # jax/jaxlib versions on every run's record: the per-re-anchor
+    # "did a jaxlib upgrade fix the heap landmine?" check needs a paper
+    # trail of which jaxlib each tier-1 result was produced under
+    import importlib.metadata as _md
+    try:
+        _jaxlib = _md.version("jaxlib")
+    except _md.PackageNotFoundError:
+        _jaxlib = "unknown"
+    lines = [f"jax {jax.__version__} / jaxlib {_jaxlib} "
+             f"(tier-1 results are judged per-jaxlib; see ROADMAP env "
+             "note)"]
+    # the known environment landmine (documented in test_resilience.py):
+    # jax's persistent compile cache + the xdist/randomly plugins
+    # corrupts the native heap when a SECOND paged step backend compiles
+    # in one process (glibc double-free at exit). Tier-1 runs with
+    # `-p no:xdist -p no:randomly` and is immune — warn when a run is
+    # NOT in that safe configuration so a native crash is attributable.
+    risky = [p for p in ("xdist", "randomly")
+             if config.pluginmanager.has_plugin(p)]
+    if risky:
+        lines.append(
+            "WARNING: plugin(s) %s active with the persistent jax "
+            "compile cache — known native-heap landmine when a second "
+            "paged serving backend compiles in-process (glibc "
+            "double-free at exit). Tier-1 passes -p no:xdist "
+            "-p no:randomly; re-check on each jaxlib upgrade."
+            % "/".join(risky))
     if os.environ.get("PT_FULL") == "1":
-        return ["lane: FULL (every test; weekly lane)"]
+        lines.append("lane: FULL (every test; weekly lane)")
+        return lines
     n = len(_full_lane_prefixes())
-    return [f"lane: quick — tests/full_lane.txt lists {n} "
-            "compile-heavy groups deselected here; PT_FULL=1 runs all"]
+    lines.append(f"lane: quick — tests/full_lane.txt lists {n} "
+                 "compile-heavy groups deselected here; PT_FULL=1 runs "
+                 "all")
+    return lines

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
-                 "tests/test_resilience.py", "tests/test_observability.py"]
+                 "tests/test_resilience.py", "tests/test_observability.py",
+                 "tests/test_serving_tp.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -50,6 +51,19 @@ REQUIRED_NODES = [
     "test_dumps_on_circuit_open",
     "test_observability.py::TestProfilerSchedulerGating::"
     "test_closed_scheduler_keeps_host_ring_silent",
+    # PR 7 tensor-parallel pins: dense + paged sharded bit-identity on
+    # the simulated 2x4 mesh, the seeded-sampling parity, the int8-hop
+    # queryable bound, and the AOT 4/5-output arity compatibility
+    "test_serving_tp.py::TestDenseTPParity::"
+    "test_greedy_staggered_bit_exact_one_compile",
+    "test_serving_tp.py::TestDenseTPParity::"
+    "test_seeded_sampling_bit_exact",
+    "test_serving_tp.py::TestPagedTPParity::"
+    "test_greedy_staggered_bit_exact_one_compile",
+    "test_serving_tp.py::TestPsumInt8::"
+    "test_int8_bound_queryable_from_live_state",
+    "test_serving.py::TestDecodeBlockArity::"
+    "test_legacy_four_output_stream_bit_identical",
 ]
 
 

@@ -217,3 +217,71 @@ class TestArtifactServing:
         for rid, p in enumerate(prompts):
             np.testing.assert_array_equal(
                 res[rid], _ref(model, p, 5, temperature=0.0))
+
+    def test_artifact_block_arity_both_directions(self, serving_setup,
+                                                  tmp_path):
+        """New exports record block_outputs=5 so the serving host knows
+        the artifact carries the NaN-sentinel flags; an old artifact
+        (no arity key — simulated by stripping it) still loads, with
+        carries_nan_flags False."""
+        import pickle
+        from paddle_tpu.inference import export_decoder
+        from paddle_tpu.serving.engine import ArtifactStepBackend
+        model, cfg, engine = serving_setup
+        path = export_decoder(model, str(tmp_path / "arity"), batch=1,
+                              prompt_len=8, max_len=64, engine_slots=2,
+                              engine_decode_block=4,
+                              engine_prompt_buckets=(8,))
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        assert blob["engine"]["config"]["block_outputs"] == 5
+        assert ArtifactStepBackend(blob).carries_nan_flags
+        del blob["engine"]["config"]["block_outputs"]
+        assert not ArtifactStepBackend(blob).carries_nan_flags
+
+
+class TestDecodeBlockArity:
+    """The PR 5 NaN-sentinel grew the decode block from 4 outputs
+    (cache, state, toks, lives) to 5 (+ per-step (S,) ok flags).
+    Serving hosts meet BOTH generations: new programs carry the flags;
+    old 4-output AOT artifacts are padded with flags=None by
+    engine.step_block, which makes the sentinel inert for them without
+    touching the stream."""
+
+    class _LegacyBackend:
+        """A pre-sentinel artifact: its decode block returns 4 values."""
+        def __init__(self, inner):
+            self._inner = inner
+            self.carries_nan_flags = False
+
+        def __getattr__(self, name):
+            return getattr(self.__dict__["_inner"], name)
+
+        def decode_block(self, cache_flat, state):
+            return self._inner.decode_block(cache_flat, state)[:4]
+
+    def test_new_block_emits_five_outputs(self, serving_setup):
+        model, cfg, engine = serving_setup
+        engine.reset()
+        out = engine.backend.decode_block(engine._cache, engine._state)
+        assert len(out) == 5        # (cache, state, toks, lives, oks)
+        engine.reset()              # the direct call donated cache/state
+
+    def test_legacy_four_output_stream_bit_identical(self,
+                                                     serving_setup):
+        """A 4-output backend serves the same greedy stream: the engine
+        pads the missing ok flags with None and the armed sentinel
+        (Server default) skips quarantine instead of crashing."""
+        model, cfg, engine = serving_setup
+        legacy = ContinuousBatchingEngine(
+            backend=self._LegacyBackend(engine.backend))
+        rs = np.random.RandomState(21)
+        prompts = [rs.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+                   for L in (5, 9, 12)]
+        srv = Server(legacy)
+        assert legacy.nan_sentinel          # armed, inert on None flags
+        rids = [srv.submit(p, max_new_tokens=5) for p in prompts]
+        res = srv.run_until_idle()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(
+                res[rid], _ref(model, p, 5, temperature=0.0))
