@@ -257,7 +257,11 @@ def export_decoder(model, path: str, batch: int, prompt_len: int,
                    top_k: int = 0, top_p: float = 1.0,
                    engine_slots: Optional[int] = None,
                    engine_decode_block: int = 8,
-                   engine_prompt_buckets: Sequence[int] = (16, 32)):
+                   engine_prompt_buckets: Sequence[int] = (16, 32),
+                   engine_paged: bool = False,
+                   engine_block_size: int = 16,
+                   engine_num_blocks: Optional[int] = None,
+                   engine_prefill_chunk: Optional[int] = None):
     """AOT-export the autoregressive serving path of a causal LM: TWO
     StableHLO programs — prefill (prompt → first token + KV cache) and
     decode step (token, cache, pos → next token, cache) — plus weights
@@ -275,7 +279,17 @@ def export_decoder(model, path: str, batch: int, prompt_len: int,
     engine's programs (the slot-pool decode block over
     ``engine_slots`` × ``max_len`` caches, plus one prefill per prompt
     bucket) so ``GenerationPredictor.serve()`` runs the SAME serving
-    engine from the artifact alone — see ``paddle_tpu.serving``."""
+    engine from the artifact alone — see ``paddle_tpu.serving``.
+
+    ``engine_paged=True`` exports the PAGED engine's two programs
+    instead: the block-arena decode block (in-state block tables) and
+    the ONE chunked-prefill chunk program — ``engine_block_size`` /
+    ``engine_num_blocks`` / ``engine_prefill_chunk`` mirror the
+    ``PagedEngine`` knobs (defaults match: full dense capacity + trash
+    block, chunk = 2 blocks). The artifact records the program arities
+    (``block_outputs``/``chunk_outputs``) so a serving host can tell
+    what it loaded; ``serving.paging.PagedArtifactStepBackend`` is the
+    loader. The int8 KV arena is not exported (fp32 arena only)."""
     from ..models.generation import build_decode_step
     from ..tensor import Tensor
 
@@ -316,7 +330,68 @@ def export_decoder(model, path: str, batch: int, prompt_len: int,
         "gen_config": {"batch": batch, "prompt_len": prompt_len,
                        "max_len": max_len, **sample_kwargs},
     }
-    if engine_slots is not None:
+    if engine_slots is not None and engine_paged:
+        from ..serving.engine import (build_paged_chunk_fn,
+                                      build_slot_block_fn,
+                                      init_slot_state)
+        if max_len % engine_block_size != 0:
+            raise ValueError(
+                f"max_len={max_len} must be a multiple of "
+                f"engine_block_size={engine_block_size}")
+        max_blocks = max_len // engine_block_size
+        if engine_num_blocks is None:
+            engine_num_blocks = 1 + engine_slots * max_blocks
+        if engine_prefill_chunk is None:
+            engine_prefill_chunk = 2 * engine_block_size
+        pool0 = model.init_paged_kv_cache(engine_num_blocks,
+                                          engine_block_size)
+        pflat, ptree = jax.tree.flatten(
+            pool0, is_leaf=lambda x: isinstance(x, Tensor))
+        eng_holder = {"tree": ptree}
+        eng_pure = build_decode_step(model, None, eng_holder)
+        pool_specs = tuple(jax.ShapeDtypeStruct(c._value.shape,
+                                                c._value.dtype)
+                           for c in pflat)
+        state_specs = jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            init_slot_state(engine_slots))
+        state_specs["table"] = jax.ShapeDtypeStruct(
+            (engine_slots, max_blocks), jnp.int32)
+        block_fn = build_slot_block_fn(eng_pure, engine_decode_block,
+                                       paged=True)
+        exp_block = jax.export.export(jax.jit(block_fn))(
+            pspecs, bspecs, pool_specs, state_specs)
+        chunk_fn = build_paged_chunk_fn(eng_pure, engine_prefill_chunk)
+        exp_chunk = jax.export.export(jax.jit(chunk_fn))(
+            pspecs, bspecs,
+            jax.ShapeDtypeStruct((1, engine_prefill_chunk), jnp.int32),
+            pool_specs,
+            jax.ShapeDtypeStruct((1, max_blocks), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.float32))
+        blob["engine"] = {
+            "block": exp_block.serialize(),
+            "chunk": exp_chunk.serialize(),
+            "pool_specs": [(tuple(s.shape), str(np.dtype(s.dtype)))
+                           for s in pool_specs],
+            # arities recorded like the dense engine's block_outputs:
+            # block emits (cache, state, toks, lives, oks), the chunk
+            # program (tok0, cache) — a serving host can tell what the
+            # artifact carries without deserializing anything
+            "config": {"paged": True, "num_slots": engine_slots,
+                       "max_len": max_len,
+                       "decode_block": engine_decode_block,
+                       "block_size": engine_block_size,
+                       "num_blocks": engine_num_blocks,
+                       "prefill_chunk": engine_prefill_chunk,
+                       "kv_int8": False,
+                       "block_outputs": 5, "chunk_outputs": 2},
+        }
+    elif engine_slots is not None:
         from ..serving.engine import (build_slot_block_fn,
                                       build_slot_prefill_fn,
                                       init_slot_state)
@@ -435,11 +510,18 @@ class GenerationPredictor:
         from ..serving import ContinuousBatchingEngine, Server
         from ..serving.engine import ArtifactStepBackend
         if self._server is None:
-            backend = ArtifactStepBackend(self._engine_blob)
-            engine = ContinuousBatchingEngine(
-                backend=backend,
-                prompt_buckets=self._engine_blob["engine"]["config"]
-                ["prompt_buckets"])
+            cfgs = self._engine_blob["engine"]["config"]
+            if cfgs.get("paged"):
+                from ..serving.paging import PagedArtifactStepBackend
+                backend = PagedArtifactStepBackend(self._engine_blob)
+                # is_paged on the backend routes the factory to the
+                # PagedEngine (chunked prefill + block manager)
+                engine = ContinuousBatchingEngine(backend=backend)
+            else:
+                backend = ArtifactStepBackend(self._engine_blob)
+                engine = ContinuousBatchingEngine(
+                    backend=backend,
+                    prompt_buckets=cfgs["prompt_buckets"])
             self._server = Server(engine)
         server = self._server
         for req in requests:

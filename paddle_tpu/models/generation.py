@@ -164,14 +164,25 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
             k_read = _pa.paged_gather(ck, block_table)
             v_read = _pa.paged_gather(cv, block_table)
     elif per_row:
-        def upd(cachev, blockv):
-            return jax.vmap(
-                lambda cr, xr, p: jax.lax.dynamic_update_slice(
-                    cr, xr, (p, 0, 0)))(cachev,
-                                        blockv.astype(cachev.dtype),
-                                        posv)
-        ck = upd(ckv, kv_)
-        cv = upd(cvv, vv)
+        if s == 1:
+            def upd(cachev, blockv):
+                return jax.vmap(
+                    lambda cr, xr, p: jax.lax.dynamic_update_slice(
+                        cr, xr, (p, 0, 0)))(cachev,
+                                            blockv.astype(cachev.dtype),
+                                            posv)
+            ck = upd(ckv, kv_)
+            cv = upd(cvv, vv)
+        else:
+            # speculative verify: a k+1-wide per-row write. Scatter
+            # (not dynamic_update_slice) because jax DROPS out-of-bounds
+            # scatter updates — a draft window hanging past max_len
+            # near capacity just loses its junk tail instead of
+            # clamping backward over valid cache entries
+            rows = jnp.arange(b)[:, None]
+            tpos = posv[:, None] + jnp.arange(s)[None, :]
+            ck = ckv.at[rows, tpos].set(kv_.astype(ckv.dtype))
+            cv = cvv.at[rows, tpos].set(vv.astype(cvv.dtype))
         k_read, v_read = ck, cv
     else:
         ck = jax.lax.dynamic_update_slice(ckv, kv_.astype(ckv.dtype),
@@ -234,14 +245,23 @@ def forward_accepts_block_table(cls) -> bool:
     return cached
 
 
-def build_decode_step(model, sample_kwargs, tree_holder):
+def build_decode_step(model, sample_kwargs, tree_holder,
+                      all_positions=False):
     """The shared pure step: (params, bufs, token_block, cache_flat,
     pos, key) → (next_token, new_cache_flat). Serves prefill (block of
     length s at pos=0) and decode (length 1) — jit/retrace handles the
     two shapes within one compiled-function cache. Used by
     GenerationMixin.generate, beam search (sample_kwargs=None → returns
     next-token LOG-PROBS instead of a sampled token; the ``key`` arg is
-    accepted and ignored) and inference.export_decoder."""
+    accepted and ignored) and inference.export_decoder.
+
+    ``all_positions=True`` (requires sample_kwargs=None) returns the
+    log-probs at EVERY position of the block, shape (b, s, V) — the
+    speculative-verify head: one dispatch scores a whole candidate
+    window (serving/spec.py)."""
+    if all_positions and sample_kwargs is not None:
+        raise ValueError("all_positions=True returns raw log-probs; "
+                         "pass sample_kwargs=None")
     ptensors = [p for _, p in model.named_parameters()]
     btensors = [b for _, b in model.named_buffers()]
 
@@ -263,7 +283,9 @@ def build_decode_step(model, sample_kwargs, tree_holder):
             with framework.functional_mode(), framework.no_grad_guard():
                 logits, new_cache = model.forward(
                     Tensor(token), cache=cache, pos=Tensor(pos), **kw)
-            if last_index is None:
+            if all_positions:
+                lv = logits._value              # (b, s, V) verify head
+            elif last_index is None:
                 lv = logits._value[:, -1, :]
             else:
                 # chunked prefill: the last REAL token of a right-

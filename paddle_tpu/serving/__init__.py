@@ -10,16 +10,22 @@ path (``inference.export_decoder(engine_slots=...)`` +
 serialized artifact alone."""
 from .engine import (ArtifactStepBackend, ContinuousBatchingEngine,
                      ModelStepBackend, slot_sample_logits)
-from .paging import BlockManager, PagedEngine, PagedModelStepBackend
+from .paging import (BlockManager, PagedArtifactStepBackend, PagedEngine,
+                     PagedModelStepBackend)
 from .resilience import RequestFailure, ResilienceConfig
 from .scheduler import Request, Scheduler
 from .server import Server
+from .spec import (SpecConfig, SpecEngine, SpecModelStepBackend,
+                   SpecPagedEngine, SpecPagedStepBackend, ngram_propose)
 from .tp import (ShardedModelStepBackend, ShardedPagedStepBackend,
                  TPConfig)
 
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
-           "ArtifactStepBackend", "BlockManager", "PagedEngine",
+           "ArtifactStepBackend", "BlockManager",
+           "PagedArtifactStepBackend", "PagedEngine",
            "PagedModelStepBackend", "Request", "RequestFailure",
-           "ResilienceConfig", "Scheduler", "Server",
-           "ShardedModelStepBackend", "ShardedPagedStepBackend",
-           "TPConfig", "slot_sample_logits"]
+           "ResilienceConfig", "Scheduler", "Server", "SpecConfig",
+           "SpecEngine", "SpecModelStepBackend", "SpecPagedEngine",
+           "SpecPagedStepBackend", "ShardedModelStepBackend",
+           "ShardedPagedStepBackend", "TPConfig", "ngram_propose",
+           "slot_sample_logits"]

@@ -287,6 +287,7 @@ class ModelStepBackend(_StepBackendCommon):
         self.num_slots, self.max_len = num_slots, max_len
         self.block_size = decode_block
         tree_holder = {"tree": None}
+        self._tree_holder = tree_holder    # spec backends reuse it
         self._pure = build_decode_step(model, None, tree_holder)
         cache0 = model.init_kv_cache(num_slots, max_len)
         flat, tree = jax.tree.flatten(
@@ -320,6 +321,20 @@ class ModelStepBackend(_StepBackendCommon):
         return fn(self._pv, self._bv, ids, pad, key, temp, topk, topp)
 
 
+def artifact_fingerprint(cfgs: dict, *programs: bytes) -> str:
+    """Artifact identity: sha1 over the recorded config + the
+    serialized programs. Recorded into engine snapshots so a restore
+    onto a DIFFERENT artifact is refused — the ONE recipe shared by the
+    dense and paged artifact backends (changing it in one place cannot
+    silently de-gate the other)."""
+    import hashlib
+    h = hashlib.sha1(repr(sorted(
+        (k, str(v)) for k, v in cfgs.items())).encode())
+    for prog in programs:
+        h.update(prog)
+    return h.hexdigest()
+
+
 class ArtifactStepBackend(_StepBackendCommon):
     """AOT backend: the SAME engine programs, deserialized from an
     ``export_decoder(..., engine_slots=...)`` artifact — no model code
@@ -329,6 +344,9 @@ class ArtifactStepBackend(_StepBackendCommon):
     def __init__(self, blob):
         eng = blob["engine"]
         cfgs = eng["config"]
+        self.artifact_fingerprint = artifact_fingerprint(
+            cfgs, eng["block"],
+            *(eng["prefill"][lb] for lb in sorted(eng["prefill"])))
         self.num_slots = cfgs["num_slots"]
         self.max_len = cfgs["max_len"]
         self.block_size = cfgs["decode_block"]
@@ -399,25 +417,30 @@ class ContinuousBatchingEngine:
             backend = kw.get("backend") if len(args) < 6 else args[5]
             if paged is None:
                 from ..utils.flags import env_flag
-                from .paging import PagedModelStepBackend
-                if isinstance(backend, PagedModelStepBackend):
+                if getattr(backend, "is_paged", False):
                     paged = True     # a paged backend IS the decision
                 elif backend is None:
                     paged = env_flag("PT_SERVING_PAGED")
                 # an explicit non-paged backend (e.g. the AOT
                 # ArtifactStepBackend in GenerationPredictor) is never
-                # rerouted by the env flag — paged export is a ROADMAP
-                # follow-up
+                # rerouted by the env flag
+            from .spec import spec_requested
+            spec = spec_requested(kw.get("spec"), backend)
             if paged:
                 from .paging import PagedEngine
-                return object.__new__(PagedEngine)
+                from .spec import SpecPagedEngine
+                return object.__new__(
+                    SpecPagedEngine if spec else PagedEngine)
+            if spec:
+                from .spec import SpecEngine
+                return object.__new__(SpecEngine)
         return object.__new__(cls)
 
     def __init__(self, model=None, num_slots: int = 4, max_len: int = 256,
                  decode_block: int = 8,
                  prompt_buckets: Optional[Sequence[int]] = None,
                  backend=None, *, paged: Optional[bool] = None,
-                 tp=None):
+                 spec=None, tp=None):
         if backend is None:
             if model is None:
                 raise ValueError("pass a model or a step backend")
@@ -432,8 +455,19 @@ class ContinuousBatchingEngine:
                 backend = ShardedModelStepBackend(
                     model, num_slots, max_len, decode_block, tp_cfg)
             else:
-                backend = ModelStepBackend(model, num_slots, max_len,
-                                           decode_block)
+                # subclass hook: the speculative engine swaps in the
+                # verify-capable backend here (serving/spec.py)
+                backend = self._build_backend(model, num_slots, max_len,
+                                              decode_block)
+        if spec and not hasattr(self, "spec_k"):
+            # only the factory (ContinuousBatchingEngine(...)) routes
+            # spec= to the speculative engine classes; a direct
+            # subclass constructor silently ignoring it would be a
+            # misconfiguration, not a preference
+            raise ValueError(
+                "spec= is only honored through the "
+                "ContinuousBatchingEngine factory (or construct "
+                "serving.spec.SpecEngine/SpecPagedEngine directly)")
         self.backend = backend
         self.num_slots = backend.num_slots
         self.max_len = backend.max_len
@@ -449,6 +483,9 @@ class ContinuousBatchingEngine:
         # the hot paths at one `is None` check)
         self.tracer = None
         self.reset()
+
+    def _build_backend(self, model, num_slots, max_len, decode_block):
+        return ModelStepBackend(model, num_slots, max_len, decode_block)
 
     # -- lifecycle ---------------------------------------------------------
     def reset(self):
@@ -774,6 +811,11 @@ class ContinuousBatchingEngine:
             fin_meta.append(self._run_meta(run))
         meta = {
             "engine_class": type(self).__name__,
+            # artifact-backed engines record which programs produced
+            # this state; model-backed engines record None (either side
+            # None -> compatibility is left to the pool_specs check)
+            "backend_artifact": getattr(self.backend,
+                                        "artifact_fingerprint", None),
             "num_slots": self.num_slots, "max_len": self.max_len,
             "decode_block": self.decode_block,
             "pool_specs": [[list(s), str(np.dtype(d))]
@@ -806,6 +848,15 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"snapshot was taken by {meta['engine_class']}, this "
                 f"engine is {type(self).__name__} (dense/paged mismatch)")
+        saved_fp = meta.get("backend_artifact")
+        cur_fp = getattr(self.backend, "artifact_fingerprint", None)
+        if saved_fp is not None and cur_fp is not None \
+                and saved_fp != cur_fp:
+            raise ValueError(
+                "snapshot was taken on a different AOT artifact "
+                f"(saved {saved_fp[:12]}..., this backend "
+                f"{cur_fp[:12]}...) — restore with the artifact that "
+                "produced the snapshot")
         self.reset()
         self._cache = tuple(jnp.asarray(arrays[f"cache_{i}"])
                             for i in range(len(self.backend.pool_specs)))

@@ -58,10 +58,12 @@ from ..observability.tracing import now_us as _trace_now
 from ..utils import faults
 from ..utils.flags import env_flag, env_int
 from .engine import (ContinuousBatchingEngine, ModelStepBackend, _SlotRun,
-                     _M_PREFILLS, _M_TOKENS, build_paged_chunk_fn,
+                     _M_PREFILLS, _M_TOKENS, _StepBackendCommon,
+                     artifact_fingerprint, build_paged_chunk_fn,
                      build_slot_block_fn, init_slot_state)
 
-__all__ = ["BlockManager", "PagedModelStepBackend", "PagedEngine"]
+__all__ = ["BlockManager", "PagedArtifactStepBackend",
+           "PagedModelStepBackend", "PagedEngine"]
 
 TRASH_BLOCK = 0
 
@@ -276,6 +278,8 @@ class PagedModelStepBackend(ModelStepBackend):
     through the forward, and prefill is ONE fixed-shape chunk program
     instead of per-bucket jits."""
 
+    is_paged = True      # engine.__new__ routes on this, not isinstance
+
     def __init__(self, model, num_slots: int, max_len: int,
                  decode_block: int, block_size: int, num_blocks: int,
                  kv_int8: bool, prefill_chunk: int):
@@ -303,6 +307,7 @@ class PagedModelStepBackend(ModelStepBackend):
         self.kv_int8 = kv_int8
         self.prefill_chunk_len = prefill_chunk
         tree_holder = {"tree": None}
+        self._tree_holder = tree_holder    # spec backends reuse it
         self._pure = build_decode_step(model, None, tree_holder)
         cache0 = model.init_paged_kv_cache(num_blocks, block_size,
                                            kv_int8=kv_int8)
@@ -335,6 +340,70 @@ class PagedModelStepBackend(ModelStepBackend):
         return self._chunk_jit(self._pv, self._bv, ids, cache_flat,
                                table_row, start_pos, n_valid, key, temp,
                                topk, topp)
+
+    def prefill(self, *a, **kw):
+        raise RuntimeError("the paged backend prefills in chunks — use "
+                           "prefill_chunk (engine.admit drives it)")
+
+
+class PagedArtifactStepBackend(_StepBackendCommon):
+    """AOT paged backend: the paged engine's TWO programs (ONE decode
+    block + ONE chunked-prefill chunk), deserialized from an
+    ``export_decoder(..., engine_slots=N, engine_paged=True)`` artifact
+    — no model code or tracing needed on the serving host. The
+    ``artifact_fingerprint`` (sha1 over the serialized programs +
+    config) rides engine snapshots so a restore onto a DIFFERENT
+    artifact is refused instead of silently resuming on other
+    programs."""
+
+    is_paged = True
+
+    def __init__(self, blob):
+        eng = blob["engine"]
+        cfgs = eng["config"]
+        if not cfgs.get("paged"):
+            raise ValueError(
+                "artifact holds the dense engine programs — load it "
+                "with ArtifactStepBackend, or re-export with "
+                "export_decoder(..., engine_paged=True)")
+        self.artifact_fingerprint = artifact_fingerprint(
+            cfgs, eng["block"], eng["chunk"])
+        self.num_slots = cfgs["num_slots"]
+        self.max_len = cfgs["max_len"]
+        self.block_size = cfgs["decode_block"]
+        self.kv_block_size = cfgs["block_size"]
+        self.num_kv_blocks = cfgs["num_blocks"]
+        self.max_blocks = self.max_len // self.kv_block_size
+        self.kv_int8 = bool(cfgs.get("kv_int8", False))
+        self.prefill_chunk_len = cfgs["prefill_chunk"]
+        self.carries_nan_flags = cfgs.get("block_outputs", 4) >= 5
+        self.pool_specs = tuple((tuple(shape), np.dtype(dtype))
+                                for shape, dtype in eng["pool_specs"])
+        self._block = jax.export.deserialize(eng["block"])
+        self._chunk = jax.export.deserialize(eng["chunk"])
+        self._pv = [jnp.asarray(v) for v in blob["params"]]
+        self._bv = [jnp.asarray(v) for v in blob["buffers"]]
+        self.decode_traces = [1]     # two AOT-compiled programs
+        self.prefill_traces = [1]
+
+    def init_state(self):
+        state = init_slot_state(self.num_slots)
+        state["table"] = jnp.zeros((self.num_slots, self.max_blocks),
+                                   jnp.int32)
+        return state
+
+    def pool_cache(self):
+        return tuple(jnp.zeros(shape, dtype)
+                     for shape, dtype in self.pool_specs)
+
+    def decode_block(self, cache_flat, state):
+        return self._block.call(self._pv, self._bv, cache_flat, state)
+
+    def prefill_chunk(self, ids, cache_flat, table_row, start_pos,
+                      n_valid, key, temp, topk, topp):
+        return self._chunk.call(self._pv, self._bv, ids, cache_flat,
+                                table_row, start_pos, n_valid, key,
+                                temp, topk, topp)
 
     def prefill(self, *a, **kw):
         raise RuntimeError("the paged backend prefills in chunks — use "
@@ -399,7 +468,7 @@ class PagedEngine(ContinuousBatchingEngine):
     def __init__(self, model=None, num_slots: int = 4,
                  max_len: int = 256, decode_block: int = 8,
                  prompt_buckets: Optional[Sequence[int]] = None,
-                 backend=None, *, paged: bool = True,
+                 backend=None, *, paged: bool = True, spec=None,
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
                  kv_int8: Optional[bool] = None,
@@ -450,7 +519,9 @@ class PagedEngine(ContinuousBatchingEngine):
                     block_size, num_blocks, bool(kv_int8),
                     prefill_chunk, tp_cfg)
             else:
-                backend = PagedModelStepBackend(
+                # subclass hook: the speculative engine swaps in the
+                # verify-capable paged backend here (serving/spec.py)
+                backend = self._build_paged_backend(
                     model, num_slots, max_len, decode_block, block_size,
                     num_blocks, bool(kv_int8), prefill_chunk)
         self.kv_block_size = backend.kv_block_size
@@ -461,7 +532,14 @@ class PagedEngine(ContinuousBatchingEngine):
         self.manager = BlockManager(self.num_kv_blocks,
                                     self.kv_block_size, hash_fn)
         self._arm_jit = jax.jit(_arm_fn, donate_argnums=(0,))
-        super().__init__(backend=backend)
+        super().__init__(backend=backend, spec=spec)
+
+    def _build_paged_backend(self, model, num_slots, max_len,
+                             decode_block, block_size, num_blocks,
+                             kv_int8, prefill_chunk):
+        return PagedModelStepBackend(
+            model, num_slots, max_len, decode_block, block_size,
+            num_blocks, kv_int8, prefill_chunk)
 
     # -- lifecycle ---------------------------------------------------------
     def reset(self):

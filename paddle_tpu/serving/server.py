@@ -421,6 +421,15 @@ class Server:
         out.update(self._res.counters())
         if eng.tp_degree() > 1:                # tensor-parallel extras
             out["tp_degree"] = eng.tp_degree()
+        acc = getattr(eng, "acceptance_rate", None)
+        if acc is not None:                    # speculative extras: a
+            # tick advances 0..k+1 tokens per slot, so per-tick token
+            # accounting reads these, not decode_steps
+            out["spec_k"] = eng.spec_k
+            out["spec_verify_steps"] = eng.verify_steps
+            out["spec_acceptance_rate"] = round(acc(), 4)
+            out["spec_mean_accepted_per_step"] = round(
+                eng.mean_accepted_per_step(), 4)
         hit_rate = getattr(eng, "prefix_cache_hit_rate", None)
         if hit_rate is not None:               # paged engine extras
             out["prefix_cache_hit_rate"] = round(hit_rate(), 4)

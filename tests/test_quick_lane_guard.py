@@ -21,7 +21,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
                  "tests/test_resilience.py", "tests/test_observability.py",
-                 "tests/test_serving_tp.py"]
+                 "tests/test_serving_tp.py", "tests/test_serving_spec.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -64,6 +64,28 @@ REQUIRED_NODES = [
     "test_int8_bound_queryable_from_live_state",
     "test_serving.py::TestDecodeBlockArity::"
     "test_legacy_four_output_stream_bit_identical",
+    # PR 8 speculative-decoding pins: dense + paged/chunked bit-identity
+    # with the verify-block compile count, the eos-mid-span acceptance
+    # cut, the k=0 degenerate window, the chaos schedule with spec
+    # enabled, and the mid-stream kill/restore round trip
+    "test_serving_spec.py::TestSpecBitExactness::"
+    "test_dense_greedy_stream_bit_exact_one_compile",
+    "test_serving_spec.py::TestSpecBitExactness::"
+    "test_paged_chunked_stream_bit_exact_one_compile",
+    "test_serving_spec.py::TestAcceptance::"
+    "test_eos_inside_accepted_span",
+    "test_serving_spec.py::TestAcceptance::"
+    "test_k0_degenerates_to_plain_decode",
+    "test_serving_spec.py::TestSpecResilience::"
+    "test_chaos_schedule_with_spec_holds_invariants",
+    "test_serving_spec.py::TestSpecResilience::"
+    "test_kill_restore_mid_stream_bit_identical",
+    # PR 8 carried follow-ups: the artifact-identity snapshot gate and
+    # the paged-artifact stub routing pin
+    "test_serving.py::TestArtifactSnapshotIdentity::"
+    "test_stub_kill_restore_round_trip",
+    "test_serving_paged.py::TestPagedArtifact::"
+    "test_stub_paged_backend_routes_and_serves",
 ]
 
 

@@ -235,9 +235,105 @@ class TestArtifactServing:
         with open(path, "rb") as f:
             blob = pickle.load(f)
         assert blob["engine"]["config"]["block_outputs"] == 5
-        assert ArtifactStepBackend(blob).carries_nan_flags
+        back = ArtifactStepBackend(blob)
+        assert back.carries_nan_flags
+        # artifact identity: stable per blob, sensitive to the config
+        fp = back.artifact_fingerprint
+        assert fp == ArtifactStepBackend(blob).artifact_fingerprint
         del blob["engine"]["config"]["block_outputs"]
-        assert not ArtifactStepBackend(blob).carries_nan_flags
+        legacy = ArtifactStepBackend(blob)
+        assert not legacy.carries_nan_flags
+        assert legacy.artifact_fingerprint != fp
+
+
+class TestArtifactSnapshotIdentity:
+    """PR 5 carried follow-up: engine snapshots record the backing AOT
+    artifact's fingerprint, and a restore onto a DIFFERENT artifact is
+    refused. Pinned with a stub backend (this environment lacks
+    jax.export; the artifact-level fingerprint computation rides the
+    skipif-gated TestArtifactServing tests)."""
+
+    class _FingerprintBackend:
+        """Stub of an ArtifactStepBackend: proxies the live model
+        backend and carries an artifact fingerprint."""
+
+        def __init__(self, inner, fingerprint):
+            self._inner = inner
+            self.artifact_fingerprint = fingerprint
+
+        def __getattr__(self, name):
+            return getattr(self.__dict__["_inner"], name)
+
+    def test_stub_kill_restore_round_trip(self, serving_setup,
+                                          tmp_path):
+        """Kill mid-stream on an artifact-backed engine, restore into a
+        fresh engine on the SAME artifact: streams finish bit-identical
+        (the ArtifactStepBackend snapshot/restore contract)."""
+        model, cfg, engine = serving_setup
+        rs = np.random.RandomState(31)
+        prompts = [rs.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+                   for L in (5, 9, 12)]
+
+        def build(fp):
+            return ContinuousBatchingEngine(
+                backend=self._FingerprintBackend(engine.backend, fp),
+                prompt_buckets=(8, 16))
+
+        def submit_all(srv):
+            return [srv.submit(p, max_new_tokens=8, arrival_step=i)
+                    for i, p in enumerate(prompts)]
+
+        art = build("sha1:abc123")
+        srv_ref = Server(art)
+        rids = submit_all(srv_ref)
+        ref = srv_ref.run_until_idle()
+
+        art2 = build("sha1:abc123")
+        srv_kill = Server(art2)
+        assert submit_all(srv_kill) == rids
+        srv_kill.run_until_idle(max_ticks=2)
+        assert art2.has_live()
+        path = str(tmp_path / "art.npz")
+        srv_kill.snapshot(path)
+
+        art3 = build("sha1:abc123")       # fresh process, same artifact
+        srv_new = Server.restore(path, art3)
+        res = srv_new.run_until_idle()
+        for rid in rids:
+            np.testing.assert_array_equal(res[rid], ref[rid])
+
+    def test_restore_refuses_different_artifact(self, serving_setup,
+                                                tmp_path):
+        model, cfg, engine = serving_setup
+        art = ContinuousBatchingEngine(
+            backend=self._FingerprintBackend(engine.backend, "sha1:aaa"),
+            prompt_buckets=(8, 16))
+        path = str(tmp_path / "aaa.npz")
+        art.snapshot(path)
+        other = ContinuousBatchingEngine(
+            backend=self._FingerprintBackend(engine.backend, "sha1:bbb"),
+            prompt_buckets=(8, 16))
+        with pytest.raises(ValueError, match="different AOT artifact"):
+            other.restore(path)
+
+    def test_model_backed_engines_stay_compatible(self, serving_setup,
+                                                  tmp_path):
+        """Either side lacking a fingerprint (model-backed engine) keeps
+        the old behavior — pool_specs validation only — so existing
+        snapshots and mixed artifact/model restores still load."""
+        model, cfg, engine = serving_setup
+        engine.reset()
+        path = str(tmp_path / "plain.npz")
+        engine.snapshot(path)
+        art = ContinuousBatchingEngine(
+            backend=self._FingerprintBackend(engine.backend, "sha1:xyz"),
+            prompt_buckets=(8, 16))
+        art.restore(path)                  # saved None, current set: ok
+        art.reset()
+        path2 = str(tmp_path / "art.npz")
+        art.snapshot(path2)
+        engine.restore(path2)              # saved set, current None: ok
+        engine.reset()
 
 
 class TestDecodeBlockArity:

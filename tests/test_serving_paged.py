@@ -443,3 +443,87 @@ class TestPagedScheduling:
         with pytest.raises(ValueError, match="KV blocks"):
             Server(small).submit(np.ones((30,), np.int32),
                                  max_new_tokens=10)
+
+
+class TestPagedArtifact:
+    """PR 4 carried follow-up: export_decoder(engine_paged=True) ships
+    the paged engine's TWO programs with recorded arities, and
+    PagedArtifactStepBackend serves them. The stub test runs in THIS
+    environment; the artifact-level test rides the jax.export skipif
+    (same split as the PR 7 block_outputs=5 pins)."""
+
+    class _PagedProxyBackend:
+        """Stands in for a PagedArtifactStepBackend: proxies the live
+        paged backend and carries the artifact markers (is_paged routes
+        the factory; the arity flag mirrors the recorded config)."""
+        is_paged = True
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.carries_nan_flags = True
+            self.artifact_fingerprint = "sha1:paged-stub"
+
+        def __getattr__(self, name):
+            return getattr(self.__dict__["_inner"], name)
+
+    def test_stub_paged_backend_routes_and_serves(self, paged_setup):
+        """A backend advertising is_paged routes the factory to the
+        PagedEngine WITHOUT the paged= keyword (how the AOT serve()
+        path constructs it) and serves a bit-identical stream."""
+        model, cfg, engine = paged_setup
+        eng = ContinuousBatchingEngine(
+            backend=self._PagedProxyBackend(engine.backend))
+        assert isinstance(eng, PagedEngine)
+        _LIVE_MANAGERS.append(eng.manager)
+        rs = np.random.RandomState(41)
+        prompts = [rs.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+                   for L in (5, 9, 12)]
+        srv = Server(eng, Scheduler(prefill_token_budget=8))
+        rids = [srv.submit(p, max_new_tokens=5) for p in prompts]
+        res = srv.run_until_idle()
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(
+                res[rid], _ref(model, p, 5, temperature=0.0))
+
+    @pytest.mark.skipif(not hasattr(jax, "export"),
+                        reason="jax.export unavailable in this build")
+    def test_paged_artifact_arity_and_bit_identity(self, paged_setup,
+                                                   tmp_path):
+        """The exported paged artifact records both program arities
+        (block_outputs=5, chunk_outputs=2), loads through
+        PagedArtifactStepBackend, and GenerationPredictor.serve routes
+        it to the paged engine with bit-identical greedy results."""
+        import pickle
+        from paddle_tpu.inference import (GenerationPredictor,
+                                          export_decoder)
+        from paddle_tpu.serving import PagedArtifactStepBackend
+        model, cfg, engine = paged_setup
+        path = export_decoder(model, str(tmp_path / "paged"), batch=1,
+                              prompt_len=8, max_len=64, engine_slots=2,
+                              engine_decode_block=4,
+                              engine_paged=True, engine_block_size=8,
+                              engine_prefill_chunk=8)
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        cfgs = blob["engine"]["config"]
+        assert cfgs["paged"] is True
+        assert cfgs["block_outputs"] == 5
+        assert cfgs["chunk_outputs"] == 2
+        back = PagedArtifactStepBackend(blob)
+        assert back.carries_nan_flags
+        assert back.kv_block_size == 8
+        served = GenerationPredictor(path)
+        rs = np.random.RandomState(43)
+        prompts = [rs.randint(0, cfg.vocab_size, (L,)).astype(np.int32)
+                   for L in (5, 9, 12)]
+        srv = served.serve([{"prompt": p, "max_new_tokens": 5}
+                            for p in prompts], run=False)
+        assert isinstance(srv.engine, PagedEngine)
+        res = srv.run_until_idle()
+        for rid, p in enumerate(prompts):
+            np.testing.assert_array_equal(
+                res[rid], _ref(model, p, 5, temperature=0.0))
+        # a dense loader on a paged artifact must refuse loudly
+        from paddle_tpu.serving import ArtifactStepBackend
+        with pytest.raises(KeyError):
+            ArtifactStepBackend(blob)

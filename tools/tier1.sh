@@ -10,11 +10,42 @@
 #     double-free at exit; documented in tests/test_resilience.py).
 #     This invocation passes `-p no:xdist -p no:randomly` and is immune
 #     — re-check the landmine on every jaxlib upgrade.
+#   - a stale-cache guard: a tests/.jax_cache accumulated across MANY
+#     sessions (~140 entries, PR 7 data point) reproducibly segfaults
+#     the full suite mid-GC at a later paged-backend jax.jit even with
+#     the plugins disabled. Entry-count/age heuristic below wipes it
+#     BEFORE the run instead of after the crash.
 #
 # Usage: tools/tier1.sh [extra pytest args]
 # Log:   /tmp/_t1.log (flat), DOTS_PASSED echoed at the end.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# --- stale multi-session compile cache (ROADMAP heap-corruption
+# landmine): wipe when the entry count says "many sessions" or the
+# oldest entry says "not from today's session". A fresh worktree starts
+# cache-empty, which is why seed-comparison runs never crash.
+CACHE="tests/.jax_cache"
+CACHE_MAX_ENTRIES="${TIER1_CACHE_MAX_ENTRIES:-100}"
+CACHE_MAX_AGE_H="${TIER1_CACHE_MAX_AGE_H:-24}"
+if [ -d "$CACHE" ]; then
+  n=$(find "$CACHE" -type f 2>/dev/null | wc -l)
+  oldest=$(find "$CACHE" -type f -printf '%T@\n' 2>/dev/null \
+           | sort -n | head -1 | cut -d. -f1)
+  age_h=0
+  if [ -n "$oldest" ]; then
+    age_h=$(( ($(date +%s) - oldest) / 3600 ))
+  fi
+  if [ "$n" -gt "$CACHE_MAX_ENTRIES" ] || \
+     [ "$age_h" -gt "$CACHE_MAX_AGE_H" ]; then
+    echo "tier1: wiping stale $CACHE ($n entries, oldest ${age_h}h old" \
+         "> ${CACHE_MAX_ENTRIES}/${CACHE_MAX_AGE_H}h) — multi-session" \
+         "accumulation corrupts the native heap mid-GC (ROADMAP note)"
+    rm -rf "$CACHE"
+  else
+    echo "tier1: $CACHE ok ($n entries, oldest ${age_h}h old)"
+  fi
+fi
 
 VERS=$(JAX_PLATFORMS=cpu python - <<'EOF'
 import importlib.metadata as md
@@ -27,6 +58,8 @@ print(f"jax={v('jax')} jaxlib={v('jaxlib')}")
 EOF
 )
 echo "tier1: $VERS"
+echo "tier1: re-anchor check — re-verify the compile-cache landmine on" \
+     "any jaxlib upgrade from the version above (ROADMAP env note)"
 echo "tier1: landmine note — persistent compile cache + xdist/randomly" \
      "corrupts the native heap on a 2nd in-process paged-backend" \
      "compile; this runner passes -p no:xdist -p no:randomly (immune)." \
