@@ -41,6 +41,8 @@ SERVING_SPEC_DEADLINE_S = float(
     os.environ.get("BENCH_SERVING_SPEC_DEADLINE_S", "240"))
 SERVING_TP_DEADLINE_S = float(
     os.environ.get("BENCH_SERVING_TP_DEADLINE_S", "300"))
+SERVING_QUANT_DEADLINE_S = float(
+    os.environ.get("BENCH_SERVING_QUANT_DEADLINE_S", "300"))
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
 PROBE_DEADLINE_S = float(os.environ.get("BENCH_PROBE_DEADLINE_S", "90"))
@@ -775,7 +777,7 @@ def _run_child(mode: str, deadline: float):
     env = dict(os.environ)
     if mode in ("--child-cpu", "--child-comms", "--child-passes",
                 "--child-observability", "--child-serving-tp",
-                "--child-serving-spec"):
+                "--child-serving-spec", "--child-serving-quant"):
         env["JAX_PLATFORMS"] = "cpu"
     if mode in ("--child-comms", "--child-serving-tp"):
         # simulated 2x4 mesh on the CPU lane
@@ -959,6 +961,30 @@ def _attach_serving_spec(result, budget_s=None):
                          SERVING_SPEC_DEADLINE_S, budget_s)
 
 
+def _child_serving_quant():
+    """serving-quant stage: the bandwidth-true quantized paged engine
+    (int8 KV arena + weight-only int8 decode weights, dequant inside
+    the read/gemm) A/B'd against the fp32 paged engine
+    (serving/microbench.py) — pins quant-vs-fp32 decode tokens/s,
+    bytes-read/step from the metrics registry (~3.5x fewer), both
+    error bounds and the compile-count pin every round. On the CPU
+    lane the tokens/s delta is an overhead record; the HBM-bandwidth
+    win rides the same QuantConfig on the TPU child."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.serving.microbench import run_serving_quant_bench
+    out = run_serving_quant_bench(
+        requests=int(os.environ.get("BENCH_SERVING_QUANT_REQUESTS", "8")),
+        max_new=int(os.environ.get("BENCH_SERVING_QUANT_MAX_NEW", "48")),
+        weights=os.environ.get("BENCH_SERVING_QUANT_WEIGHTS", "int8"))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_serving_quant(result, budget_s=None):
+    return _attach_stage(result, "serving-quant", "--child-serving-quant",
+                         SERVING_QUANT_DEADLINE_S, budget_s)
+
+
 def _child_serving_tp():
     """serving-tp stage: the slot-pool decode block sharded over a
     simulated 2x4 CPU mesh (serving/microbench.py) — pins exact-mode
@@ -1048,6 +1074,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-spec":
         _child_serving_spec()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-quant":
+        _child_serving_quant()
+        return
 
     errors = []
     try:
@@ -1123,7 +1152,8 @@ def _main_measured(errors):
                 result = _attach_passes(result, remaining())
                 result = _attach_observability(result, remaining())
                 result = _attach_serving_tp(result, remaining())
-                _emit_final(_attach_serving_spec(result, remaining()))
+                result = _attach_serving_spec(result, remaining())
+                _emit_final(_attach_serving_quant(result, remaining()))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
             time.sleep(5)
@@ -1146,7 +1176,8 @@ def _main_measured(errors):
         result = _attach_passes(result, remaining())
         result = _attach_observability(result, remaining())
         result = _attach_serving_tp(result, remaining())
-        _emit_final(_attach_serving_spec(result, remaining()))
+        result = _attach_serving_spec(result, remaining())
+        _emit_final(_attach_serving_quant(result, remaining()))
         return
     # last resort: still one JSON line, rc 0, explicit marker
     _emit_final({

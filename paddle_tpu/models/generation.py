@@ -149,6 +149,17 @@ def cached_attention(qv, kv_, vv, ckv, cvv, posv, *, scale, cos=None,
             cv = cvv.at[blk, off].set(vq.astype(cvv.dtype))
             sk = kv_scales[0].at[blk, off].set(ks)
             sv = kv_scales[1].at[blk, off].set(vs)
+            if s == 1 and window is None:
+                # bandwidth-true decode: dequant INSIDE the read
+                # (Pallas int8 kernel on TPU, per-block scan fallback
+                # off-TPU) — the dense fp32 KV transient of the old
+                # dequant-then-gather path never materializes
+                out = _pa.paged_attention_decode_int8(
+                    qv[:, 0], ck, cv, sk, sv, block_table, posv + 1,
+                    scale=scale)
+                return out[:, None].astype(qv.dtype), ck, cv, sk, sv
+            # s > 1 (chunked prefill / speculative verify window):
+            # compute-bound, batch-1-ish — the gathered dequant stays
             k_read = _pa.dequantize_kv(_pa.paged_gather(ck, block_table),
                                        _pa.paged_gather(sk, block_table))
             v_read = _pa.dequantize_kv(_pa.paged_gather(cv, block_table),

@@ -4,7 +4,16 @@
 TPU-native take: int8/int4 weight-only quant keeps HBM traffic down
 (the v5e decode bottleneck); the matmul itself runs bf16/f32 after an
 in-kernel dequant — XLA fuses the dequant multiply into the gemm
-prologue, so there is no separate dequant pass over HBM."""
+prologue, so there is no separate dequant pass over HBM.
+
+``group_size > 0`` switches from per-output-channel scales to
+per-(group, output-channel) scales — ``group_size`` consecutive input
+rows share one absmax bucket, so a channel with one outlier row no
+longer inflates the quantization step of every other row (the
+standard int4 accuracy lever). The raw-array helpers
+(:func:`quantize_array` / :func:`dequantize_array`) are the shared
+kernel the Tensor API and the serving engine's weight-only decode path
+(``serving/quant.py``) both route through."""
 from __future__ import annotations
 
 import jax
@@ -13,7 +22,8 @@ import jax.numpy as jnp
 from ...tensor import Tensor, apply_op
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
-           "llm_int8_linear"]
+           "llm_int8_linear", "quantize_array", "dequantize_array",
+           "quant_step_bound"]
 
 
 def _bits(algo):
@@ -24,70 +34,155 @@ def _bits(algo):
     raise ValueError(f"unsupported weight-quant algo {algo!r}")
 
 
-def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
-    """Per-output-channel absmax symmetric quantization of a (in, out)
-    weight. Returns (int8 quantized weight, float scale per out
-    channel). int4 packs two nibbles per int8 byte like the reference;
-    an odd row count is padded for packing, and the original count is
-    carried on the returned tensor (``_orig_in_features``) so the
-    round-trip can slice the pad back off."""
-    bits = _bits(algo)
-    qmax = 2 ** (bits - 1) - 1
+def _pack_int4(q):
+    """(in, out) int8 codes in [-8, 7] -> (ceil(in/2), out) packed
+    bytes: two consecutive input rows per byte (low nibble = even row).
+    An odd row count is padded; the caller carries the true count."""
+    even, odd = q[::2], q[1::2]
+    if odd.shape[0] < even.shape[0]:
+        odd = jnp.pad(odd, ((0, 1), (0, 0)))
+    return ((even.astype(jnp.uint8) & 0xF)
+            | ((odd.astype(jnp.uint8) & 0xF) << 4)).astype(jnp.int8)
 
-    def f(w):
-        scale = jnp.max(jnp.abs(w), axis=0)                  # (out,)
+
+def _unpack_int4(q, in_features=None):
+    """Inverse of :func:`_pack_int4`; ``in_features`` slices the
+    packing pad back off (odd row counts)."""
+    lo = (q.astype(jnp.uint8) & 0xF).astype(jnp.int8)
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = (q.astype(jnp.uint8) >> 4).astype(jnp.int8)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    n2 = q.shape[0] * 2
+    full = jnp.zeros((n2, q.shape[1]), jnp.int8)
+    full = full.at[::2].set(lo).at[1::2].set(hi)
+    if in_features is not None and in_features < n2:
+        full = full[:in_features]
+    return full
+
+
+def quantize_array(w, bits: int = 8, group_size: int = -1):
+    """Raw-array absmax symmetric quantization of a (in, out) weight:
+    returns (int8 codes — int4 nibble-packed on the in dim — and fp32
+    scales). Scales are ``(out,)`` per-channel, or ``(in//group_size,
+    out)`` when ``group_size > 0`` (which must divide in_features —
+    refused loudly otherwise: silently falling back to per-channel
+    was the PR-2-era bug this signature fixes)."""
+    qmax = 2 ** (bits - 1) - 1
+    w = jnp.asarray(w)
+    rows = int(w.shape[0])
+    if group_size and group_size > 0:
+        if rows % group_size:
+            raise ValueError(
+                f"group_size={group_size} does not divide in_features="
+                f"{rows}; weight-only grouped quantization needs whole "
+                "groups (pad the weight or use per-channel group_size=-1)")
+        gw = w.reshape(rows // group_size, group_size, -1)
+        scale = jnp.max(jnp.abs(gw), axis=1)             # (groups, out)
+        q = jnp.clip(jnp.round(gw / jnp.maximum(scale, 1e-9)[:, None]
+                               * qmax), -qmax - 1, qmax)
+        q = q.reshape(rows, -1).astype(jnp.int8)
+    else:
+        scale = jnp.max(jnp.abs(w), axis=0)              # (out,)
         q = jnp.clip(jnp.round(w / jnp.maximum(scale, 1e-9) * qmax),
                      -qmax - 1, qmax).astype(jnp.int8)
-        if bits == 4:
-            even, odd = q[::2], q[1::2]
-            if odd.shape[0] < even.shape[0]:
-                odd = jnp.pad(odd, ((0, 1), (0, 0)))
-            q = ((even.astype(jnp.uint8) & 0xF) |
-                 ((odd.astype(jnp.uint8) & 0xF) << 4)).astype(jnp.int8)
-        return q, scale
+    if bits == 4:
+        q = _pack_int4(q)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_array(q, scale, bits: int = 8, in_features=None,
+                     out_dtype=jnp.float32):
+    """Raw-array inverse of :func:`quantize_array` (grouped layout
+    detected from ``scale.ndim``). Pure jax — safe inside a jitted
+    program, where XLA fuses the scale multiply into the consumer gemm
+    (the serving decode path's in-gemm dequant)."""
+    qmax = 2 ** (bits - 1) - 1
+    if bits == 4:
+        q = _unpack_int4(q, in_features)
+    qf = q.astype(jnp.float32)
+    if scale.ndim == 2:                                   # grouped
+        groups = scale.shape[0]
+        rows = qf.shape[0]
+        g = rows // groups
+        w = (qf.reshape(groups, g, -1) * scale[:, None, :]
+             / qmax).reshape(rows, -1)
+    else:
+        w = qf * scale / qmax
+    return w.astype(out_dtype)
+
+
+def quant_step_bound(scale, bits: int = 8) -> float:
+    """Worst-case elementwise |dequant - original| of a weight
+    quantized against ``scale``: half the quantization step,
+    max(scale) / qmax / 2 (round-to-nearest). The weight half of the
+    serving engine's ``quant_error_bound()``."""
+    import numpy as np
+    qmax = 2 ** (bits - 1) - 1
+    return float(np.max(np.asarray(scale))) / qmax / 2
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None, group_size=-1):
+    """Per-output-channel (or per-group: ``group_size > 0``) absmax
+    symmetric quantization of a (in, out) weight. Returns (int8
+    quantized weight, float scale — ``(out,)`` per-channel or
+    ``(in//group_size, out)`` grouped). int4 packs two nibbles per int8
+    byte like the reference; an odd row count is padded for packing,
+    and the original count is carried on the returned tensor
+    (``_orig_in_features``) so the round-trip can slice the pad back
+    off."""
+    bits = _bits(algo)
     rows = int(x.shape[0])
-    qw, scale = apply_op(f, x)
+    qw, scale = apply_op(
+        lambda w: quantize_array(w, bits, group_size), x)
     qw._orig_in_features = rows
     return qw, scale
 
 
 def weight_dequantize(x, scale, algo="weight_only_int8",
-                      out_dtype="float32", in_features=None):
-    """Inverse of :func:`weight_quantize`. For int4 the unpacked row
-    count is ``2 * packed`` minus any packing pad: pass
-    ``in_features`` explicitly, or it is read off the
-    ``_orig_in_features`` tag weight_quantize leaves on the tensor
+                      out_dtype="float32", in_features=None,
+                      group_size=-1):
+    """Inverse of :func:`weight_quantize` (the grouped layout is
+    carried by the scale's shape, so ``group_size`` never needs
+    restating). For int4 the unpacked row count is ``2 * packed`` minus
+    any packing pad: pass ``in_features`` explicitly, or it is read off
+    the ``_orig_in_features`` tag weight_quantize leaves on the tensor
     (odd in_features would otherwise come back one row too long)."""
     bits = _bits(algo)
-    qmax = 2 ** (bits - 1) - 1
     if in_features is None:
         in_features = getattr(x, "_orig_in_features", None)
-
-    def f(q, s):
-        if bits == 4:
-            lo = (q.astype(jnp.uint8) & 0xF).astype(jnp.int8)
-            lo = jnp.where(lo >= 8, lo - 16, lo)
-            hi = (q.astype(jnp.uint8) >> 4).astype(jnp.int8)
-            hi = jnp.where(hi >= 8, hi - 16, hi)
-            n2 = q.shape[0] * 2
-            full = jnp.zeros((n2, q.shape[1]), jnp.int8)
-            full = full.at[::2].set(lo).at[1::2].set(hi)
-            q = full
-            if in_features is not None and in_features < n2:
-                q = q[:in_features]
-        return (q.astype(jnp.float32) * s / qmax).astype(out_dtype)
-    return apply_op(f, x, scale)
+    return apply_op(
+        lambda q, s: dequantize_array(q, s, bits, in_features=in_features,
+                                      out_dtype=out_dtype), x, scale)
 
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8", arch=None, group_size=-1):
     """y = x @ dequant(weight) + bias. The dequant multiply stays
-    inside the jitted program so XLA fuses it into the gemm. For int4
-    the activation's feature dim fixes the true row count, so weights
-    with odd in_features multiply correctly even when the packing tag
-    was lost (e.g. a checkpoint round-trip)."""
+    inside the jitted program so XLA fuses it into the gemm. Honors
+    grouped scales (a 2-D ``weight_scale``); a ``group_size > 0``
+    request against per-channel scales is refused instead of silently
+    behaving per-channel. For int4 the activation's feature dim fixes
+    the true row count, so weights with odd in_features multiply
+    correctly even when the packing tag was lost (e.g. a checkpoint
+    round-trip)."""
     algo = "weight_only_int4" if weight_dtype == "int4" \
         else "weight_only_int8"
+    if group_size and group_size > 0 and weight_scale is not None:
+        if len(weight_scale.shape) != 2:
+            raise ValueError(
+                f"weight_only_linear: group_size={group_size} requested "
+                "but weight_scale is per-channel (1-D) — quantize with "
+                "weight_quantize(..., group_size=...) to get per-group "
+                "scales (silently running per-channel would misreport "
+                "the quantization error)")
+        rows = int(x.shape[-1])
+        if int(weight_scale.shape[0]) * group_size != rows:
+            raise ValueError(
+                f"weight_only_linear: group_size={group_size} "
+                f"contradicts the scales' grouping — "
+                f"{int(weight_scale.shape[0])} groups x {group_size} != "
+                f"in_features={rows} (the weight was quantized with a "
+                "different group size)")
     in_f = None
     if weight_dtype == "int4":
         in_f = int(x.shape[-1])

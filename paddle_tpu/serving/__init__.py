@@ -12,6 +12,7 @@ from .engine import (ArtifactStepBackend, ContinuousBatchingEngine,
                      ModelStepBackend, slot_sample_logits)
 from .paging import (BlockManager, PagedArtifactStepBackend, PagedEngine,
                      PagedModelStepBackend)
+from .quant import QuantConfig
 from .resilience import RequestFailure, ResilienceConfig
 from .scheduler import Request, Scheduler
 from .server import Server
@@ -23,9 +24,9 @@ from .tp import (ShardedModelStepBackend, ShardedPagedStepBackend,
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
            "ArtifactStepBackend", "BlockManager",
            "PagedArtifactStepBackend", "PagedEngine",
-           "PagedModelStepBackend", "Request", "RequestFailure",
-           "ResilienceConfig", "Scheduler", "Server", "SpecConfig",
-           "SpecEngine", "SpecModelStepBackend", "SpecPagedEngine",
-           "SpecPagedStepBackend", "ShardedModelStepBackend",
-           "ShardedPagedStepBackend", "TPConfig", "ngram_propose",
-           "slot_sample_logits"]
+           "PagedModelStepBackend", "QuantConfig", "Request",
+           "RequestFailure", "ResilienceConfig", "Scheduler", "Server",
+           "SpecConfig", "SpecEngine", "SpecModelStepBackend",
+           "SpecPagedEngine", "SpecPagedStepBackend",
+           "ShardedModelStepBackend", "ShardedPagedStepBackend",
+           "TPConfig", "ngram_propose", "slot_sample_logits"]

@@ -48,6 +48,18 @@ _M_COMPILES = _om.gauge("pt_engine_decode_compiles",
                         "(static-shape invariant: stays 1)")
 _M_PREFILLS = _om.counter("pt_engine_prefills_total",
                           "prefill dispatches (whole-prompt or chunk)")
+_M_BYTES = _om.counter(
+    "pt_serving_decode_bytes_read_total",
+    "estimated HBM bytes read by decode steps (weights + buffers + "
+    "KV pool, capacity-based — the quant-vs-fp32 A/B numerator)")
+_M_W_BYTES = _om.gauge(
+    "pt_serving_decode_weight_bytes",
+    "weight + buffer bytes one decode step reads (codes + scales "
+    "under weight-only quant)")
+_M_KV_BYTES = _om.gauge(
+    "pt_serving_decode_kv_bytes",
+    "KV pool bytes resident per decode step (codes + scales under "
+    "the int8 arena)")
 
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
            "ArtifactStepBackend", "slot_sample_logits", "init_slot_state",
@@ -260,6 +272,12 @@ class _StepBackendCommon:
     (in-process, paged, AOT) — keyed off ``num_slots``/``pool_specs``
     which each backend sets up."""
 
+    # weight-only quantization state (serving/quant.py): None/empty on
+    # fp32 backends, so every hot path stays one falsy check
+    quant_cfg = None
+    _qmeta = None
+    _weight_bound = 0.0
+
     def init_state(self):
         return init_slot_state(self.num_slots)
 
@@ -270,13 +288,40 @@ class _StepBackendCommon:
                     for shape, dtype in self.pool_specs)
         return total // self.num_slots
 
+    def _setup_weight_quant(self, model, quant):
+        """Quantize the serving weight set in-place (model backends
+        call this between pv construction and program building; see
+        serving/quant.py). No-op when ``quant`` is None."""
+        if quant is None:
+            return
+        from .quant import quantize_backend_params
+        self.quant_cfg = quant
+        self._pv, self._qmeta, self._weight_bound = \
+            quantize_backend_params(model, self._pv, quant)
+
+    def _maybe_quant_pure(self, pure):
+        """Wrap a pure step with the in-graph dequant when this backend
+        holds quantized weights — EVERY program (decode block, prefill,
+        chunk, spec verify) must be built from the wrapped step."""
+        if not self._qmeta:
+            return pure
+        from .quant import wrap_pure_with_dequant
+        return wrap_pure_with_dequant(pure, self._qmeta)
+
+    def param_bytes(self) -> int:
+        """HBM bytes of weights + buffers one decode step reads (codes
+        AND scales under weight-only quant — the wire footprint, which
+        is the point)."""
+        return sum(int(v.nbytes) for v in jax.tree.leaves(self._pv)) \
+            + sum(int(v.nbytes) for v in jax.tree.leaves(self._bv))
+
 
 class ModelStepBackend(_StepBackendCommon):
     """In-process backend: jits the slot block + per-bucket prefills
     over a live model (the same pure step ``generate()`` uses)."""
 
     def __init__(self, model, num_slots: int, max_len: int,
-                 decode_block: int):
+                 decode_block: int, quant=None):
         from ..models.generation import (build_decode_step,
                                          forward_accepts_pad)
         from ..tensor import Tensor
@@ -299,6 +344,11 @@ class ModelStepBackend(_StepBackendCommon):
                                for shape, dtype in self.pool_specs)
         self._pv = [p._value for _, p in model.named_parameters()]
         self._bv = [b._value for _, b in model.named_buffers()]
+        # weight-only quant happens BEFORE any program is built so the
+        # decode block, prefills (and subclasses' chunk/verify programs)
+        # all trace against codes + in-graph dequant
+        self._setup_weight_quant(model, quant)
+        self._pure = self._maybe_quant_pure(self._pure)
         self.decode_traces = [0]
         self._block_jit = jax.jit(
             build_slot_block_fn(self._pure, decode_block,
@@ -440,12 +490,14 @@ class ContinuousBatchingEngine:
                  decode_block: int = 8,
                  prompt_buckets: Optional[Sequence[int]] = None,
                  backend=None, *, paged: Optional[bool] = None,
-                 spec=None, tp=None):
+                 spec=None, tp=None, quant=None):
         if backend is None:
             if model is None:
                 raise ValueError("pass a model or a step backend")
+            from .quant import resolve_quant_config
             from .tp import resolve_tp_config
             tp_cfg = resolve_tp_config(tp)
+            q_cfg = resolve_quant_config(quant)
             if tp_cfg is not None:
                 # tensor-parallel serving: the SAME decode/prefill
                 # programs, sharded over a mesh (serving/tp.py). An
@@ -453,12 +505,25 @@ class ContinuousBatchingEngine:
                 # PT_SERVING_TP env flag — same contract as paged.
                 from .tp import ShardedModelStepBackend
                 backend = ShardedModelStepBackend(
-                    model, num_slots, max_len, decode_block, tp_cfg)
+                    model, num_slots, max_len, decode_block, tp_cfg,
+                    quant=q_cfg)
             else:
                 # subclass hook: the speculative engine swaps in the
                 # verify-capable backend here (serving/spec.py)
                 backend = self._build_backend(model, num_slots, max_len,
-                                              decode_block)
+                                              decode_block, q_cfg)
+        elif quant is not None:
+            # same contract as kv_int8/num_blocks on the paged engine:
+            # the quantization is baked into the backend at construction
+            # — a silently ignored quant= (INCLUDING quant=False against
+            # a quantized backend, which cannot be de-quantized) would
+            # be a misconfiguration, not a preference (and the env knob
+            # never reroutes an explicit backend either: resolution
+            # only runs above)
+            raise ValueError(
+                "quant= cannot be set alongside an explicit backend — "
+                "weight-only quantization is baked into the backend at "
+                "construction")
         if spec and not hasattr(self, "spec_k"):
             # only the factory (ContinuousBatchingEngine(...)) routes
             # spec= to the speculative engine classes; a direct
@@ -484,8 +549,10 @@ class ContinuousBatchingEngine:
         self.tracer = None
         self.reset()
 
-    def _build_backend(self, model, num_slots, max_len, decode_block):
-        return ModelStepBackend(model, num_slots, max_len, decode_block)
+    def _build_backend(self, model, num_slots, max_len, decode_block,
+                       quant=None):
+        return ModelStepBackend(model, num_slots, max_len, decode_block,
+                                quant=quant)
 
     # -- lifecycle ---------------------------------------------------------
     def reset(self):
@@ -498,6 +565,7 @@ class ContinuousBatchingEngine:
         self._remaining_host = np.zeros((self.num_slots,), np.int64)
         self._finished: List[_SlotRun] = []
         self._pending_block = None     # dispatched, not yet harvested
+        self._bytes_step = None        # decode_bytes_per_step memo
         self.steps = 0                # engine decode steps executed
         self.tokens_emitted = 0       # useful tokens (incl. prefill's)
         self.decode_tokens = 0        # live-slot decode steps only
@@ -541,6 +609,57 @@ class ContinuousBatchingEngine:
         if fn is None:
             return 0.0
         return fn(self._cache, self._state)
+
+    def kv_error_bound(self) -> float:
+        """Runtime worst-case |dequant - fp32| over the KV cache — 0.0
+        on the dense engine (fp32 rows); the paged engine's int8 arena
+        overrides this with the EQuARX bound."""
+        return 0.0
+
+    def weight_error_bound(self) -> float:
+        """Build-time worst-case elementwise |dequant - fp32| over the
+        weight-only-quantized decode weights (half the largest
+        quantization step; 0.0 when quant is off)."""
+        return float(getattr(self.backend, "_weight_bound", 0.0))
+
+    def quant_error_bound(self) -> dict:
+        """Both quantization error components of the decode path, from
+        the live engine: ``{"kv": ..., "weights": ...}`` (each 0.0 when
+        that half is off). Also refreshes the
+        ``pt_serving_{kv,weight}_error_bound`` gauges, so a scrape
+        after any call carries the current bounds."""
+        kv, w = self.kv_error_bound(), self.weight_error_bound()
+        if _om.enabled():
+            from .quant import _M_KV_BOUND, _M_W_BOUND
+            _M_KV_BOUND.set(kv)
+            _M_W_BOUND.set(w)
+        return {"kv": kv, "weights": w}
+
+    def decode_bytes_per_step(self) -> dict:
+        """Estimated HBM bytes ONE decode step reads:
+        ``{"weights": ..., "kv": ..., "total": ...}`` — every
+        weight/buffer byte (codes + scales under weight-only quant)
+        plus the KV pool's resident bytes (codes + scales under the
+        int8 arena). Capacity-based: the paged read only touches live
+        blocks, so the kv term is an upper bound — but it is the term
+        quantization shrinks, which is what the A/B measures."""
+        if self._bytes_step is None:
+            w = self.backend.param_bytes() \
+                if hasattr(self.backend, "param_bytes") else 0
+            kv = sum(int(c.nbytes) for c in self._cache)
+            self._bytes_step = {"weights": w, "kv": kv,
+                                "total": w + kv}
+        return self._bytes_step
+
+    def _note_decode_bytes(self, steps: int):
+        """Metrics hook on the decode dispatch path (one enabled-check
+        when metrics are off)."""
+        if not _om.enabled():
+            return
+        b = self.decode_bytes_per_step()
+        _M_BYTES.inc(b["total"] * steps)
+        _M_W_BYTES.set(b["weights"])
+        _M_KV_BYTES.set(b["kv"])
 
     def bucket_len(self, prompt_len: int) -> int:
         if self.prompt_buckets is None:
@@ -672,6 +791,7 @@ class ContinuousBatchingEngine:
             self.slot_steps += self.decode_block * self.num_slots
             _M_STEPS.inc(self.decode_block)
             _M_COMPILES.set(self.backend.decode_traces[0])
+            self._note_decode_bytes(self.decode_block)
         faults.fault_point("serving.harvest")
         toks, lives, oks = self._pending_block
         toks_np = np.asarray(toks)                  # ONE host sync/block

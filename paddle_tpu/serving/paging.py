@@ -282,7 +282,7 @@ class PagedModelStepBackend(ModelStepBackend):
 
     def __init__(self, model, num_slots: int, max_len: int,
                  decode_block: int, block_size: int, num_blocks: int,
-                 kv_int8: bool, prefill_chunk: int):
+                 kv_int8: bool, prefill_chunk: int, quant=None):
         from ..models.generation import (build_decode_step,
                                          forward_accepts_block_table,
                                          forward_accepts_pad)
@@ -318,6 +318,10 @@ class PagedModelStepBackend(ModelStepBackend):
                                 for c in flat)
         self._pv = [p._value for _, p in model.named_parameters()]
         self._bv = [b._value for _, b in model.named_buffers()]
+        # weight-only quant BEFORE the decode-block and chunk programs
+        # are built (serving/quant.py)
+        self._setup_weight_quant(model, quant)
+        self._pure = self._maybe_quant_pure(self._pure)
         self.decode_traces = [0]
         self.prefill_traces = [0]
         self._block_jit = jax.jit(
@@ -473,19 +477,20 @@ class PagedEngine(ContinuousBatchingEngine):
                  num_blocks: Optional[int] = None,
                  kv_int8: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
-                 hash_fn=None, tp=None):
+                 hash_fn=None, tp=None, quant=None):
         if prompt_buckets is not None:
             raise ValueError(
                 "paged mode takes no prompt_buckets: prompts are "
                 "unpadded and prefilled in fixed-size chunks")
         if backend is not None:
             # the backend already baked these in — a silently ignored
-            # kv_int8=True (fp32 arena, bound 0.0) or num_blocks would
-            # be a misconfiguration, not a preference
+            # kv_int8=True (fp32 arena, bound 0.0), num_blocks or
+            # quant= would be a misconfiguration, not a preference
             given = {k: v for k, v in (("block_size", block_size),
                                        ("num_blocks", num_blocks),
                                        ("kv_int8", kv_int8),
-                                       ("prefill_chunk", prefill_chunk))
+                                       ("prefill_chunk", prefill_chunk),
+                                       ("quant", quant))
                      if v is not None}
             if given:
                 raise ValueError(
@@ -507,8 +512,10 @@ class PagedEngine(ContinuousBatchingEngine):
         if backend is None:
             if model is None:
                 raise ValueError("pass a model or a paged step backend")
+            from .quant import resolve_quant_config
             from .tp import resolve_tp_config
             tp_cfg = resolve_tp_config(tp)
+            q_cfg = resolve_quant_config(quant)
             if tp_cfg is not None:
                 # tensor-parallel paged serving: the shared KV arena
                 # shards its kv-head dim over the mesh (serving/tp.py);
@@ -517,13 +524,13 @@ class PagedEngine(ContinuousBatchingEngine):
                 backend = ShardedPagedStepBackend(
                     model, num_slots, max_len, decode_block,
                     block_size, num_blocks, bool(kv_int8),
-                    prefill_chunk, tp_cfg)
+                    prefill_chunk, tp_cfg, quant=q_cfg)
             else:
                 # subclass hook: the speculative engine swaps in the
                 # verify-capable paged backend here (serving/spec.py)
                 backend = self._build_paged_backend(
                     model, num_slots, max_len, decode_block, block_size,
-                    num_blocks, bool(kv_int8), prefill_chunk)
+                    num_blocks, bool(kv_int8), prefill_chunk, q_cfg)
         self.kv_block_size = backend.kv_block_size
         self.num_kv_blocks = backend.num_kv_blocks
         self.max_blocks = backend.max_blocks
@@ -536,10 +543,10 @@ class PagedEngine(ContinuousBatchingEngine):
 
     def _build_paged_backend(self, model, num_slots, max_len,
                              decode_block, block_size, num_blocks,
-                             kv_int8, prefill_chunk):
+                             kv_int8, prefill_chunk, quant=None):
         return PagedModelStepBackend(
             model, num_slots, max_len, decode_block, block_size,
-            num_blocks, kv_int8, prefill_chunk)
+            num_blocks, kv_int8, prefill_chunk, quant=quant)
 
     # -- lifecycle ---------------------------------------------------------
     def reset(self):

@@ -295,8 +295,11 @@ class _SpecBackendMixin:
         from ..models.generation import build_decode_step
         self.spec = spec
         self.spec_k = spec.k
-        verify = build_decode_step(model, None, self._tree_holder,
-                                   all_positions=True)
+        # the verify head dequantizes the same weight codes as the
+        # plain block under weight-only quant (no-op wrapper otherwise)
+        verify = self._maybe_quant_pure(
+            build_decode_step(model, None, self._tree_holder,
+                              all_positions=True))
         self._spec_jit = jax.jit(
             build_spec_block_fn(verify, spec.k, self.decode_traces,
                                 paged=paged),
@@ -313,8 +316,9 @@ class SpecModelStepBackend(_SpecBackendMixin, ModelStepBackend):
     """Dense slot-pool backend with the (S, k+1) verify program."""
 
     def __init__(self, model, num_slots: int, max_len: int,
-                 decode_block: int, spec: SpecConfig):
-        super().__init__(model, num_slots, max_len, decode_block)
+                 decode_block: int, spec: SpecConfig, quant=None):
+        super().__init__(model, num_slots, max_len, decode_block,
+                         quant=quant)
         self._setup_spec(model, spec, paged=False)
 
 
@@ -324,9 +328,11 @@ class SpecPagedStepBackend(_SpecBackendMixin, PagedModelStepBackend):
 
     def __init__(self, model, num_slots: int, max_len: int,
                  decode_block: int, block_size: int, num_blocks: int,
-                 kv_int8: bool, prefill_chunk: int, spec: SpecConfig):
+                 kv_int8: bool, prefill_chunk: int, spec: SpecConfig,
+                 quant=None):
         super().__init__(model, num_slots, max_len, decode_block,
-                         block_size, num_blocks, kv_int8, prefill_chunk)
+                         block_size, num_blocks, kv_int8, prefill_chunk,
+                         quant=quant)
         self._setup_spec(model, spec, paged=True)
 
 
@@ -460,6 +466,7 @@ class _SpecEngineMixin:
             self.slot_steps += self.num_slots * (self.spec_k + 1)
             _M_STEPS.inc()
             _M_COMPILES.set(self.backend.decode_traces[0])
+            self._note_decode_bytes(1)
             _M_SPEC_STEPS.inc()
             _M_SPEC_DRAFTED.inc(proposed)
         faults.fault_point("serving.harvest")
@@ -527,7 +534,7 @@ class SpecEngine(_SpecEngineMixin, ContinuousBatchingEngine):
                  max_len: int = 256, decode_block: int = 8,
                  prompt_buckets: Optional[Sequence[int]] = None,
                  backend=None, *, paged: Optional[bool] = None,
-                 spec=None, tp=None):
+                 spec=None, tp=None, quant=None):
         if paged:
             # same loud-refusal rule as spec= on a direct subclass
             # ctor: silently serving DENSE from a paged= request would
@@ -538,11 +545,14 @@ class SpecEngine(_SpecEngineMixin, ContinuousBatchingEngine):
                 "spec=...) or SpecPagedEngine for the paged one")
         self._init_spec(spec, backend, tp)
         super().__init__(model, num_slots, max_len, decode_block,
-                         prompt_buckets, backend, paged=False)
+                         prompt_buckets, backend, paged=False,
+                         quant=quant)
 
-    def _build_backend(self, model, num_slots, max_len, decode_block):
+    def _build_backend(self, model, num_slots, max_len, decode_block,
+                       quant=None):
         return SpecModelStepBackend(model, num_slots, max_len,
-                                    decode_block, self.spec)
+                                    decode_block, self.spec,
+                                    quant=quant)
 
 
 class SpecPagedEngine(_SpecEngineMixin, PagedEngine):
@@ -560,7 +570,7 @@ class SpecPagedEngine(_SpecEngineMixin, PagedEngine):
                  num_blocks: Optional[int] = None,
                  kv_int8: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
-                 hash_fn=None, tp=None):
+                 hash_fn=None, tp=None, quant=None):
         if paged is not None and not paged:
             raise ValueError(
                 "SpecPagedEngine is the paged speculative engine — use "
@@ -571,12 +581,12 @@ class SpecPagedEngine(_SpecEngineMixin, PagedEngine):
                          prompt_buckets, backend, paged=True,
                          block_size=block_size, num_blocks=num_blocks,
                          kv_int8=kv_int8, prefill_chunk=prefill_chunk,
-                         hash_fn=hash_fn)
+                         hash_fn=hash_fn, quant=quant)
 
     def _build_paged_backend(self, model, num_slots, max_len,
                              decode_block, block_size, num_blocks,
-                             kv_int8, prefill_chunk):
+                             kv_int8, prefill_chunk, quant=None):
         return SpecPagedStepBackend(model, num_slots, max_len,
                                     decode_block, block_size,
                                     num_blocks, kv_int8, prefill_chunk,
-                                    self.spec)
+                                    self.spec, quant=quant)

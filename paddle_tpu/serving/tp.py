@@ -234,6 +234,24 @@ class _TPBackendMixin:
                 raise ValueError(
                     f"{attr}={hv} not divisible by TP degree {d} — "
                     "head-axis sharding needs whole heads per device")
+        if self._qmeta:
+            # weight-only quant composes with the exact layout only:
+            # per-shard scales ride the weight's out-dim axes (a
+            # row-sharded psum weight would split int4 nibble packing
+            # and group boundaries on the in dim — refused, not
+            # silently de-quantized)
+            if tp.mode != "exact":
+                raise NotImplementedError(
+                    "weight-only serving quant composes with tp "
+                    "mode='exact' only — row-parallel (psum) shards "
+                    "split the quantized in dim; drop quant= or use "
+                    "mode='exact'")
+            from .quant import scale_pspec
+            for i in self._qmeta:
+                scales = self._pv[i][1]
+                self._pv_pspecs[i] = (self._pv_pspecs[i],
+                                      scale_pspec(self._pv_pspecs[i],
+                                                  scales))
         # the KV cache shards its kv-head dim (dim 2 of every pool leaf,
         # 4D arenas/rows and 3D int8 scale arrays alike)
         for shape, _ in self.pool_specs:
@@ -248,8 +266,14 @@ class _TPBackendMixin:
         self._state_pspecs = jax.tree.map(lambda _: P(),
                                           super().init_state())
         # shard-commit the weights once (uncommitted arrays would be
-        # re-laid-out on every dispatch)
-        self._pv = [jax.device_put(v, NamedSharding(mesh, s))
+        # re-laid-out on every dispatch; quantized entries are
+        # (codes, scales) tuples with matching spec tuples)
+        def _commit(v, s):
+            if isinstance(s, tuple) and not isinstance(s, P):
+                return tuple(jax.device_put(a, NamedSharding(mesh, ps))
+                             for a, ps in zip(v, s))
+            return jax.device_put(v, NamedSharding(mesh, s))
+        self._pv = [_commit(v, s)
                     for v, s in zip(self._pv, self._pv_pspecs)]
         self._bv = [jax.device_put(v, NamedSharding(mesh, P()))
                     for v in self._bv]
@@ -425,8 +449,9 @@ class ShardedModelStepBackend(_TPBackendMixin, ModelStepBackend):
     bit-identical to :class:`ModelStepBackend` on one chip."""
 
     def __init__(self, model, num_slots: int, max_len: int,
-                 decode_block: int, tp: TPConfig):
-        super().__init__(model, num_slots, max_len, decode_block)
+                 decode_block: int, tp: TPConfig, quant=None):
+        super().__init__(model, num_slots, max_len, decode_block,
+                         quant=quant)
         self._setup_tp(model, tp)
         # local-shape row specs: the prefill program zero-fills its
         # fresh cache row INSIDE shard_map, where shapes are per-device
@@ -479,10 +504,12 @@ class ShardedPagedStepBackend(_TPBackendMixin, PagedModelStepBackend):
 
     def __init__(self, model, num_slots: int, max_len: int,
                  decode_block: int, block_size: int, num_blocks: int,
-                 kv_int8: bool, prefill_chunk: int, tp: TPConfig):
+                 kv_int8: bool, prefill_chunk: int, tp: TPConfig,
+                 quant=None):
         from .engine import build_paged_chunk_fn
         super().__init__(model, num_slots, max_len, decode_block,
-                         block_size, num_blocks, kv_int8, prefill_chunk)
+                         block_size, num_blocks, kv_int8, prefill_chunk,
+                         quant=quant)
         self._setup_tp(model, tp)
         self._block_jit = self._shard_jit(
             build_slot_block_fn(self._pure, self.block_size,

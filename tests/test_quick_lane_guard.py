@@ -21,7 +21,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
                  "tests/test_resilience.py", "tests/test_observability.py",
-                 "tests/test_serving_tp.py", "tests/test_serving_spec.py"]
+                 "tests/test_serving_tp.py", "tests/test_serving_spec.py",
+                 "tests/test_serving_quant.py",
+                 "tests/test_sparse_quant.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -86,6 +88,27 @@ REQUIRED_NODES = [
     "test_stub_kill_restore_round_trip",
     "test_serving_paged.py::TestPagedArtifact::"
     "test_stub_paged_backend_routes_and_serves",
+    # PR 10 bandwidth-true quantization pins: in-read int8-KV parity
+    # vs the dequant-then-dense oracle (kernel interpret + CPU
+    # fallback), the no-dense-fp32-KV-transient jaxpr walk, the
+    # weight-quant bit-identity-to-dequantized-twin contract, and the
+    # quant routing matrix
+    "test_serving_quant.py::TestInt8KVInRead::"
+    "test_interpret_kernel_matches_oracle",
+    "test_serving_quant.py::TestInt8KVInRead::"
+    "test_cpu_fallback_matches_oracle",
+    "test_serving_quant.py::TestInt8KVInRead::"
+    "test_quantized_decode_holds_no_dense_fp32_kv",
+    "test_serving_quant.py::TestInt8KVInRead::"
+    "test_int8_engine_stream_matches_oracle_route",
+    "test_serving_quant.py::TestWeightOnlyServing::"
+    "test_int8_dense_stream_bit_identical_to_dequant_twin",
+    "test_serving_quant.py::TestWeightOnlyServing::"
+    "test_paged_kv_int8_plus_weight_int8",
+    "test_serving_quant.py::TestQuantRouting::"
+    "test_env_flag_never_reroutes_explicit_backend",
+    "test_sparse_quant.py::TestWeightOnlyQuant::"
+    "test_grouped_roundtrip_and_linear",
 ]
 
 

@@ -230,6 +230,84 @@ class TestWeightOnlyQuant:
             weight_only_linear(bad_x, qw2, weight_scale=sc,
                                weight_dtype="int4")
 
+    def test_grouped_roundtrip_and_linear(self):
+        """group_size > 0 is HONORED (per-group scales, not a silent
+        per-channel fallback): the scale shape carries the groups, the
+        round-trip respects per-group steps, and a weight whose rows
+        have wildly different dynamic ranges per group reconstructs
+        strictly better grouped than per-channel."""
+        from paddle_tpu.nn.quant import (weight_quantize,
+                                         weight_dequantize,
+                                         weight_only_linear)
+        rs = np.random.RandomState(3)
+        # rows 0..7 tiny, rows 8..15 ~100x: one per-channel absmax
+        # flattens the tiny half to ~zero codes
+        wv = np.concatenate([0.01 * rs.randn(8, 6),
+                             1.0 * rs.randn(8, 6)]).astype(np.float32)
+        w = paddle.to_tensor(wv)
+        x = paddle.to_tensor(rs.randn(4, 16).astype(np.float32))
+        ref = x.numpy() @ wv
+        for algo, dtype in (("weight_only_int8", "int8"),
+                            ("weight_only_int4", "int4")):
+            qg, sg = weight_quantize(w, algo=algo, group_size=8)
+            assert tuple(sg.shape) == (2, 6)       # (groups, out)
+            wg = weight_dequantize(qg, sg, algo=algo)
+            assert tuple(wg.shape) == (16, 6)
+            qc, sc = weight_quantize(w, algo=algo)
+            wc = weight_dequantize(qc, sc, algo=algo)
+            # the tiny rows share the outlier rows' per-channel step;
+            # their own group gives them a ~100x finer one
+            err_g = np.abs(wg.numpy() - wv)[:8].max()
+            err_c = np.abs(wc.numpy() - wv)[:8].max()
+            assert err_g < err_c / 10
+            yg = weight_only_linear(x, qg, weight_scale=sg,
+                                    weight_dtype=dtype, group_size=8)
+            yd = x.numpy() @ wg.numpy()            # gemm == x @ dequant
+            np.testing.assert_allclose(yg.numpy(), yd, rtol=2e-5,
+                                       atol=2e-5)
+            rel = np.abs(yg.numpy() - ref).max() / np.abs(ref).max()
+            assert rel < (0.02 if dtype == "int8" else 0.35)
+
+    def test_grouped_int4_odd_in_features(self):
+        """Odd in_features with an odd group size that divides it: the
+        int4 packing pad and group boundaries coexist (round-trip shape
+        exact, gemm parity against the dequantized weight)."""
+        from paddle_tpu.nn.quant import (weight_quantize,
+                                         weight_dequantize,
+                                         weight_only_linear)
+        rs = np.random.RandomState(4)
+        w = paddle.to_tensor(rs.randn(15, 4).astype(np.float32))
+        x = paddle.to_tensor(rs.randn(3, 15).astype(np.float32))
+        qw, sc = weight_quantize(w, algo="weight_only_int4",
+                                 group_size=5)
+        assert qw.shape[0] == 8                    # ceil(15/2)
+        assert tuple(sc.shape) == (3, 4)
+        wd = weight_dequantize(qw, sc, algo="weight_only_int4")
+        assert tuple(wd.shape) == (15, 4)
+        y = weight_only_linear(x, qw, weight_scale=sc,
+                               weight_dtype="int4", group_size=5)
+        np.testing.assert_allclose(y.numpy(), x.numpy() @ wd.numpy(),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grouped_misuse_refused(self):
+        """group_size not dividing in_features, and a group_size
+        request against per-channel scales, both refuse loudly."""
+        from paddle_tpu.nn.quant import (weight_quantize,
+                                         weight_only_linear)
+        rs = np.random.RandomState(5)
+        w = paddle.to_tensor(rs.randn(16, 4).astype(np.float32))
+        x = paddle.to_tensor(rs.randn(2, 16).astype(np.float32))
+        with pytest.raises(ValueError, match="does not divide"):
+            weight_quantize(w, group_size=5)
+        qw, sc = weight_quantize(w)                # per-channel scales
+        with pytest.raises(ValueError, match="per-channel"):
+            weight_only_linear(x, qw, weight_scale=sc, group_size=8)
+        # a group_size that contradicts the scales' actual grouping is
+        # refused too, not silently served with the quantized layout
+        qg, sg = weight_quantize(w, group_size=8)  # (2, 4) scales
+        with pytest.raises(ValueError, match="contradicts"):
+            weight_only_linear(x, qg, weight_scale=sg, group_size=4)
+
     def test_bias_and_llm_int8(self):
         from paddle_tpu.nn.quant import (weight_quantize,
                                          weight_only_linear,
