@@ -43,6 +43,10 @@ SERVING_TP_DEADLINE_S = float(
     os.environ.get("BENCH_SERVING_TP_DEADLINE_S", "300"))
 SERVING_QUANT_DEADLINE_S = float(
     os.environ.get("BENCH_SERVING_QUANT_DEADLINE_S", "300"))
+SERVING_MEGA_DEADLINE_S = float(
+    os.environ.get("BENCH_SERVING_MEGA_DEADLINE_S", "300"))
+AUTOTUNE_DEADLINE_S = float(
+    os.environ.get("BENCH_AUTOTUNE_DEADLINE_S", "300"))
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
 PROBE_DEADLINE_S = float(os.environ.get("BENCH_PROBE_DEADLINE_S", "90"))
@@ -608,6 +612,11 @@ def _child_tpu():
             if err:
                 errors.append(err)
             if sp is not None:
+                # attribute the A/B to the block config that produced
+                # it (tuned/env/default + effective sizes) — the
+                # autotune-era contract for sdpa numbers
+                from paddle_tpu.ops.pallas import flash_attention as _fa
+                sp["sdpa_block_choice"] = _fa.last_block_choice()
                 big["sdpa_ab"] = {"jax_flash": big["mfu"],
                                   "splash": sp["mfu"]}
                 if sp["mfu"] > big["mfu"]:
@@ -674,6 +683,27 @@ def _child_tpu():
             errors.append(err)
         decode.update(sp_dec if sp_dec is not None
                       else {"serving_spec_speedup": None})
+        _release_hbm()
+        # fused decode-layer megakernel on the REAL chip: the Pallas
+        # decode-layer kernel dispatches here (kernel_calls > 0), so
+        # the tokens/s delta is the HBM-round-trip win, not overhead
+        from paddle_tpu.serving.microbench import \
+            run_serving_megakernel_bench
+        mega, err = _staged(run_serving_megakernel_bench,
+                            "serving-megakernel")
+        if err:
+            errors.append(err)
+        decode.update(mega if mega is not None
+                      else {"serving_megakernel_bit_identical": None})
+        _release_hbm()
+        # block-size autotune sweep on the REAL chip (flash/splash
+        # blocks + the CPU-honest knobs, persisted per device kind)
+        from paddle_tpu.ops.pallas.autotune import run_autotune
+        tune, err = _staged(run_autotune, "autotune")
+        if err:
+            errors.append(err)
+        decode.update(tune if tune is not None
+                      else {"autotune_entries": None})
         _emit(small, big, decode, errors)
         if small is None and big is None:
             raise RuntimeError("every config failed: " + "; ".join(errors))
@@ -777,7 +807,8 @@ def _run_child(mode: str, deadline: float):
     env = dict(os.environ)
     if mode in ("--child-cpu", "--child-comms", "--child-passes",
                 "--child-observability", "--child-serving-tp",
-                "--child-serving-spec", "--child-serving-quant"):
+                "--child-serving-spec", "--child-serving-quant",
+                "--child-serving-megakernel", "--child-autotune"):
         env["JAX_PLATFORMS"] = "cpu"
     if mode in ("--child-comms", "--child-serving-tp"):
         # simulated 2x4 mesh on the CPU lane
@@ -985,6 +1016,56 @@ def _attach_serving_quant(result, budget_s=None):
                          SERVING_QUANT_DEADLINE_S, budget_s)
 
 
+def _child_serving_megakernel():
+    """serving-megakernel stage: the decode-layer fusion pass + fused
+    decode-layer call (passes/fusion_decode.py +
+    ops/pallas/decode_layer.py) A/B'd against the plain paged+int8-KV
+    engine (serving/microbench.py) — pins fused-vs-unfused bit-identity,
+    tokens/s, the no-hidden-state-transient jaxpr walk, the per-layer
+    rewrite count and the compile-count pin every round. On the CPU
+    lane the fused body is the captured unfused jaxpr (structure pin);
+    the VMEM-residency win rides the same flag on the TPU child, where
+    the Pallas megakernel dispatches."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.serving.microbench import run_serving_megakernel_bench
+    out = run_serving_megakernel_bench(
+        requests=int(os.environ.get("BENCH_SERVING_MEGA_REQUESTS", "8")),
+        max_new=int(os.environ.get("BENCH_SERVING_MEGA_MAX_NEW", "32")))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_serving_megakernel(result, budget_s=None):
+    return _attach_stage(result, "serving-megakernel",
+                         "--child-serving-megakernel",
+                         SERVING_MEGA_DEADLINE_S, budget_s)
+
+
+def _child_autotune():
+    """autotune stage: the Pallas block-size sweep harness
+    (ops/pallas/autotune.py) — sweeps every knob that is honest on this
+    backend (xent vocab-chunk + paged arena block size on any lane;
+    flash/splash blocks only where the kernels dispatch), persists the
+    provenance-stamped table, and PROVES a kernel reads it at trace
+    time (the xent chunk cap re-derived through the production lookup).
+    Also records the effective flash block-choice attribution so sdpa
+    A/Bs are attributable to a config, not a guess."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    from paddle_tpu.ops.pallas.autotune import run_autotune
+    out = run_autotune(
+        rows=int(os.environ.get("BENCH_AUTOTUNE_ROWS", "256")),
+        vocab=int(os.environ.get("BENCH_AUTOTUNE_VOCAB", "8192")))
+    out["autotune_flash_block_choice"] = fa.last_block_choice()
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_autotune(result, budget_s=None):
+    return _attach_stage(result, "autotune", "--child-autotune",
+                         AUTOTUNE_DEADLINE_S, budget_s)
+
+
 def _child_serving_tp():
     """serving-tp stage: the slot-pool decode block sharded over a
     simulated 2x4 CPU mesh (serving/microbench.py) — pins exact-mode
@@ -1077,6 +1158,12 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-quant":
         _child_serving_quant()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-megakernel":
+        _child_serving_megakernel()
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-autotune":
+        _child_autotune()
+        return
 
     errors = []
     try:
@@ -1153,7 +1240,9 @@ def _main_measured(errors):
                 result = _attach_observability(result, remaining())
                 result = _attach_serving_tp(result, remaining())
                 result = _attach_serving_spec(result, remaining())
-                _emit_final(_attach_serving_quant(result, remaining()))
+                result = _attach_serving_quant(result, remaining())
+                result = _attach_serving_megakernel(result, remaining())
+                _emit_final(_attach_autotune(result, remaining()))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
             time.sleep(5)
@@ -1177,7 +1266,9 @@ def _main_measured(errors):
         result = _attach_observability(result, remaining())
         result = _attach_serving_tp(result, remaining())
         result = _attach_serving_spec(result, remaining())
-        _emit_final(_attach_serving_quant(result, remaining()))
+        result = _attach_serving_quant(result, remaining())
+        result = _attach_serving_megakernel(result, remaining())
+        _emit_final(_attach_autotune(result, remaining()))
         return
     # last resort: still one JSON line, rc 0, explicit marker
     _emit_final({

@@ -330,3 +330,6 @@ counter("pt_passes_eqns_removed_total",
         "jaxpr equations removed, by pass", labels=("pass",))
 counter("pt_passes_rewrites_total",
         "fusion-rule rewrites applied, by rule", labels=("rule",))
+counter("pt_autotune_lookups_total",
+        "autotune-table lookups by kernel and result (hit/miss/stale)",
+        labels=("kernel", "result"))

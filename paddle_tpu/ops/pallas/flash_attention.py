@@ -364,20 +364,57 @@ def flash_block(q, k, v, is_causal=False, scale=None, window=None):
     return fb(q, k, v)
 
 
-def _jax_flash_blocks(jfa, sq, sk):
+# the effective block choice of the most recent tiled-kernel dispatch:
+# {"kernel", "source": "env"|"tuned"|"default", "block_q", "block_kv"}
+# — recorded so bench A/Bs can ATTRIBUTE a number to the block config
+# that produced it instead of guessing from the environment
+LAST_BLOCK_CHOICE = {"kernel": "none", "source": "default",
+                     "block_q": None, "block_kv": None}
+
+
+def last_block_choice() -> dict:
+    return dict(LAST_BLOCK_CHOICE)
+
+
+def _block_pref(env_name: str, kernel: str, seq: int, dim: int,
+                default: int = 512):
+    """Resolve a kernel's preferred block size: explicit env override
+    (routed through utils/flags.env_int, 0 = kernel defaults) beats a
+    valid autotune-table entry beats the PROFILE_r03 default (512).
+    Returns (pref, source)."""
+    import os
+    if os.environ.get(env_name) is not None:
+        return env_int(env_name, default), "env"
+    from .autotune import lookup
+    cfg = lookup("flash_attention", {"seq": seq, "dim": dim})
+    if cfg and int(cfg.get("block_kv", 0)) > 0:
+        return int(cfg["block_kv"]), "tuned"
+    return default, "default"
+
+
+def _note_blocks(kernel, source, bq, bk):
+    LAST_BLOCK_CHOICE.update(kernel=kernel, source=source, block_q=bq,
+                             block_kv=bk)
+
+
+def _jax_flash_blocks(jfa, sq, sk, dim=128):
     """Block sizes for jax's TPU flash kernel. The kernel's built-in
     default is 128 everywhere; PROFILE_r03 (v5e, b32 h16 s1024 d64)
     measured the three 128-block kernels at 53% of device self-time for
     ~14% of step FLOPs. Bigger tiles amortize the HBM traffic per score
-    tile — FLASH_BLOCKS_r03.json records the on-chip sweep; 512 wins.
+    tile — FLASH_BLOCKS_r03.json records the on-chip sweep; 512 wins,
+    unless the autotune table holds a fresher per-device winner.
     Env overrides: PT_JAX_FLASH_BLOCK (kv block), PT_JAX_FLASH_BLOCK_Q.
     Returns None (= kernel default) when the sequence doesn't tile."""
-    pref = env_int("PT_JAX_FLASH_BLOCK", 512)
+    pref, source = _block_pref("PT_JAX_FLASH_BLOCK", "jax_flash", sk,
+                               dim)
     pref_q = env_int("PT_JAX_FLASH_BLOCK_Q", pref)
     bq = _pick_block(sq, min(pref_q, sq))
     bk = _pick_block(sk, min(pref, sk))
     if bq is None or bk is None or (bq <= 128 and bk <= 128):
+        _note_blocks("jax_flash", source, None, None)
         return None
+    _note_blocks("jax_flash", source, bq, bk)
     return jfa.BlockSizes(
         block_q=bq, block_k_major=bk, block_k=bk, block_b=1,
         block_q_major_dkv=bq, block_k_major_dkv=bk, block_k_dkv=bk,
@@ -397,7 +434,7 @@ def _jax_tpu_flash(q, k, v, is_causal, scale):
         return None
     if k.shape[2] != q.shape[2]:
         return None
-    blocks = _jax_flash_blocks(jfa, q.shape[1], k.shape[1])
+    blocks = _jax_flash_blocks(jfa, q.shape[1], k.shape[1], q.shape[3])
     try:
         out = jfa.flash_attention(
             jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
@@ -445,11 +482,14 @@ def _splash_attention(q, k, v, is_causal, scale, window=None):
     # splash's built-in default is 128-tiles everywhere — the same
     # tiling PROFILE_r03 measured at 53% of step time on the jax flash
     # kernel; hand it 512-class tiles when the sequence tiles
-    # (PT_SPLASH_BLOCK overrides, 0 = kernel defaults)
-    pref = env_int("PT_SPLASH_BLOCK", 512)
+    # (PT_SPLASH_BLOCK overrides via utils/flags.env_int, 0 = kernel
+    # defaults; a valid autotune-table entry beats the 512 default)
+    pref, source = _block_pref("PT_SPLASH_BLOCK", "splash", sk, d)
     blocks = None
     bq = _pick_block(sq, min(pref, sq)) if pref else None
     bk = _pick_block(sk, min(pref, sk)) if pref else None
+    _note_blocks("splash", source, bq if bq and bk else None,
+                 bk if bq and bk else None)
     if bq and bk and (bq > 128 or bk > 128):
         blocks = sak.BlockSizes(
             block_q=bq, block_kv=bk, block_kv_compute=bk,

@@ -38,8 +38,25 @@ __all__ = ["softmax_xent_rows", "softmax_xent_rows_reference"]
 _NEG = -1e30
 
 
-def _best_chunk(v: int, cap: int = 4096) -> int:
-    """Largest divisor of ``v`` that is <= cap (prefers >= 128)."""
+def _tuned_chunk_cap(v: int, default: int = 4096) -> int:
+    """The fallback's chunk cap: the autotune table's winner for this
+    vocab on this device kind when a valid (stamp-matching) entry
+    exists, the documented 4096 otherwise — regression-pinned in
+    tests/test_autotune.py."""
+    from .autotune import lookup
+    cfg = lookup("xent", {"vocab": v})
+    if cfg:
+        cap = int(cfg.get("chunk_cap", 0))
+        if cap > 0:
+            return cap
+    return default
+
+
+def _best_chunk(v: int, cap: int = None) -> int:
+    """Largest divisor of ``v`` that is <= cap (prefers >= 128).
+    ``cap=None`` consults the autotune table (fallback 4096)."""
+    if cap is None:
+        cap = _tuned_chunk_cap(v)
     for c in range(min(v, cap), 127, -1):
         if v % c == 0:
             return c
@@ -50,9 +67,9 @@ def _best_chunk(v: int, cap: int = 4096) -> int:
 # chunked-scan fallback (CPU / non-aligned shapes): (N, chunk) transients
 # ---------------------------------------------------------------------------
 
-def _rows_scan_fwd(x, labels):
+def _rows_scan_fwd(x, labels, chunk_cap=None):
     n, v = x.shape
-    c = _best_chunk(v)
+    c = _best_chunk(v, chunk_cap)
     if c == v:
         xf = x.astype(jnp.float32)
         m = jnp.max(xf, axis=-1)

@@ -29,8 +29,108 @@ import time
 
 import numpy as np
 
-__all__ = ["run_serving_quant_bench", "run_serving_spec_bench",
-           "run_serving_tp_bench"]
+__all__ = ["run_serving_megakernel_bench", "run_serving_quant_bench",
+           "run_serving_spec_bench", "run_serving_tp_bench"]
+
+
+def run_serving_megakernel_bench(requests: int = 8, max_new: int = 32,
+                                 num_slots: int = 8,
+                                 decode_block: int = 8) -> dict:
+    """Fused decode-layer A/B: the megakernel engine (decode-fusion
+    pass + ops/pallas/decode_layer.py) against the plain paged+int8-KV
+    engine on the SAME greedy stream.
+
+    What the stage pins every round:
+
+    - **bit-identity**: fused greedy streams must equal the unfused
+      engine's token-for-token (on the CPU lane the fused call's body
+      IS the captured unfused jaxpr, so this pins the pass/splice
+      plumbing; on TPU the same gate pins the kernel's numerics
+      against greedy argmax);
+    - **decode tokens/s A/B** — an overhead record on the CPU lane
+      (same math, one extra call boundary); the HBM win belongs to the
+      TPU child, where the fused program stops round-tripping the
+      hidden state between attention/o_proj/MLP;
+    - **the no-transient jaxpr walk**: the transformed decode-block
+      program must hold NO fp32 hidden-state interior ((S, 1, ff) MLP
+      activation, (S, kvh, g, dh) attention internals) outside the
+      fused calls — the structural form of the VMEM-residency claim;
+    - rewrite/kernel-call counts from the pass, and the compile-count
+      pin (ONE decode program).
+    """
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.passes.fusion_decode import (fused_decode_calls,
+                                                 walk_outside_fused)
+    from paddle_tpu.serving import ContinuousBatchingEngine, Server
+
+    paddle.seed(0)
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=256, intermediate_size=768,
+        num_hidden_layers=4, num_attention_heads=8,
+        num_key_value_heads=8, max_position_embeddings=256,
+        tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          (8 + (i % 3) * 8,)).astype(np.int32)
+               for i in range(requests)]
+    max_len = -(-(32 + max_new) // 16) * 16
+    kw = dict(num_slots=num_slots, max_len=max_len,
+              decode_block=decode_block, paged=True, block_size=16,
+              prefill_chunk=32, kv_int8=True)
+    plain = ContinuousBatchingEngine(model, megakernel=False, **kw)
+    mega = ContinuousBatchingEngine(model, megakernel=True, **kw)
+
+    def run(engine):
+        engine.reset()
+        srv = Server(engine)
+        rids = [srv.submit(p, max_new_tokens=max_new) for p in prompts]
+        t0 = time.perf_counter()
+        res = srv.run_until_idle()
+        return [res[r] for r in rids], time.perf_counter() - t0
+
+    run(plain), run(mega)                   # compile warmup
+    ref, dt_plain = run(plain)
+    got, dt_mega = run(mega)
+    identical = all(np.array_equal(a, b) for a, b in zip(ref, got))
+
+    # the no-transient walk over the TRANSFORMED decode-block program
+    closed = mega.backend._block_jit._closed
+    S = num_slots
+    kvh = cfg.num_key_value_heads
+    g = cfg.num_attention_heads // kvh
+    dh = cfg.hidden_size // cfg.num_attention_heads
+    banned = {(S, 1, cfg.intermediate_size), (S, kvh, g, dh)}
+    outside = set()
+    for eqn in walk_outside_fused(closed):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and \
+                    getattr(aval, "dtype", None) == jnp.float32:
+                outside.add(tuple(aval.shape))
+    no_transient = not (outside & banned)
+
+    useful = requests * max_new
+    return {
+        "serving_megakernel_bit_identical": bool(identical),
+        "serving_megakernel_tokens_per_sec_unfused":
+            round(useful / dt_plain, 1),
+        "serving_megakernel_tokens_per_sec":
+            round(useful / dt_mega, 1),
+        "serving_megakernel_speedup": round(dt_plain / dt_mega, 3),
+        "serving_megakernel_rewrites": mega.megakernel_rewrites(),
+        "serving_megakernel_kernel_calls":
+            mega.megakernel_kernel_calls(),
+        "serving_megakernel_fused_calls_in_program":
+            len(fused_decode_calls(closed)),
+        "serving_megakernel_no_hidden_state_transient":
+            bool(no_transient),
+        "serving_megakernel_decode_compiles":
+            mega.decode_compile_count(),
+    }
 
 
 def run_serving_quant_bench(requests: int = 8, max_new: int = 48,

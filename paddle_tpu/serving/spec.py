@@ -316,9 +316,10 @@ class SpecModelStepBackend(_SpecBackendMixin, ModelStepBackend):
     """Dense slot-pool backend with the (S, k+1) verify program."""
 
     def __init__(self, model, num_slots: int, max_len: int,
-                 decode_block: int, spec: SpecConfig, quant=None):
+                 decode_block: int, spec: SpecConfig, quant=None,
+                 fuse=None):
         super().__init__(model, num_slots, max_len, decode_block,
-                         quant=quant)
+                         quant=quant, fuse=fuse)
         self._setup_spec(model, spec, paged=False)
 
 
@@ -329,10 +330,10 @@ class SpecPagedStepBackend(_SpecBackendMixin, PagedModelStepBackend):
     def __init__(self, model, num_slots: int, max_len: int,
                  decode_block: int, block_size: int, num_blocks: int,
                  kv_int8: bool, prefill_chunk: int, spec: SpecConfig,
-                 quant=None):
+                 quant=None, fuse=None):
         super().__init__(model, num_slots, max_len, decode_block,
                          block_size, num_blocks, kv_int8, prefill_chunk,
-                         quant=quant)
+                         quant=quant, fuse=fuse)
         self._setup_spec(model, spec, paged=True)
 
 
@@ -534,7 +535,7 @@ class SpecEngine(_SpecEngineMixin, ContinuousBatchingEngine):
                  max_len: int = 256, decode_block: int = 8,
                  prompt_buckets: Optional[Sequence[int]] = None,
                  backend=None, *, paged: Optional[bool] = None,
-                 spec=None, tp=None, quant=None):
+                 spec=None, tp=None, quant=None, megakernel=None):
         if paged:
             # same loud-refusal rule as spec= on a direct subclass
             # ctor: silently serving DENSE from a paged= request would
@@ -546,13 +547,13 @@ class SpecEngine(_SpecEngineMixin, ContinuousBatchingEngine):
         self._init_spec(spec, backend, tp)
         super().__init__(model, num_slots, max_len, decode_block,
                          prompt_buckets, backend, paged=False,
-                         quant=quant)
+                         quant=quant, megakernel=megakernel)
 
     def _build_backend(self, model, num_slots, max_len, decode_block,
-                       quant=None):
+                       quant=None, fuse=None):
         return SpecModelStepBackend(model, num_slots, max_len,
                                     decode_block, self.spec,
-                                    quant=quant)
+                                    quant=quant, fuse=fuse)
 
 
 class SpecPagedEngine(_SpecEngineMixin, PagedEngine):
@@ -570,7 +571,7 @@ class SpecPagedEngine(_SpecEngineMixin, PagedEngine):
                  num_blocks: Optional[int] = None,
                  kv_int8: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
-                 hash_fn=None, tp=None, quant=None):
+                 hash_fn=None, tp=None, quant=None, megakernel=None):
         if paged is not None and not paged:
             raise ValueError(
                 "SpecPagedEngine is the paged speculative engine — use "
@@ -581,12 +582,14 @@ class SpecPagedEngine(_SpecEngineMixin, PagedEngine):
                          prompt_buckets, backend, paged=True,
                          block_size=block_size, num_blocks=num_blocks,
                          kv_int8=kv_int8, prefill_chunk=prefill_chunk,
-                         hash_fn=hash_fn, quant=quant)
+                         hash_fn=hash_fn, quant=quant,
+                         megakernel=megakernel)
 
     def _build_paged_backend(self, model, num_slots, max_len,
                              decode_block, block_size, num_blocks,
-                             kv_int8, prefill_chunk, quant=None):
+                             kv_int8, prefill_chunk, quant=None,
+                             fuse=None):
         return SpecPagedStepBackend(model, num_slots, max_len,
                                     decode_block, block_size,
                                     num_blocks, kv_int8, prefill_chunk,
-                                    self.spec, quant=quant)
+                                    self.spec, quant=quant, fuse=fuse)

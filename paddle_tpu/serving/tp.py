@@ -450,8 +450,12 @@ class ShardedModelStepBackend(_TPBackendMixin, ModelStepBackend):
 
     def __init__(self, model, num_slots: int, max_len: int,
                  decode_block: int, tp: TPConfig, quant=None):
+        # fuse=False, not env-resolved: the sharded shard_map programs
+        # below replace the base decode block, and the megakernel pass
+        # is not yet composed with TP (the engine factory refuses
+        # megakernel= + tp= loudly; the env knob must not half-arm it)
         super().__init__(model, num_slots, max_len, decode_block,
-                         quant=quant)
+                         quant=quant, fuse=False)
         self._setup_tp(model, tp)
         # local-shape row specs: the prefill program zero-fills its
         # fresh cache row INSIDE shard_map, where shapes are per-device
@@ -507,9 +511,10 @@ class ShardedPagedStepBackend(_TPBackendMixin, PagedModelStepBackend):
                  kv_int8: bool, prefill_chunk: int, tp: TPConfig,
                  quant=None):
         from .engine import build_paged_chunk_fn
+        # fuse=False for the same reason as the dense sharded backend
         super().__init__(model, num_slots, max_len, decode_block,
                          block_size, num_blocks, kv_int8, prefill_chunk,
-                         quant=quant)
+                         quant=quant, fuse=False)
         self._setup_tp(model, tp)
         self._block_jit = self._shard_jit(
             build_slot_block_fn(self._pure, self.block_size,

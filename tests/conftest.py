@@ -39,6 +39,17 @@ os.environ["XLA_FLAGS"] = flags.strip()
 os.environ.setdefault("USE_TF", "0")
 os.environ.setdefault("TRANSFORMERS_NO_ADVISORY_WARNINGS", "1")
 
+# autotune isolation: kernels consult the block-size tuning table at
+# trace time (ops/pallas/autotune.py), so ANY reachable table — the
+# default ~/.cache path (e.g. written by bench.py's autotune stage) OR
+# an inherited PT_TUNE_TABLE export — would make block choices, and
+# therefore compiled programs and timing-sensitive pins,
+# machine-dependent. Pin the suite unconditionally to a path that never
+# exists; autotune tests monkeypatch their own tmp tables.
+os.environ["PT_TUNE_TABLE"] = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    ".tune_table_isolated.json")
+
 import jax
 
 if not _ON_TPU:

@@ -23,7 +23,8 @@ GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
                  "tests/test_resilience.py", "tests/test_observability.py",
                  "tests/test_serving_tp.py", "tests/test_serving_spec.py",
                  "tests/test_serving_quant.py",
-                 "tests/test_sparse_quant.py"]
+                 "tests/test_sparse_quant.py",
+                 "tests/test_megakernel.py", "tests/test_autotune.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -109,6 +110,25 @@ REQUIRED_NODES = [
     "test_env_flag_never_reroutes_explicit_backend",
     "test_sparse_quant.py::TestWeightOnlyQuant::"
     "test_grouped_roundtrip_and_linear",
+    # PR 12 megakernel + autotuner pins: the fused-vs-unfused
+    # composition matrix (paged+kv_int8 is the flagship), the
+    # no-hidden-state-transient jaxpr walk, the interpret-mode
+    # megakernel parity, the impostor-marker soundness pin, and the
+    # autotune staleness/consumer contracts
+    "test_megakernel.py::TestFusedBitParity::test_paged_kv_int8",
+    "test_megakernel.py::TestFusedBitParity::test_quant_int8_paged",
+    "test_megakernel.py::TestFusedBitParity::test_spec_k8_paged",
+    "test_megakernel.py::TestNoTransientWalk::"
+    "test_fused_program_holds_no_hidden_state_interior",
+    "test_megakernel.py::TestMegaKernelInterpret::"
+    "test_kernel_matches_reference[paged_int8]",
+    "test_megakernel.py::TestDecodeFusionPass::"
+    "test_impostor_marker_left_unfused",
+    "test_autotune.py::TestTable::test_stale_stamp_refused_and_warned",
+    "test_autotune.py::TestConsumers::"
+    "test_xent_chunk_default_unchanged_without_table",
+    "test_autotune.py::TestConsumers::"
+    "test_flash_block_pref_resolution_order",
 ]
 
 

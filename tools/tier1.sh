@@ -68,6 +68,37 @@ echo "tier1: landmine note — persistent compile cache + xdist/randomly" \
      "and re-run before blaming the tree. Re-check on each jaxlib" \
      "upgrade (ROADMAP env note)."
 
+# --- autotune tuning-table provenance: kernels consult the table at
+# trace time (ops/pallas/autotune.py); a stamp that disagrees with the
+# running jaxlib/device kind is refused by lookup() — surface the same
+# verdict here instead of letting stale block shapes pass silently.
+TUNE_TABLE="${PT_TUNE_TABLE:-$HOME/.cache/paddle_tpu/tune_table.json}"
+if [ -f "$TUNE_TABLE" ]; then
+  JAX_PLATFORMS=cpu PT_TUNE_TABLE="$TUNE_TABLE" python - <<'EOF'
+from paddle_tpu.ops.pallas import autotune as at
+path = at.table_path()
+table = at.load_table(path)
+if table is None:
+    print(f"tier1: WARNING autotune table {path} unreadable — kernels "
+          "fall back to documented defaults")
+else:
+    ok, reason = at.stamp_matches(table.get("stamp", {}))
+    n = len(table.get("entries", {}))
+    if ok:
+        print(f"tier1: autotune table ok ({path}, {n} entries, stamp "
+              f"{table['stamp'].get('jaxlib_version')}/"
+              f"{table['stamp'].get('device_kind')})")
+    else:
+        print(f"tier1: WARNING autotune table {path} is STALE "
+              f"({reason}) — kernels fall back to documented defaults; "
+              "re-run the bench autotune stage to refresh")
+EOF
+else
+  echo "tier1: no autotune table at $TUNE_TABLE (kernels use" \
+       "documented default block shapes; bench.py's autotune stage" \
+       "writes one)"
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
