@@ -23,33 +23,37 @@ import time
 
 import numpy as np
 
+# env knobs through the utils/flags helpers (the PR 4/5 migration
+# pattern — uniform empty-value leniency). Importing the package does
+# NOT initialize a jax backend (verified: xla_bridge._backends stays
+# empty), so the parent's never-init contract holds.
+from paddle_tpu.utils.flags import env_float, env_int, env_str
+
 # chip peak bf16 FLOP/s by generation (public specs)
 PEAK_FLOPS = {"v5e": 197e12, "v5litepod": 197e12, "v4": 275e12,
               "v5p": 459e12, "v6e": 918e12, "cpu": 1e12}
 
-TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", "2"))
+TPU_ATTEMPTS = env_int("BENCH_TPU_ATTEMPTS", 2)
 # r3 learning: 480s deadline-killed the ~1B config mid-compile (its
 # scan_layers compile + 3-batch ladder needs ~10-15 min end to end);
 # the 90s probe already bounds the wedged-tunnel cost, and per-stage
 # BENCH_JSON emission preserves earlier stages if the child dies
-TPU_DEADLINE_S = float(os.environ.get("BENCH_TPU_DEADLINE_S", "1100"))
-CPU_DEADLINE_S = float(os.environ.get("BENCH_CPU_DEADLINE_S", "420"))
-COMMS_DEADLINE_S = float(os.environ.get("BENCH_COMMS_DEADLINE_S", "240"))
-PASSES_DEADLINE_S = float(os.environ.get("BENCH_PASSES_DEADLINE_S", "240"))
-OBS_DEADLINE_S = float(os.environ.get("BENCH_OBS_DEADLINE_S", "240"))
-SERVING_SPEC_DEADLINE_S = float(
-    os.environ.get("BENCH_SERVING_SPEC_DEADLINE_S", "240"))
-SERVING_TP_DEADLINE_S = float(
-    os.environ.get("BENCH_SERVING_TP_DEADLINE_S", "300"))
-SERVING_QUANT_DEADLINE_S = float(
-    os.environ.get("BENCH_SERVING_QUANT_DEADLINE_S", "300"))
-SERVING_MEGA_DEADLINE_S = float(
-    os.environ.get("BENCH_SERVING_MEGA_DEADLINE_S", "300"))
-AUTOTUNE_DEADLINE_S = float(
-    os.environ.get("BENCH_AUTOTUNE_DEADLINE_S", "300"))
+TPU_DEADLINE_S = env_float("BENCH_TPU_DEADLINE_S", 1100)
+CPU_DEADLINE_S = env_float("BENCH_CPU_DEADLINE_S", 420)
+COMMS_DEADLINE_S = env_float("BENCH_COMMS_DEADLINE_S", 240)
+PASSES_DEADLINE_S = env_float("BENCH_PASSES_DEADLINE_S", 240)
+OBS_DEADLINE_S = env_float("BENCH_OBS_DEADLINE_S", 240)
+SERVING_SPEC_DEADLINE_S = env_float("BENCH_SERVING_SPEC_DEADLINE_S", 240)
+SERVING_TP_DEADLINE_S = env_float("BENCH_SERVING_TP_DEADLINE_S", 300)
+SERVING_QUANT_DEADLINE_S = env_float("BENCH_SERVING_QUANT_DEADLINE_S",
+                                     300)
+SERVING_MEGA_DEADLINE_S = env_float("BENCH_SERVING_MEGA_DEADLINE_S", 300)
+SERVING_FRONTDOOR_DEADLINE_S = env_float(
+    "BENCH_SERVING_FRONTDOOR_DEADLINE_S", 300)
+AUTOTUNE_DEADLINE_S = env_float("BENCH_AUTOTUNE_DEADLINE_S", 300)
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
-PROBE_DEADLINE_S = float(os.environ.get("BENCH_PROBE_DEADLINE_S", "90"))
+PROBE_DEADLINE_S = env_float("BENCH_PROBE_DEADLINE_S", 90)
 
 
 def _bench_train(model_cfg, batch, seq, steps, warmup, peak,
@@ -434,14 +438,15 @@ def _child_tpu():
         # multi-minute big-config compile entirely if the backend
         # supports serialized executables
         jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("PT_JAX_CACHE_DIR",
-                                         "/root/.pt_jax_cache"))
+                          env_str("PT_JAX_CACHE_DIR",
+                                  "/root/.pt_jax_cache") or
+                          "/root/.pt_jax_cache")
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
     except Exception:
         pass
     dev = jax.devices()[0]
     on_tpu = dev.platform != "cpu"
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower() if on_tpu \
+    gen = env_str("PALLAS_AXON_TPU_GEN", "v5e").lower() if on_tpu \
         else "cpu"
     peak = PEAK_FLOPS.get(gen, 197e12 if on_tpu else 1e12)
 
@@ -696,6 +701,17 @@ def _child_tpu():
         decode.update(mega if mega is not None
                       else {"serving_megakernel_bit_identical": None})
         _release_hbm()
+        # multi-tenant front door on the REAL chip: WFQ shares,
+        # preemption + bit-identical resume, per-priority TTFT
+        from paddle_tpu.serving.microbench import \
+            run_serving_frontdoor_bench
+        fd, err = _staged(run_serving_frontdoor_bench,
+                          "serving-frontdoor")
+        if err:
+            errors.append(err)
+        decode.update(fd if fd is not None
+                      else {"serving_frontdoor_bit_identical": None})
+        _release_hbm()
         # block-size autotune sweep on the REAL chip (flash/splash
         # blocks + the CPU-honest knobs, persisted per device kind)
         from paddle_tpu.ops.pallas.autotune import run_autotune
@@ -808,7 +824,8 @@ def _run_child(mode: str, deadline: float):
     if mode in ("--child-cpu", "--child-comms", "--child-passes",
                 "--child-observability", "--child-serving-tp",
                 "--child-serving-spec", "--child-serving-quant",
-                "--child-serving-megakernel", "--child-autotune"):
+                "--child-serving-megakernel",
+                "--child-serving-frontdoor", "--child-autotune"):
         env["JAX_PLATFORMS"] = "cpu"
     if mode in ("--child-comms", "--child-serving-tp"):
         # simulated 2x4 mesh on the CPU lane
@@ -903,7 +920,7 @@ def _child_comms():
     jax.config.update("jax_platforms", "cpu")
     from paddle_tpu.distributed.collectives import run_comms_bench
     out = run_comms_bench(
-        size_mb=float(os.environ.get("BENCH_COMMS_MB", "2")))
+        size_mb=env_float("BENCH_COMMS_MB", 2))
     print("BENCH_JSON " + json.dumps(out), flush=True)
 
 
@@ -939,8 +956,8 @@ def _child_passes():
     jax.config.update("jax_platforms", "cpu")
     from paddle_tpu.passes.microbench import run_passes_bench
     out = run_passes_bench(
-        rows=int(os.environ.get("BENCH_PASSES_ROWS", "256")),
-        vocab=int(os.environ.get("BENCH_PASSES_VOCAB", "2048")))
+        rows=env_int("BENCH_PASSES_ROWS", 256),
+        vocab=env_int("BENCH_PASSES_VOCAB", 2048))
     print("BENCH_JSON " + json.dumps(out), flush=True)
 
 
@@ -960,8 +977,8 @@ def _child_observability():
     jax.config.update("jax_platforms", "cpu")
     from paddle_tpu.observability.microbench import run_observability_bench
     out = run_observability_bench(
-        requests=int(os.environ.get("BENCH_OBS_REQUESTS", "8")),
-        max_new=int(os.environ.get("BENCH_OBS_MAX_NEW", "24")))
+        requests=env_int("BENCH_OBS_REQUESTS", 8),
+        max_new=env_int("BENCH_OBS_MAX_NEW", 24))
     print("BENCH_JSON " + json.dumps(out), flush=True)
 
 
@@ -981,9 +998,9 @@ def _child_serving_spec():
     jax.config.update("jax_platforms", "cpu")
     from paddle_tpu.serving.microbench import run_serving_spec_bench
     out = run_serving_spec_bench(
-        requests=int(os.environ.get("BENCH_SERVING_SPEC_REQUESTS", "8")),
-        max_new=int(os.environ.get("BENCH_SERVING_SPEC_MAX_NEW", "64")),
-        k=int(os.environ.get("BENCH_SERVING_SPEC_K", "8")))
+        requests=env_int("BENCH_SERVING_SPEC_REQUESTS", 8),
+        max_new=env_int("BENCH_SERVING_SPEC_MAX_NEW", 64),
+        k=env_int("BENCH_SERVING_SPEC_K", 8))
     print("BENCH_JSON " + json.dumps(out), flush=True)
 
 
@@ -1005,9 +1022,9 @@ def _child_serving_quant():
     jax.config.update("jax_platforms", "cpu")
     from paddle_tpu.serving.microbench import run_serving_quant_bench
     out = run_serving_quant_bench(
-        requests=int(os.environ.get("BENCH_SERVING_QUANT_REQUESTS", "8")),
-        max_new=int(os.environ.get("BENCH_SERVING_QUANT_MAX_NEW", "48")),
-        weights=os.environ.get("BENCH_SERVING_QUANT_WEIGHTS", "int8"))
+        requests=env_int("BENCH_SERVING_QUANT_REQUESTS", 8),
+        max_new=env_int("BENCH_SERVING_QUANT_MAX_NEW", 48),
+        weights=env_str("BENCH_SERVING_QUANT_WEIGHTS", "int8"))
     print("BENCH_JSON " + json.dumps(out), flush=True)
 
 
@@ -1030,8 +1047,8 @@ def _child_serving_megakernel():
     jax.config.update("jax_platforms", "cpu")
     from paddle_tpu.serving.microbench import run_serving_megakernel_bench
     out = run_serving_megakernel_bench(
-        requests=int(os.environ.get("BENCH_SERVING_MEGA_REQUESTS", "8")),
-        max_new=int(os.environ.get("BENCH_SERVING_MEGA_MAX_NEW", "32")))
+        requests=env_int("BENCH_SERVING_MEGA_REQUESTS", 8),
+        max_new=env_int("BENCH_SERVING_MEGA_MAX_NEW", 32))
     print("BENCH_JSON " + json.dumps(out), flush=True)
 
 
@@ -1039,6 +1056,32 @@ def _attach_serving_megakernel(result, budget_s=None):
     return _attach_stage(result, "serving-megakernel",
                          "--child-serving-megakernel",
                          SERVING_MEGA_DEADLINE_S, budget_s)
+
+
+def _child_serving_frontdoor():
+    """serving-frontdoor stage: the multi-tenant traffic layer
+    (serving/frontend.py) on the paged engine — pins measured
+    per-tenant throughput shares vs the configured WFQ weights (gate:
+    within 10%) on a saturated 3-tenant workload, priority preemption
+    (count, the evicted request still completing bit-identical to an
+    uninterrupted run), TTFT p50/p95 split by priority with a
+    preemption-on/off A/B, and the decode/prefill compile-count pin
+    every round. All fields non-null on the CPU lane; the TPU child
+    stages the same workload."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.serving.microbench import run_serving_frontdoor_bench
+    out = run_serving_frontdoor_bench(
+        requests_per_tenant=env_int("BENCH_SERVING_FRONTDOOR_REQUESTS",
+                                    18),
+        max_new=env_int("BENCH_SERVING_FRONTDOOR_MAX_NEW", 8))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_serving_frontdoor(result, budget_s=None):
+    return _attach_stage(result, "serving-frontdoor",
+                         "--child-serving-frontdoor",
+                         SERVING_FRONTDOOR_DEADLINE_S, budget_s)
 
 
 def _child_autotune():
@@ -1055,8 +1098,8 @@ def _child_autotune():
     from paddle_tpu.ops.pallas import flash_attention as fa
     from paddle_tpu.ops.pallas.autotune import run_autotune
     out = run_autotune(
-        rows=int(os.environ.get("BENCH_AUTOTUNE_ROWS", "256")),
-        vocab=int(os.environ.get("BENCH_AUTOTUNE_VOCAB", "8192")))
+        rows=env_int("BENCH_AUTOTUNE_ROWS", 256),
+        vocab=env_int("BENCH_AUTOTUNE_VOCAB", 8192))
     out["autotune_flash_block_choice"] = fa.last_block_choice()
     print("BENCH_JSON " + json.dumps(out), flush=True)
 
@@ -1077,8 +1120,8 @@ def _child_serving_tp():
     jax.config.update("jax_platforms", "cpu")
     from paddle_tpu.serving.microbench import run_serving_tp_bench
     out = run_serving_tp_bench(
-        requests=int(os.environ.get("BENCH_SERVING_TP_REQUESTS", "6")),
-        max_new=int(os.environ.get("BENCH_SERVING_TP_MAX_NEW", "16")))
+        requests=env_int("BENCH_SERVING_TP_REQUESTS", 6),
+        max_new=env_int("BENCH_SERVING_TP_MAX_NEW", 16))
     print("BENCH_JSON " + json.dumps(out), flush=True)
 
 
@@ -1158,6 +1201,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-quant":
         _child_serving_quant()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-frontdoor":
+        _child_serving_frontdoor()
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-megakernel":
         _child_serving_megakernel()
         return
@@ -1192,7 +1238,7 @@ def _main_measured(errors):
     # an outer `timeout`); probe retries must not eat the TPU child's
     # window — and a too-late recovery must skip to the CPU fallback
     # rather than start a doomed heavy run
-    total_budget = float(os.environ.get("BENCH_TOTAL_BUDGET_S", "0")) \
+    total_budget = env_float("BENCH_TOTAL_BUDGET_S", 0) \
         or None     # unset → unbounded: never shrink the child deadline
 
     def remaining():
@@ -1200,14 +1246,14 @@ def _main_measured(errors):
             return float("inf")
         return total_budget - (time.time() - t_start)
 
-    tpu_intended = os.environ.get("JAX_PLATFORMS", "axon") != "cpu"
+    tpu_intended = env_str("JAX_PLATFORMS", "axon") != "cpu"
     tpu_healthy = tpu_intended
     if tpu_intended:
         # a wedged tunnel often recovers within minutes (r3: wedged for
         # hours mid-round, healthy windows either side) — keep probing
         # inside a bounded retry window before surrendering the round's
         # only driver-visible TPU artifact to the CPU fallback
-        retry_budget = float(os.environ.get("BENCH_PROBE_RETRY_S", "600"))
+        retry_budget = env_float("BENCH_PROBE_RETRY_S", 600)
         attempt = 0
         while True:
             attempt += 1
@@ -1242,6 +1288,7 @@ def _main_measured(errors):
                 result = _attach_serving_spec(result, remaining())
                 result = _attach_serving_quant(result, remaining())
                 result = _attach_serving_megakernel(result, remaining())
+                result = _attach_serving_frontdoor(result, remaining())
                 _emit_final(_attach_autotune(result, remaining()))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
@@ -1268,6 +1315,7 @@ def _main_measured(errors):
         result = _attach_serving_spec(result, remaining())
         result = _attach_serving_quant(result, remaining())
         result = _attach_serving_megakernel(result, remaining())
+        result = _attach_serving_frontdoor(result, remaining())
         _emit_final(_attach_autotune(result, remaining()))
         return
     # last resort: still one JSON line, rc 0, explicit marker

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ...utils.flags import env_int, env_str
+from ...utils.flags import env_int, env_set, env_str
 
 
 def _xla_sdpa(q, k, v, mask=None, is_causal=False, dropout_p=0.0,
@@ -382,8 +382,7 @@ def _block_pref(env_name: str, kernel: str, seq: int, dim: int,
     (routed through utils/flags.env_int, 0 = kernel defaults) beats a
     valid autotune-table entry beats the PROFILE_r03 default (512).
     Returns (pref, source)."""
-    import os
-    if os.environ.get(env_name) is not None:
+    if env_set(env_name):     # presence check: NAME=0 still means "env"
         return env_int(env_name, default), "env"
     from .autotune import lookup
     cfg = lookup("flash_attention", {"seq": seq, "dim": dim})

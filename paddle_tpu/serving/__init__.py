@@ -10,11 +10,12 @@ path (``inference.export_decoder(engine_slots=...)`` +
 serialized artifact alone."""
 from .engine import (ArtifactStepBackend, ContinuousBatchingEngine,
                      ModelStepBackend, slot_sample_logits)
+from .frontend import FairScheduler, Frontend, TenantConfig, TokenStream
 from .paging import (BlockManager, PagedArtifactStepBackend, PagedEngine,
                      PagedModelStepBackend)
 from .quant import QuantConfig
 from .resilience import RequestFailure, ResilienceConfig
-from .scheduler import Request, Scheduler
+from .scheduler import Request, ResumeState, Scheduler
 from .server import Server
 from .spec import (SpecConfig, SpecEngine, SpecModelStepBackend,
                    SpecPagedEngine, SpecPagedStepBackend, ngram_propose)
@@ -22,11 +23,12 @@ from .tp import (ShardedModelStepBackend, ShardedPagedStepBackend,
                  TPConfig)
 
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
-           "ArtifactStepBackend", "BlockManager",
-           "PagedArtifactStepBackend", "PagedEngine",
+           "ArtifactStepBackend", "BlockManager", "FairScheduler",
+           "Frontend", "PagedArtifactStepBackend", "PagedEngine",
            "PagedModelStepBackend", "QuantConfig", "Request",
-           "RequestFailure", "ResilienceConfig", "Scheduler", "Server",
-           "SpecConfig", "SpecEngine", "SpecModelStepBackend",
-           "SpecPagedEngine", "SpecPagedStepBackend",
-           "ShardedModelStepBackend", "ShardedPagedStepBackend",
-           "TPConfig", "ngram_propose", "slot_sample_logits"]
+           "RequestFailure", "ResilienceConfig", "ResumeState",
+           "Scheduler", "Server", "SpecConfig", "SpecEngine",
+           "SpecModelStepBackend", "SpecPagedEngine",
+           "SpecPagedStepBackend", "ShardedModelStepBackend",
+           "ShardedPagedStepBackend", "TPConfig", "TenantConfig",
+           "TokenStream", "ngram_propose", "slot_sample_logits"]

@@ -805,8 +805,13 @@ class ContinuousBatchingEngine:
         """Prefill the request's prompt (batch-1, left-padded to its
         bucket) and splice the row into a free slot. Returns True if the
         request already finished at admission (max_new==1 or eos on the
-        first token) — it then never occupies a slot."""
+        first token) — it then never occupies a slot. A request carrying
+        preemption ``resume`` state re-prefills its generated history
+        instead (see :meth:`_admit_resume`)."""
         from ..profiler import RecordEvent
+        resume = getattr(request, "resume", None)
+        if resume is not None and resume.tokens:
+            return self._admit_resume(request, resume)
         prompt = np.asarray(request.prompt, np.int32).reshape(-1)
         L = int(prompt.shape[0])
         self.validate_request(L, request.max_new_tokens)
@@ -860,12 +865,133 @@ class ContinuousBatchingEngine:
         self._remaining_host[slot] = rem0
         return False
 
+    def _admit_resume(self, request, resume) -> bool:
+        """Re-admit a preempted request: re-prefill prompt + generated
+        history — the KV the eviction dropped — into a fresh row, then
+        arm the slot with the CARRIED stream state (``tokens[-1]`` as
+        the in-hand next token, the saved rng key, the remaining token
+        budget). The re-prefill's in-graph sample is DISCARDED (the
+        stream already owns its next token, and the saved key must not
+        be advanced), so the resumed greedy AND seeded-sampled streams
+        are bit-identical to an uninterrupted run. Padding shifts are
+        invisible by construction: RoPE positions are pad-corrected and
+        masked slots contribute exact zeros, the same property that
+        makes bucket-padded serving equal generate()."""
+        from ..profiler import RecordEvent
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        toks = list(resume.tokens)
+        full = np.concatenate([prompt, np.asarray(toks[:-1], np.int32)])
+        pl = int(full.shape[0])
+        rem0 = request.max_new_tokens - len(toks)
+        self.validate_request(pl, rem0 + 1)
+        Lb = self.bucket_len(pl)
+        slot = next((i for i, s in enumerate(self._slots) if s is None),
+                    None)
+        if slot is None:
+            raise RuntimeError("no free slot (scheduler bug)")
+        tr = self.tracer
+        if tr is not None:
+            tr.span_end(request.request_id, "queue_wait", resumed=True)
+            t_prefill = _trace_now()
+        ids = np.zeros((1, Lb), np.int32)
+        ids[0, Lb - pl:] = full
+        pad0 = Lb - pl
+        with RecordEvent("serving.prefill"):
+            _discard, row = self.backend.prefill(
+                Lb, jnp.asarray(ids), jnp.asarray([pad0], jnp.int32),
+                jax.random.PRNGKey(0), jnp.float32(0.0), jnp.int32(0),
+                jnp.float32(1.0))
+        if tr is not None:
+            tr.span_at(request.request_id, "prefill", t_prefill,
+                       tokens=pl, bucket=Lb, resumed=True)
+        _M_PREFILLS.inc()
+        # t_admit carries over: the first token existed before the
+        # eviction, so TTFT keeps measuring the first admission
+        run = _SlotRun(request, tokens=toks, t_admit=resume.t_admit)
+        eos = request.eos_token_id
+        with RecordEvent("serving.admit"):
+            self._cache, self._state = self._admit_jit(
+                self._cache, self._state, row, jnp.int32(slot),
+                jnp.int32(toks[-1]), jnp.int32(Lb), jnp.int32(pad0),
+                jnp.int32(rem0),
+                jnp.int32(-1 if eos is None else eos),
+                jnp.float32(request.temperature),
+                jnp.int32(request.top_k), jnp.float32(request.top_p),
+                jnp.asarray(np.asarray(resume.key, np.uint32)))
+        if tr is not None:
+            tr.instant(request.request_id, "resume", slot=slot,
+                       reused_tokens=len(toks))
+            tr.span_begin(request.request_id, "decode", slot=slot)
+        self._slots[slot] = run
+        self._remaining_host[slot] = rem0
+        request.resume = None       # consumed; a later preemption
+        return False                # rebuilds it from the live run
+
     def try_admit(self, request) -> bool:
         """Admit if resources allow; False means "retry later" (the
         paged engine's block pool can be exhausted even with a free
         slot — the dense engine always admits into a free slot)."""
         self.admit(request)
         return True
+
+    # -- preemption --------------------------------------------------------
+    def can_resume(self, run: "_SlotRun") -> bool:
+        """Whether a preempted ``run`` could later be re-admitted: its
+        prompt + generated history must still fit the engine (dense: a
+        prompt bucket; paged: the block pool). The preemption policy
+        checks this BEFORE evicting — a victim that could never come
+        back would be a silent kill, not a preemption."""
+        if not run.tokens:
+            return True          # mid-prefill: requeues as submitted
+        req = run.request
+        pl = int(np.asarray(req.prompt).reshape(-1).shape[0]) \
+            + len(run.tokens) - 1
+        mnt = req.max_new_tokens - len(run.tokens) + 1
+        try:
+            self.validate_request(pl, mnt)
+        except ValueError:
+            return False
+        return True
+
+    def preempt_slot(self, slot: int):
+        """Evict the request in ``slot`` mid-flight WITHOUT failing it:
+        the slot is killed in-graph through the same ``_cancel_fn``
+        program deadlines use, its resources release (paged blocks at
+        exact refcounts — the prefix-index entries are retained, which
+        is what makes the later re-prefill mostly cache hits), and the
+        run is handed back to the caller with the slot's rng key so the
+        request can requeue carrying :class:`~.scheduler.ResumeState`.
+        Returns ``(run, key)``; ``key`` is None for a mid-prefill
+        victim (nothing armed yet — it requeues as-submitted). Only
+        legal at a tick boundary, like snapshots."""
+        run = self._slots[slot]
+        if run is None:
+            raise RuntimeError(f"slot {slot} is empty")
+        if self._pending_block is not None:
+            raise RuntimeError(
+                "preempt only at a tick boundary — a dispatched decode "
+                "block is awaiting harvest (call step_block first)")
+        key = None
+        if slot in self._prefill_slots:
+            self._prefill_slots.discard(slot)
+            self._abort_prefill(slot)
+        else:
+            key = np.asarray(self._state["key"])[slot].copy()
+            self._state = self._cancel_jit(self._state, jnp.int32(slot))
+        if self.tracer is not None:
+            rid = run.request.request_id
+            self.tracer.span_end(rid, "decode", preempted=True)
+            self.tracer.instant(rid, "preempt", slot=slot,
+                                tokens=len(run.tokens))
+        self._slots[slot] = None
+        self._remaining_host[slot] = 0
+        self._release_slot_resources(run)
+        return run, key
+
+    def _release_slot_resources(self, run: "_SlotRun"):
+        """Free everything a preempted run held besides the slot
+        itself — dense rows are pool-owned, nothing to do (the paged
+        engine releases the run's arena blocks here)."""
 
     # -- decode ------------------------------------------------------------
     def has_pending_harvest(self) -> bool:

@@ -1,6 +1,7 @@
-"""Serving microbenches: tensor-parallel decode (serving/tp.py) and
-speculative draft-verify decode (serving/spec.py), each A/B'd against
-the plain 1-chip engine.
+"""Serving microbenches: tensor-parallel decode (serving/tp.py),
+speculative draft-verify decode (serving/spec.py), quantized and
+megakernel decode, and the multi-tenant front door
+(serving/frontend.py) — each A/B'd against the plain engine.
 
 Tensor-parallel stage — the slot-pool decode block sharded
 over a device mesh (serving/tp.py) A/B'd against the 1-chip engine.
@@ -29,8 +30,148 @@ import time
 
 import numpy as np
 
-__all__ = ["run_serving_megakernel_bench", "run_serving_quant_bench",
-           "run_serving_spec_bench", "run_serving_tp_bench"]
+__all__ = ["run_serving_frontdoor_bench", "run_serving_megakernel_bench",
+           "run_serving_quant_bench", "run_serving_spec_bench",
+           "run_serving_tp_bench"]
+
+
+def run_serving_frontdoor_bench(requests_per_tenant: int = 18,
+                                max_new: int = 8, num_slots: int = 4,
+                                decode_block: int = 4) -> dict:
+    """Multi-tenant front-door stage (serving/frontend.py): weighted-
+    fair shares, priority preemption, and per-priority TTFT on the
+    paged engine.
+
+    What the stage pins every round:
+
+    - **fairness**: a saturated 3-tenant workload (weights 1:2:3, equal
+      request shapes) measured via the streaming sink's per-tenant
+      token tallies while every tenant is still backlogged — measured
+      throughput shares must sit within 10% of the configured weights;
+    - **preemption**: a pool full of low-priority decodes evicted by a
+      high-priority burst — preemption count, the evicted requests
+      still completing (no starvation), and their outputs BIT-IDENTICAL
+      to an uninterrupted run (the resume-correctness contract);
+    - **TTFT p50/p95 split by priority**: the latency win preemption
+      buys the high tier while the low tier still finishes;
+    - the compile-count pin: ONE decode block + ONE chunk program
+      across fairness, evictions and resumes (no new compiled
+      programs).
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.serving import (ContinuousBatchingEngine, Frontend,
+                                    TenantConfig)
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    weights = {"bronze": 1.0, "silver": 2.0, "gold": 3.0}
+    engine = ContinuousBatchingEngine(
+        model, num_slots=num_slots, max_len=64,
+        decode_block=decode_block, paged=True, block_size=8,
+        prefill_chunk=16)
+
+    # ---- phase 1: weighted-fair shares under saturation ------------------
+    fe = Frontend(engine, tenants={t: TenantConfig(weight=w)
+                                   for t, w in weights.items()},
+                  preemption=True)
+    for i in range(requests_per_tenant):
+        for t in weights:
+            p = rs.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+            fe.submit(p, tenant=t, max_new_tokens=max_new)
+
+    def outstanding(t):
+        c = fe.server.tenant_counts.get(t, {})
+        return c.get("submitted", 0) - c.get("completed", 0) \
+            - c.get("failed", 0)
+
+    t0 = time.perf_counter()
+    # measure only while EVERY tenant is backlogged: the share claim is
+    # about contention, not about who finishes first
+    while all(outstanding(t) > 0 for t in weights) and fe.pump():
+        pass
+    dt_shares = time.perf_counter() - t0
+    streamed = dict(fe.tenant_tokens)
+    total = max(sum(streamed.values()), 1)
+    wsum = sum(weights.values())
+    shares = {t: streamed.get(t, 0) / total for t in weights}
+    expected = {t: w / wsum for t, w in weights.items()}
+    rel_err = max(abs(shares[t] - expected[t]) / expected[t]
+                  for t in weights)
+    fe.run_until_idle()                     # drain the tail
+
+    # ---- phase 2: priority preemption + per-priority TTFT ----------------
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          (5 + (i % 3) * 4,)).astype(np.int32)
+               for i in range(num_slots)]
+    hi_prompts = [rs.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+                  for _ in range(2)]
+
+    def low_refs():
+        engine.reset()
+        ref_fe = Frontend(engine)
+        rids = [ref_fe.submit(p, max_new_tokens=24) for p in prompts]
+        res = ref_fe.run_until_idle()
+        return [res[r] for r in rids]
+
+    ref = low_refs()                        # uninterrupted twins
+
+    def burst(preempt):
+        engine.reset()
+        f = Frontend(engine, preemption=preempt)
+        lo = [f.submit(p, max_new_tokens=24, priority=0)
+              for p in prompts]
+        for _ in range(3):
+            f.pump()                        # pool fully decoding
+        hi_ = [f.submit(p, max_new_tokens=6, priority=5)
+               for p in hi_prompts]
+        return f, lo, hi_, f.run_until_idle()
+
+    # the A/B that makes the TTFT split meaningful: the same
+    # high-priority burst lands on the same busy pool, with and
+    # without the eviction policy. One warmup pass first — the first
+    # eviction ever compiles the (tiny) slot-cancel program, which
+    # would otherwise land inside the preemption side's TTFT
+    burst(True)
+    fe_off, _, hi_off, _ = burst(False)
+    fe2, low, hi, res = burst(True)
+    st = fe2.stats()
+    identical = all(np.array_equal(res[r], a)
+                    for r, a in zip(low, ref))
+
+    def ttft_ms(frontend, rids, q):
+        vals = [frontend.server.ttft[r] * 1000 for r in rids
+                if r in frontend.server.ttft]
+        return round(float(np.percentile(vals, q)), 2) if vals else None
+
+    return {
+        "serving_frontdoor_weights": {t: w for t, w in weights.items()},
+        "serving_frontdoor_share_bronze": round(shares["bronze"], 4),
+        "serving_frontdoor_share_silver": round(shares["silver"], 4),
+        "serving_frontdoor_share_gold": round(shares["gold"], 4),
+        "serving_frontdoor_share_max_rel_err": round(rel_err, 4),
+        "serving_frontdoor_shares_within_10pct": bool(rel_err <= 0.10),
+        "serving_frontdoor_fair_tokens_per_sec": round(
+            total / dt_shares, 1),
+        "serving_frontdoor_preemptions": st["preemptions"],
+        "serving_frontdoor_resumes": st["resumes"],
+        "serving_frontdoor_bit_identical": bool(identical),
+        "serving_frontdoor_ttft_p50_ms_high": ttft_ms(fe2, hi, 50),
+        "serving_frontdoor_ttft_p95_ms_high": ttft_ms(fe2, hi, 95),
+        "serving_frontdoor_ttft_p50_ms_high_nopreempt":
+            ttft_ms(fe_off, hi_off, 50),
+        "serving_frontdoor_ttft_p95_ms_high_nopreempt":
+            ttft_ms(fe_off, hi_off, 95),
+        "serving_frontdoor_ttft_p50_ms_low": ttft_ms(fe2, low, 50),
+        "serving_frontdoor_ttft_p95_ms_low": ttft_ms(fe2, low, 95),
+        "serving_frontdoor_decode_compiles":
+            engine.decode_compile_count(),
+        "serving_frontdoor_prefill_compiles":
+            engine.prefill_compile_count(),
+    }
 
 
 def run_serving_megakernel_bench(requests: int = 8, max_new: int = 32,

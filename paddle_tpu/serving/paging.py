@@ -446,7 +446,11 @@ def _arm_fn(state, slot, table_row, tok0, pos0, rem0, eos0, temp0,
 class _PrefillJob:
     """One admitted request still streaming its prompt into the arena
     (``done`` counts tokens already resident, including the shared
-    prefix it skipped)."""
+    prefix it skipped). For a preemption resume, ``prompt`` is the
+    original prompt plus the generated history being re-prefilled and
+    ``resume_tok`` is the carried in-hand next token — the chunk
+    programs' in-graph samples are discarded and the slot arms with it
+    instead."""
     run: _SlotRun
     slot: int
     prompt: np.ndarray
@@ -458,6 +462,7 @@ class _PrefillJob:
     topk: jnp.ndarray
     topp: jnp.ndarray
     tok0: Optional[int] = None
+    resume_tok: Optional[int] = None
 
 
 class PagedEngine(ContinuousBatchingEngine):
@@ -627,14 +632,27 @@ class PagedEngine(ContinuousBatchingEngine):
     # -- admission ---------------------------------------------------------
     def try_admit(self, request) -> bool:
         prompt = np.asarray(request.prompt, np.int32).reshape(-1)
-        L = int(prompt.shape[0])
-        self.validate_request(L, request.max_new_tokens)
+        resume = getattr(request, "resume", None)
+        if resume is not None and resume.tokens:
+            # preemption resume: the "prompt" to prefill is the original
+            # prompt plus the generated history minus the in-hand next
+            # token; the first full prompt blocks are usually still in
+            # the prefix index (eviction retained them), so most of this
+            # re-prefill is cache hits rather than recompute
+            full = np.concatenate([
+                prompt, np.asarray(resume.tokens[:-1], np.int32)])
+            mnt = request.max_new_tokens - len(resume.tokens) + 1
+        else:
+            resume = None
+            full, mnt = prompt, request.max_new_tokens
+        L = int(full.shape[0])
+        self.validate_request(L, mnt)
         slot = next((i for i, s in enumerate(self._slots) if s is None),
                     None)
         if slot is None:
             raise RuntimeError("no free slot (scheduler bug)")
-        shared = self.manager.match_prefix(prompt)
-        total = self.blocks_needed(L, request.max_new_tokens)
+        shared = self.manager.match_prefix(full)
+        total = self.blocks_needed(L, mnt)
         fresh = self.manager.allocate(total - len(shared))
         if fresh is None:            # pool exhausted: retry later
             self.manager.release(shared)
@@ -643,23 +661,35 @@ class PagedEngine(ContinuousBatchingEngine):
         if self.tracer is not None:
             self.tracer.span_end(request.request_id, "queue_wait",
                                  shared_blocks=len(shared),
-                                 fresh_blocks=len(fresh))
+                                 fresh_blocks=len(fresh),
+                                 resumed=resume is not None)
         table_row = np.zeros((self.max_blocks,), np.int32)
         table_row[:len(block_ids)] = block_ids
-        key = jax.random.PRNGKey(request.seed)
-        key, sub = jax.random.split(key)   # generate()'s key schedule
-        run = _SlotRun(request, block_ids=block_ids)
+        if resume is None:
+            key = jax.random.PRNGKey(request.seed)
+            key, sub = jax.random.split(key)  # generate()'s key schedule
+            run = _SlotRun(request, block_ids=block_ids)
+            resume_tok = None
+        else:
+            # the saved key IS the next step's split input — arming with
+            # it (and discarding the chunk programs' in-graph samples)
+            # keeps seeded-sampled resumes bit-identical
+            key = jnp.asarray(np.asarray(resume.key, np.uint32))
+            sub = jax.random.PRNGKey(0)            # discarded draw
+            run = _SlotRun(request, tokens=list(resume.tokens),
+                           t_admit=resume.t_admit, block_ids=block_ids)
+            resume_tok = int(resume.tokens[-1])
         self._slots[slot] = run
         self._prefill_slots.add(slot)
         n_shared = len(shared) * self.kv_block_size
         self.prompt_tokens += L
         self.shared_tokens += n_shared
         self._jobs.append(_PrefillJob(
-            run=run, slot=slot, prompt=prompt, done=n_shared,
+            run=run, slot=slot, prompt=full, done=n_shared,
             table_row=table_row, key=key, sub=sub,
             temp=jnp.float32(request.temperature),
             topk=jnp.int32(request.top_k),
-            topp=jnp.float32(request.top_p)))
+            topp=jnp.float32(request.top_p), resume_tok=resume_tok))
         return True
 
     def admit(self, request) -> bool:
@@ -712,19 +742,31 @@ class PagedEngine(ContinuousBatchingEngine):
 
     def _finish_prefill(self, job: _PrefillJob, tok0_dev):
         req = job.run.request
-        tok0 = int(tok0_dev)
         now = time.perf_counter()
-        job.run.tokens = [tok0]
-        job.run.t_admit = now               # TTFT timestamp
-        self.tokens_emitted += 1
-        _M_TOKENS.inc()
+        eos = req.eos_token_id
+        if job.resume_tok is not None:
+            # preemption resume: the carried stream owns the next token
+            # — the chunk's in-graph sample is discarded, tokens and the
+            # TTFT timestamp ride over from the evicted run
+            tok0 = job.resume_tok
+            rem0 = req.max_new_tokens - len(job.run.tokens)
+            req.resume = None
+            if self.tracer is not None:
+                self.tracer.instant(req.request_id, "resume",
+                                    slot=job.slot,
+                                    reused_tokens=len(job.run.tokens))
+        else:
+            tok0 = int(tok0_dev)
+            job.run.tokens = [tok0]
+            job.run.t_admit = now           # TTFT timestamp
+            self.tokens_emitted += 1
+            _M_TOKENS.inc()
+            rem0 = req.max_new_tokens - 1
+            if eos is not None and tok0 == eos:
+                rem0 = 0
         # the prompt's full blocks are resident now — index them so the
         # NEXT request with this prefix skips the compute
         self.manager.register_prefix(job.prompt, job.run.block_ids)
-        eos = req.eos_token_id
-        rem0 = req.max_new_tokens - 1
-        if eos is not None and tok0 == eos:
-            rem0 = 0
         self._prefill_slots.discard(job.slot)
         if rem0 <= 0:                # finished at admission
             self._retire(job.slot, job.run, now)
@@ -753,6 +795,16 @@ class PagedEngine(ContinuousBatchingEngine):
         shared ``_retire`` path. The slot never armed, so there is no
         in-graph state to kill."""
         self._jobs = [j for j in self._jobs if j.slot != slot]
+
+    def _release_slot_resources(self, run):
+        """Preemption release: the run's arena blocks drop one ref —
+        registered prompt-prefix blocks park in the LRU cache (their
+        prefix-index entries RETAINED, so the resume's re-prefill is
+        mostly cache hits), unregistered decode blocks return to the
+        free list."""
+        if run.block_ids is not None:
+            self.manager.release(run.block_ids)
+            run.block_ids = None
 
     def _poison_live_slot(self):
         """Paged poison: NaN the arena block holding the victim's
@@ -799,7 +851,8 @@ class PagedEngine(ContinuousBatchingEngine):
             jobs_meta.append({
                 "slot": job.slot, "done": job.done,
                 "temp": float(job.temp), "topk": int(job.topk),
-                "topp": float(job.topp), "tok0": job.tok0})
+                "topp": float(job.topp), "tok0": job.tok0,
+                "resume_tok": job.resume_tok})
         meta["jobs"] = jobs_meta
         meta["paged_counters"] = {
             "prompt_tokens": self.prompt_tokens,
@@ -839,7 +892,8 @@ class PagedEngine(ContinuousBatchingEngine):
                 sub=jnp.asarray(arrays[f"job{j}_sub"]),
                 temp=jnp.float32(jm["temp"]),
                 topk=jnp.int32(jm["topk"]),
-                topp=jnp.float32(jm["topp"]), tok0=jm["tok0"]))
+                topp=jnp.float32(jm["topp"]), tok0=jm["tok0"],
+                resume_tok=jm.get("resume_tok")))
         pc = meta["paged_counters"]
         self.prompt_tokens = pc["prompt_tokens"]
         self.shared_tokens = pc["shared_tokens"]

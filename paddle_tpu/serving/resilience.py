@@ -197,18 +197,39 @@ class ResilienceState:
 
 _REQ_FIELDS = ("request_id", "max_new_tokens", "temperature", "top_k",
                "top_p", "eos_token_id", "seed", "arrival_step",
-               "t_submit", "deadline_ticks", "deadline_s")
+               "t_submit", "deadline_ticks", "deadline_s", "tenant",
+               "priority", "wait_from")
 
 
 def request_to_meta(req: Request) -> dict:
     """JSON-safe dict of a Request minus its prompt (prompts are
-    arrays — they ride the snapshot's npz payload instead)."""
-    return {f: getattr(req, f) for f in _REQ_FIELDS}
+    arrays — they ride the snapshot's npz payload instead). Preemption
+    ``resume`` state — the generated tokens, the slot rng key, the
+    first-token timestamp — serializes inline: it is exactly the host
+    half of the per-slot snapshot format, small enough for JSON."""
+    meta = {f: getattr(req, f) for f in _REQ_FIELDS}
+    if req.resume is not None:
+        meta["resume"] = {
+            "tokens": [int(t) for t in req.resume.tokens],
+            "key": [int(k) for k in
+                    np.asarray(req.resume.key, np.uint32).reshape(-1)],
+            "t_admit": float(req.resume.t_admit)}
+    return meta
 
 
 def request_from_meta(meta: dict, prompt) -> Request:
+    from .scheduler import ResumeState
+    resume = None
+    rs = meta.get("resume")
+    if rs is not None:
+        resume = ResumeState(tokens=list(rs["tokens"]),
+                             key=np.asarray(rs["key"], np.uint32),
+                             t_admit=rs["t_admit"])
+    # tolerant field read: snapshots written before tenant/priority
+    # existed restore with the dataclass defaults
     return Request(prompt=np.asarray(prompt, np.int32).reshape(-1),
-                   **{f: meta[f] for f in _REQ_FIELDS})
+                   resume=resume,
+                   **{f: meta[f] for f in _REQ_FIELDS if f in meta})
 
 
 # ---------------------------------------------------------------------------

@@ -10,12 +10,28 @@ the engine would otherwise sit idle."""
 from __future__ import annotations
 
 import bisect
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["Request", "Scheduler"]
+__all__ = ["Request", "ResumeState", "Scheduler"]
+
+
+@dataclass
+class ResumeState:
+    """Carried state of a preempted in-flight request (the host half of
+    the PR 5 per-slot snapshot discipline, small enough to ride the
+    queue): the token stream generated so far — ``tokens[-1]`` is the
+    next token to decode, sampled but not yet written to KV — the
+    slot's rng key at eviction (the key the NEXT step would have split,
+    which is what makes a resumed seeded-sampled stream bit-identical
+    to an uninterrupted run), and the original first-token timestamp so
+    TTFT keeps measuring the FIRST admission. Serialized into server
+    snapshots by ``resilience.request_to_meta``."""
+    tokens: List[int] = field(default_factory=list)
+    key: Optional[np.ndarray] = None    # (2,) uint32 per-slot PRNG key
+    t_admit: float = 0.0
 
 
 @dataclass
@@ -31,7 +47,15 @@ class Request:
     still queued or in flight past its deadline is CANCELLED — slot
     freed, paged blocks released — and a ``RequestFailure`` lands in
     ``Server.results`` instead of a silent hang (None disables; the
-    server-level ``ResilienceConfig`` supplies defaults)."""
+    server-level ``ResilienceConfig`` supplies defaults).
+
+    ``tenant`` / ``priority``: the multi-tenant front-door dimensions
+    (serving/frontend.py). Tenants share throughput by weighted-fair
+    queueing; priorities are strict — a higher-priority request admits
+    first within the fairness tier and, with preemption armed, can
+    evict a strictly-lower-priority slot mid-decode. ``resume`` is set
+    by that eviction: a queued request carrying one re-prefills its
+    generated history instead of sampling afresh."""
     request_id: int
     prompt: np.ndarray
     max_new_tokens: int = 20
@@ -44,6 +68,14 @@ class Request:
     t_submit: float = 0.0
     deadline_ticks: Optional[int] = None
     deadline_s: Optional[float] = None
+    tenant: str = "default"
+    priority: int = 0
+    resume: Optional[ResumeState] = None
+    # set to the current tick when a preemption requeues the request:
+    # the max-queue-wait gate measures from HERE, not arrival_step — a
+    # victim's decode time is service, not queue wait (deadlines, which
+    # are end-to-end by contract, still measure from arrival)
+    wait_from: Optional[int] = None
 
 
 class Scheduler:
@@ -56,6 +88,11 @@ class Scheduler:
     tokens of prefill from the in-flight decode — that bounds the
     decode-latency spike a long prompt used to cause. At least one
     request always passes when the gate is open (no starvation)."""
+
+    # strict FIFO pop: a slot freed by preemption would go back to the
+    # front-inserted victim, so the Server's preemption policy refuses
+    # to run on this class (frontend.FairScheduler sets True)
+    priority_aware = False
 
     def __init__(self, max_wait_steps: int = 0, min_admit: int = 1,
                  prefill_token_budget: Optional[int] = None):
@@ -99,29 +136,53 @@ class Scheduler:
     def next_arrival(self) -> Optional[int]:
         return self._queue[0].arrival_step if self._queue else None
 
-    def pop_ready(self, now: int, free_slots: int, engine_idle: bool,
-                  token_budget: Optional[int] = None) -> List[Request]:
-        """Requests to admit this tick. The batching gate holds until
-        ``min_admit`` requests are visible OR the oldest visible request
-        has waited ``max_wait_steps`` ticks — unless the engine is idle
-        (no live slots), where holding would only add latency. The
-        released prefix is additionally cut at the prefill token budget
-        (argument, else the scheduler's own; first request exempt)."""
+    def visible(self, now: int) -> List[Request]:
+        """Queued requests already visible at tick ``now`` (a PEEK — the
+        queue is untouched). The server's preemption policy reads this
+        to decide whether higher-priority work is waiting on capacity."""
+        n = bisect.bisect_right(self._queue, now,
+                                key=lambda r: r.arrival_step)
+        return self._queue[:n]
+
+    def _gate_visible(self, now: int, free_slots: int,
+                      engine_idle: bool,
+                      token_budget: Optional[int]):
+        """Shared admission preamble for this class and its fair
+        subclass (one implementation, so a gate-semantics fix can never
+        silently diverge the two): returns ``(n_visible,
+        token_budget)`` when the batching gate is open, else None. The
+        gate holds until ``min_admit`` requests are visible OR the
+        oldest visible request has waited ``max_wait_steps`` ticks —
+        unless the engine is idle (no live slots), where holding would
+        only add latency."""
         if free_slots <= 0 or not self._queue:
-            return []
+            return None
         # the queue is arrival-sorted: visible requests are a prefix
         n_visible = bisect.bisect_right(self._queue, now,
                                         key=lambda r: r.arrival_step)
         if n_visible == 0:
-            return []
+            return None
         oldest_wait = now - self._queue[0].arrival_step
         gate_open = (n_visible >= self.min_admit
                      or oldest_wait >= self.max_wait_steps
                      or engine_idle)
         if not gate_open:
-            return []
+            return None
         if token_budget is None:
             token_budget = self.prefill_token_budget
+        return n_visible, token_budget
+
+    def pop_ready(self, now: int, free_slots: int, engine_idle: bool,
+                  token_budget: Optional[int] = None) -> List[Request]:
+        """Requests to admit this tick (see :meth:`_gate_visible` for
+        the batching gate). The released prefix is additionally cut at
+        the prefill token budget (argument, else the scheduler's own;
+        first request exempt)."""
+        gate = self._gate_visible(now, free_slots, engine_idle,
+                                  token_budget)
+        if gate is None:
+            return []
+        n_visible, token_budget = gate
         take: List[Request] = []
         tokens = 0
         for r in self._queue[:min(free_slots, n_visible)]:

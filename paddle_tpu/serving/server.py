@@ -71,6 +71,36 @@ _M_TTFT = _om.histogram("pt_server_ttft_seconds",
                         "submit -> first token per completed request")
 _M_OCC = _om.gauge("pt_server_slot_occupancy",
                    "fraction of decode slot-steps that emitted a token")
+# multi-tenant front-door families (serving/frontend.py policy, but the
+# Server owns the lifecycle accounting; registered here at import so
+# the catalog stays complete at zero)
+_M_T_DONE = _om.counter("pt_server_tenant_completed_total",
+                        "completed requests by tenant",
+                        labels=("tenant",))
+_M_T_FAILED = _om.counter("pt_server_tenant_failed_total",
+                          "failed requests by tenant",
+                          labels=("tenant",))
+_M_T_SHED = _om.counter("pt_server_tenant_shed_total",
+                        "submits shed at the global depth cap or the "
+                        "tenant queue quota, by tenant",
+                        labels=("tenant",))
+_M_T_PREEMPT = _om.counter("pt_server_tenant_preemptions_total",
+                           "priority preemptions (slot evicted "
+                           "mid-flight) by victim tenant",
+                           labels=("tenant",))
+_M_T_LAT = _om.histogram("pt_server_tenant_request_latency_seconds",
+                         "submit -> harvest wall time per completed "
+                         "request, by tenant", labels=("tenant",))
+_M_T_TTFT = _om.histogram("pt_server_tenant_ttft_seconds",
+                          "submit -> first token per completed "
+                          "request, by tenant", labels=("tenant",))
+_M_PREEMPT = _om.counter("pt_server_preemptions_total",
+                         "slots evicted mid-flight for higher-priority "
+                         "work (preempt/resume are span events, never "
+                         "request terminals)")
+_M_RESUMED = _om.counter("pt_server_resumes_total",
+                         "preempted requests re-admitted via history "
+                         "re-prefill")
 
 
 class Server:
@@ -85,10 +115,59 @@ class Server:
     def __init__(self, engine: ContinuousBatchingEngine,
                  scheduler: Optional[Scheduler] = None,
                  resilience: Optional[ResilienceConfig] = None,
-                 observability: Optional[ObservabilityConfig] = None):
+                 observability: Optional[ObservabilityConfig] = None,
+                 preemption: Optional[bool] = None):
         self.engine = engine
         self.scheduler = scheduler or Scheduler()
         self.resilience = resilience or ResilienceConfig()
+        env_armed = preemption is None
+        if preemption is None:
+            from ..utils.flags import env_bool
+            preemption = env_bool("PT_SERVING_PREEMPTION")
+        if preemption and not getattr(self.scheduler, "priority_aware",
+                                      False):
+            # a FIFO scheduler hands the freed slot straight back to
+            # the front-inserted victim: eviction churn + priority
+            # inversion instead of lower TTFT. Explicit misconfig is
+            # refused loudly; the env knob (weaker than explicit
+            # config, same contract as PT_SERVING_PAGED) never forces
+            # an unsupported scheduler.
+            if env_armed:
+                preemption = False
+            else:
+                raise ValueError(
+                    "preemption=True needs a priority-aware scheduler "
+                    "(serving.frontend.FairScheduler): the FIFO "
+                    "scheduler would hand every freed slot back to the "
+                    "evicted victim")
+        if preemption and (hasattr(engine, "spec_k")
+                           or engine.tp_degree() > 1):
+            # untested compositions are refused loudly, never run
+            # silently — same contract as spec+tp / megakernel+tp
+            # (the drafter's per-run history cache and the sharded
+            # state's eviction path are unpinned; ROADMAP follow-up)
+            if env_armed:
+                preemption = False
+            else:
+                raise NotImplementedError(
+                    "priority preemption is not yet composed with "
+                    "speculative or tensor-parallel engines — drop "
+                    "preemption= or spec=/tp= (ROADMAP follow-up)")
+        # priority preemption policy: strictly-higher-priority visible
+        # work may evict a live lower-priority slot (engine.preempt_slot
+        # mechanism; default off — the PR 1/4 bit-identity contract is
+        # untouched without it)
+        self.preemption = bool(preemption)
+        self.preemptions = 0
+        self.resumes = 0
+        # per-tenant lifecycle accounting (frontend.py stats + metrics)
+        self.tenant_counts: Dict[str, Dict[str, int]] = {}
+        self._tenant_of: Dict[int, str] = {}
+        # token-stream hook (serving/frontend.py): when set, called as
+        # sink(rid, tokens_list_or_None, done, failure) from the
+        # harvest/fail paths and once per tick for live runs — None
+        # keeps every hot path at one `is None` check
+        self.stream_sink = None
         self._res = ResilienceState(self.resilience)
         engine.nan_sentinel = self.resilience.nan_sentinel
         # the breaker gauge tracks THIS server from birth — without the
@@ -116,18 +195,23 @@ class Server:
                top_p: float = 1.0, eos_token_id: Optional[int] = None,
                seed: int = 0, arrival_step: int = 0,
                deadline_ticks: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               tenant: str = "default", priority: int = 0) -> int:
         """Queue one request; returns its id (key into ``results``).
         Capacity is validated HERE — a request that can never fit a
         slot (or, paged, the block pool) is rejected at the door, not
         re-queued forever mid-stream. With ``max_queue_depth`` set, a
         submit beyond the cap is load-shed: the id comes back with a
-        ``RequestFailure(reason="shed")`` already recorded."""
+        ``RequestFailure(reason="shed")`` already recorded. A scheduler
+        with per-tenant quotas (frontend.FairScheduler) sheds the same
+        way when ``tenant``'s queue quota is exhausted."""
         prompt = np.asarray(prompt, np.int32)
         self.engine.validate_request(int(prompt.size), max_new_tokens)
         rid = self._next_id
         self._next_id += 1
         _M_SUBMIT.inc()
+        self._tenant_of[rid] = tenant
+        self._tcount(tenant)["submitted"] += 1
         self.tracer.start(rid)
         depth = self.resilience.max_queue_depth
         if depth is not None and self.scheduler.pending() >= depth:
@@ -137,15 +221,33 @@ class Server:
             self._fail(rid, "shed",
                        f"queue depth at cap ({depth}); retry later")
             return rid
+        quota = getattr(self.scheduler, "quota_exceeded", None)
+        if quota is not None and quota(tenant):
+            self._res.shed_requests += 1
+            _M_SHED.inc()
+            self.flight.record("shed", rid=rid, tenant=tenant)
+            self._fail(rid, "shed",
+                       f"tenant {tenant!r} queue quota exhausted; "
+                       "retry later")
+            return rid
         self.scheduler.submit(Request(
             request_id=rid, prompt=np.asarray(prompt, np.int32),
             max_new_tokens=max_new_tokens, temperature=temperature,
             top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
             seed=seed, arrival_step=arrival_step,
             t_submit=time.perf_counter(),
-            deadline_ticks=deadline_ticks, deadline_s=deadline_s))
+            deadline_ticks=deadline_ticks, deadline_s=deadline_s,
+            tenant=tenant, priority=priority))
         _M_QUEUE.set(self.scheduler.pending())
         return rid
+
+    def _tcount(self, tenant: str) -> Dict[str, int]:
+        c = self.tenant_counts.get(tenant)
+        if c is None:
+            c = {"submitted": 0, "completed": 0, "failed": 0,
+                 "shed": 0, "preemptions": 0, "tokens": 0}
+            self.tenant_counts[tenant] = c
+        return c
 
     # -- failure plumbing --------------------------------------------------
     def _fail(self, rid: int, reason: str, message: str = "",
@@ -155,11 +257,20 @@ class Server:
             tokens_emitted=tokens)
         self._res.count_failure(reason)
         _M_FAILED.inc(reason=reason)
+        tenant = self._tenant_of.get(rid, "default")
+        tc = self._tcount(tenant)
+        tc["failed"] += 1
+        _M_T_FAILED.inc(tenant=tenant)
+        if reason == "shed":
+            tc["shed"] += 1
+            _M_T_SHED.inc(tenant=tenant)
         if reason == "timeout":
             _M_DEADLINE.inc()
         self.flight.record("request_failed", rid=rid, reason=reason,
                            tokens=tokens)
         self.tracer.terminal(rid, reason, tokens=tokens)
+        if self.stream_sink is not None:
+            self.stream_sink(rid, None, True, reason)
 
     def _deadline_hit(self, req: Request, now: float) -> bool:
         cfg = self.resilience
@@ -181,7 +292,11 @@ class Server:
         mw = self.resilience.max_queue_wait_ticks
 
         def queued_out(r):
-            if mw is not None and self._clock - r.arrival_step > mw:
+            # a preempted victim's wait is measured from its requeue
+            # (wait_from), not arrival — its decode time was service;
+            # deadlines stay end-to-end via _deadline_hit below
+            base = r.arrival_step if r.wait_from is None else r.wait_from
+            if mw is not None and self._clock - base > mw:
                 return True
             return self._deadline_hit(r, now)
 
@@ -245,25 +360,125 @@ class Server:
             self._fail(r.request_id, reason,
                        "circuit breaker open: queue drained")
 
+    # -- priority preemption ----------------------------------------------
+    def _preempt_victim(self, below: int) -> bool:
+        """Evict ONE live run with priority strictly under ``below``:
+        lowest priority first, then fewest generated tokens (least
+        re-prefill work lost), then highest slot — deterministic. Only
+        resumable victims qualify (can_resume), so a preemption is
+        always a pause, never a silent kill. Returns False when no run
+        qualifies."""
+        cands = [(run.request.priority, len(run.tokens), -slot, slot,
+                  run)
+                 for slot, run in self.engine.live_runs()
+                 if run.request.priority < below
+                 and self.engine.can_resume(run)]
+        if not cands:
+            return False
+        *_, slot, run = min(cands, key=lambda c: c[:3])
+        self._do_preempt(slot, run)
+        return True
+
+    def _do_preempt(self, slot: int, run):
+        """Preempt mechanism glue: evict through the engine (in-graph
+        slot kill, paged blocks released at exact refcounts with the
+        prefix index retained), attach the carried stream state to the
+        request, and requeue it at the front of its arrival tick. The
+        request stays OPEN — preempt/resume are span events on its
+        trace, never terminals."""
+        from .scheduler import ResumeState
+        req = run.request
+        _, key = self.engine.preempt_slot(slot)
+        if key is not None:          # was decoding: carry the stream
+            req.resume = ResumeState(tokens=list(run.tokens),
+                                     key=np.asarray(key, np.uint32),
+                                     t_admit=run.t_admit)
+        # else mid-prefill: a fresh victim requeues as-submitted; a
+        # victim mid-RESUME-prefill keeps its existing resume state
+        req.wait_from = self._clock      # queue wait restarts here
+        self.scheduler.requeue(req)
+        self.tracer.span_begin(req.request_id, "queue_wait",
+                               requeued=True)
+        self.preemptions += 1
+        tenant = getattr(req, "tenant", "default")
+        self._tcount(tenant)["preemptions"] += 1
+        _M_PREEMPT.inc()
+        _M_T_PREEMPT.inc(tenant=tenant)
+        self.flight.record("preempt", rid=req.request_id, slot=slot,
+                           tokens=len(run.tokens), clock=self._clock)
+
+    def _preempt_for_priority(self):
+        """Admission-side preemption: walk the visible queue from the
+        highest priority down; each request that would otherwise wait
+        on a full pool evicts one strictly-lower-priority victim. The
+        freed slots are then handed out by the scheduler's normal
+        pop_ready order — eviction opens capacity, it does not
+        hard-assign slots. Runs only when the admission batching gate
+        would actually release work (probed with one hypothetical free
+        slot) — evicting into a held gate would idle the freed slot
+        for up to max_wait_steps while the victim pays a re-prefill
+        for nothing."""
+        gate = getattr(self.scheduler, "_gate_visible", None)
+        if gate is not None and gate(
+                self._clock, 1, not self.engine.has_live(),
+                None) is None:
+            return
+        vis = self.scheduler.visible(self._clock)
+        if not vis:
+            return
+        free = self.engine.free_slot_count()
+        if free >= len(vis):
+            return          # every waiter gets a slot without eviction
+        # O(V) bail before the O(V log V) sort: nothing waiting
+        # outranks anything running -> no eviction is possible
+        runs = self.engine.live_runs()
+        if not runs or min(r.request.priority for _, r in runs) >= \
+                max(r.priority for r in vis):
+            return
+        for req in sorted(vis, key=lambda r: -r.priority):
+            if free > 0:
+                free -= 1            # a free slot serves this request
+                continue
+            if not self._preempt_victim(below=req.priority):
+                break    # nothing evictable at this (or any lower) tier
+            # the freed slot is spoken for by req: net free stays 0
+
     # -- the tick ----------------------------------------------------------
     def _tick(self):
         self._expire()
+        if self.preemption and not self.engine.has_pending_harvest():
+            # only at a clean block boundary — a dispatched block
+            # awaiting a harvest retry must land before any eviction
+            self._preempt_for_priority()
         admitted = self.scheduler.pop_ready(
             self._clock, self.engine.free_slot_count(),
             engine_idle=not self.engine.has_live())
         for i, req in enumerate(admitted):
-            if not self.engine.try_admit(req):
-                # re-queue in reverse: requeue() front-inserts per
-                # arrival tick, so forward order would flip
-                # same-tick FIFO and let peers overtake the oldest
-                _M_DEFER.inc(len(admitted) - i)
-                self.flight.record(
-                    "block_pool_defer", rid=req.request_id,
-                    clock=self._clock,
-                    deferred=len(admitted) - i)
-                for r in reversed(admitted[i:]):
-                    self.scheduler.requeue(r)
-                break
+            resumed = getattr(req, "resume", None) is not None
+            ok = self.engine.try_admit(req)
+            while not ok and self.preemption and \
+                    not self.engine.has_pending_harvest() and \
+                    self._preempt_victim(below=req.priority):
+                # paged: the block pool (not the slots) was the limit —
+                # evict lower-priority work until the request fits or
+                # no victims remain
+                ok = self.engine.try_admit(req)
+            if ok:
+                if resumed:
+                    self.resumes += 1
+                    _M_RESUMED.inc()
+                continue
+            # re-queue in reverse: requeue() front-inserts per
+            # arrival tick, so forward order would flip
+            # same-tick FIFO and let peers overtake the oldest
+            _M_DEFER.inc(len(admitted) - i)
+            self.flight.record(
+                "block_pool_defer", rid=req.request_id,
+                clock=self._clock,
+                deferred=len(admitted) - i)
+            for r in reversed(admitted[i:]):
+                self.scheduler.requeue(r)
+            break
         prefill_tick = getattr(self.engine, "prefill_tick", None)
         if prefill_tick is not None:
             # chunks dispatched before a mid-loop fault keep their
@@ -314,10 +529,20 @@ class Server:
             _M_DONE.inc()
             _M_LAT.observe(self.latencies[req.request_id])
             _M_TTFT.observe(self.ttft[req.request_id])
+            tenant = getattr(req, "tenant", "default")
+            tc = self._tcount(tenant)
+            tc["completed"] += 1
+            tc["tokens"] += len(run.tokens)
+            _M_T_DONE.inc(tenant=tenant)
+            _M_T_LAT.observe(self.latencies[req.request_id],
+                             tenant=tenant)
+            _M_T_TTFT.observe(self.ttft[req.request_id], tenant=tenant)
             self.tracer.instant(req.request_id, "harvest",
                                 tokens=len(run.tokens))
             self.tracer.terminal(req.request_id, "completed",
                                  tokens=len(run.tokens))
+            if self.stream_sink is not None:
+                self.stream_sink(req.request_id, run.tokens, True, None)
 
     def run_until_idle(self, max_ticks: Optional[int] = None
                        ) -> Dict[int, object]:
@@ -355,6 +580,7 @@ class Server:
             self._clock += 1
             ticks += 1
             self._harvest()
+            self._drain_live_streams()
             tick_s = time.perf_counter() - t_tick
             self.tick_seconds.append(tick_s)
             self.tracer.server_span_at("tick", t_tick_us,
@@ -374,6 +600,19 @@ class Server:
                 break
         self._wall += time.perf_counter() - t0
         return self.results
+
+    def _drain_live_streams(self):
+        """Token-by-token streaming out of the harvest path: after each
+        tick's harvest, in-flight runs' freshly decoded tokens flow to
+        the stream sink (the frontend fans them out to per-request
+        bounded queues / callbacks). Token visibility granularity is
+        the decode block — exactly when the host learns of them."""
+        if self.stream_sink is None:
+            return
+        for _slot, run in self.engine.live_runs():
+            if run.tokens:
+                self.stream_sink(run.request.request_id, run.tokens,
+                                 False, None)
 
     def _circuit_open_drain(self):
         """Breaker-open endgame: auto-dump the flight recorder (the
@@ -417,6 +656,12 @@ class Server:
             "max_tick_s": round(max(ticks), 4) if ticks else 0.0,
             "p95_tick_s": round(float(np.percentile(ticks, 95)), 4)
             if ticks else 0.0,
+            "preemptions": self.preemptions,
+            "resumes": self.resumes,
+            # per-tenant breakdown (single-tenant traffic shows one
+            # "default" row — the shape is stable either way)
+            "tenants": {t: dict(c)
+                        for t, c in sorted(self.tenant_counts.items())},
         }
         out.update(self._res.counters())
         if eng.tp_degree() > 1:                # tensor-parallel extras
@@ -482,6 +727,12 @@ class Server:
             "ttft": {str(k): v for k, v in self.ttft.items()},
             "results": res_meta, "queue": qmeta,
             "counters": self._res.counters(),
+            "preemption_enabled": self.preemption,
+            "preemptions": self.preemptions,
+            "resumes": self.resumes,
+            "tenant_counts": self.tenant_counts,
+            "tenant_of": {str(k): v
+                          for k, v in self._tenant_of.items()},
             # the flight ring rides the snapshot (restored server keeps
             # its pre-crash event history) AND dumps beside it for
             # humans reading the crash site without np.load
@@ -494,8 +745,8 @@ class Server:
     def restore(cls, path: str, engine: ContinuousBatchingEngine,
                 scheduler: Optional[Scheduler] = None,
                 resilience: Optional[ResilienceConfig] = None,
-                observability: Optional[ObservabilityConfig] = None
-                ) -> "Server":
+                observability: Optional[ObservabilityConfig] = None,
+                preemption: Optional[bool] = None) -> "Server":
         """Rebuild a server from a snapshot into a freshly constructed
         engine of the same configuration (fresh process simulation:
         programs recompile, state restores — then ``run_until_idle()``
@@ -506,8 +757,11 @@ class Server:
         with a smaller capacity keeps only the newest events that fit."""
         meta, arrays = load_snapshot(path)
         engine.restore_state(meta["engine"], arrays)
-        srv = cls(engine, scheduler, resilience, observability)
         sm = meta["server"]
+        if preemption is None:   # the saved policy survives by default
+            preemption = sm.get("preemption_enabled")
+        srv = cls(engine, scheduler, resilience, observability,
+                  preemption=preemption)
         srv._next_id = sm["next_id"]
         srv._clock = sm["clock"]
         srv._wall = sm["wall"]
@@ -527,6 +781,13 @@ class Server:
         # budget, breaker) survives the restore — an open circuit must
         # stay open in the resumed process
         srv._res.restore_counters(sm["counters"])
+        # front-door accounting (tolerant: pre-frontend snapshots)
+        srv.preemptions = sm.get("preemptions", 0)
+        srv.resumes = sm.get("resumes", 0)
+        srv.tenant_counts = {t: dict(c) for t, c in
+                             sm.get("tenant_counts", {}).items()}
+        srv._tenant_of = {int(k): v for k, v in
+                          sm.get("tenant_of", {}).items()}
         _M_BREAKER.set(1 if srv._res.breaker_open else 0)
         if "flight" in sm:       # pre-observability snapshots lack it
             srv.flight.restore_meta(sm["flight"])

@@ -6,7 +6,7 @@ import os
 from typing import Any
 
 __all__ = ["define_flag", "get_flags", "set_flags", "FLAGS", "env_flag",
-           "env_bool", "env_int", "env_float", "env_str"]
+           "env_bool", "env_int", "env_float", "env_set", "env_str"]
 
 
 def env_bool(name: str, default: bool = False) -> bool:
@@ -48,6 +48,15 @@ def env_str(name: str, default: str = "") -> str:
     """Read a PT_* string env knob (stripped)."""
     v = os.environ.get(name)
     return default if v is None else v.strip()
+
+
+def env_set(name: str) -> bool:
+    """Whether an env knob is PRESENT at all (even set-empty or "0") —
+    for resolution orders where "explicitly set" must beat other
+    sources regardless of the value (e.g. the flash-block preference:
+    env beats the autotune table, and `NAME=0` means "kernel defaults",
+    not "unset")."""
+    return os.environ.get(name) is not None
 
 _REGISTRY: dict[str, Any] = {}
 

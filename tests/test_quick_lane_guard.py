@@ -24,7 +24,8 @@ GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
                  "tests/test_serving_tp.py", "tests/test_serving_spec.py",
                  "tests/test_serving_quant.py",
                  "tests/test_sparse_quant.py",
-                 "tests/test_megakernel.py", "tests/test_autotune.py"]
+                 "tests/test_megakernel.py", "tests/test_autotune.py",
+                 "tests/test_frontend.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -129,6 +130,29 @@ REQUIRED_NODES = [
     "test_xent_chunk_default_unchanged_without_table",
     "test_autotune.py::TestConsumers::"
     "test_flash_block_pref_resolution_order",
+    # PR 13 front-door pins: streaming bit-identity (dense + paged),
+    # the preempt-resume bit-identity matrix (greedy AND seeded-
+    # sampled), the span-events-never-terminals trace contract, the
+    # resume-state snapshot round trip, WFQ shares, and the chaos
+    # schedule with preemption + WFQ active
+    "test_frontend.py::TestStreaming::"
+    "test_iterator_greedy_bit_identical[dense]",
+    "test_frontend.py::TestStreaming::"
+    "test_iterator_greedy_bit_identical[paged]",
+    "test_frontend.py::TestPreemption::"
+    "test_greedy_preempt_resume_bit_identical[paged]",
+    "test_frontend.py::TestPreemption::"
+    "test_seeded_sampled_preempt_resume_bit_identical[dense]",
+    "test_frontend.py::TestPreemption::"
+    "test_seeded_sampled_preempt_resume_bit_identical[paged]",
+    "test_frontend.py::TestPreemption::"
+    "test_preempt_resume_are_span_events_one_terminal",
+    "test_frontend.py::TestPreemption::"
+    "test_preempted_request_survives_snapshot_restore",
+    "test_frontend.py::TestFairScheduler::"
+    "test_weighted_shares_over_backlog",
+    "test_frontend.py::TestFrontdoorChaos::"
+    "test_chaos_with_preemption_and_wfq",
 ]
 
 
