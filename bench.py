@@ -50,6 +50,8 @@ SERVING_QUANT_DEADLINE_S = env_float("BENCH_SERVING_QUANT_DEADLINE_S",
 SERVING_MEGA_DEADLINE_S = env_float("BENCH_SERVING_MEGA_DEADLINE_S", 300)
 SERVING_FRONTDOOR_DEADLINE_S = env_float(
     "BENCH_SERVING_FRONTDOOR_DEADLINE_S", 300)
+SERVING_DISAGG_DEADLINE_S = env_float(
+    "BENCH_SERVING_DISAGG_DEADLINE_S", 300)
 AUTOTUNE_DEADLINE_S = env_float("BENCH_AUTOTUNE_DEADLINE_S", 300)
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
@@ -712,6 +714,17 @@ def _child_tpu():
         decode.update(fd if fd is not None
                       else {"serving_frontdoor_bit_identical": None})
         _release_hbm()
+        # disaggregated prefill/decode fleet on the REAL chip: handoff
+        # wire bytes, fleet-wide prefix hit rate, disagg-vs-unified
+        # TTFT/tokens/s (the hardware-pool split claim lives here)
+        from paddle_tpu.serving.microbench import \
+            run_serving_disagg_bench
+        dis, err = _staged(run_serving_disagg_bench, "serving-disagg")
+        if err:
+            errors.append(err)
+        decode.update(dis if dis is not None
+                      else {"serving_disagg_bit_identical": None})
+        _release_hbm()
         # block-size autotune sweep on the REAL chip (flash/splash
         # blocks + the CPU-honest knobs, persisted per device kind)
         from paddle_tpu.ops.pallas.autotune import run_autotune
@@ -825,7 +838,8 @@ def _run_child(mode: str, deadline: float):
                 "--child-observability", "--child-serving-tp",
                 "--child-serving-spec", "--child-serving-quant",
                 "--child-serving-megakernel",
-                "--child-serving-frontdoor", "--child-autotune"):
+                "--child-serving-frontdoor", "--child-serving-disagg",
+                "--child-autotune"):
         env["JAX_PLATFORMS"] = "cpu"
     if mode in ("--child-comms", "--child-serving-tp"):
         # simulated 2x4 mesh on the CPU lane
@@ -1084,6 +1098,32 @@ def _attach_serving_frontdoor(result, budget_s=None):
                          SERVING_FRONTDOOR_DEADLINE_S, budget_s)
 
 
+def _child_serving_disagg():
+    """serving-disagg stage: the prefill/decode fleet
+    (serving/fleet.py + handoff.py) on a shared-system-prompt workload
+    — pins cross-worker bit-identity vs a unified Server, handoff KV
+    payload bytes at wire size with the fp32-vs-int8 ratio (~3.6x),
+    fleet-wide prefix hit rate with an affinity-on/off A/B (gate:
+    affinity >= the single-replica rate), disagg-vs-unified TTFT p50
+    and decode tokens/s, and the compile-count pins (ONE decode block
+    per decode worker, ONE chunk program per prefill worker). All
+    fields non-null on the CPU lane; the TPU child stages the same
+    fleet."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.serving.microbench import run_serving_disagg_bench
+    out = run_serving_disagg_bench(
+        requests_per_group=env_int("BENCH_SERVING_DISAGG_REQUESTS", 6),
+        max_new=env_int("BENCH_SERVING_DISAGG_MAX_NEW", 8))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_serving_disagg(result, budget_s=None):
+    return _attach_stage(result, "serving-disagg",
+                         "--child-serving-disagg",
+                         SERVING_DISAGG_DEADLINE_S, budget_s)
+
+
 def _child_autotune():
     """autotune stage: the Pallas block-size sweep harness
     (ops/pallas/autotune.py) — sweeps every knob that is honest on this
@@ -1207,6 +1247,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-megakernel":
         _child_serving_megakernel()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-disagg":
+        _child_serving_disagg()
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-autotune":
         _child_autotune()
         return
@@ -1289,6 +1332,7 @@ def _main_measured(errors):
                 result = _attach_serving_quant(result, remaining())
                 result = _attach_serving_megakernel(result, remaining())
                 result = _attach_serving_frontdoor(result, remaining())
+                result = _attach_serving_disagg(result, remaining())
                 _emit_final(_attach_autotune(result, remaining()))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
@@ -1316,6 +1360,7 @@ def _main_measured(errors):
         result = _attach_serving_quant(result, remaining())
         result = _attach_serving_megakernel(result, remaining())
         result = _attach_serving_frontdoor(result, remaining())
+        result = _attach_serving_disagg(result, remaining())
         _emit_final(_attach_autotune(result, remaining()))
         return
     # last resort: still one JSON line, rc 0, explicit marker

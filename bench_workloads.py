@@ -28,18 +28,19 @@ the analytic 6*N*T "model FLOPs" convention; both are recorded.
 from __future__ import annotations
 
 import json
-import os
 import sys
 import time
 
 import numpy as np
+
+from paddle_tpu.utils.flags import env_bool
 
 PEAK = 197e12  # v5e bf16 peak FLOP/s
 HBM_LIMIT = 15.2e9
 # PT_WORKLOADS_TINY=1: shrink every config/shape so the whole file can
 # be smoke-tested on CPU (tests/test_bench_workloads.py) before a chip
 # session spends its window on it.
-TINY = os.environ.get("PT_WORKLOADS_TINY", "") == "1"
+TINY = env_bool("PT_WORKLOADS_TINY")
 
 
 def _compiled_flops(step, batch_t):

@@ -10,7 +10,12 @@ path (``inference.export_decoder(engine_slots=...)`` +
 serialized artifact alone."""
 from .engine import (ArtifactStepBackend, ContinuousBatchingEngine,
                      ModelStepBackend, slot_sample_logits)
+from .fleet import (DecodeWorker, Fleet, FleetRouter, InProcessTransport,
+                    PrefillDenseEngine, PrefillPagedEngine,
+                    PrefillWorker, Transport)
 from .frontend import FairScheduler, Frontend, TenantConfig, TokenStream
+from .handoff import (KVHandoff, decode_handoff, encode_handoff,
+                      reshard_kv_chunks)
 from .paging import (BlockManager, PagedArtifactStepBackend, PagedEngine,
                      PagedModelStepBackend)
 from .quant import QuantConfig
@@ -23,12 +28,16 @@ from .tp import (ShardedModelStepBackend, ShardedPagedStepBackend,
                  TPConfig)
 
 __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
-           "ArtifactStepBackend", "BlockManager", "FairScheduler",
-           "Frontend", "PagedArtifactStepBackend", "PagedEngine",
-           "PagedModelStepBackend", "QuantConfig", "Request",
-           "RequestFailure", "ResilienceConfig", "ResumeState",
-           "Scheduler", "Server", "SpecConfig", "SpecEngine",
-           "SpecModelStepBackend", "SpecPagedEngine",
+           "ArtifactStepBackend", "BlockManager", "DecodeWorker",
+           "FairScheduler", "Fleet", "FleetRouter", "Frontend",
+           "InProcessTransport", "KVHandoff",
+           "PagedArtifactStepBackend", "PagedEngine",
+           "PagedModelStepBackend", "PrefillDenseEngine",
+           "PrefillPagedEngine", "PrefillWorker", "QuantConfig",
+           "Request", "RequestFailure", "ResilienceConfig",
+           "ResumeState", "Scheduler", "Server", "SpecConfig",
+           "SpecEngine", "SpecModelStepBackend", "SpecPagedEngine",
            "SpecPagedStepBackend", "ShardedModelStepBackend",
            "ShardedPagedStepBackend", "TPConfig", "TenantConfig",
-           "TokenStream", "ngram_propose", "slot_sample_logits"]
+           "TokenStream", "Transport", "decode_handoff", "encode_handoff",
+           "ngram_propose", "reshard_kv_chunks", "slot_sample_logits"]

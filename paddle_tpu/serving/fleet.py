@@ -1,0 +1,1009 @@
+"""Disaggregated prefill/decode serving fleet: prefill workers, decode
+workers, KV-block handoff, prefix-affinity routing, live migration.
+
+Prefill is compute-bound (one big batched forward per prompt) and
+decode is bandwidth-bound (every weight and KV byte re-read per token);
+at production scale they want different hardware pools. This module
+splits the Server into replicas of two specialties and a router:
+
+- **PrefillWorker**: a Server over a prefill-only engine
+  (:class:`PrefillDenseEngine` / :class:`PrefillPagedEngine`). Prompts
+  admit, (chunked-)prefill and sample their first token exactly as on
+  a unified server — same programs, same key schedule — but a finished
+  prefill parks in a handoff **outbox** instead of arming the slot.
+  The slot and its arena blocks stay held until the payload ships, so
+  a serialize/transport fault retries against live state.
+- **KV handoff** (serving/handoff.py): the outbox entry serializes to
+  a versioned, bytes-true payload — prompt-position KV blocks at
+  storage dtype (int8 codes + scales ship quantized, never dequantized
+  in transit), the in-hand token, the post-split rng key, the request.
+- **DecodeWorker**: a Server over an ordinary engine. ``adopt()``
+  allocates the request's blocks from its OWN BlockManager at exact
+  refcounts, scatters the shipped rows into its arena through ONE
+  fixed-shape jitted program (padded to ``max_blocks``; pad rows land
+  in the trash block), registers the prompt prefix in its own index,
+  and arms the slot through the engine's EXISTING arm/admit program —
+  zero new compiled programs on the decode steady path, decode compile
+  count stays 1. A request prefilled on worker A and decoded on worker
+  B streams BIT-IDENTICAL to a single-replica Server (greedy and
+  seeded-sampled; dense, paged, paged+kv_int8) because the decode
+  block is a pure function of exactly the adopted state.
+- **FleetRouter**: chained-SHA1 prefix-hash affinity — the digest of a
+  prompt's first full block (the same key the BlockManager indexes it
+  under) picks the prefill worker, so a tenant's system prompt lands
+  where its registered blocks already live and the PR 4 prefix cache
+  becomes a fleet-wide asset. Queue-depth spillover diverts from a
+  backlogged affinity target to the least-loaded worker.
+- **Transport**: in-process-first behind a 2-method interface
+  (deterministic FIFO, CPU-lane testable); a network transport drops
+  in without touching the workers. Handoff failures ride the PR 5
+  retry/backoff/breaker machinery (``ResilienceState``): serialize,
+  transport and adopt faults retry with seeded backoff, a permanent
+  failure records an explicit ``RequestFailure(reason="handoff")``,
+  and an open circuit fails fast as ``circuit_open``.
+- **Live migration / scale**: a decode worker snapshots via the PR 5
+  ``Server.snapshot`` path and restores into a fresh engine
+  (``Fleet.migrate_decode_worker``) with every in-flight stream
+  finishing bit-identical; ``add_decode_worker`` scales the decode
+  pool mid-stream; ``drain_prefill_worker`` stops routing to a worker
+  so it can retire cleanly.
+
+Knobs (utils/flags helpers): ``PT_SERVING_FLEET_AFFINITY`` (default
+on) and ``PT_SERVING_FLEET_SPILL_DEPTH`` (default 8).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..observability import metrics as _om
+from ..utils import faults
+from ..utils.flags import env_bool, env_int
+from .engine import (ContinuousBatchingEngine, _M_PREFILLS, _M_TOKENS,
+                     _SlotRun)
+from .handoff import KVHandoff, decode_handoff, encode_handoff
+from .paging import PagedEngine, _sha1_chain
+from .resilience import (RequestFailure, ResilienceConfig,
+                         ResilienceState, request_from_meta,
+                         request_to_meta)
+from .server import Server
+
+__all__ = ["DecodeWorker", "Fleet", "FleetRouter", "InProcessTransport",
+           "PrefillDenseEngine", "PrefillPagedEngine", "PrefillWorker",
+           "Transport"]
+
+# fleet metric families (registered at import so the catalog stays
+# complete at zero; no-ops until metrics.enable()/PT_METRICS)
+_M_HANDOFFS = _om.counter("pt_fleet_handoffs_total",
+                          "KV handoff payloads adopted by decode "
+                          "workers")
+_M_HANDOFF_BYTES = _om.counter("pt_fleet_handoff_bytes_total",
+                               "wire bytes of shipped handoff payloads")
+_M_HANDOFF_FAILS = _om.counter(
+    "pt_fleet_handoff_failures_total",
+    "handoffs that permanently failed, by reason", labels=("reason",))
+_M_FLEET_RETRIES = _om.counter("pt_fleet_retries_total",
+                               "transient handoff-op retry attempts")
+_M_ADOPT_DEFERS = _om.counter(
+    "pt_fleet_adopt_defers_total",
+    "adoptions deferred (decode slot/block pool momentarily full)")
+_M_AFFINITY = _om.counter("pt_fleet_affinity_routes_total",
+                          "submissions routed by prefix-hash affinity")
+_M_SPILL = _om.counter("pt_fleet_spillovers_total",
+                       "submissions diverted off their affinity worker "
+                       "by queue-depth spillover")
+_M_MIGRATIONS = _om.counter("pt_fleet_migrations_total",
+                            "live worker migrations (snapshot/restore)")
+_M_PF_DEPTH = _om.gauge("pt_fleet_prefill_queue_depth",
+                        "queued requests per prefill worker",
+                        labels=("worker",))
+_M_DEC_FREE = _om.gauge("pt_fleet_decode_free_slots",
+                        "free decode slots per decode worker",
+                        labels=("worker",))
+
+
+def _leaf_specs(backend) -> list:
+    """Canonical per-leaf KV layout (shape past the pool dim + dtype):
+    the ONE compatibility signature shared by payload producers
+    (extract_handoff), the adopt-time validator and the fleet-wide
+    compat check — a format change cannot drift them apart."""
+    return [[list(s[1:]), str(np.dtype(d))]
+            for s, d in backend.pool_specs]
+
+
+# ---------------------------------------------------------------------------
+# prefill-only engines
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _PendingHandoff:
+    """One finished prefill waiting to ship. The slot stays occupied
+    (in ``_prefill_slots``, so it never decodes) and paged blocks stay
+    referenced until the payload is on the wire — a serialize or
+    transport fault retries against state that is still alive."""
+    run: _SlotRun
+    slot: int
+    prompt: np.ndarray
+    tok0: int
+    rem0: int
+    key: np.ndarray                     # (2,) uint32 post-split key
+    row: Optional[tuple] = None         # dense: prefilled cache row
+    pad0: int = 0                       # dense: bucket pad count
+    bucket: int = 0                     # dense: bucket length Lb
+
+
+class _PrefillEngineMixin:
+    """Outbox plumbing shared by the dense and paged prefill engines."""
+
+    def reset(self):
+        super().reset()
+        self._outbox: List[_PendingHandoff] = []
+
+    def take_handoffs(self) -> List[_PendingHandoff]:
+        """Drain ship-ready outbox entries. Entries whose run was
+        cancelled meanwhile (deadline expiry went through
+        ``cancel_slot`` → ``_retire``, which already released the slot
+        and blocks) are dropped here, not shipped."""
+        live, self._outbox = self._outbox, []
+        return [ph for ph in live
+                if ph.run.failure is None
+                and self._slots[ph.slot] is ph.run]
+
+    def release_handoff(self, ph: _PendingHandoff):
+        """Free everything a shipped (or permanently failed) handoff
+        held on this worker: the slot, and — paged — its arena blocks
+        at exact refcounts (registered prefix blocks park in the LRU
+        cache, which is what keeps the worker's prefix index hot for
+        the next same-prefix arrival)."""
+        self._prefill_slots.discard(ph.slot)
+        if self._slots[ph.slot] is ph.run:
+            self._slots[ph.slot] = None
+        self._release_slot_resources(ph.run)
+
+    def snapshot_state(self):
+        if self._outbox:
+            raise RuntimeError(
+                "prefill worker holds un-shipped handoffs — drive the "
+                "fleet until the outbox drains before snapshotting")
+        return super().snapshot_state()
+
+
+class PrefillPagedEngine(_PrefillEngineMixin, PagedEngine):
+    """Paged engine that prefills but never decodes: chunked prefill,
+    prefix reuse and the block manager are inherited unchanged; a
+    finished prefill parks in the handoff outbox with its blocks still
+    referenced instead of arming the slot. Requests that finish AT
+    prefill (eos on the first token, max_new==1) complete here — no
+    decode worker ever sees them."""
+
+    def try_admit(self, request) -> bool:
+        resume = getattr(request, "resume", None)
+        if resume is not None and resume.tokens:
+            raise NotImplementedError(
+                "prefill workers do not take preemption resumes — the "
+                "fleet never preempts (route resumes to a unified "
+                "Server)")
+        return super().try_admit(request)
+
+    def _finish_prefill(self, job, tok0_dev):
+        req = job.run.request
+        now = time.perf_counter()
+        eos = req.eos_token_id
+        tok0 = int(tok0_dev)
+        job.run.tokens = [tok0]
+        job.run.t_admit = now               # the fleet TTFT timestamp
+        self.tokens_emitted += 1
+        _M_TOKENS.inc()
+        rem0 = req.max_new_tokens - 1
+        if eos is not None and tok0 == eos:
+            rem0 = 0
+        self.manager.register_prefix(job.prompt, job.run.block_ids)
+        if rem0 <= 0:                       # finished at admission
+            self._prefill_slots.discard(job.slot)
+            self._retire(job.slot, job.run, now)
+            return
+        if self.tracer is not None:
+            self.tracer.instant(req.request_id, "handoff_ready",
+                                slot=job.slot)
+        self._outbox.append(_PendingHandoff(
+            run=job.run, slot=job.slot, prompt=job.prompt, tok0=tok0,
+            rem0=rem0, key=np.asarray(job.key, np.uint32)))
+
+    def extract_handoff(self, ph: _PendingHandoff,
+                        source: str = "") -> KVHandoff:
+        """Build the wire payload from live state: only the blocks
+        holding prompt positions ``[0, L)`` ship — decode-position
+        blocks are junk the decode worker overwrites before reading.
+        Arrays leave at storage dtype (int8 codes stay int8)."""
+        L = int(ph.prompt.shape[0])
+        bs = self.kv_block_size
+        n_ship = -(-L // bs)
+        ids = np.asarray(ph.run.block_ids[:n_ship], np.int32)
+        arrays = {"prompt": np.asarray(ph.prompt, np.int32),
+                  "key": np.asarray(ph.key, np.uint32)}
+        for i, c in enumerate(self._cache):
+            arrays[f"kv_{i}"] = np.asarray(c[ids])
+        req = ph.run.request
+        meta = {
+            "kind": "paged", "request": request_to_meta(req),
+            "tok0": ph.tok0, "pos0": L, "rem0": ph.rem0,
+            "n_blocks": len(ph.run.block_ids), "n_ship": n_ship,
+            "block_size": bs, "kv_int8": bool(self.kv_int8),
+            "leaf_specs": _leaf_specs(self.backend),
+            "t_admit": float(ph.run.t_admit),
+            "source": {"worker": source,
+                       "tp_degree": self.tp_degree()},
+        }
+        return KVHandoff(meta=meta, arrays=arrays)
+
+
+class PrefillDenseEngine(_PrefillEngineMixin, ContinuousBatchingEngine):
+    """Dense engine that prefills but never decodes. Admission runs the
+    SAME bucket prefill + key schedule as the unified dense engine
+    (``key = PRNGKey(seed); key, sub = split(key)``; ``sub`` samples
+    the first token, ``key`` arms the slot), but the prefilled row
+    parks in the outbox instead of splicing into the pool."""
+
+    def admit(self, request) -> bool:
+        from ..profiler import RecordEvent
+        if getattr(request, "resume", None) is not None \
+                and request.resume.tokens:
+            raise NotImplementedError(
+                "prefill workers do not take preemption resumes — the "
+                "fleet never preempts (route resumes to a unified "
+                "Server)")
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        L = int(prompt.shape[0])
+        self.validate_request(L, request.max_new_tokens)
+        Lb = self.bucket_len(L)
+        slot = next((i for i, s in enumerate(self._slots) if s is None),
+                    None)
+        if slot is None:
+            raise RuntimeError("no free slot (scheduler bug)")
+        tr = self.tracer
+        if tr is not None:
+            tr.span_end(request.request_id, "queue_wait")
+        ids = np.zeros((1, Lb), np.int32)
+        ids[0, Lb - L:] = prompt
+        pad0 = Lb - L
+        key = jax.random.PRNGKey(request.seed)
+        key, sub = jax.random.split(key)     # generate()'s key schedule
+        with RecordEvent("serving.prefill"):
+            tok0_dev, row = self.backend.prefill(
+                Lb, jnp.asarray(ids), jnp.asarray([pad0], jnp.int32),
+                sub, jnp.float32(request.temperature),
+                jnp.int32(request.top_k), jnp.float32(request.top_p))
+        tok0 = int(tok0_dev)
+        _M_PREFILLS.inc()
+        _M_TOKENS.inc()
+        run = _SlotRun(request, tokens=[tok0],
+                       t_admit=time.perf_counter())
+        self.tokens_emitted += 1
+        eos = request.eos_token_id
+        rem0 = request.max_new_tokens - 1
+        if eos is not None and tok0 == eos:
+            rem0 = 0
+        if rem0 <= 0:                        # finished at admission
+            run.t_done = time.perf_counter()
+            self._finished.append(run)
+            return True
+        self._slots[slot] = run
+        self._prefill_slots.add(slot)        # occupied, never decoding
+        self._outbox.append(_PendingHandoff(
+            run=run, slot=slot, prompt=prompt, tok0=tok0, rem0=rem0,
+            key=np.asarray(key, np.uint32), row=row, pad0=pad0,
+            bucket=Lb))
+        return False
+
+    def extract_handoff(self, ph: _PendingHandoff,
+                        source: str = "") -> KVHandoff:
+        """Dense payload: the populated row prefix ``[:, :Lb]``. The
+        row beyond the bucket is zeros by construction (prefill starts
+        from a zero row), so shipping the prefix and zero-filling on
+        adopt reconstructs the row EXACTLY — bit-identity needs no
+        junk bytes on the wire."""
+        Lb = ph.bucket
+        arrays = {"prompt": np.asarray(ph.prompt, np.int32),
+                  "key": np.asarray(ph.key, np.uint32)}
+        for i, r in enumerate(ph.row):
+            arrays[f"kv_{i}"] = np.asarray(r[:, :Lb])
+        req = ph.run.request
+        meta = {
+            "kind": "dense", "request": request_to_meta(req),
+            "tok0": ph.tok0, "pos0": Lb, "pad0": ph.pad0,
+            "rem0": ph.rem0,
+            "leaf_specs": _leaf_specs(self.backend),
+            "t_admit": float(ph.run.t_admit),
+            "source": {"worker": source,
+                       "tp_degree": self.tp_degree()},
+        }
+        return KVHandoff(meta=meta, arrays=arrays)
+
+
+# ---------------------------------------------------------------------------
+# transport
+# ---------------------------------------------------------------------------
+
+class Transport:
+    """Two-method wire interface. ``send`` must raise on failure (the
+    fleet's retry/breaker machinery wraps it); ``recv`` returns the
+    next payload for ``dst`` or None. Implementations must preserve
+    per-destination FIFO order — adoption order is part of the
+    deterministic replay contract."""
+
+    def send(self, dst: str, data: bytes):
+        raise NotImplementedError
+
+    def recv(self, dst: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def pending(self) -> int:
+        raise NotImplementedError
+
+
+class InProcessTransport(Transport):
+    """Deterministic in-process transport: per-destination FIFO queues
+    of real byte strings (payloads cross an actual serialize/
+    deserialize boundary, so wire size and dtype fidelity are measured,
+    not assumed). The ``fleet.transport`` fault site fires in ``send``
+    BEFORE the payload is enqueued — a retry never double-delivers."""
+
+    def __init__(self):
+        self._queues: Dict[str, deque] = {}
+        self.sends = 0
+        self.bytes_sent = 0
+
+    def send(self, dst: str, data: bytes):
+        faults.fault_point("fleet.transport")
+        self._queues.setdefault(dst, deque()).append(bytes(data))
+        self.sends += 1
+        self.bytes_sent += len(data)
+
+    def recv(self, dst: str) -> Optional[bytes]:
+        q = self._queues.get(dst)
+        return q.popleft() if q else None
+
+    def pending(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+
+# ---------------------------------------------------------------------------
+# router
+# ---------------------------------------------------------------------------
+
+class FleetRouter:
+    """Prefix-affinity request router with queue-depth spillover.
+
+    The affinity key of a prompt is the chained-SHA1 digest of its
+    FIRST full block — the exact key the BlockManager's prefix index
+    stores that block under — so every request sharing a system prompt
+    maps to the same prefill worker and its registered blocks.
+    Prompts too short to share (no full block: ``L <= block_size``)
+    key on their whole token tuple, which is still deterministic.
+    Spillover: when the affinity target's queue is ``spill_depth``
+    deeper than the shallowest worker's, the request diverts to the
+    least-loaded worker (prefix locality traded for latency, counted).
+    """
+
+    def __init__(self, block_size: int, affinity: Optional[bool] = None,
+                 spill_depth: Optional[int] = None):
+        if affinity is None:
+            affinity = env_bool("PT_SERVING_FLEET_AFFINITY", True)
+        if spill_depth is None:
+            spill_depth = env_int("PT_SERVING_FLEET_SPILL_DEPTH", 8)
+        if spill_depth < 1:
+            raise ValueError(
+                f"spill_depth={spill_depth}; must be >= 1")
+        self.block_size = block_size
+        self.affinity = bool(affinity)
+        self.spill_depth = spill_depth
+        self.affinity_routes = 0
+        self.spillovers = 0
+
+    def affinity_key(self, prompt) -> bytes:
+        toks = np.asarray(prompt).reshape(-1)
+        if toks.size > self.block_size:      # has a shareable block
+            toks = toks[:self.block_size]
+        return _sha1_chain(b"", tuple(int(t) for t in toks))
+
+    def route(self, prompt, depths: List[int],
+              eligible: List[int]) -> int:
+        """Pick a prefill worker index. ``depths`` aligns with
+        ``eligible`` (the non-draining workers)."""
+        if not eligible:
+            raise RuntimeError("no routable prefill worker (all "
+                               "draining)")
+        least = min(range(len(eligible)), key=lambda i: (depths[i], i))
+        if not self.affinity:
+            return eligible[least]
+        pick = int.from_bytes(self.affinity_key(prompt)[:8], "big") \
+            % len(eligible)
+        if depths[pick] - depths[least] > self.spill_depth:
+            self.spillovers += 1
+            _M_SPILL.inc()
+            return eligible[least]
+        self.affinity_routes += 1
+        _M_AFFINITY.inc()
+        return eligible[pick]
+
+
+# ---------------------------------------------------------------------------
+# workers
+# ---------------------------------------------------------------------------
+
+class PrefillWorker:
+    """A Server over a prefill-only engine. The full PR 5/13 door
+    machinery applies — scheduler gating, queue-depth shedding,
+    deadlines (an expired outbox entry is dropped un-shipped), retries
+    around prefill faults — while decode never runs here."""
+
+    def __init__(self, engine, *, name: str = "",
+                 scheduler=None, resilience=None, observability=None):
+        if not isinstance(engine, (PrefillDenseEngine,
+                                   PrefillPagedEngine)):
+            raise ValueError(
+                "PrefillWorker needs a prefill-only engine "
+                "(PrefillDenseEngine / PrefillPagedEngine); got "
+                f"{type(engine).__name__}")
+        self.engine = engine
+        self.name = name
+        self.server = Server(engine, scheduler, resilience,
+                             observability)
+
+    def queue_depth(self) -> int:
+        return self.server.scheduler.pending()
+
+    def busy(self) -> bool:
+        return self.server.scheduler.pending() > 0 \
+            or self.engine.has_live()
+
+    def tick(self):
+        self.server.run_until_idle(max_ticks=1)
+
+
+class DecodeWorker:
+    """A Server over an ordinary engine whose requests arrive by
+    adoption instead of submission. ``adopt()`` is the only addition;
+    decode, harvest, deadlines, NaN quarantine, streaming sinks and
+    snapshot/restore are the stock Server/engine paths — which is why
+    migration is just PR 5 snapshot/restore."""
+
+    def __init__(self, engine, *, name: str = "", resilience=None,
+                 observability=None, server: Optional[Server] = None):
+        if isinstance(engine, (PrefillDenseEngine, PrefillPagedEngine)):
+            raise ValueError("DecodeWorker needs a decoding engine, "
+                             "not a prefill-only one")
+        self.engine = engine
+        self.name = name
+        self.server = server or Server(engine, resilience=resilience,
+                                       observability=observability)
+        self._adopt_jit = None
+
+    # -- capacity ----------------------------------------------------------
+    def free_slots(self) -> int:
+        return self.engine.free_slot_count()
+
+    def busy(self) -> bool:
+        return self.engine.has_live()
+
+    def tick(self):
+        self.server.run_until_idle(max_ticks=1)
+
+    # -- adoption ----------------------------------------------------------
+    def _validate(self, h: KVHandoff):
+        eng = self.engine
+        paged = isinstance(eng, PagedEngine)
+        want_kind = "paged" if paged else "dense"
+        if h.kind != want_kind:
+            raise ValueError(
+                f"{h.kind} handoff cannot adopt into a {want_kind} "
+                "engine")
+        specs = _leaf_specs(eng.backend)
+        if h.meta["leaf_specs"] != specs:
+            raise ValueError(
+                "handoff KV layout does not match this engine "
+                f"(payload {h.meta['leaf_specs'][:2]}..., engine "
+                f"{specs[:2]}...) — same model config / paging layout "
+                "required")
+        if paged and (h.meta["block_size"] != eng.kv_block_size
+                      or bool(h.meta["kv_int8"]) != bool(eng.kv_int8)):
+            raise ValueError(
+                "handoff arena geometry mismatch (block_size/kv_int8)")
+        if h.meta["pos0"] + h.meta["rem0"] > eng.max_len:
+            raise ValueError(
+                f"handoff needs {h.meta['pos0'] + h.meta['rem0']} "
+                f"positions but this engine's max_len is {eng.max_len}")
+
+    def adopt(self, h: KVHandoff) -> bool:
+        """Adopt one payload: False = momentarily out of capacity
+        (retry after retirements), True = the slot is armed in the ONE
+        compiled decode block. The ``fleet.adopt`` fault site fires
+        before any state mutates, so a retry is clean."""
+        faults.fault_point("fleet.adopt")
+        self._validate(h)
+        eng = self.engine
+        slot = next((i for i, s in enumerate(eng._slots) if s is None),
+                    None)
+        if slot is None:
+            return False
+        if isinstance(eng, PagedEngine):
+            ok = self._adopt_paged(h, slot)
+        else:
+            ok = self._adopt_dense(h, slot)
+        if not ok:
+            return False
+        rid = h.request_id
+        srv = self.server
+        srv._tenant_of[rid] = h.meta["request"].get("tenant", "default")
+        if srv.tracer.enabled:
+            srv.tracer.start(rid)
+            srv.tracer.span_begin(rid, "decode", slot=slot,
+                                  adopted=True)
+        _M_HANDOFFS.inc()
+        return True
+
+    def _commit(self):
+        """TP targets re-shard freshly adopted arrays onto their mesh
+        through the same backend hook snapshot restore uses — the
+        portable-redistribution half of cross-degree handoff."""
+        commit = getattr(self.engine.backend, "commit_arrays", None)
+        if commit is not None:
+            self.engine._cache, self.engine._state = commit(
+                self.engine._cache, self.engine._state)
+
+    def _adopt_paged(self, h: KVHandoff, slot: int) -> bool:
+        eng = self.engine
+        meta = h.meta
+        prompt = h.arrays["prompt"]
+        n_total, n_ship = meta["n_blocks"], meta["n_ship"]
+        blocks = eng.manager.allocate(n_total)
+        if blocks is None:
+            return False
+        req = request_from_meta(meta["request"], prompt)
+        table_row = np.zeros((eng.max_blocks,), np.int32)
+        table_row[:n_total] = blocks
+        if self._adopt_jit is None:
+            def _adopt_fn(cache_flat, rows_flat, table):
+                # pad rows (beyond the shipped prefix) write zeros into
+                # the reserved trash block — the one block whose
+                # content is junk by contract
+                return tuple(c.at[table].set(r.astype(c.dtype))
+                             for c, r in zip(cache_flat, rows_flat))
+            self._adopt_jit = jax.jit(_adopt_fn, donate_argnums=(0,))
+        rows = []
+        for i, (shape, dtype) in enumerate(eng.backend.pool_specs):
+            r = np.zeros((eng.max_blocks,) + tuple(shape[1:]),
+                         np.dtype(dtype))
+            r[:n_ship] = h.arrays[f"kv_{i}"]
+            rows.append(r)
+        eng._cache = self._adopt_jit(eng._cache, tuple(rows), table_row)
+        # index the prompt's prefix blocks in THIS worker's manager so
+        # the adopted copy is reusable here too (no-op for any digest
+        # already registered)
+        eng.manager.register_prefix(prompt, blocks)
+        run = _SlotRun(req, tokens=[meta["tok0"]],
+                       t_admit=meta["t_admit"], block_ids=blocks)
+        eng._slots[slot] = run
+        eos = req.eos_token_id
+        eng._state = eng._arm_jit(
+            eng._state, jnp.int32(slot), jnp.asarray(table_row),
+            jnp.int32(meta["tok0"]), jnp.int32(meta["pos0"]),
+            jnp.int32(meta["rem0"]),
+            jnp.int32(-1 if eos is None else eos),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.float32(req.top_p),
+            jnp.asarray(np.asarray(h.arrays["key"], np.uint32)))
+        self._commit()
+        eng._remaining_host[slot] = meta["rem0"]
+        return True
+
+    def _adopt_dense(self, h: KVHandoff, slot: int) -> bool:
+        eng = self.engine
+        meta = h.meta
+        prompt = h.arrays["prompt"]
+        req = request_from_meta(meta["request"], prompt)
+        Lb = meta["pos0"]
+        row = []
+        for i, (shape, dtype) in enumerate(eng.backend.pool_specs):
+            r = np.zeros((1,) + tuple(shape[1:]), np.dtype(dtype))
+            r[:, :Lb] = h.arrays[f"kv_{i}"]
+            row.append(r)
+        eos = req.eos_token_id
+        # the stock admission program: zero new compiled programs
+        eng._cache, eng._state = eng._admit_jit(
+            eng._cache, eng._state, tuple(row), jnp.int32(slot),
+            jnp.int32(meta["tok0"]), jnp.int32(Lb),
+            jnp.int32(meta["pad0"]), jnp.int32(meta["rem0"]),
+            jnp.int32(-1 if eos is None else eos),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.float32(req.top_p),
+            jnp.asarray(np.asarray(h.arrays["key"], np.uint32)))
+        self._commit()
+        run = _SlotRun(req, tokens=[meta["tok0"]],
+                       t_admit=meta["t_admit"])
+        eng._slots[slot] = run
+        eng._remaining_host[slot] = meta["rem0"]
+        return True
+
+
+# ---------------------------------------------------------------------------
+# the fleet
+# ---------------------------------------------------------------------------
+
+class Fleet:
+    """N prefill workers + M decode workers + router + transport, one
+    deterministic tick loop. ``submit()`` routes by prefix affinity;
+    each tick advances every prefill worker, ships ready handoffs to
+    the least-loaded decode worker, adopts delivered payloads, and
+    advances every decode worker. ``results`` aggregates every
+    worker's results plus explicit handoff failures — each submitted
+    request ends in exactly one of them."""
+
+    def __init__(self, prefill_workers: List[PrefillWorker],
+                 decode_workers: List[DecodeWorker], *,
+                 transport: Optional[Transport] = None,
+                 affinity: Optional[bool] = None,
+                 spill_depth: Optional[int] = None,
+                 resilience: Optional[ResilienceConfig] = None):
+        if not prefill_workers or not decode_workers:
+            raise ValueError("need at least one prefill and one decode "
+                             "worker")
+        self.prefill = list(prefill_workers)
+        self.decode = list(decode_workers)
+        for i, w in enumerate(self.prefill):
+            w.name = w.name or f"prefill{i}"
+            # disjoint request-id ranges: the rid a prefill worker
+            # assigns IS the fleet-wide id the decode worker completes
+            if w.server._next_id == 0:
+                w.server._next_id = (i + 1) * 1_000_000
+        for i, d in enumerate(self.decode):
+            d.name = d.name or f"decode{i}"
+        names = [d.name for d in self.decode]
+        if len(set(names)) != len(names):
+            raise ValueError(
+                f"duplicate decode worker names {sorted(names)} — "
+                "names address transport queues and assignment "
+                "counters, so they must be unique")
+        self._check_compat()
+        self.transport = transport or InProcessTransport()
+        paged = isinstance(self.prefill[0].engine, PagedEngine)
+        self.router = FleetRouter(
+            self.prefill[0].engine.kv_block_size if paged else 16,
+            affinity=affinity, spill_depth=spill_depth)
+        self.resilience = resilience or ResilienceConfig()
+        self._res = ResilienceState(self.resilience)
+        self._failures: Dict[int, RequestFailure] = {}
+        self._pending_adopt: Dict[str, deque] = {
+            d.name: deque() for d in self.decode}
+        self._assigned: Dict[str, int] = {d.name: 0
+                                          for d in self.decode}
+        self._draining: set = set()
+        self.handoffs = 0
+        self.handoff_wire_bytes: List[int] = []
+        self.handoff_kv_bytes: List[int] = []
+        self.migrations = 0
+        self._clock = 0
+
+    def _check_compat(self):
+        """Every engine in the fleet must share the KV layout — a
+        payload must adopt onto ANY decode worker. Refused loudly at
+        construction (and at add_decode_worker), not discovered
+        mid-stream."""
+        engines = [w.engine for w in self.prefill] \
+            + [d.engine for d in self.decode]
+        for e in engines[1:]:
+            self._check_engine_compat(e, engines[0])
+
+    @staticmethod
+    def _check_engine_compat(e, first):
+        paged0 = isinstance(first, PagedEngine)
+        if isinstance(e, PagedEngine) != paged0:
+            raise ValueError("mixed dense/paged fleet — every "
+                             "worker must share the engine kind")
+        if e.max_len != first.max_len:
+            raise ValueError(
+                f"max_len mismatch across the fleet "
+                f"({e.max_len} vs {first.max_len})")
+        if _leaf_specs(e.backend) != _leaf_specs(first.backend):
+            raise ValueError(
+                "KV leaf layout mismatch across the fleet — same "
+                "model config / paging layout required")
+        if paged0 and (e.kv_block_size != first.kv_block_size
+                       or bool(e.kv_int8) != bool(first.kv_int8)):
+            raise ValueError(
+                "paged arena geometry mismatch across the fleet "
+                "(block_size/kv_int8)")
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 20, **kw) -> int:
+        """Route and submit one request; returns the fleet-wide id
+        (key into ``results``). Capacity is validated against BOTH
+        pools at the door: the routed prefill worker's (inside
+        ``Server.submit``) and the largest decode pool's — a request no
+        decode worker could ever adopt is refused here, not deferred
+        forever mid-stream."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        err = None
+        for d in self.decode:
+            try:
+                d.engine.validate_request(int(prompt.size),
+                                          max_new_tokens)
+                err = None
+                break
+            except ValueError as e:
+                err = e
+        if err is not None:
+            raise ValueError(f"no decode worker can serve this "
+                             f"request: {err}")
+        eligible = [i for i in range(len(self.prefill))
+                    if i not in self._draining]
+        depths = [self.prefill[i].queue_depth() for i in eligible]
+        wi = self.router.route(prompt, depths, eligible)
+        return self.prefill[wi].server.submit(
+            prompt, max_new_tokens=max_new_tokens, **kw)
+
+    # -- the tick ----------------------------------------------------------
+    def _with_retry(self, fn):
+        """PR 5 retry/backoff/breaker around one handoff op. Returns
+        ``(ok, value)``; counts toward the fleet's consecutive-failure
+        budget and trips its breaker like Server's step retries. Same
+        policy loop as ``Server._with_retry`` over the same
+        ``ResilienceState``, minus the per-server flight-recorder/
+        tracer hooks (the fleet has neither) and plus the return
+        value adopt() needs."""
+        res, cfg = self._res, self.resilience
+        for attempt in range(cfg.retry_attempts + 1):
+            if res.breaker_open:
+                return False, None
+            try:
+                out = fn()
+                res.consecutive_failures = 0
+                return True, out
+            except res.transient as e:
+                res.step_failures += 1
+                res.consecutive_failures += 1
+                res.last_error = f"{type(e).__name__}: {e}"
+                if res.consecutive_failures >= cfg.breaker_threshold:
+                    res.breaker_open = True
+                    return False, None
+                if attempt < cfg.retry_attempts:
+                    res.retries += 1
+                    _M_FLEET_RETRIES.inc()
+                    time.sleep(res.backoff_s(attempt))
+        return False, None
+
+    def _fail_handoff(self, rid: int, reason: str, message: str,
+                      tokens: int = 0):
+        self._failures[rid] = RequestFailure(
+            request_id=rid, reason=reason, message=message,
+            tokens_emitted=tokens)
+        self._res.count_failure(reason)
+        _M_HANDOFF_FAILS.inc(reason=reason)
+
+    def _pick_decode(self) -> int:
+        """Least-loaded decode worker: free slots minus payloads
+        already assigned but not yet adopted; ties break low-index for
+        determinism."""
+        names = [d.name for d in self.decode]
+        return max(range(len(self.decode)),
+                   key=lambda i: (self.decode[i].free_slots()
+                                  - self._assigned[names[i]],
+                                  -i))
+
+    def _ship(self, w: PrefillWorker, ph: _PendingHandoff):
+        rid = ph.run.request.request_id
+        if self._res.breaker_open:
+            w.engine.release_handoff(ph)
+            self._fail_handoff(rid, "circuit_open",
+                               "fleet handoff circuit open")
+            return
+        di = self._pick_decode()
+        dst = self.decode[di].name
+        holder = {}
+
+        def _do():
+            if "data" not in holder:          # extract + serialize
+                h = w.engine.extract_handoff(ph, source=w.name)
+                holder["kv"] = h.kv_bytes()
+                holder["data"] = encode_handoff(h)
+            self.transport.send(dst, holder["data"])
+
+        ok, _ = self._with_retry(_do)
+        if ok:
+            w.engine.release_handoff(ph)
+            self._assigned[dst] += 1
+            self.handoffs += 1
+            self.handoff_wire_bytes.append(len(holder["data"]))
+            self.handoff_kv_bytes.append(holder["kv"])
+            _M_HANDOFF_BYTES.inc(len(holder["data"]))
+        else:
+            reason = "circuit_open" if self._res.breaker_open \
+                else "handoff"
+            w.engine.release_handoff(ph)
+            self._fail_handoff(
+                rid, reason,
+                f"handoff to {dst} failed: {self._res.last_error}",
+                tokens=len(ph.run.tokens))
+
+    def _deliver(self, d: DecodeWorker):
+        q = self._pending_adopt[d.name]
+        while True:
+            if not q:
+                data = self.transport.recv(d.name)
+                if data is None:
+                    return
+                q.append(decode_handoff(data))
+            h = q[0]
+            ok, adopted = self._with_retry(lambda: d.adopt(h))
+            if ok and adopted:
+                q.popleft()
+                self._assigned[d.name] -= 1
+                continue
+            if ok and not adopted:            # capacity: retry later
+                _M_ADOPT_DEFERS.inc()
+                return
+            reason = "circuit_open" if self._res.breaker_open \
+                else "handoff"
+            self._fail_handoff(
+                h.request_id, reason,
+                f"adopt on {d.name} failed: {self._res.last_error}",
+                tokens=1)
+            q.popleft()
+            self._assigned[d.name] -= 1
+
+    def tick(self):
+        """One fleet tick: prefill advance → ship → deliver/adopt →
+        decode advance. Deterministic given the same submissions and
+        fault schedule."""
+        self._clock += 1
+        for w in self.prefill:
+            w.tick()
+        for w in self.prefill:
+            for ph in w.engine.take_handoffs():
+                self._ship(w, ph)
+        for d in self.decode:
+            self._deliver(d)
+        for d in self.decode:
+            d.tick()
+        if _om.enabled():
+            for w in self.prefill:
+                _M_PF_DEPTH.set(w.queue_depth(), worker=w.name)
+            for d in self.decode:
+                _M_DEC_FREE.set(d.free_slots(), worker=d.name)
+
+    def busy(self) -> bool:
+        return (any(w.busy() for w in self.prefill)
+                or self.transport.pending() > 0
+                or any(self._pending_adopt.values())
+                or any(d.busy() for d in self.decode))
+
+    def run_until_idle(self, max_ticks: Optional[int] = None
+                       ) -> Dict[int, object]:
+        ticks = 0
+        while self.busy():
+            if max_ticks is not None and ticks >= max_ticks:
+                break
+            self.tick()
+            ticks += 1
+        return self.results
+
+    # -- results / stats ---------------------------------------------------
+    @property
+    def results(self) -> Dict[int, object]:
+        out: Dict[int, object] = {}
+        for w in self.prefill:
+            out.update(w.server.results)
+        for d in self.decode:
+            out.update(d.server.results)
+        out.update(self._failures)
+        return out
+
+    def prefix_hit_rate(self) -> float:
+        """Fleet-wide prefix-cache hit rate: shared / submitted prompt
+        tokens summed over every prefill worker (0.0 on dense fleets,
+        which have no prefix index)."""
+        pt = sum(getattr(w.engine, "prompt_tokens", 0)
+                 for w in self.prefill)
+        st = sum(getattr(w.engine, "shared_tokens", 0)
+                 for w in self.prefill)
+        return st / pt if pt else 0.0
+
+    def stats(self) -> dict:
+        res = self.results
+        completed = sum(1 for v in res.values()
+                        if not isinstance(v, RequestFailure))
+        wire = self.handoff_wire_bytes
+        kv = self.handoff_kv_bytes
+        return {
+            "requests_completed": completed,
+            "requests_failed": len(res) - completed,
+            "handoffs": self.handoffs,
+            "handoff_wire_bytes_mean": round(float(np.mean(wire)), 1)
+            if wire else 0.0,
+            "handoff_kv_bytes_mean": round(float(np.mean(kv)), 1)
+            if kv else 0.0,
+            "handoff_failures": dict(self._res.failures_by_reason),
+            "handoff_retries": self._res.retries,
+            "breaker_open": self._res.breaker_open,
+            "affinity_routes": self.router.affinity_routes,
+            "spillovers": self.router.spillovers,
+            "prefix_hit_rate": round(self.prefix_hit_rate(), 4),
+            "migrations": self.migrations,
+            "ticks": self._clock,
+            "prefill_workers": [
+                {"name": w.name, "queue": w.queue_depth(),
+                 "tokens_emitted": w.engine.tokens_emitted,
+                 "prefill_compiles": w.engine.prefill_compile_count()
+                 if hasattr(w.engine, "prefill_compile_count") else 1}
+                for w in self.prefill],
+            "decode_workers": [
+                {"name": d.name, "free_slots": d.free_slots(),
+                 "tokens_emitted": d.engine.tokens_emitted,
+                 "decode_compiles": d.engine.decode_compile_count()}
+                for d in self.decode],
+        }
+
+    # -- scale / migration -------------------------------------------------
+    def add_decode_worker(self, worker: DecodeWorker):
+        """Scale up the decode pool mid-stream; the least-loaded pick
+        starts routing payloads to it on the next tick. Same
+        compatibility contract as construction — an incompatible
+        engine is refused here, not discovered when a payload fails to
+        adopt mid-stream."""
+        self._check_engine_compat(worker.engine,
+                                  self.prefill[0].engine)
+        worker.name = worker.name or f"decode{len(self.decode)}"
+        if worker.name in self._pending_adopt:
+            raise ValueError(f"decode worker name {worker.name!r} "
+                             "already in the fleet")
+        self.decode.append(worker)
+        self._pending_adopt[worker.name] = deque()
+        self._assigned[worker.name] = 0
+
+    def migrate_decode_worker(self, idx: int, engine,
+                              path: str) -> DecodeWorker:
+        """Live migration = PR 5 snapshot/restore: snapshot worker
+        ``idx``'s Server at a tick boundary, restore into a freshly
+        constructed engine of the same configuration, and swap it into
+        the fleet under the SAME name (in-transit payloads addressed to
+        it deliver to the successor). Every in-flight stream finishes
+        bit-identical — the decode block is a pure function of the
+        restored state."""
+        old = self.decode[idx]
+        old.server.snapshot(path)
+        srv = Server.restore(path, engine)
+        new = DecodeWorker(engine, name=old.name, server=srv)
+        self.decode[idx] = new
+        self.migrations += 1
+        _M_MIGRATIONS.inc()
+        return new
+
+    def drain_prefill_worker(self, idx: int):
+        """Stop routing new work to prefill worker ``idx``; once idle
+        (queue drained, outbox shipped) it can be removed or
+        snapshotted for migration. Idempotent — re-draining a draining
+        worker is a no-op, not a spurious last-worker refusal."""
+        if not 0 <= idx < len(self.prefill):
+            raise ValueError(f"no prefill worker at index {idx}")
+        if idx in self._draining:
+            return
+        if len(self._draining) + 1 >= len(self.prefill):
+            raise ValueError("cannot drain the last routable prefill "
+                             "worker")
+        self._draining.add(idx)
+
+    def remove_prefill_worker(self, idx: int):
+        if self.prefill[idx].busy():
+            raise RuntimeError("prefill worker still busy — drain and "
+                               "run the fleet idle first")
+        self._draining.discard(idx)
+        w = self.prefill.pop(idx)
+        self._draining = {i - 1 if i > idx else i
+                          for i in self._draining}
+        return w

@@ -304,22 +304,114 @@ class Frontend:
                  observability: Optional[ObservabilityConfig] = None,
                  preemption: Optional[bool] = None,
                  stream_capacity: int = 1024):
+        scheduler = self._resolve_scheduler(tenants, scheduler)
+        server = Server(engine, scheduler, resilience,
+                        observability, preemption=preemption)
+        self._wire(engine, scheduler, server, stream_capacity)
+
+    @staticmethod
+    def _resolve_scheduler(tenants, scheduler) -> Scheduler:
+        """ONE tenants/scheduler contract for __init__ AND restore —
+        the two entry points cannot drift."""
         if scheduler is None:
-            scheduler = FairScheduler(tenants=tenants)
-        elif tenants:
+            return FairScheduler(tenants=tenants)
+        if tenants:
             raise ValueError(
                 "pass tenants= (builds a FairScheduler) or an explicit "
                 "scheduler, not both — silently ignoring the tenant "
                 "weights would be a misconfiguration")
+        return scheduler
+
+    def _wire(self, engine, scheduler, server: Server,
+              stream_capacity: int):
+        """Attach this frontend to a server (fresh or restored)."""
         self.engine = engine
         self.scheduler = scheduler
-        self.server = Server(engine, scheduler, resilience,
-                             observability, preemption=preemption)
+        self.server = server
         self.stream_capacity = stream_capacity
         self._streams: Dict[int, TokenStream] = {}
         self._emitted: Dict[int, int] = {}
         self.tenant_tokens: Dict[str, int] = {}   # streamed, per tenant
+        ex = server.restored_extras.get("frontend")
+        if ex is not None:
+            # delivered offsets ride the snapshot: a re-attached
+            # consumer (or a migrated decode worker's streams) sees
+            # only the tokens the pre-kill consumer never took —
+            # buffered-but-unconsumed tokens were subtracted at
+            # snapshot time, so they re-deliver
+            self._emitted = {int(k): v
+                             for k, v in ex["emitted"].items()}
+            self.tenant_tokens = dict(ex["tenant_tokens"])
         self.server.stream_sink = self._sink
+        self.server.snapshot_extras["frontend"] = self._snapshot_extra
+
+    @classmethod
+    def restore(cls, path: str, engine: ContinuousBatchingEngine,
+                tenants: Optional[Dict[str, TenantConfig]] = None,
+                scheduler: Optional[Scheduler] = None,
+                resilience: Optional[ResilienceConfig] = None,
+                observability=None, preemption: Optional[bool] = None,
+                stream_capacity: int = 1024) -> "Frontend":
+        """Rebuild a front door from a ``Server`` snapshot (fresh
+        process simulation). The per-request delivered offsets saved by
+        the frontend's snapshot-extras provider rehydrate here, so
+        streams re-attached via :meth:`attach_stream` resume at the
+        first unseen token instead of re-streaming from offset 0."""
+        scheduler = cls._resolve_scheduler(tenants, scheduler)
+        server = Server.restore(path, engine, scheduler, resilience,
+                                observability, preemption=preemption)
+        fe = cls.__new__(cls)
+        fe._wire(engine, scheduler, server, stream_capacity)
+        return fe
+
+    def _snapshot_extra(self) -> dict:
+        """Snapshot-extras provider (server.snapshot_extras hook): the
+        per-request DELIVERED offsets. Tokens still sitting in a LIVE
+        stream's bounded buffer were never taken by the consumer, so
+        they are subtracted — after a restore they deliver again,
+        exactly once. Terminal streams keep their full offset (the
+        sink never fires for them again, so subtracting would only
+        undercount the tenant tallies forever — a re-attached consumer
+        of a finished request reads ``results`` instead), and so do
+        callback streams: ``on_token`` already fired for every pushed
+        token, so their buffered copies WERE delivered."""
+        emitted = dict(self._emitted)
+        tenant_tokens = dict(self.tenant_tokens)
+        for rid, ts in self._streams.items():
+            buffered = len(ts._buf)
+            if buffered and not ts.done and ts.on_token is None \
+                    and rid in emitted:
+                emitted[rid] -= buffered
+                tenant = self.server._tenant_of.get(rid, "default")
+                tenant_tokens[tenant] = \
+                    tenant_tokens.get(tenant, 0) - buffered
+        return {"emitted": {str(k): v for k, v in emitted.items()},
+                "tenant_tokens": tenant_tokens}
+
+    def attach_stream(self, rid: int,
+                      on_token: Optional[Callable[[int], None]] = None
+                      ) -> TokenStream:
+        """(Re-)attach a consumer to a known request — the other half
+        of the delivered-offset contract: after a restore, the new
+        stream yields only tokens past the saved offset. Re-attaching
+        over a LIVE existing stream hands its buffered-but-unconsumed
+        tokens to the new one (exactly-once holds across re-attach
+        too). A request already terminal closes the stream immediately
+        (its full output lives in ``results``)."""
+        ts = TokenStream(rid, frontend=self,
+                         capacity=self.stream_capacity,
+                         on_token=on_token)
+        old = self._streams.get(rid)
+        if old is not None:
+            ts._buf.extend(old.drain())
+            if old.done:
+                ts._finish(old.failure)
+        self._streams[rid] = ts
+        v = self.server.results.get(rid)
+        if v is not None and not ts.done:
+            ts._finish(v.reason if isinstance(v, RequestFailure)
+                       else None)
+        return ts
 
     # -- server glue --------------------------------------------------------
     def _sink(self, rid: int, tokens, done: bool,

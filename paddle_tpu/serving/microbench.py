@@ -1,7 +1,8 @@
 """Serving microbenches: tensor-parallel decode (serving/tp.py),
 speculative draft-verify decode (serving/spec.py), quantized and
-megakernel decode, and the multi-tenant front door
-(serving/frontend.py) — each A/B'd against the plain engine.
+megakernel decode, the multi-tenant front door (serving/frontend.py),
+and the disaggregated prefill/decode fleet (serving/fleet.py) — each
+A/B'd against the plain engine.
 
 Tensor-parallel stage — the slot-pool decode block sharded
 over a device mesh (serving/tp.py) A/B'd against the 1-chip engine.
@@ -30,9 +31,161 @@ import time
 
 import numpy as np
 
-__all__ = ["run_serving_frontdoor_bench", "run_serving_megakernel_bench",
-           "run_serving_quant_bench", "run_serving_spec_bench",
-           "run_serving_tp_bench"]
+__all__ = ["run_serving_disagg_bench", "run_serving_frontdoor_bench",
+           "run_serving_megakernel_bench", "run_serving_quant_bench",
+           "run_serving_spec_bench", "run_serving_tp_bench"]
+
+
+def run_serving_disagg_bench(requests_per_group: int = 6,
+                             groups: int = 3, max_new: int = 8,
+                             num_slots: int = 2) -> dict:
+    """Disaggregated prefill/decode fleet stage (serving/fleet.py +
+    handoff.py): a 2-prefill/2-decode paged fleet on a shared-system-
+    prompt workload, A/B'd against a single-replica Server and against
+    itself with affinity routing off.
+
+    What the stage pins every round:
+
+    - **handoff payload at wire size**: mean KV payload bytes per
+      request for the fp32 arena vs the int8 arena on the SAME
+      workload — the int8 payload must be ~3.6x smaller (codes +
+      scales ship quantized, never dequantized in transit);
+    - **fleet-wide prefix cache**: burst hit rate with affinity
+      routing (each group's warm system prompt lands where its
+      registered blocks live) vs the single-replica rate (gate: >=)
+      and vs the same fleet with affinity off (scattered groups pay
+      the prefix cold);
+    - **disagg-vs-unified TTFT p50 and decode tokens/s**: the
+      pipelining record on the CPU lane (the hardware-pool split is a
+      TPU-fleet claim; the CPU number tracks overhead);
+    - the compile-count pin: ONE decode block per decode worker, ONE
+      chunk program per prefill worker, and cross-worker streams
+      bit-identical to the unified server.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    DecodeWorker, Fleet, PrefillWorker,
+                                    PrefillPagedEngine, Server)
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    kw = dict(num_slots=num_slots, max_len=64, decode_block=4,
+              block_size=8, prefill_chunk=16)
+
+    # shared-system-prompt workload: each group shares a 16-token
+    # prefix (two full blocks); one warm request per group first, so
+    # the burst measures the hot-tenant steady state
+    sys_ps = [rs.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+              for _ in range(groups)]
+    warm = [np.concatenate([sp, rs.randint(0, cfg.vocab_size, (2,))
+                            .astype(np.int32)]) for sp in sys_ps]
+    burst = [np.concatenate([sys_ps[g], rs.randint(
+        0, cfg.vocab_size, (3 + k % 4,)).astype(np.int32)])
+        for g in range(groups) for k in range(requests_per_group)]
+
+    def drive(submit, run, engines):
+        for p in warm:
+            submit(p)
+        run()
+        pt0 = sum(e.prompt_tokens for e in engines)
+        st0 = sum(e.shared_tokens for e in engines)
+        rids = [submit(p) for p in burst]
+        t0 = time.perf_counter()
+        res = run()
+        dt = time.perf_counter() - t0
+        pt = sum(e.prompt_tokens for e in engines) - pt0
+        st = sum(e.shared_tokens for e in engines) - st0
+        return rids, res, dt, st / pt
+
+    pf_engines = [PrefillPagedEngine(model, **kw) for _ in range(2)]
+    dc_engines = [ContinuousBatchingEngine(model, paged=True, **kw)
+                  for _ in range(2)]
+
+    def mk_fleet(affinity, pf_list, dc_list):
+        for e in pf_list + dc_list:
+            e.reset()
+        return Fleet([PrefillWorker(e) for e in pf_list],
+                     [DecodeWorker(e) for e in dc_list],
+                     affinity=affinity, spill_depth=100)
+
+    # ---- unified single-replica baseline ---------------------------------
+    uni_eng = ContinuousBatchingEngine(model, paged=True, **kw)
+    uni = Server(uni_eng)
+    uni_rids, uni_res, dt_uni, uni_rate = drive(
+        lambda p: uni.submit(p, max_new_tokens=max_new),
+        lambda: uni.run_until_idle(), [uni_eng])
+    uni_ttft = [uni.ttft[r] * 1000 for r in uni_rids if r in uni.ttft]
+
+    # ---- fp32 fleet, affinity on -----------------------------------------
+    fleet = mk_fleet(True, pf_engines, dc_engines)
+    f_rids, f_res, dt_fleet, fleet_rate = drive(
+        lambda p: fleet.submit(p, max_new_tokens=max_new),
+        lambda: fleet.run_until_idle(max_ticks=2000),
+        [w.engine for w in fleet.prefill])
+    identical = all(np.array_equal(f_res[a], uni_res[b])
+                    for a, b in zip(f_rids, uni_rids))
+    # burst requests only, matching the unified sample (warm requests
+    # pay the cold prefix and would bias the fleet p50 upward)
+    ttft_ms = [d.server.ttft[r] * 1000 for d in fleet.decode
+               for r in f_rids if r in d.server.ttft]
+    fst = fleet.stats()
+    compiles = (max(d.engine.decode_compile_count()
+                    for d in fleet.decode),
+                max(w.engine.prefill_compile_count()
+                    for w in fleet.prefill))
+    kv_fp32 = fst["handoff_kv_bytes_mean"]
+    wire_fp32 = fst["handoff_wire_bytes_mean"]
+
+    # ---- same engines, affinity OFF (the A/B) ----------------------------
+    off = mk_fleet(False, pf_engines, dc_engines[:1])
+    *_, off_rate = drive(
+        lambda p: off.submit(p, max_new_tokens=max_new),
+        lambda: off.run_until_idle(max_ticks=2000),
+        [w.engine for w in off.prefill])
+
+    # ---- int8 fleet: same workload, quantized wire -----------------------
+    f8 = Fleet([PrefillWorker(PrefillPagedEngine(
+        model, kv_int8=True, **kw))],
+        [DecodeWorker(ContinuousBatchingEngine(
+            model, paged=True, kv_int8=True, **kw))],
+        affinity=True, spill_depth=100)
+    drive(lambda p: f8.submit(p, max_new_tokens=max_new),
+          lambda: f8.run_until_idle(max_ticks=2000),
+          [w.engine for w in f8.prefill])
+    kv_int8 = f8.stats()["handoff_kv_bytes_mean"]
+
+    useful = len(burst) * max_new
+    return {
+        "serving_disagg_workers": "2p+2d",
+        "serving_disagg_bit_identical": bool(identical),
+        "serving_disagg_handoffs": fst["handoffs"],
+        "serving_disagg_handoff_kv_bytes_fp32": kv_fp32,
+        "serving_disagg_handoff_kv_bytes_int8": kv_int8,
+        "serving_disagg_handoff_int8_ratio": round(
+            kv_fp32 / max(kv_int8, 1.0), 2),
+        "serving_disagg_handoff_wire_bytes": wire_fp32,
+        "serving_disagg_prefix_hit_rate_fleet": round(fleet_rate, 4),
+        "serving_disagg_prefix_hit_rate_noaffinity": round(off_rate,
+                                                           4),
+        "serving_disagg_prefix_hit_rate_single": round(uni_rate, 4),
+        "serving_disagg_affinity_ge_single": bool(
+            fleet_rate >= uni_rate - 1e-9),
+        "serving_disagg_tokens_per_sec": round(useful / dt_fleet, 1),
+        "serving_disagg_tokens_per_sec_unified": round(
+            useful / dt_uni, 1),
+        "serving_disagg_ttft_p50_ms": round(
+            float(np.percentile(ttft_ms, 50)), 2) if ttft_ms else None,
+        "serving_disagg_ttft_p50_ms_unified": round(
+            float(np.percentile(uni_ttft, 50)), 2) if uni_ttft
+        else None,
+        "serving_disagg_spillovers": fst["spillovers"],
+        "serving_disagg_decode_compiles": compiles[0],
+        "serving_disagg_prefill_compiles": compiles[1],
+    }
 
 
 def run_serving_frontdoor_bench(requests_per_tenant: int = 18,

@@ -140,19 +140,19 @@ class Server:
                     "(serving.frontend.FairScheduler): the FIFO "
                     "scheduler would hand every freed slot back to the "
                     "evicted victim")
-        if preemption and (hasattr(engine, "spec_k")
-                           or engine.tp_degree() > 1):
-            # untested compositions are refused loudly, never run
-            # silently — same contract as spec+tp / megakernel+tp
-            # (the drafter's per-run history cache and the sharded
-            # state's eviction path are unpinned; ROADMAP follow-up)
+        if preemption and engine.tp_degree() > 1:
+            # the sharded state's eviction path is unpinned; refused
+            # loudly, never run silently (ROADMAP follow-up). Spec
+            # engines compose since PR 14: drafting is a pure host
+            # function of history, so a resumed spec stream re-drafts
+            # identically — pinned in tests/test_serving_spec.py.
             if env_armed:
                 preemption = False
             else:
                 raise NotImplementedError(
                     "priority preemption is not yet composed with "
-                    "speculative or tensor-parallel engines — drop "
-                    "preemption= or spec=/tp= (ROADMAP follow-up)")
+                    "tensor-parallel engines — drop preemption= or "
+                    "tp= (ROADMAP follow-up)")
         # priority preemption policy: strictly-higher-priority visible
         # work may evict a live lower-priority slot (engine.preempt_slot
         # mechanism; default off — the PR 1/4 bit-identity contract is
@@ -182,6 +182,13 @@ class Server:
         # the engine only carries a tracer when tracing is armed, so
         # its hot paths pay one `is None` check when it isn't
         engine.tracer = self.tracer if self.tracer.enabled else None
+        # attachment points for layered state that must ride snapshots
+        # (e.g. the frontend's per-stream delivered offsets): name ->
+        # zero-arg callable returning a JSON-safe dict, captured at
+        # snapshot time; a restored server surfaces the saved dicts in
+        # ``restored_extras`` for the layer to rehydrate from
+        self.snapshot_extras: Dict[str, object] = {}
+        self.restored_extras: Dict[str, dict] = {}
         self.results: Dict[int, object] = {}
         self.latencies: Dict[int, float] = {}
         self.ttft: Dict[int, float] = {}       # submit -> first token
@@ -737,6 +744,9 @@ class Server:
             # its pre-crash event history) AND dumps beside it for
             # humans reading the crash site without np.load
             "flight": self.flight.to_meta(),
+            # layered-state providers (frontend stream offsets, ...)
+            "extras": {name: fn()
+                       for name, fn in self.snapshot_extras.items()},
         }
         self.flight.dump(path + ".flight.json", reason="snapshot")
         save_snapshot(path, {"engine": meta, "server": smeta}, arrays)
@@ -788,6 +798,8 @@ class Server:
                              sm.get("tenant_counts", {}).items()}
         srv._tenant_of = {int(k): v for k, v in
                           sm.get("tenant_of", {}).items()}
+        # pre-extras snapshots restore with no layered state
+        srv.restored_extras = dict(sm.get("extras", {}))
         _M_BREAKER.set(1 if srv._res.breaker_open else 0)
         if "flight" in sm:       # pre-observability snapshots lack it
             srv.flight.restore_meta(sm["flight"])

@@ -30,17 +30,19 @@ imported but disarmed.
 
 Sites currently threaded (see docs/architecture.md for the table):
 ``server.tick``, ``serving.step_block``, ``serving.harvest``,
-``serving.prefill_tick``, ``serving.allocate``, ``serving.poison``.
+``serving.prefill_tick``, ``serving.allocate``, ``serving.poison``,
+and the fleet handoff sites ``fleet.serialize``, ``fleet.transport``,
+``fleet.adopt`` (serving/fleet.py).
 """
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
 import numpy as np
 
 from ..observability import metrics as _om
+from .flags import env_int, env_str
 
 __all__ = ["InjectedFault", "configure", "clear", "active",
            "should_fire", "fault_point", "site_stats", "injected"]
@@ -181,6 +183,6 @@ class injected:
 
 
 # env arming (bench children, operators): PT_FAULTS="site:spec;..."
-_env_spec = os.environ.get("PT_FAULTS", "")
-if _env_spec.strip():
-    configure(_env_spec, int(os.environ.get("PT_FAULTS_SEED", "0") or 0))
+_env_spec = env_str("PT_FAULTS")
+if _env_spec:
+    configure(_env_spec, env_int("PT_FAULTS_SEED", 0))

@@ -420,32 +420,36 @@ class TestPreemption:
         for rid, v in res.items():
             assert not isinstance(v, RequestFailure)
 
-    class _SpecLikeEngine:
-        """Proxy wearing the spec marker (spec_k) over a real engine —
-        the Server guard keys on the attribute, and a REAL second
-        model backend in this process would trip the documented jaxlib
-        compile-cache heap landmine (same stub discipline as
+    class _TPLikeEngine:
+        """Proxy wearing the TP marker (tp_degree() > 1) over a real
+        engine — the Server guard keys on the method, and a REAL
+        sharded backend in this process would trip the documented
+        jaxlib compile-cache heap landmine (same stub discipline as
         test_serving.py's _FingerprintBackend)."""
 
         def __init__(self, inner):
             self._inner = inner
-            self.spec_k = 2
+
+        def tp_degree(self):
+            return 2
 
         def __getattr__(self, name):
             return getattr(self.__dict__["_inner"], name)
 
-    def test_preemption_refused_on_spec_engine(self, setup,
-                                               monkeypatch):
-        """Untested composition: preemption with speculative (or TP)
+    def test_preemption_refused_on_tp_engine(self, setup,
+                                             monkeypatch):
+        """Untested composition: preemption with tensor-parallel
         engines is refused loudly on explicit config and degrades to
-        off when only the env knob armed it."""
+        off when only the env knob armed it. (Spec engines compose
+        since PR 14 — pinned in test_serving_spec.py's
+        TestSpecPreemption.)"""
         model, cfg, dense, _ = setup
         dense.reset()
-        spec = self._SpecLikeEngine(dense)
-        with pytest.raises(NotImplementedError, match="speculative"):
-            Server(spec, FairScheduler(), preemption=True)
+        tp = self._TPLikeEngine(dense)
+        with pytest.raises(NotImplementedError, match="tensor-parallel"):
+            Server(tp, FairScheduler(), preemption=True)
         monkeypatch.setenv("PT_SERVING_PREEMPTION", "1")
-        srv = Server(spec, FairScheduler())   # env-armed: degrades
+        srv = Server(tp, FairScheduler())     # env-armed: degrades
         assert not srv.preemption
 
     def test_equal_priority_never_preempts(self, setup):
@@ -538,6 +542,86 @@ class TestPreemption:
             np.testing.assert_array_equal(res[rid], ref[rid])
         engine2.manager.assert_consistent()
         assert engine2.decode_compile_count() == 1
+
+
+class TestStreamRestore:
+    def test_kill_restore_reattach_sees_only_unseen_tokens(
+            self, setup, tmp_path, _no_compile_cache):
+        """The PR 13 follow-up fixed: each stream's DELIVERED offset
+        rides Server.snapshot (the frontend's snapshot-extras
+        provider), so a consumer re-attached after a kill/restore sees
+        exactly the tokens it never consumed — no token twice, none
+        lost, buffered-but-unconsumed tokens re-deliver."""
+        model, cfg, _, paged = setup
+        paged.reset()
+        p = _prompts(cfg, 30, (6,))[0]
+        ref = _ref(model, p, 12, temperature=0.0)
+        tail = [int(t) for t in ref[6:]]
+        fe = Frontend(paged)
+        s = fe.submit(p, max_new_tokens=12, stream=True)
+        consumed = [next(s) for _ in range(6)]   # then "crash"
+        # tokens arrive in bursts (the prefill token, then 4-token
+        # decode blocks): 6 next() calls sit mid-burst with tokens
+        # still buffered, so the snapshot's buffered-subtraction
+        # branch is genuinely exercised
+        assert len(s._buf) > 0
+        path = str(tmp_path / "stream.npz")
+        fe.server.snapshot(path)
+
+        paddle.seed(0)
+        model2 = LlamaForCausalLM(cfg)           # fresh process sim
+        engine2 = ContinuousBatchingEngine(
+            model2, num_slots=2, max_len=64, decode_block=4,
+            paged=True, block_size=8, prefill_chunk=8)
+        fe2 = Frontend.restore(path, engine2)
+        s2 = fe2.attach_stream(s.request_id)
+        rest = s2.read_all()
+        assert consumed + rest == tail           # exactly-once stream
+        assert s2.done and s2.failure is None
+        np.testing.assert_array_equal(
+            fe2.results[s.request_id], ref)
+        engine2.manager.assert_consistent()
+
+    def test_live_reattach_transfers_buffered_tokens(self, setup):
+        """Re-attaching over a LIVE stream must not lose its
+        buffered-but-unconsumed tokens — they move to the new stream,
+        so the old + new consumers together see the stream exactly
+        once."""
+        model, cfg, _, paged = setup
+        paged.reset()
+        p = _prompts(cfg, 32, (6,))[0]
+        ref = _ref(model, p, 8, temperature=0.0)
+        fe = Frontend(paged)
+        s = fe.submit(p, max_new_tokens=8, stream=True)
+        consumed = [next(s) for _ in range(2)]
+        assert len(s._buf) > 0               # mid-block leftovers
+        s2 = fe.attach_stream(s.request_id)
+        rest = s2.read_all()
+        assert consumed + rest == [int(t) for t in ref[6:]]
+
+    def test_delivered_offset_recorded_in_snapshot_meta(
+            self, setup, tmp_path, _no_compile_cache):
+        """The wire-level half of the contract: the frontend's
+        snapshot-extras provider records the CONSUMED offset (3 here —
+        buffered-but-unconsumed tokens subtracted) in the snapshot's
+        server meta, which is exactly what Frontend.restore rehydrates
+        from (the full kill/restore/re-attach behavior is pinned
+        above)."""
+        model, cfg, _, paged = setup
+        paged.reset()
+        p = _prompts(cfg, 31, (6,))[0]
+        fe = Frontend(paged)
+        s = fe.submit(p, max_new_tokens=8, stream=True)
+        [next(s) for _ in range(3)]
+        path = str(tmp_path / "stream2.npz")
+        fe.server.snapshot(path)
+        import json
+        import numpy as _np
+        with _np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["__meta__"]))
+        ex = meta["server"]["extras"]["frontend"]
+        assert ex["emitted"][str(s.request_id)] == 3
+        fe.run_until_idle()                      # drain the original
 
 
 class TestFrontdoorChaos:

@@ -25,7 +25,7 @@ GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
                  "tests/test_serving_quant.py",
                  "tests/test_sparse_quant.py",
                  "tests/test_megakernel.py", "tests/test_autotune.py",
-                 "tests/test_frontend.py"]
+                 "tests/test_frontend.py", "tests/test_fleet.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -153,6 +153,38 @@ REQUIRED_NODES = [
     "test_weighted_shares_over_backlog",
     "test_frontend.py::TestFrontdoorChaos::"
     "test_chaos_with_preemption_and_wfq",
+    # PR 14 disaggregated-fleet pins: cross-worker bit-identity
+    # (greedy + seeded-sampled, dense + paged + paged+kv_int8), the
+    # bytes-true int8 wire format, the prefix-affinity fleet-wide
+    # cache gate, live decode-worker migration, and the chaos schedule
+    # over the handoff fault sites with zero leaks on both arenas
+    "test_fleet.py::TestFleetBitIdentity::"
+    "test_paged_greedy_staggered_bit_identical_one_compile",
+    "test_fleet.py::TestFleetBitIdentity::"
+    "test_paged_seeded_sampled_bit_identical",
+    "test_fleet.py::TestFleetBitIdentity::"
+    "test_dense_greedy_and_sampled_bit_identical",
+    "test_fleet.py::TestFleetBitIdentity::"
+    "test_paged_kv_int8_bit_identical",
+    "test_fleet.py::TestWireFormat::"
+    "test_int8_payload_ships_codes_never_dequantized",
+    "test_fleet.py::TestRouter::"
+    "test_fleet_wide_prefix_cache_via_affinity",
+    "test_fleet.py::TestFleetResilience::"
+    "test_chaos_handoff_sites_hold_invariants",
+    "test_fleet.py::TestMigrationAndScale::"
+    "test_decode_worker_live_migration_bit_identical",
+    # PR 14 satellites: preemption composes with spec engines
+    # (bit-identical resumes), and stream delivered-offsets ride
+    # snapshots (kill/restore/re-attach sees only unseen tokens)
+    "test_serving_spec.py::TestSpecPreemption::"
+    "test_greedy_preempt_resume_bit_identical[dense]",
+    "test_serving_spec.py::TestSpecPreemption::"
+    "test_greedy_preempt_resume_bit_identical[paged]",
+    "test_serving_spec.py::TestSpecPreemption::"
+    "test_seeded_sampled_preempt_resume_bit_identical",
+    "test_frontend.py::TestStreamRestore::"
+    "test_kill_restore_reattach_sees_only_unseen_tokens",
 ]
 
 

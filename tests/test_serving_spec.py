@@ -339,6 +339,74 @@ class TestRouting:
                                      tp=TPConfig(axes=("mp",)))
 
 
+class TestSpecPreemption:
+    """PR 13 follow-up lifted: priority preemption composes with
+    speculative engines. Drafting is a pure host function of history —
+    a resumed slot re-drafts exactly what the uninterrupted run would
+    have, so preempted spec streams stay bit-identical to generate()
+    (greedy) / generate(seed) (sampled, which never speculates)."""
+
+    @pytest.mark.parametrize("which", ["dense", "paged"])
+    def test_greedy_preempt_resume_bit_identical(self, spec_setup,
+                                                 which):
+        from paddle_tpu.serving import Frontend
+        model, cfg, dense, paged = spec_setup
+        engine = dense if which == "dense" else paged
+        engine.reset()
+        prompts = _prompts(cfg, 30, (5, 9, 12))
+        fe = Frontend(engine, preemption=True)
+        low = [fe.submit(p, max_new_tokens=20, priority=0)
+               for p in prompts[:2]]
+        for _ in range(3):
+            fe.pump()
+        hi = fe.submit(prompts[2], max_new_tokens=4, priority=5)
+        res = fe.run_until_idle()
+        st = fe.stats()
+        assert st["preemptions"] >= 1 and st["resumes"] >= 1
+        for rid, p, mn in zip(low + [hi], prompts, (20, 20, 4)):
+            np.testing.assert_array_equal(
+                res[rid], _ref(model, p, mn, temperature=0.0))
+        assert engine.decode_compile_count() == 1
+        assert all(s is None for s in engine._slots)
+
+    def test_seeded_sampled_preempt_resume_bit_identical(
+            self, spec_setup):
+        """A sampled slot on a spec engine (the in-graph k=0 fallback)
+        carries its rng key through the eviction — the resumed stream
+        follows the exact generate(seed) key schedule."""
+        from paddle_tpu.serving import Frontend
+        model, cfg, dense, _ = spec_setup
+        dense.reset()
+        prompts = _prompts(cfg, 31, (5, 9, 12))
+        fe = Frontend(dense, preemption=True)
+        rs_ = fe.submit(prompts[0], max_new_tokens=20, priority=0,
+                        temperature=0.9, top_k=40, seed=11)
+        rg = fe.submit(prompts[1], max_new_tokens=20, priority=0)
+        for _ in range(3):
+            fe.pump()
+        hi = fe.submit(prompts[2], max_new_tokens=4, priority=5)
+        res = fe.run_until_idle()
+        assert fe.stats()["preemptions"] >= 1
+        np.testing.assert_array_equal(
+            res[rs_], _ref(model, prompts[0], 20, do_sample=True,
+                           temperature=0.9, top_k=40, seed=11))
+        np.testing.assert_array_equal(
+            res[rg], _ref(model, prompts[1], 20, temperature=0.0))
+        np.testing.assert_array_equal(
+            res[hi], _ref(model, prompts[2], 4, temperature=0.0))
+        assert dense.decode_compile_count() == 1
+
+    def test_explicit_preemption_no_longer_refused(self, spec_setup):
+        """The PR 13 NotImplementedError guard is gone: explicit
+        preemption=True on a spec engine constructs (TP engines are
+        still refused — see test_frontend.py)."""
+        from paddle_tpu.serving import FairScheduler, Server
+        model, cfg, dense, _ = spec_setup
+        dense.reset()
+        srv = Server(dense, FairScheduler(), preemption=True)
+        assert srv.preemption
+
+
 class TestSpecResilience:
     def test_chaos_schedule_with_spec_holds_invariants(self,
                                                        spec_setup):
