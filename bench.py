@@ -50,6 +50,8 @@ SERVING_QUANT_DEADLINE_S = env_float("BENCH_SERVING_QUANT_DEADLINE_S",
 SERVING_MEGA_DEADLINE_S = env_float("BENCH_SERVING_MEGA_DEADLINE_S", 300)
 SERVING_FRONTDOOR_DEADLINE_S = env_float(
     "BENCH_SERVING_FRONTDOOR_DEADLINE_S", 300)
+SERVING_FAILOVER_DEADLINE_S = env_float(
+    "BENCH_SERVING_FAILOVER_DEADLINE_S", 300)
 SERVING_DISAGG_DEADLINE_S = env_float(
     "BENCH_SERVING_DISAGG_DEADLINE_S", 300)
 AUTOTUNE_DEADLINE_S = env_float("BENCH_AUTOTUNE_DEADLINE_S", 300)
@@ -725,6 +727,18 @@ def _child_tpu():
         decode.update(dis if dis is not None
                       else {"serving_disagg_bit_identical": None})
         _release_hbm()
+        # fleet failure domains on the REAL chip: kill-one-decode-
+        # worker A/B over the socket transport (redrive latency +
+        # goodput under worker loss are the chip claims)
+        from paddle_tpu.serving.microbench import \
+            run_serving_failover_bench
+        fo, err = _staged(run_serving_failover_bench,
+                          "serving-failover")
+        if err:
+            errors.append(err)
+        decode.update(fo if fo is not None
+                      else {"serving_failover_bit_identical": None})
+        _release_hbm()
         # block-size autotune sweep on the REAL chip (flash/splash
         # blocks + the CPU-honest knobs, persisted per device kind)
         from paddle_tpu.ops.pallas.autotune import run_autotune
@@ -1124,6 +1138,31 @@ def _attach_serving_disagg(result, budget_s=None):
                          SERVING_DISAGG_DEADLINE_S, budget_s)
 
 
+def _child_serving_failover():
+    """serving-failover stage: the fleet failure-domain layer
+    (serving/transport.py + fleet.py) — kill-one-decode-worker A/B on
+    the REAL localhost-TCP SocketTransport with ~1% wire faults armed.
+    Pins recovered-stream bit-identity (greedy + seeded-sampled),
+    redrive latency p50/p95, goodput with/without the mid-run kill,
+    and the handoff retry / (rid, seq)-dedup / transport
+    resend-reconnect-CRC counters from the metrics registry. All
+    fields non-null on the CPU lane; the TPU child stages the same
+    fleet."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.serving.microbench import run_serving_failover_bench
+    out = run_serving_failover_bench(
+        requests=env_int("BENCH_SERVING_FAILOVER_REQUESTS", 6),
+        max_new=env_int("BENCH_SERVING_FAILOVER_MAX_NEW", 24))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_serving_failover(result, budget_s=None):
+    return _attach_stage(result, "serving-failover",
+                         "--child-serving-failover",
+                         SERVING_FAILOVER_DEADLINE_S, budget_s)
+
+
 def _child_autotune():
     """autotune stage: the Pallas block-size sweep harness
     (ops/pallas/autotune.py) — sweeps every knob that is honest on this
@@ -1250,6 +1289,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-disagg":
         _child_serving_disagg()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-failover":
+        _child_serving_failover()
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-autotune":
         _child_autotune()
         return
@@ -1333,6 +1375,7 @@ def _main_measured(errors):
                 result = _attach_serving_megakernel(result, remaining())
                 result = _attach_serving_frontdoor(result, remaining())
                 result = _attach_serving_disagg(result, remaining())
+                result = _attach_serving_failover(result, remaining())
                 _emit_final(_attach_autotune(result, remaining()))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
@@ -1361,6 +1404,7 @@ def _main_measured(errors):
         result = _attach_serving_megakernel(result, remaining())
         result = _attach_serving_frontdoor(result, remaining())
         result = _attach_serving_disagg(result, remaining())
+        result = _attach_serving_failover(result, remaining())
         _emit_final(_attach_autotune(result, remaining()))
         return
     # last resort: still one JSON line, rc 0, explicit marker

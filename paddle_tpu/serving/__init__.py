@@ -12,7 +12,8 @@ from .engine import (ArtifactStepBackend, ContinuousBatchingEngine,
                      ModelStepBackend, slot_sample_logits)
 from .fleet import (DecodeWorker, Fleet, FleetRouter, InProcessTransport,
                     PrefillDenseEngine, PrefillPagedEngine,
-                    PrefillWorker, Transport)
+                    PrefillWorker, SocketTransport, Transport,
+                    TransportError)
 from .frontend import FairScheduler, Frontend, TenantConfig, TokenStream
 from .handoff import (KVHandoff, decode_handoff, encode_handoff,
                       reshard_kv_chunks)
@@ -35,9 +36,10 @@ __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
            "PagedModelStepBackend", "PrefillDenseEngine",
            "PrefillPagedEngine", "PrefillWorker", "QuantConfig",
            "Request", "RequestFailure", "ResilienceConfig",
-           "ResumeState", "Scheduler", "Server", "SpecConfig",
-           "SpecEngine", "SpecModelStepBackend", "SpecPagedEngine",
-           "SpecPagedStepBackend", "ShardedModelStepBackend",
-           "ShardedPagedStepBackend", "TPConfig", "TenantConfig",
-           "TokenStream", "Transport", "decode_handoff", "encode_handoff",
+           "ResumeState", "Scheduler", "Server", "SocketTransport",
+           "SpecConfig", "SpecEngine", "SpecModelStepBackend",
+           "SpecPagedEngine", "SpecPagedStepBackend",
+           "ShardedModelStepBackend", "ShardedPagedStepBackend",
+           "TPConfig", "TenantConfig", "TokenStream", "Transport",
+           "TransportError", "decode_handoff", "encode_handoff",
            "ngram_propose", "reshard_kv_chunks", "slot_sample_logits"]

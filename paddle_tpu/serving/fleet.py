@@ -34,13 +34,25 @@ splits the Server into replicas of two specialties and a router:
   where its registered blocks already live and the PR 4 prefix cache
   becomes a fleet-wide asset. Queue-depth spillover diverts from a
   backlogged affinity target to the least-loaded worker.
-- **Transport**: in-process-first behind a 2-method interface
-  (deterministic FIFO, CPU-lane testable); a network transport drops
-  in without touching the workers. Handoff failures ride the PR 5
-  retry/backoff/breaker machinery (``ResilienceState``): serialize,
-  transport and adopt faults retry with seeded backoff, a permanent
-  failure records an explicit ``RequestFailure(reason="handoff")``,
-  and an open circuit fails fast as ``circuit_open``.
+- **Transport** (serving/transport.py): the in-process FIFO default,
+  or the REAL localhost-TCP :class:`SocketTransport` (length-framed,
+  CRC32-trailed, seq-numbered, acked, reconnecting, at-least-once —
+  adopt() restores exactly-once by (rid, payload seq) dedup). Handoff
+  failures ride the PR 5 retry/backoff/breaker machinery
+  (``ResilienceState``): serialize, transport and adopt faults retry
+  with seeded backoff, a permanent failure records an explicit
+  ``RequestFailure(reason="handoff")``, and an open circuit fails
+  fast as ``circuit_open``.
+- **Failure domains** (PR 15): per-worker heartbeat leases (a worker
+  missing N beats is DEAD — flight event + ``pt_fleet_worker_state``
+  gauge, never read again), and REDRIVE of streams lost with a dead
+  decode worker: rebuilt from the fleet's own records (submission +
+  shipped key + heartbeat token progress, key host-replayed one
+  split per observed token), re-prefilled on a surviving prefill
+  worker via a ``redrive`` ResumeState, completing bit-identical to
+  an unfailed run. A dead prefill worker's un-shipped requests
+  resubmit under their original ids; unrecoverable streams fail
+  explicitly as ``worker_lost``.
 - **Live migration / scale**: a decode worker snapshots via the PR 5
   ``Server.snapshot`` path and restores into a fresh engine
   (``Fleet.migrate_decode_worker``) with every in-flight stream
@@ -49,7 +61,8 @@ splits the Server into replicas of two specialties and a router:
   so it can retire cleanly.
 
 Knobs (utils/flags helpers): ``PT_SERVING_FLEET_AFFINITY`` (default
-on) and ``PT_SERVING_FLEET_SPILL_DEPTH`` (default 8).
+on), ``PT_SERVING_FLEET_SPILL_DEPTH`` (default 8) and
+``PT_SERVING_FLEET_LEASE_MISSES`` (default 3 missed heartbeats).
 """
 from __future__ import annotations
 
@@ -62,6 +75,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import FlightRecorder
 from ..observability import metrics as _om
 from ..utils import faults
 from ..utils.flags import env_bool, env_int
@@ -72,11 +86,14 @@ from .paging import PagedEngine, _sha1_chain
 from .resilience import (RequestFailure, ResilienceConfig,
                          ResilienceState, request_from_meta,
                          request_to_meta)
+from .scheduler import Request, ResumeState
 from .server import Server
+from .transport import (InProcessTransport, SocketTransport, Transport,
+                        TransportError)
 
 __all__ = ["DecodeWorker", "Fleet", "FleetRouter", "InProcessTransport",
            "PrefillDenseEngine", "PrefillPagedEngine", "PrefillWorker",
-           "Transport"]
+           "SocketTransport", "Transport", "TransportError"]
 
 # fleet metric families (registered at import so the catalog stays
 # complete at zero; no-ops until metrics.enable()/PT_METRICS)
@@ -106,6 +123,34 @@ _M_PF_DEPTH = _om.gauge("pt_fleet_prefill_queue_depth",
 _M_DEC_FREE = _om.gauge("pt_fleet_decode_free_slots",
                         "free decode slots per decode worker",
                         labels=("worker",))
+# failure-domain families (PR 15)
+_M_WORKER_STATE = _om.gauge("pt_fleet_worker_state",
+                            "per-worker lease state: 1 live, 0 dead",
+                            labels=("worker",))
+_M_WORKERS_LOST = _om.counter("pt_fleet_workers_lost_total",
+                              "workers whose lease expired, by role",
+                              labels=("role",))
+_M_REDRIVES = _om.counter(
+    "pt_fleet_redrives_total",
+    "streams reconstructed from fleet records after a worker died")
+_M_ADOPT_DUPS = _om.counter(
+    "pt_fleet_adopt_duplicates_total",
+    "adopt() calls deduplicated on (rid, payload seq) — the "
+    "at-least-once wire's retransmits made idempotent")
+
+
+def _replay_key(key0, n: int) -> np.ndarray:
+    """Host replay of the decode block's per-slot key schedule: the
+    in-graph step does ``key, sub = split(key)`` exactly once per
+    emitted token, so a slot that produced ``n`` decode tokens after
+    arming with ``key0`` holds ``split^n(key0)[0]``. This is what makes
+    a stream reconstructible from OBSERVED tokens alone — the fleet
+    never needs to read a dead worker's device state to resume its
+    seeded-sampled streams bit-identically."""
+    k = jnp.asarray(np.asarray(key0, np.uint32).reshape(2))
+    for _ in range(n):
+        k = jax.random.split(k)[0]
+    return np.asarray(k, np.uint32)
 
 
 def _leaf_specs(backend) -> list:
@@ -115,6 +160,18 @@ def _leaf_specs(backend) -> list:
     compat check — a format change cannot drift them apart."""
     return [[list(s[1:]), str(np.dtype(d))]
             for s, d in backend.pool_specs]
+
+
+def _stamp_resume_meta(meta: dict, ph: "_PendingHandoff"):
+    """Redrive payloads carry the generated history: the decode worker
+    arms with ``tokens[-1]`` and its run starts from the FULL token
+    list, so the completed result is original-prompt + every token.
+    ``orig_prompt_len`` is recorded because ``arrays["prompt"]`` is
+    then the re-prefilled ``prompt + tokens[:-1]`` sequence, not the
+    user's prompt."""
+    if ph.tokens is not None:
+        meta["tokens"] = [int(t) for t in ph.tokens]
+        meta["orig_prompt_len"] = int(ph.orig_len)
 
 
 # ---------------------------------------------------------------------------
@@ -129,13 +186,18 @@ class _PendingHandoff:
     transport fault retries against state that is still alive."""
     run: _SlotRun
     slot: int
-    prompt: np.ndarray
+    prompt: np.ndarray                  # the PREFILLED token sequence
     tok0: int
     rem0: int
     key: np.ndarray                     # (2,) uint32 post-split key
     row: Optional[tuple] = None         # dense: prefilled cache row
     pad0: int = 0                       # dense: bucket pad count
     bucket: int = 0                     # dense: bucket length Lb
+    # redrive resume: the carried generated history (tokens[-1] ==
+    # tok0) and the ORIGINAL prompt length — ``prompt`` above is then
+    # prompt+tokens[:-1], the re-prefilled sequence
+    tokens: Optional[List[int]] = None
+    orig_len: Optional[int] = None
 
 
 class _PrefillEngineMixin:
@@ -184,25 +246,42 @@ class PrefillPagedEngine(_PrefillEngineMixin, PagedEngine):
 
     def try_admit(self, request) -> bool:
         resume = getattr(request, "resume", None)
-        if resume is not None and resume.tokens:
+        if resume is not None and resume.tokens \
+                and not resume.redrive:
             raise NotImplementedError(
                 "prefill workers do not take preemption resumes — the "
                 "fleet never preempts (route resumes to a unified "
                 "Server)")
+        # a redrive resume rides the PR 13 paged resume branch
+        # unchanged: chunked re-prefill of prompt+tokens[:-1] (mostly
+        # prefix-index hits for shared prompts), carried key armed,
+        # the chunk programs' in-graph samples discarded
         return super().try_admit(request)
 
     def _finish_prefill(self, job, tok0_dev):
         req = job.run.request
         now = time.perf_counter()
         eos = req.eos_token_id
-        tok0 = int(tok0_dev)
-        job.run.tokens = [tok0]
-        job.run.t_admit = now               # the fleet TTFT timestamp
-        self.tokens_emitted += 1
-        _M_TOKENS.inc()
-        rem0 = req.max_new_tokens - 1
-        if eos is not None and tok0 == eos:
-            rem0 = 0
+        if job.resume_tok is not None:      # redrive re-prefill done
+            tok0 = job.resume_tok           # the carried in-hand token
+            rem0 = req.max_new_tokens - len(job.run.tokens)
+            req.resume = None
+            tokens = list(job.run.tokens)
+            orig_len = int(np.asarray(req.prompt).reshape(-1).size)
+            if self.tracer is not None:
+                self.tracer.instant(req.request_id, "resume",
+                                    slot=job.slot, redrive=True,
+                                    reused_tokens=len(tokens))
+        else:
+            tok0 = int(tok0_dev)
+            job.run.tokens = [tok0]
+            job.run.t_admit = now           # the fleet TTFT timestamp
+            self.tokens_emitted += 1
+            _M_TOKENS.inc()
+            rem0 = req.max_new_tokens - 1
+            if eos is not None and tok0 == eos:
+                rem0 = 0
+            tokens, orig_len = None, None
         self.manager.register_prefix(job.prompt, job.run.block_ids)
         if rem0 <= 0:                       # finished at admission
             self._prefill_slots.discard(job.slot)
@@ -213,7 +292,8 @@ class PrefillPagedEngine(_PrefillEngineMixin, PagedEngine):
                                 slot=job.slot)
         self._outbox.append(_PendingHandoff(
             run=job.run, slot=job.slot, prompt=job.prompt, tok0=tok0,
-            rem0=rem0, key=np.asarray(job.key, np.uint32)))
+            rem0=rem0, key=np.asarray(job.key, np.uint32),
+            tokens=tokens, orig_len=orig_len))
 
     def extract_handoff(self, ph: _PendingHandoff,
                         source: str = "") -> KVHandoff:
@@ -240,6 +320,7 @@ class PrefillPagedEngine(_PrefillEngineMixin, PagedEngine):
             "source": {"worker": source,
                        "tp_degree": self.tp_degree()},
         }
+        _stamp_resume_meta(meta, ph)
         return KVHandoff(meta=meta, arrays=arrays)
 
 
@@ -252,12 +333,14 @@ class PrefillDenseEngine(_PrefillEngineMixin, ContinuousBatchingEngine):
 
     def admit(self, request) -> bool:
         from ..profiler import RecordEvent
-        if getattr(request, "resume", None) is not None \
-                and request.resume.tokens:
-            raise NotImplementedError(
-                "prefill workers do not take preemption resumes — the "
-                "fleet never preempts (route resumes to a unified "
-                "Server)")
+        resume = getattr(request, "resume", None)
+        if resume is not None and resume.tokens:
+            if not resume.redrive:
+                raise NotImplementedError(
+                    "prefill workers do not take preemption resumes — "
+                    "the fleet never preempts (route resumes to a "
+                    "unified Server)")
+            return self._admit_redrive(request, resume)
         prompt = np.asarray(request.prompt, np.int32).reshape(-1)
         L = int(prompt.shape[0])
         self.validate_request(L, request.max_new_tokens)
@@ -301,6 +384,56 @@ class PrefillDenseEngine(_PrefillEngineMixin, ContinuousBatchingEngine):
             bucket=Lb))
         return False
 
+    def _admit_redrive(self, request, resume) -> bool:
+        """Redrive re-prefill, dense flavour: prompt + tokens[:-1]
+        left-padded to its bucket, the in-graph sample DISCARDED (the
+        stream owns its next token and the carried key must not be
+        advanced), the prefilled row parked in the outbox with the
+        carried history — the mirror of the unified engine's
+        ``_admit_resume`` with the arm replaced by a handoff."""
+        from ..profiler import RecordEvent
+        prompt = np.asarray(request.prompt, np.int32).reshape(-1)
+        toks = list(resume.tokens)
+        full = np.concatenate([prompt, np.asarray(toks[:-1], np.int32)])
+        pl = int(full.shape[0])
+        rem0 = request.max_new_tokens - len(toks)
+        self.validate_request(pl, rem0 + 1)
+        Lb = self.bucket_len(pl)
+        slot = next((i for i, s in enumerate(self._slots) if s is None),
+                    None)
+        if slot is None:
+            raise RuntimeError("no free slot (scheduler bug)")
+        if self.tracer is not None:
+            self.tracer.span_end(request.request_id, "queue_wait",
+                                 resumed=True, redrive=True)
+        ids = np.zeros((1, Lb), np.int32)
+        ids[0, Lb - pl:] = full
+        pad0 = Lb - pl
+        with RecordEvent("serving.prefill"):
+            _discard, row = self.backend.prefill(
+                Lb, jnp.asarray(ids), jnp.asarray([pad0], jnp.int32),
+                jax.random.PRNGKey(0), jnp.float32(0.0), jnp.int32(0),
+                jnp.float32(1.0))
+        _M_PREFILLS.inc()
+        run = _SlotRun(request, tokens=toks, t_admit=resume.t_admit)
+        request.resume = None
+        if rem0 <= 0:                        # defensive: already done
+            run.t_done = time.perf_counter()
+            self._finished.append(run)
+            return True
+        self._slots[slot] = run
+        self._prefill_slots.add(slot)
+        if self.tracer is not None:
+            self.tracer.instant(request.request_id, "resume",
+                                slot=slot, redrive=True,
+                                reused_tokens=len(toks))
+        self._outbox.append(_PendingHandoff(
+            run=run, slot=slot, prompt=full, tok0=int(toks[-1]),
+            rem0=rem0, key=np.asarray(resume.key, np.uint32), row=row,
+            pad0=pad0, bucket=Lb, tokens=toks,
+            orig_len=int(prompt.shape[0])))
+        return False
+
     def extract_handoff(self, ph: _PendingHandoff,
                         source: str = "") -> KVHandoff:
         """Dense payload: the populated row prefix ``[:, :Lb]``. The
@@ -323,54 +456,8 @@ class PrefillDenseEngine(_PrefillEngineMixin, ContinuousBatchingEngine):
             "source": {"worker": source,
                        "tp_degree": self.tp_degree()},
         }
+        _stamp_resume_meta(meta, ph)
         return KVHandoff(meta=meta, arrays=arrays)
-
-
-# ---------------------------------------------------------------------------
-# transport
-# ---------------------------------------------------------------------------
-
-class Transport:
-    """Two-method wire interface. ``send`` must raise on failure (the
-    fleet's retry/breaker machinery wraps it); ``recv`` returns the
-    next payload for ``dst`` or None. Implementations must preserve
-    per-destination FIFO order — adoption order is part of the
-    deterministic replay contract."""
-
-    def send(self, dst: str, data: bytes):
-        raise NotImplementedError
-
-    def recv(self, dst: str) -> Optional[bytes]:
-        raise NotImplementedError
-
-    def pending(self) -> int:
-        raise NotImplementedError
-
-
-class InProcessTransport(Transport):
-    """Deterministic in-process transport: per-destination FIFO queues
-    of real byte strings (payloads cross an actual serialize/
-    deserialize boundary, so wire size and dtype fidelity are measured,
-    not assumed). The ``fleet.transport`` fault site fires in ``send``
-    BEFORE the payload is enqueued — a retry never double-delivers."""
-
-    def __init__(self):
-        self._queues: Dict[str, deque] = {}
-        self.sends = 0
-        self.bytes_sent = 0
-
-    def send(self, dst: str, data: bytes):
-        faults.fault_point("fleet.transport")
-        self._queues.setdefault(dst, deque()).append(bytes(data))
-        self.sends += 1
-        self.bytes_sent += len(data)
-
-    def recv(self, dst: str) -> Optional[bytes]:
-        q = self._queues.get(dst)
-        return q.popleft() if q else None
-
-    def pending(self) -> int:
-        return sum(len(q) for q in self._queues.values())
 
 
 # ---------------------------------------------------------------------------
@@ -455,6 +542,18 @@ class PrefillWorker:
         self.name = name
         self.server = Server(engine, scheduler, resilience,
                              observability)
+        self.killed = False
+
+    def kill(self):
+        """Simulate whole-worker loss (see DecodeWorker.kill)."""
+        self.killed = True
+
+    def heartbeat(self) -> Optional[dict]:
+        if self.killed:
+            return None
+        return {"queue_depth": self.server.scheduler.pending(),
+                "occupancy": self.engine.occupancy(),
+                "outbox": len(self.engine._outbox)}
 
     def queue_depth(self) -> int:
         return self.server.scheduler.pending()
@@ -464,6 +563,8 @@ class PrefillWorker:
             or self.engine.has_live()
 
     def tick(self):
+        if self.killed:
+            return
         self.server.run_until_idle(max_ticks=1)
 
 
@@ -472,7 +573,14 @@ class DecodeWorker:
     adoption instead of submission. ``adopt()`` is the only addition;
     decode, harvest, deadlines, NaN quarantine, streaming sinks and
     snapshot/restore are the stock Server/engine paths — which is why
-    migration is just PR 5 snapshot/restore."""
+    migration is just PR 5 snapshot/restore.
+
+    Liveness: the worker emits a :meth:`heartbeat` each fleet tick
+    (queue depth, occupancy, and per-stream token progress — the
+    observations the fleet's redrive records are built from). A worker
+    ``kill()``-ed to simulate whole-process loss stops ticking,
+    adopting and heartbeating; the fleet notices via its lease and
+    redrives every stream the corpse owned."""
 
     def __init__(self, engine, *, name: str = "", resilience=None,
                  observability=None, server: Optional[Server] = None):
@@ -484,6 +592,47 @@ class DecodeWorker:
         self.server = server or Server(engine, resilience=resilience,
                                        observability=observability)
         self._adopt_jit = None
+        self.killed = False
+        # exactly-once adoption over an at-least-once wire: payloads
+        # already armed, keyed (rid, payload seq)
+        self._adopted: set = set()
+        self.duplicate_adopts = 0
+
+    # -- liveness ----------------------------------------------------------
+    def kill(self):
+        """Simulate whole-worker loss: the worker stops participating
+        (no ticks, no adopts, no heartbeats). Its ENGINE state — KV
+        arena, slot state, rng keys — is deliberately never read again
+        by the fleet: stream recovery must work from the fleet's own
+        records, as it would have to across a real process boundary.
+        Its ``server.results`` ledger IS still read: those outputs
+        were delivered at harvest time (the stream sink fires before
+        any kill can land), so the in-process dict stands in for the
+        client's already-received copy, not for worker memory."""
+        self.killed = True
+
+    def heartbeat(self) -> Optional[dict]:
+        """One liveness report, or None from a dead worker. Carries
+        queue depth/occupancy (the health the router could act on) and
+        per-live-stream token progress — the fleet's redrive substrate:
+        everything needed to reconstruct a stream is on this side of
+        the wire BEFORE the worker can die."""
+        if self.killed:
+            return None
+        if len(self._adopted) > 256:
+            # duplicates only arrive within one ship's retransmit
+            # window; once a stream terminated (its rid is in the
+            # results ledger, which adopt() also dedups against) its
+            # dedup entries are dead weight
+            self._adopted = {t for t in self._adopted
+                             if t[0] not in self.server.results}
+        return {
+            "queue_depth": self.server.scheduler.pending(),
+            "occupancy": self.engine.occupancy(),
+            "free_slots": self.engine.free_slot_count(),
+            "progress": {run.request.request_id: list(run.tokens)
+                         for _slot, run in self.engine.live_runs()},
+        }
 
     # -- capacity ----------------------------------------------------------
     def free_slots(self) -> int:
@@ -493,6 +642,8 @@ class DecodeWorker:
         return self.engine.has_live()
 
     def tick(self):
+        if self.killed:
+            return
         self.server.run_until_idle(max_ticks=1)
 
     # -- adoption ----------------------------------------------------------
@@ -520,25 +671,53 @@ class DecodeWorker:
                 f"handoff needs {h.meta['pos0'] + h.meta['rem0']} "
                 f"positions but this engine's max_len is {eng.max_len}")
 
-    def adopt(self, h: KVHandoff) -> bool:
-        """Adopt one payload: False = momentarily out of capacity
-        (retry after retirements), True = the slot is armed in the ONE
-        compiled decode block. The ``fleet.adopt`` fault site fires
-        before any state mutates, so a retry is clean."""
+    #: adopt() outcomes
+    ADOPTED = "adopted"         # slot armed in the ONE decode block
+    DEFER = "defer"             # momentarily out of slots/blocks
+    DUPLICATE = "duplicate"     # (rid, payload seq) already armed
+
+    def adopt(self, h: KVHandoff) -> str:
+        """Adopt one payload; returns :data:`ADOPTED`, :data:`DEFER`
+        (retry after retirements) or :data:`DUPLICATE`. The
+        ``fleet.adopt`` fault site fires before any state mutates, so
+        a retry is clean.
+
+        Idempotency contract (the at-least-once wire's other half): a
+        payload whose ``(rid, meta["seq"])`` was already armed — an
+        ack-lost retransmit — is a NO-OP at exact refcounts: no slot,
+        no block allocation, no arena write, no double-registration.
+        And a payload whose ``meta["crc32"]`` does not match its
+        arrays is refused loudly BEFORE any allocator state is
+        touched."""
         faults.fault_point("fleet.adopt")
+        if self.killed:
+            raise TransportError(
+                f"decode worker {self.name!r} is dead")
+        h.verify_crc()                  # loud, pre-allocation
+        rid = h.request_id
+        seq = h.meta.get("seq")
+        if (seq is not None and (rid, seq) in self._adopted) \
+                or rid in self.server.results:
+            # dedup by (rid, seq) while the stream is open, and by the
+            # results ledger after it terminated — a straggler
+            # duplicate must never re-decode a finished stream
+            self.duplicate_adopts += 1
+            _M_ADOPT_DUPS.inc()
+            return self.DUPLICATE
         self._validate(h)
         eng = self.engine
         slot = next((i for i, s in enumerate(eng._slots) if s is None),
                     None)
         if slot is None:
-            return False
+            return self.DEFER
         if isinstance(eng, PagedEngine):
             ok = self._adopt_paged(h, slot)
         else:
             ok = self._adopt_dense(h, slot)
         if not ok:
-            return False
-        rid = h.request_id
+            return self.DEFER
+        if seq is not None:
+            self._adopted.add((rid, seq))
         srv = self.server
         srv._tenant_of[rid] = h.meta["request"].get("tenant", "default")
         if srv.tracer.enabled:
@@ -546,7 +725,7 @@ class DecodeWorker:
             srv.tracer.span_begin(rid, "decode", slot=slot,
                                   adopted=True)
         _M_HANDOFFS.inc()
-        return True
+        return self.ADOPTED
 
     def _commit(self):
         """TP targets re-shard freshly adopted arrays onto their mesh
@@ -557,6 +736,20 @@ class DecodeWorker:
             self.engine._cache, self.engine._state = commit(
                 self.engine._cache, self.engine._state)
 
+    @staticmethod
+    def _carried(meta, prompt):
+        """(request, tokens) for the adopted run: a redrive payload's
+        ``arrays["prompt"]`` is the re-prefilled prompt+history, so
+        the request is rebuilt over the ORIGINAL prompt prefix and the
+        run starts from the full carried token list — harvest then
+        assembles original-prompt + every token, exactly the unfailed
+        stream."""
+        orig = prompt[:int(meta.get("orig_prompt_len",
+                                    prompt.shape[0]))]
+        req = request_from_meta(meta["request"], orig)
+        toks = [int(t) for t in meta.get("tokens", [meta["tok0"]])]
+        return req, toks
+
     def _adopt_paged(self, h: KVHandoff, slot: int) -> bool:
         eng = self.engine
         meta = h.meta
@@ -565,7 +758,7 @@ class DecodeWorker:
         blocks = eng.manager.allocate(n_total)
         if blocks is None:
             return False
-        req = request_from_meta(meta["request"], prompt)
+        req, toks = self._carried(meta, prompt)
         table_row = np.zeros((eng.max_blocks,), np.int32)
         table_row[:n_total] = blocks
         if self._adopt_jit is None:
@@ -587,7 +780,7 @@ class DecodeWorker:
         # the adopted copy is reusable here too (no-op for any digest
         # already registered)
         eng.manager.register_prefix(prompt, blocks)
-        run = _SlotRun(req, tokens=[meta["tok0"]],
+        run = _SlotRun(req, tokens=toks,
                        t_admit=meta["t_admit"], block_ids=blocks)
         eng._slots[slot] = run
         eos = req.eos_token_id
@@ -607,7 +800,7 @@ class DecodeWorker:
         eng = self.engine
         meta = h.meta
         prompt = h.arrays["prompt"]
-        req = request_from_meta(meta["request"], prompt)
+        req, toks = self._carried(meta, prompt)
         Lb = meta["pos0"]
         row = []
         for i, (shape, dtype) in enumerate(eng.backend.pool_specs):
@@ -625,8 +818,7 @@ class DecodeWorker:
             jnp.float32(req.top_p),
             jnp.asarray(np.asarray(h.arrays["key"], np.uint32)))
         self._commit()
-        run = _SlotRun(req, tokens=[meta["tok0"]],
-                       t_admit=meta["t_admit"])
+        run = _SlotRun(req, tokens=toks, t_admit=meta["t_admit"])
         eng._slots[slot] = run
         eng._remaining_host[slot] = meta["rem0"]
         return True
@@ -640,20 +832,45 @@ class Fleet:
     """N prefill workers + M decode workers + router + transport, one
     deterministic tick loop. ``submit()`` routes by prefix affinity;
     each tick advances every prefill worker, ships ready handoffs to
-    the least-loaded decode worker, adopts delivered payloads, and
-    advances every decode worker. ``results`` aggregates every
-    worker's results plus explicit handoff failures — each submitted
-    request ends in exactly one of them."""
+    the least-loaded decode worker, adopts delivered payloads,
+    advances every decode worker, then collects heartbeats and renews
+    leases. ``results`` aggregates every worker's results plus
+    explicit handoff failures — each submitted request ends in exactly
+    one of them.
+
+    **Failure domains** (PR 15): every worker holds a lease renewed by
+    its per-tick heartbeat; a worker missing ``lease_misses``
+    consecutive heartbeats is marked DEAD (flight-recorder event +
+    ``pt_fleet_worker_state`` gauge) and never read again. Streams a
+    dead decode worker owned are REDRIVEN from the fleet's own
+    records — the submitted request, the shipped rng key, and the
+    token progress carried by heartbeats — via a ``redrive``
+    :class:`ResumeState`: re-prefill of prompt+tokens[:-1] on a
+    surviving prefill worker (mostly prefix-index hits), then a normal
+    handoff arming the carried next token and the host-replayed key,
+    so the recovered stream completes BIT-IDENTICAL to an unfailed
+    run (greedy AND seeded-sampled). A dead prefill worker's
+    un-handed-off requests are resubmitted from the fleet's
+    submission records under their original ids. Streams that cannot
+    be redriven (no surviving workers, unfittable history) fail
+    explicitly as ``RequestFailure(reason="worker_lost")``."""
 
     def __init__(self, prefill_workers: List[PrefillWorker],
                  decode_workers: List[DecodeWorker], *,
                  transport: Optional[Transport] = None,
                  affinity: Optional[bool] = None,
                  spill_depth: Optional[int] = None,
-                 resilience: Optional[ResilienceConfig] = None):
+                 resilience: Optional[ResilienceConfig] = None,
+                 lease_misses: Optional[int] = None):
         if not prefill_workers or not decode_workers:
             raise ValueError("need at least one prefill and one decode "
                              "worker")
+        if lease_misses is None:
+            lease_misses = env_int("PT_SERVING_FLEET_LEASE_MISSES", 3)
+        if lease_misses < 1:
+            raise ValueError(
+                f"lease_misses={lease_misses}; must be >= 1")
+        self.lease_misses = lease_misses
         self.prefill = list(prefill_workers)
         self.decode = list(decode_workers)
         for i, w in enumerate(self.prefill):
@@ -664,11 +881,12 @@ class Fleet:
                 w.server._next_id = (i + 1) * 1_000_000
         for i, d in enumerate(self.decode):
             d.name = d.name or f"decode{i}"
-        names = [d.name for d in self.decode]
+        names = [w.name for w in self.prefill] \
+            + [d.name for d in self.decode]
         if len(set(names)) != len(names):
             raise ValueError(
-                f"duplicate decode worker names {sorted(names)} — "
-                "names address transport queues and assignment "
+                f"duplicate worker names {sorted(names)} — names "
+                "address transport queues, leases and assignment "
                 "counters, so they must be unique")
         self._check_compat()
         self.transport = transport or InProcessTransport()
@@ -678,16 +896,41 @@ class Fleet:
             affinity=affinity, spill_depth=spill_depth)
         self.resilience = resilience or ResilienceConfig()
         self._res = ResilienceState(self.resilience)
+        self.flight = FlightRecorder()
         self._failures: Dict[int, RequestFailure] = {}
+        # redrive-completed streams that never re-reach a worker (the
+        # carried history already held every token)
+        self._local_results: Dict[int, np.ndarray] = {}
         self._pending_adopt: Dict[str, deque] = {
             d.name: deque() for d in self.decode}
         self._assigned: Dict[str, int] = {d.name: 0
                                           for d in self.decode}
         self._draining: set = set()
+        # -- failure-domain records (everything redrive needs lives on
+        # THIS side of the wire) --
+        # rid -> {prompt, kw, worker, t_submit}: every submission
+        self._requests: Dict[int, dict] = {}
+        # rid -> {dst, key0, base_len, t_admit}: every shipped handoff
+        # (key0 = the rng key at ship, base_len = carried tokens then)
+        self._handoffs: Dict[int, dict] = {}
+        # rid -> last observed token list (heartbeat-carried)
+        self._progress: Dict[int, list] = {}
+        # worker name -> health record; 1 heartbeat miss tolerated per
+        # missing tick, lease_misses misses = dead
+        self._health: Dict[str, dict] = {
+            n: {"state": "live", "misses": 0} for n in names}
+        for n in names:
+            _M_WORKER_STATE.set(1, worker=n)
+        self._handoff_seq = 0
         self.handoffs = 0
         self.handoff_wire_bytes: List[int] = []
         self.handoff_kv_bytes: List[int] = []
         self.migrations = 0
+        self.redrives = 0
+        self.workers_lost = 0
+        self.redrive_latencies: List[float] = []
+        # rid -> (detection wall time) for redriven streams still open
+        self._redrive_t0: Dict[int, float] = {}
         self._clock = 0
 
     def _check_compat(self):
@@ -730,7 +973,7 @@ class Fleet:
         forever mid-stream."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         err = None
-        for d in self.decode:
+        for d in self._live_decode():
             try:
                 d.engine.validate_request(int(prompt.size),
                                           max_new_tokens)
@@ -741,12 +984,32 @@ class Fleet:
         if err is not None:
             raise ValueError(f"no decode worker can serve this "
                              f"request: {err}")
-        eligible = [i for i in range(len(self.prefill))
-                    if i not in self._draining]
+        eligible = self._routable_prefill()
         depths = [self.prefill[i].queue_depth() for i in eligible]
         wi = self.router.route(prompt, depths, eligible)
-        return self.prefill[wi].server.submit(
-            prompt, max_new_tokens=max_new_tokens, **kw)
+        w = self.prefill[wi]
+        rid = w.server.submit(prompt, max_new_tokens=max_new_tokens,
+                              **kw)
+        # the submission record: with this (plus the shipped key and
+        # heartbeat-carried progress) the fleet can rebuild the request
+        # after ANY worker holding it dies
+        self._requests[rid] = {
+            "prompt": prompt.copy(), "worker": w.name,
+            "t_submit": time.perf_counter(),
+            "kw": dict(kw, max_new_tokens=max_new_tokens)}
+        return rid
+
+    # -- liveness views ----------------------------------------------------
+    def _alive(self, name: str) -> bool:
+        return self._health[name]["state"] == "live"
+
+    def _live_decode(self) -> List[DecodeWorker]:
+        return [d for d in self.decode if self._alive(d.name)]
+
+    def _routable_prefill(self) -> List[int]:
+        return [i for i in range(len(self.prefill))
+                if i not in self._draining
+                and self._alive(self.prefill[i].name)]
 
     # -- the tick ----------------------------------------------------------
     def _with_retry(self, fn):
@@ -786,15 +1049,21 @@ class Fleet:
         self._res.count_failure(reason)
         _M_HANDOFF_FAILS.inc(reason=reason)
 
-    def _pick_decode(self) -> int:
-        """Least-loaded decode worker: free slots minus payloads
+    def _pick_decode(self) -> Optional[int]:
+        """Least-loaded LIVE decode worker: free slots minus payloads
         already assigned but not yet adopted; ties break low-index for
-        determinism."""
+        determinism. A killed-but-undetected worker is still a target
+        (the fleet cannot know yet — its payloads are redriven when
+        the lease expires); a detected-dead one never is. None when
+        the decode pool is gone entirely."""
         names = [d.name for d in self.decode]
-        return max(range(len(self.decode)),
-                   key=lambda i: (self.decode[i].free_slots()
-                                  - self._assigned[names[i]],
-                                  -i))
+        live = [i for i in range(len(self.decode))
+                if self._alive(names[i])]
+        if not live:
+            return None
+        return max(live, key=lambda i: (self.decode[i].free_slots()
+                                        - self._assigned[names[i]],
+                                        -i))
 
     def _ship(self, w: PrefillWorker, ph: _PendingHandoff):
         rid = ph.run.request.request_id
@@ -804,12 +1073,25 @@ class Fleet:
                                "fleet handoff circuit open")
             return
         di = self._pick_decode()
+        if di is None:
+            w.engine.release_handoff(ph)
+            self._fail_handoff(rid, "worker_lost",
+                               "no live decode worker to ship to",
+                               tokens=len(ph.run.tokens))
+            return
         dst = self.decode[di].name
+        self._handoff_seq += 1
+        seq = self._handoff_seq
         holder = {}
 
         def _do():
             if "data" not in holder:          # extract + serialize
                 h = w.engine.extract_handoff(ph, source=w.name)
+                # payload seq (adopt's dedup key half) + arrays CRC
+                # (refused loudly pre-allocation) ride the meta
+                h.meta["seq"] = seq
+                h.meta["crc32"] = h.payload_crc32()
+                holder["h"] = h
                 holder["kv"] = h.kv_bytes()
                 holder["data"] = encode_handoff(h)
             self.transport.send(dst, holder["data"])
@@ -822,6 +1104,18 @@ class Fleet:
             self.handoff_wire_bytes.append(len(holder["data"]))
             self.handoff_kv_bytes.append(holder["kv"])
             _M_HANDOFF_BYTES.inc(len(holder["data"]))
+            # the redrive record: the key the slot arms with and how
+            # many tokens it carried — with heartbeat progress, the
+            # slot key after m more emissions is split^m(key0)
+            h = holder["h"]
+            toks = [int(t) for t in h.meta.get("tokens",
+                                               [h.meta["tok0"]])]
+            self._handoffs[rid] = {
+                "dst": dst,
+                "key0": np.asarray(h.arrays["key"], np.uint32),
+                "base_len": len(toks), "tokens0": list(toks),
+                "t_admit": float(h.meta["t_admit"])}
+            self._progress[rid] = toks
         else:
             reason = "circuit_open" if self._res.breaker_open \
                 else "handoff"
@@ -832,6 +1126,8 @@ class Fleet:
                 tokens=len(ph.run.tokens))
 
     def _deliver(self, d: DecodeWorker):
+        if d.killed:        # a dead process runs no receive loop; its
+            return          # queued payloads redrive at lease expiry
         q = self._pending_adopt[d.name]
         while True:
             if not q:
@@ -840,12 +1136,38 @@ class Fleet:
                     return
                 q.append(decode_handoff(data))
             h = q[0]
-            ok, adopted = self._with_retry(lambda: d.adopt(h))
-            if ok and adopted:
+            if h.request_id in self._failures:
+                # an at-least-once straggler: one send attempt reached
+                # the receiver, but the ship as a whole was recorded a
+                # permanent failure (breaker/budget) and released the
+                # prefill state. The stream's terminal already exists —
+                # drop the frame, never adopt it (and never decrement
+                # _assigned: a failed ship never incremented it)
+                q.popleft()
+                continue
+            carried = len(h.meta.get("tokens", [h.meta.get("tok0")]))
+            try:
+                ok, status = self._with_retry(lambda: d.adopt(h))
+            except ValueError as e:
+                # corrupt/incompatible payload: permanent, loud, no
+                # retry — the prefill side's state is long released,
+                # so the stream ends in an explicit failure
+                self._fail_handoff(h.request_id, "handoff",
+                                   f"adopt refused: {e}",
+                                   tokens=carried)
                 q.popleft()
                 self._assigned[d.name] -= 1
                 continue
-            if ok and not adopted:            # capacity: retry later
+            if ok and status == DecodeWorker.ADOPTED:
+                q.popleft()
+                self._assigned[d.name] -= 1
+                continue
+            if ok and status == DecodeWorker.DUPLICATE:
+                # an ack-lost retransmit: the first copy already
+                # decremented the assignment — drop silently
+                q.popleft()
+                continue
+            if ok:                            # DEFER: retry next tick
                 _M_ADOPT_DEFERS.inc()
                 return
             reason = "circuit_open" if self._res.breaker_open \
@@ -853,35 +1175,53 @@ class Fleet:
             self._fail_handoff(
                 h.request_id, reason,
                 f"adopt on {d.name} failed: {self._res.last_error}",
-                tokens=1)
+                tokens=carried)
             q.popleft()
             self._assigned[d.name] -= 1
 
     def tick(self):
         """One fleet tick: prefill advance → ship → deliver/adopt →
-        decode advance. Deterministic given the same submissions and
-        fault schedule."""
+        decode advance → heartbeats/lease scan. Deterministic given
+        the same submissions, kill schedule and fault schedule. Dead
+        workers (lease expired) are skipped everywhere; killed-but-
+        undetected workers simply stop making progress until their
+        lease expires and their streams redrive."""
         self._clock += 1
         for w in self.prefill:
-            w.tick()
+            if self._alive(w.name):
+                w.tick()
         for w in self.prefill:
+            if w.killed or not self._alive(w.name):
+                continue        # a dead process ships nothing
             for ph in w.engine.take_handoffs():
                 self._ship(w, ph)
         for d in self.decode:
-            self._deliver(d)
+            if self._alive(d.name):
+                self._deliver(d)
         for d in self.decode:
-            d.tick()
+            if self._alive(d.name):
+                d.tick()
+        self._beat()
+        if self._redrive_t0:
+            self._settle_redrives()
+        if self._clock % 64 == 0:
+            self._gc_records()
         if _om.enabled():
             for w in self.prefill:
-                _M_PF_DEPTH.set(w.queue_depth(), worker=w.name)
+                if self._alive(w.name):
+                    _M_PF_DEPTH.set(w.queue_depth(), worker=w.name)
             for d in self.decode:
-                _M_DEC_FREE.set(d.free_slots(), worker=d.name)
+                if self._alive(d.name):
+                    _M_DEC_FREE.set(d.free_slots(), worker=d.name)
 
     def busy(self) -> bool:
-        return (any(w.busy() for w in self.prefill)
+        return (any(w.busy() for w in self.prefill
+                    if self._alive(w.name))
                 or self.transport.pending() > 0
-                or any(self._pending_adopt.values())
-                or any(d.busy() for d in self.decode))
+                or any(q for n, q in self._pending_adopt.items()
+                       if self._alive(n))
+                or any(d.busy() for d in self.decode
+                       if self._alive(d.name)))
 
     def run_until_idle(self, max_ticks: Optional[int] = None
                        ) -> Dict[int, object]:
@@ -893,6 +1233,213 @@ class Fleet:
             ticks += 1
         return self.results
 
+    # -- worker health: heartbeats, leases, death --------------------------
+    def _beat(self):
+        """Collect every worker's heartbeat, renew leases, absorb
+        decode-side token progress into the redrive records, and
+        declare workers whose lease ran out dead."""
+        for w in self.prefill:
+            self._beat_one(w, "prefill")
+        for d in self.decode:
+            self._beat_one(d, "decode")
+
+    def _beat_one(self, worker, role: str):
+        h = self._health[worker.name]
+        if h["state"] == "dead":
+            return
+        hb = worker.heartbeat()
+        if hb is None:
+            h["misses"] += 1
+            self.flight.record("heartbeat_miss", worker=worker.name,
+                               role=role, misses=h["misses"],
+                               clock=self._clock)
+            if h["misses"] >= self.lease_misses:
+                self._declare_dead(worker, role)
+            return
+        h["misses"] = 0
+        h["last"] = hb
+        if role == "decode":
+            # progress carried by the heartbeat IS the redrive record:
+            # after the worker dies, tokens generated since its last
+            # beat are simply regenerated (the decode block is a pure
+            # function of the carried state)
+            for rid, toks in hb["progress"].items():
+                if rid in self._handoffs:
+                    self._progress[rid] = list(toks)
+
+    def _declare_dead(self, worker, role: str):
+        h = self._health[worker.name]
+        h["state"] = "dead"
+        self.workers_lost += 1
+        _M_WORKERS_LOST.inc(role=role)
+        _M_WORKER_STATE.set(0, worker=worker.name)
+        self.flight.record("worker_dead", worker=worker.name,
+                           role=role, clock=self._clock,
+                           lease_misses=self.lease_misses)
+        if role == "decode":
+            self._recover_decode_streams(worker)
+        else:
+            self._recover_prefill_streams(worker)
+
+    def kill_decode_worker(self, idx: int):
+        """Test/chaos hook: kill decode worker ``idx`` (the worker
+        stops participating; the fleet notices via the lease and
+        redrives its streams ``lease_misses`` ticks later)."""
+        self.decode[idx].kill()
+
+    def kill_prefill_worker(self, idx: int):
+        self.prefill[idx].kill()
+
+    # -- redrive: streams lost with a dead worker --------------------------
+    def _terminal(self, rid: int) -> bool:
+        return (rid in self._failures or rid in self._local_results
+                or any(rid in w.server.results for w in self.prefill)
+                or any(rid in d.server.results for d in self.decode))
+
+    def _recover_decode_streams(self, d: DecodeWorker):
+        """Every stream the dead decode worker owned — adopted,
+        in-flight on the wire, or queued for adoption — is redriven
+        from the fleet's records. The corpse's ENGINE state is never
+        read: completed results count as terminal because they were
+        DELIVERED at harvest (see DecodeWorker.kill); everything else
+        reconstructs from the submission record + shipped key +
+        heartbeat progress."""
+        self.transport.drop_endpoint(d.name)
+        self._pending_adopt[d.name].clear()
+        self._assigned[d.name] = 0
+        lost = [rid for rid, rec in self._handoffs.items()
+                if rec["dst"] == d.name and not self._terminal(rid)]
+        for rid in sorted(lost):
+            self._redrive(rid)
+
+    def _recover_prefill_streams(self, w: PrefillWorker):
+        """A dead prefill worker's un-handed-off requests (queued,
+        mid-prefill, or parked in its outbox) resubmit from the
+        fleet's submission records under their ORIGINAL ids — nothing
+        was lost but compute, so a fresh prefill on a surviving worker
+        regenerates the identical stream."""
+        lost = [rid for rid, rec in self._requests.items()
+                if rec["worker"] == w.name and rid not in self._handoffs
+                and not self._terminal(rid)]
+        for rid in sorted(lost):
+            self._reinject(rid, resume=None)
+
+    def _request_from_record(self, rid: int, resume) -> Request:
+        rec = self._requests[rid]
+        kw = rec["kw"]
+        return Request(
+            request_id=rid, prompt=rec["prompt"],
+            max_new_tokens=kw.get("max_new_tokens", 20),
+            temperature=kw.get("temperature", 0.0),
+            top_k=kw.get("top_k", 0), top_p=kw.get("top_p", 1.0),
+            eos_token_id=kw.get("eos_token_id"),
+            seed=kw.get("seed", 0), t_submit=rec["t_submit"],
+            deadline_ticks=kw.get("deadline_ticks"),
+            deadline_s=kw.get("deadline_s"),
+            tenant=kw.get("tenant", "default"),
+            priority=kw.get("priority", 0), resume=resume)
+
+    def _reinject(self, rid: int, resume) -> bool:
+        """Route a reconstructed request to a surviving prefill worker
+        under its original id. False = nowhere to go / cannot fit —
+        the stream fails explicitly as ``worker_lost``."""
+        if rid not in self._requests:
+            self._fail_handoff(rid, "worker_lost",
+                               "no submission record to redrive from")
+            return False
+        eligible = self._routable_prefill()
+        if not eligible:
+            self._fail_handoff(rid, "worker_lost",
+                               "no surviving prefill worker to "
+                               "redrive on")
+            return False
+        rec = self._requests[rid]
+        req = self._request_from_record(rid, resume)
+        pl = int(rec["prompt"].size)
+        mnt = req.max_new_tokens
+        if resume is not None and resume.tokens:
+            pl += len(resume.tokens) - 1
+            mnt = req.max_new_tokens - len(resume.tokens) + 1
+        depths = [self.prefill[i].queue_depth() for i in eligible]
+        wi = self.router.route(rec["prompt"], depths, eligible)
+        w = self.prefill[wi]
+        try:
+            # the re-prefill must fit the TARGET engine (dense: the
+            # history may outgrow the original bucket)
+            w.engine.validate_request(pl, mnt)
+        except ValueError as e:
+            self._fail_handoff(rid, "worker_lost",
+                               f"redrive does not fit {w.name}: {e}",
+                               tokens=len(resume.tokens)
+                               if resume else 0)
+            return False
+        # visible on the target's own clock immediately; rec["worker"]
+        # moves so a second failure redrives from the right place
+        req.arrival_step = w.server._clock
+        rec["worker"] = w.name
+        w.server.inject(req)
+        self.flight.record("redrive", rid=rid, to=w.name,
+                           carried_tokens=len(resume.tokens)
+                           if resume else 0, clock=self._clock)
+        return True
+
+    def _redrive(self, rid: int):
+        """Rebuild one lost stream: carried tokens from the last
+        heartbeat, the rng key host-replayed from the shipped key
+        (one split per observed token — the decode block's schedule),
+        and the PR 13 resume path doing the rest. The redriven stream
+        completes BIT-IDENTICAL to an unfailed run."""
+        hrec = self._handoffs.pop(rid)
+        toks = [int(t)
+                for t in self._progress.pop(rid, hrec["tokens0"])]
+        self._redrive_t0[rid] = time.perf_counter()
+        key = _replay_key(hrec["key0"], len(toks) - hrec["base_len"])
+        resume = ResumeState(tokens=toks, key=key,
+                             t_admit=hrec["t_admit"], redrive=True)
+        rec = self._requests.get(rid)
+        if rec is not None:
+            kw = rec["kw"]
+            eos = kw.get("eos_token_id")
+            done = (len(toks) >= kw.get("max_new_tokens", 20)
+                    or (eos is not None and toks[-1] == eos))
+            if done:
+                # the carried history already holds every token (the
+                # worker died between producing the last token and
+                # harvesting it): complete locally, eos-padded to
+                # max_new exactly like Server._harvest
+                out = list(toks)
+                mn = kw.get("max_new_tokens", 20)
+                if len(out) < mn:
+                    out += [eos] * (mn - len(out))
+                self._local_results[rid] = np.concatenate(
+                    [rec["prompt"],
+                     np.asarray(out, np.int32)]).astype(np.int32)
+                self.redrives += 1
+                _M_REDRIVES.inc()
+                return
+        if self._reinject(rid, resume):
+            self.redrives += 1
+            _M_REDRIVES.inc()
+
+    def _gc_records(self):
+        """Drop failure-domain records of streams that reached a
+        terminal (amortized: every 64 ticks) — a long-lived fleet must
+        not hold every prompt it ever served. Open streams' records
+        are untouchable: they ARE the redrive substrate."""
+        done = [rid for rid in self._requests if self._terminal(rid)]
+        for rid in done:
+            self._requests.pop(rid, None)
+            self._handoffs.pop(rid, None)
+            self._progress.pop(rid, None)
+
+    def _settle_redrives(self):
+        """Close the redrive-latency clock for redriven streams that
+        reached a terminal (the bench's recovery-latency numbers)."""
+        for rid in list(self._redrive_t0):
+            if self._terminal(rid):
+                self.redrive_latencies.append(
+                    time.perf_counter() - self._redrive_t0.pop(rid))
+
     # -- results / stats ---------------------------------------------------
     @property
     def results(self) -> Dict[int, object]:
@@ -901,6 +1448,7 @@ class Fleet:
             out.update(w.server.results)
         for d in self.decode:
             out.update(d.server.results)
+        out.update(self._local_results)
         out.update(self._failures)
         return out
 
@@ -936,14 +1484,31 @@ class Fleet:
             "prefix_hit_rate": round(self.prefix_hit_rate(), 4),
             "migrations": self.migrations,
             "ticks": self._clock,
+            "lease_misses": self.lease_misses,
+            "workers_lost": self.workers_lost,
+            "redrives": self.redrives,
+            "redrive_latency_p50_s": round(float(np.percentile(
+                self.redrive_latencies, 50)), 4)
+            if self.redrive_latencies else None,
+            "redrive_latency_p95_s": round(float(np.percentile(
+                self.redrive_latencies, 95)), 4)
+            if self.redrive_latencies else None,
+            "duplicate_adopts": sum(d.duplicate_adopts
+                                    for d in self.decode),
+            "worker_states": {n: h["state"]
+                              for n, h in sorted(self._health.items())},
+            "transport": self.transport.stats()
+            if hasattr(self.transport, "stats") else None,
             "prefill_workers": [
-                {"name": w.name, "queue": w.queue_depth(),
+                {"name": w.name, "state": self._health[w.name]["state"],
+                 "queue": w.queue_depth(),
                  "tokens_emitted": w.engine.tokens_emitted,
                  "prefill_compiles": w.engine.prefill_compile_count()
                  if hasattr(w.engine, "prefill_compile_count") else 1}
                 for w in self.prefill],
             "decode_workers": [
-                {"name": d.name, "free_slots": d.free_slots(),
+                {"name": d.name, "state": self._health[d.name]["state"],
+                 "free_slots": d.free_slots(),
                  "tokens_emitted": d.engine.tokens_emitted,
                  "decode_compiles": d.engine.decode_compile_count()}
                 for d in self.decode],
@@ -959,12 +1524,14 @@ class Fleet:
         self._check_engine_compat(worker.engine,
                                   self.prefill[0].engine)
         worker.name = worker.name or f"decode{len(self.decode)}"
-        if worker.name in self._pending_adopt:
+        if worker.name in self._health:
             raise ValueError(f"decode worker name {worker.name!r} "
                              "already in the fleet")
         self.decode.append(worker)
         self._pending_adopt[worker.name] = deque()
         self._assigned[worker.name] = 0
+        self._health[worker.name] = {"state": "live", "misses": 0}
+        _M_WORKER_STATE.set(1, worker=worker.name)
 
     def migrate_decode_worker(self, idx: int, engine,
                               path: str) -> DecodeWorker:
@@ -976,10 +1543,17 @@ class Fleet:
         bit-identical — the decode block is a pure function of the
         restored state."""
         old = self.decode[idx]
+        if old.killed or not self._alive(old.name):
+            raise RuntimeError(
+                "cannot migrate a dead worker — its state is "
+                "unreadable by contract; its streams redrive instead")
         old.server.snapshot(path)
         srv = Server.restore(path, engine)
         new = DecodeWorker(engine, name=old.name, server=srv)
-        self.decode[idx] = new
+        new._adopted = set(old._adopted)     # the dedup history moves
+        self.decode[idx] = new               # with the identity
+        self._health[old.name] = {"state": "live", "misses": 0}
+        _M_WORKER_STATE.set(1, worker=old.name)
         self.migrations += 1
         _M_MIGRATIONS.inc()
         return new
@@ -993,7 +1567,7 @@ class Fleet:
             raise ValueError(f"no prefill worker at index {idx}")
         if idx in self._draining:
             return
-        if len(self._draining) + 1 >= len(self.prefill):
+        if len([i for i in self._routable_prefill() if i != idx]) < 1:
             raise ValueError("cannot drain the last routable prefill "
                              "worker")
         self._draining.add(idx)
@@ -1004,6 +1578,7 @@ class Fleet:
                                "run the fleet idle first")
         self._draining.discard(idx)
         w = self.prefill.pop(idx)
+        self._health.pop(w.name, None)
         self._draining = {i - 1 if i > idx else i
                           for i in self._draining}
         return w

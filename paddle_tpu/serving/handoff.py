@@ -90,6 +90,31 @@ class KVHandoff:
         return sum(int(v.nbytes) for k, v in self.arrays.items()
                    if k.startswith("kv_"))
 
+    def payload_crc32(self) -> int:
+        """CRC32 over every array's name, dtype, shape and raw bytes
+        (name-sorted, so the digest is layout-order independent). The
+        fleet stamps it into ``meta["crc32"]`` at ship time;
+        ``DecodeWorker.adopt`` recomputes it BEFORE touching any
+        allocator state — a tampered/corrupted payload is refused
+        loudly, never scattered into an arena."""
+        import zlib
+        c = 0
+        for name in sorted(self.arrays):
+            a = np.ascontiguousarray(self.arrays[name])
+            c = zlib.crc32(
+                f"{name}|{a.dtype}|{a.shape}".encode(), c)
+            c = zlib.crc32(a.tobytes(), c)
+        return c & 0xFFFFFFFF
+
+    def verify_crc(self):
+        """Raise ValueError when ``meta["crc32"]`` (if present) does
+        not match the arrays actually carried."""
+        want = self.meta.get("crc32")
+        if want is not None and int(want) != self.payload_crc32():
+            raise ValueError(
+                f"handoff payload CRC mismatch (rid {self.request_id})"
+                " — refusing to adopt corrupted KV state")
+
 
 def encode_handoff(handoff: KVHandoff) -> bytes:
     """Serialize to one uncompressed npz byte string (bytes-true:

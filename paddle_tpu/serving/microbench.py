@@ -31,7 +31,8 @@ import time
 
 import numpy as np
 
-__all__ = ["run_serving_disagg_bench", "run_serving_frontdoor_bench",
+__all__ = ["run_fleet_kill_soak", "run_serving_disagg_bench",
+           "run_serving_failover_bench", "run_serving_frontdoor_bench",
            "run_serving_megakernel_bench", "run_serving_quant_bench",
            "run_serving_spec_bench", "run_serving_tp_bench"]
 
@@ -185,6 +186,230 @@ def run_serving_disagg_bench(requests_per_group: int = 6,
         "serving_disagg_spillovers": fst["spillovers"],
         "serving_disagg_decode_compiles": compiles[0],
         "serving_disagg_prefill_compiles": compiles[1],
+    }
+
+
+def run_serving_failover_bench(requests: int = 6, max_new: int = 24,
+                               num_slots: int = 2,
+                               kill_after: int = 3) -> dict:
+    """Fleet failure-domain stage (serving/transport.py + fleet.py):
+    kill-one-decode-worker A/B on a paged 2-prefill/2-decode fleet
+    over the REAL localhost-TCP SocketTransport with ~1% wire faults
+    armed (partial_write/corrupt/disconnect).
+
+    What the stage pins every round:
+
+    - **recovered-stream bit-identity**: every stream of the killed
+      run — including the redriven ones, greedy AND seeded-sampled —
+      token-equal to the clean (unfailed) run of the same workload;
+    - **redrive latency p50/p95**: wall time from lease-expiry
+      detection to the redriven stream's terminal;
+    - **goodput with and without the mid-run kill**: completed useful
+      tokens/s A/B — the cost of losing (and re-homing) a failure
+      domain mid-traffic;
+    - **handoff retry/dedup counters from the metrics registry**:
+      transport resends/reconnects/CRC drops, fleet handoff retries,
+      and (rid, seq)-deduplicated adopts;
+    - the compile-count pin: the surviving decode worker's ONE block
+      (redrive arms through the existing programs, zero new compiles).
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.observability import metrics as om
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    DecodeWorker, Fleet, PrefillWorker,
+                                    PrefillPagedEngine, RequestFailure,
+                                    SocketTransport)
+    from paddle_tpu.serving import fleet as fleet_mod
+    from paddle_tpu.serving import transport as transport_mod
+    from paddle_tpu.utils import faults
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    kw = dict(num_slots=num_slots, max_len=64, decode_block=4,
+              block_size=8, prefill_chunk=16)
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          (int(rs.randint(5, 14)),)).astype(np.int32)
+               for _ in range(requests)]
+    news = [max_new - (i % 3) * 2 for i in range(requests)]
+    sampled = [i % 3 == 1 for i in range(requests)]
+
+    pf_engines = [PrefillPagedEngine(model, **kw) for _ in range(2)]
+    dc_engines = [ContinuousBatchingEngine(model, paged=True, **kw)
+                  for _ in range(2)]
+
+    def drive(kill: bool):
+        for e in pf_engines + dc_engines:
+            e.reset()
+        t = SocketTransport("fleet", retry_backoff_s=0.001)
+        fleet = Fleet([PrefillWorker(e) for e in pf_engines],
+                      [DecodeWorker(e) for e in dc_engines],
+                      transport=t, lease_misses=2, spill_depth=100)
+        rids = []
+        for i, (p, mn) in enumerate(zip(prompts, news)):
+            skw = dict(temperature=0.9, top_k=40, seed=100 + i) \
+                if sampled[i] else {}
+            rids.append(fleet.submit(p, max_new_tokens=mn, **skw))
+        t0 = time.perf_counter()
+        spec = ("transport.partial_write:p=0.01;transport.corrupt:"
+                "p=0.01;transport.disconnect:p=0.01")
+        with faults.injected(spec if kill else "", seed=7):
+            if kill:
+                for _ in range(kill_after):
+                    fleet.tick()
+                fleet.kill_decode_worker(1)
+            res = fleet.run_until_idle(max_ticks=2000)
+        dt = time.perf_counter() - t0
+        done = sum(news[i] for i, r in enumerate(rids)
+                   if not isinstance(res.get(r), RequestFailure))
+        out = ([res[r] if not isinstance(res[r], RequestFailure)
+                else None for r in rids], done / dt, fleet.stats())
+        t.close()
+        return out
+
+    drive(kill=False)                # warm-up: compiles land here, so
+    om.reset()                       # the A/B compares steady states
+    om.enable(True)
+    try:
+        clean_rows, clean_goodput, _ = drive(kill=False)
+        kill_rows, kill_goodput, kst = drive(kill=True)
+    finally:
+        om.enable(False)
+    identical = all(a is not None and b is not None
+                    and np.array_equal(a, b)
+                    for a, b in zip(clean_rows, kill_rows))
+    lat = kst["redrive_latency_p50_s"]
+    lat95 = kst["redrive_latency_p95_s"]
+    return {
+        "serving_failover_workers": "2p+2d",
+        "serving_failover_bit_identical": bool(identical),
+        "serving_failover_workers_lost": kst["workers_lost"],
+        "serving_failover_redrives": kst["redrives"],
+        "serving_failover_redrive_latency_p50_ms": round(
+            lat * 1000, 2) if lat is not None else 0.0,
+        "serving_failover_redrive_latency_p95_ms": round(
+            lat95 * 1000, 2) if lat95 is not None else 0.0,
+        "serving_failover_goodput_tokens_per_sec": round(
+            kill_goodput, 1),
+        "serving_failover_goodput_tokens_per_sec_clean": round(
+            clean_goodput, 1),
+        "serving_failover_goodput_ratio": round(
+            kill_goodput / clean_goodput, 3) if clean_goodput else 0.0,
+        # the registry's view (both runs; the kill run armed it)
+        "serving_failover_handoff_retries": int(
+            fleet_mod._M_FLEET_RETRIES.value()),
+        "serving_failover_duplicate_adopts": int(
+            fleet_mod._M_ADOPT_DUPS.value()),
+        "serving_failover_transport_resends": int(
+            transport_mod._M_RESENDS.value()),
+        "serving_failover_transport_crc_drops": int(
+            transport_mod._M_CRC_DROPS.value()),
+        "serving_failover_transport_reconnects": int(
+            transport_mod._M_RECONNECTS.value()),
+        "serving_failover_decode_compiles": max(
+            e.decode_compile_count() for e in dc_engines),
+    }
+
+
+def run_fleet_kill_soak(seed: int = 0, kills: int = 2,
+                        requests: int = 12, max_new: int = 16,
+                        wire_fault_p: float = 0.01) -> dict:
+    """Seeded worker-kill chaos soak (tools/chaos.sh): K decode-worker
+    kills at seeded ticks over one traffic run on the socket
+    transport with wire faults armed; after each kill a fresh decode
+    worker scales in (``add_decode_worker``) so capacity survives the
+    schedule. Asserts every request completed-or-explicitly-failed,
+    completed greedy rows bit-identical to generate(), and zero block
+    leaks on every surviving arena (prefill AND decode)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    DecodeWorker, Fleet, PrefillWorker,
+                                    PrefillPagedEngine, RequestFailure,
+                                    SocketTransport)
+    from paddle_tpu.utils import faults
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(seed)
+    kw = dict(num_slots=2, max_len=64, decode_block=4, block_size=8,
+              prefill_chunk=8)
+    prompts = [rs.randint(0, cfg.vocab_size,
+                          (int(rs.randint(4, 14)),)).astype(np.int32)
+               for _ in range(requests)]
+    news = [max_new - int(rs.randint(0, 8)) for _ in range(requests)]
+    kill_ticks = sorted(int(t) for t in rs.randint(2, 14, size=kills))
+
+    t = SocketTransport("fleet", retry_backoff_s=0.001)
+    fleet = Fleet(
+        [PrefillWorker(PrefillPagedEngine(model, **kw))
+         for _ in range(2)],
+        [DecodeWorker(ContinuousBatchingEngine(model, paged=True,
+                                               **kw))
+         for _ in range(2)],
+        transport=t, lease_misses=2, spill_depth=100)
+    rids = [fleet.submit(p, max_new_tokens=mn, arrival_step=i % 4)
+            for i, (p, mn) in enumerate(zip(prompts, news))]
+    spec = (f"transport.partial_write:p={wire_fault_p};"
+            f"transport.corrupt:p={wire_fault_p};"
+            f"transport.disconnect:p={wire_fault_p}")
+    killed = 0
+    next_name = len(fleet.decode)
+    with faults.injected(spec, seed=seed):
+        ticks = 0
+        while fleet.busy() and ticks < 3000:
+            fleet.tick()
+            ticks += 1
+            if killed < kills and ticks >= kill_ticks[killed]:
+                victims = [i for i, d in enumerate(fleet.decode)
+                           if not d.killed]
+                vi = victims[int(rs.randint(0, len(victims)))]
+                fleet.kill_decode_worker(vi)
+                killed += 1
+                fleet.add_decode_worker(DecodeWorker(
+                    ContinuousBatchingEngine(model, paged=True, **kw),
+                    name=f"decode{next_name}"))
+                next_name += 1
+        res = fleet.results
+    completed = failed = 0
+    for rid, p, mn in zip(rids, prompts, news):
+        assert rid in res, f"request {rid} vanished"
+        v = res[rid]
+        if isinstance(v, RequestFailure):
+            assert v.reason in ("timeout", "poisoned", "circuit_open",
+                                "shed", "handoff", "worker_lost"), \
+                f"{rid}: unexpected reason {v.reason}"
+            failed += 1
+        else:
+            ref = model.generate(paddle.to_tensor(p[None, :]),
+                                 max_new_tokens=mn).numpy()[0]
+            assert np.array_equal(v, ref), \
+                f"completed stream {rid} not bit-identical"
+            completed += 1
+    # zero leaks on every surviving arena, both specialties
+    for w in fleet.prefill:
+        if fleet._alive(w.name) and hasattr(w.engine, "manager"):
+            assert not w.engine.manager._ref
+            w.engine.manager.assert_consistent()
+    for d in fleet.decode:
+        if fleet._alive(d.name) and hasattr(d.engine, "manager"):
+            assert not d.engine.manager._ref
+            d.engine.manager.assert_consistent()
+    st = fleet.stats()
+    t.close()
+    return {
+        "soak_seed": seed, "soak_kills": killed,
+        "soak_requests": requests, "soak_completed": completed,
+        "soak_failed": failed, "soak_redrives": st["redrives"],
+        "soak_workers_lost": st["workers_lost"],
+        "soak_duplicate_adopts": st["duplicate_adopts"],
+        "soak_transport": st["transport"], "soak_ticks": st["ticks"],
+        "soak_leaks": 0,
     }
 
 

@@ -65,10 +65,14 @@ class RequestFailure:
 
 def _transient_types() -> Tuple[type, ...]:
     """Exception types the retry loop treats as transient: injected
-    faults always; XLA's runtime error (device-side failures — e.g. a
-    preempted or flaky accelerator) when the class is importable.
-    Programming errors (ValueError & friends) always propagate."""
-    types = [InjectedFault]
+    faults always; the fleet transport's wire failure (a send that
+    exhausted its reconnect budget — the network being down is
+    operational, not a bug); XLA's runtime error (device-side failures
+    — e.g. a preempted or flaky accelerator) when the class is
+    importable. Programming errors (ValueError & friends) always
+    propagate."""
+    from .transport import TransportError
+    types = [InjectedFault, TransportError]
     try:
         from jax.errors import JaxRuntimeError
         types.append(JaxRuntimeError)
@@ -213,7 +217,8 @@ def request_to_meta(req: Request) -> dict:
             "tokens": [int(t) for t in req.resume.tokens],
             "key": [int(k) for k in
                     np.asarray(req.resume.key, np.uint32).reshape(-1)],
-            "t_admit": float(req.resume.t_admit)}
+            "t_admit": float(req.resume.t_admit),
+            "redrive": bool(req.resume.redrive)}
     return meta
 
 
@@ -224,7 +229,8 @@ def request_from_meta(meta: dict, prompt) -> Request:
     if rs is not None:
         resume = ResumeState(tokens=list(rs["tokens"]),
                              key=np.asarray(rs["key"], np.uint32),
-                             t_admit=rs["t_admit"])
+                             t_admit=rs["t_admit"],
+                             redrive=bool(rs.get("redrive", False)))
     # tolerant field read: snapshots written before tenant/priority
     # existed restore with the dataclass defaults
     return Request(prompt=np.asarray(prompt, np.int32).reshape(-1),

@@ -28,10 +28,18 @@ class ResumeState:
     which is what makes a resumed seeded-sampled stream bit-identical
     to an uninterrupted run), and the original first-token timestamp so
     TTFT keeps measuring the FIRST admission. Serialized into server
-    snapshots by ``resilience.request_to_meta``."""
+    snapshots by ``resilience.request_to_meta``.
+
+    ``redrive`` marks fleet failure recovery (serving/fleet.py): the
+    carried state was reconstructed from the fleet's own records after
+    the stream's decode worker died, not handed back by a live engine.
+    Prefill-only engines accept redrive resumes (the lost stream must
+    re-prefill SOMEWHERE) while still refusing user-initiated
+    preemption resumes — the fleet never preempts."""
     tokens: List[int] = field(default_factory=list)
     key: Optional[np.ndarray] = None    # (2,) uint32 per-slot PRNG key
     t_admit: float = 0.0
+    redrive: bool = False
 
 
 @dataclass

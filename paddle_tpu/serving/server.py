@@ -248,6 +248,21 @@ class Server:
         _M_QUEUE.set(self.scheduler.pending())
         return rid
 
+    def inject(self, req: Request):
+        """Queue an externally-constructed :class:`Request` under ITS
+        OWN id — the fleet's redrive/resubmission path, where the id
+        was assigned at the ORIGINAL submission and must survive the
+        move to this server (one id, one terminal, one results entry
+        fleet-wide). Door policies (shed, quota) deliberately do not
+        run: the request was already admitted once; this is recovery,
+        not new load."""
+        self._tenant_of[req.request_id] = req.tenant
+        self._tcount(req.tenant)["submitted"] += 1
+        _M_SUBMIT.inc()
+        self.tracer.start(req.request_id)
+        self.scheduler.submit(req)
+        _M_QUEUE.set(self.scheduler.pending())
+
     def _tcount(self, tenant: str) -> Dict[str, int]:
         c = self.tenant_counts.get(tenant)
         if c is None:

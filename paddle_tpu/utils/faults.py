@@ -28,11 +28,26 @@ unless a schedule is armed. The serving tests pin the stronger claim —
 compile counts and greedy streams are bit-identical with the module
 imported but disarmed.
 
-Sites currently threaded (see docs/architecture.md for the table):
-``server.tick``, ``serving.step_block``, ``serving.harvest``,
-``serving.prefill_tick``, ``serving.allocate``, ``serving.poison``,
-and the fleet handoff sites ``fleet.serialize``, ``fleet.transport``,
-``fleet.adopt`` (serving/fleet.py).
+Sites currently threaded (regenerated with the fleet + network
+transport sites; tests/test_fleet_failover.py asserts every armed site
+in the tree appears here):
+
+====================== ============================== ==================
+site                   fires in                       failure simulated
+====================== ============================== ==================
+server.tick            Server.run_until_idle          whole tick skipped
+serving.step_block     engine/spec step dispatch      device step error
+serving.harvest        engine/spec pending-harvest    host transfer loss
+serving.prefill_tick   paged chunked prefill          chunk dispatch err
+serving.allocate       BlockManager.allocate          pool exhaustion
+serving.poison         engine/spec step (KV NaN)      poisoned slot
+fleet.serialize        handoff.encode_handoff         serializer crash
+fleet.transport        Transport.send (both kinds)    wire refuses send
+fleet.adopt            DecodeWorker.adopt             adopt-side crash
+transport.partial_write SocketTransport frame write   torn TCP write
+transport.corrupt      SocketTransport frame write    flipped wire byte
+transport.disconnect   SocketTransport ack wait       ack loss/conn drop
+====================== ============================== ==================
 """
 from __future__ import annotations
 

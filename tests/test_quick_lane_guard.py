@@ -25,7 +25,8 @@ GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
                  "tests/test_serving_quant.py",
                  "tests/test_sparse_quant.py",
                  "tests/test_megakernel.py", "tests/test_autotune.py",
-                 "tests/test_frontend.py", "tests/test_fleet.py"]
+                 "tests/test_frontend.py", "tests/test_fleet.py",
+                 "tests/test_fleet_failover.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -185,6 +186,30 @@ REQUIRED_NODES = [
     "test_seeded_sampled_preempt_resume_bit_identical",
     "test_frontend.py::TestStreamRestore::"
     "test_kill_restore_reattach_sees_only_unseen_tokens",
+    # PR 15 failure-domain pins: the socket transport's at-least-once
+    # duplicate delivery, adoption idempotency at exact refcounts, the
+    # tampered-CRC pre-allocation refusal, the fault-site table guard,
+    # and the headline kill-mid-decode redrive bit-identity matrix
+    # (paged under ~1% wire faults + one-terminal trace, dense,
+    # paged+kv_int8) plus the explicit worker_lost endgame
+    "test_fleet_failover.py::TestSocketTransport::"
+    "test_disconnect_before_ack_delivers_duplicate",
+    "test_fleet_failover.py::TestAdoptIdempotency::"
+    "test_duplicate_adopt_is_noop_at_exact_refcounts",
+    "test_fleet_failover.py::TestAdoptIdempotency::"
+    "test_tampered_crc_refused_before_any_allocation",
+    "test_fleet_failover.py::TestFaultSiteTable::"
+    "test_every_armed_site_appears_in_the_docstring_table",
+    "test_fleet_failover.py::TestPrefillRedriveResume::"
+    "test_user_preemption_resume_still_refused",
+    "test_fleet_failover.py::TestRedriveBitIdentity::"
+    "test_paged_kill_mid_decode_bit_identical_under_wire_faults",
+    "test_fleet_failover.py::TestRedriveBitIdentity::"
+    "test_dense_kill_mid_decode_bit_identical",
+    "test_fleet_failover.py::TestRedriveBitIdentity::"
+    "test_paged_kv_int8_kill_bit_identical",
+    "test_fleet_failover.py::TestRedriveBitIdentity::"
+    "test_no_surviving_decode_worker_fails_explicitly",
 ]
 
 

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Seeded worker-kill chaos soak for the serving fleet (CPU lane).
+#
+# Drives one traffic run on a paged 2-prefill/2-decode Fleet over the
+# REAL localhost-TCP SocketTransport with ~1% wire faults armed
+# (transport.partial_write/corrupt/disconnect), kills K decode workers
+# at seeded ticks (scaling a fresh worker in after each kill), and
+# asserts the failure-domain invariants:
+#   - every request completed OR ended in an explicit RequestFailure
+#   - completed greedy rows bit-identical to generate()
+#   - zero block leaks on every surviving arena (prefill AND decode)
+#
+# Usage: tools/chaos.sh [SEED] [KILLS] [REQUESTS]
+#   SEED     fault/kill schedule seed        (default 0)
+#   KILLS    decode workers to kill          (default 2)
+#   REQUESTS traffic size                    (default 12)
+#
+# The same SEED replays the identical kill+fault schedule bit-for-bit.
+# Exits non-zero on any invariant violation.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SEED="${1:-0}"
+KILLS="${2:-2}"
+REQUESTS="${3:-12}"
+
+JAX_PLATFORMS=cpu python - "$SEED" "$KILLS" "$REQUESTS" <<'PY'
+import json
+import sys
+
+import jax
+# the documented jaxlib landmine: a stale persistent compile cache can
+# corrupt the heap when additional paged backends compile in-process
+# (ROADMAP env note); the soak compiles one per kill, so stay cold
+jax.config.update("jax_enable_compilation_cache", False)
+
+from paddle_tpu.serving.microbench import run_fleet_kill_soak
+
+seed, kills, requests = (int(a) for a in sys.argv[1:4])
+out = run_fleet_kill_soak(seed=seed, kills=kills, requests=requests)
+print("CHAOS_JSON " + json.dumps(out))
+assert out["soak_completed"] + out["soak_failed"] == out["soak_requests"]
+print(f"chaos soak OK: seed={seed} kills={out['soak_kills']} "
+      f"completed={out['soak_completed']} failed={out['soak_failed']} "
+      f"redrives={out['soak_redrives']} leaks={out['soak_leaks']}")
+PY
