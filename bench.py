@@ -54,6 +54,8 @@ SERVING_FAILOVER_DEADLINE_S = env_float(
     "BENCH_SERVING_FAILOVER_DEADLINE_S", 300)
 SERVING_DISAGG_DEADLINE_S = env_float(
     "BENCH_SERVING_DISAGG_DEADLINE_S", 300)
+SERVING_PREFIXCACHE_DEADLINE_S = env_float(
+    "BENCH_SERVING_PREFIXCACHE_DEADLINE_S", 300)
 AUTOTUNE_DEADLINE_S = env_float("BENCH_AUTOTUNE_DEADLINE_S", 300)
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
@@ -739,6 +741,18 @@ def _child_tpu():
         decode.update(fo if fo is not None
                       else {"serving_failover_bit_identical": None})
         _release_hbm()
+        # fleet-wide KV prefix cache on the REAL chip: cold vs warm-
+        # local vs warm-remote TTFT ladder + bytes-moved-vs-flops-
+        # saved (the fetch-beats-prefill claim is a chip claim too)
+        from paddle_tpu.serving.microbench import \
+            run_serving_prefixcache_bench
+        pfx, err = _staged(run_serving_prefixcache_bench,
+                           "serving-prefixcache")
+        if err:
+            errors.append(err)
+        decode.update(pfx if pfx is not None
+                      else {"serving_prefixcache_bit_identical": None})
+        _release_hbm()
         # block-size autotune sweep on the REAL chip (flash/splash
         # blocks + the CPU-honest knobs, persisted per device kind)
         from paddle_tpu.ops.pallas.autotune import run_autotune
@@ -853,7 +867,7 @@ def _run_child(mode: str, deadline: float):
                 "--child-serving-spec", "--child-serving-quant",
                 "--child-serving-megakernel",
                 "--child-serving-frontdoor", "--child-serving-disagg",
-                "--child-autotune"):
+                "--child-serving-prefixcache", "--child-autotune"):
         env["JAX_PLATFORMS"] = "cpu"
     if mode in ("--child-comms", "--child-serving-tp"):
         # simulated 2x4 mesh on the CPU lane
@@ -1163,6 +1177,34 @@ def _attach_serving_failover(result, budget_s=None):
                          SERVING_FAILOVER_DEADLINE_S, budget_s)
 
 
+def _child_serving_prefixcache():
+    """serving-prefixcache stage: the fleet-wide KV prefix cache
+    (serving/prefix_cache.py + the fleet directory/fetch wiring) —
+    cold vs warm-local vs warm-remote TTFT on a shared-system-prompt
+    ladder, bytes moved over the wire vs prefill flops saved, and the
+    fetch/failure/duplicate/eviction counters from the metrics
+    registry. Gates: the warm-REMOTE stream is bit-identical to the
+    cold locally-prefilled one, warm-remote TTFT strictly beats cold
+    (a fetch must cost less than the prefill it replaces), and decode
+    + prefill compile counts stay 1 — the fetch adopts through the
+    existing scatter program. All fields non-null on the CPU lane; the
+    TPU child stages the same fleet."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.serving.microbench import \
+        run_serving_prefixcache_bench
+    out = run_serving_prefixcache_bench(
+        max_new=env_int("BENCH_SERVING_PREFIXCACHE_MAX_NEW", 8),
+        sys_len=env_int("BENCH_SERVING_PREFIXCACHE_SYS_LEN", 192))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_serving_prefixcache(result, budget_s=None):
+    return _attach_stage(result, "serving-prefixcache",
+                         "--child-serving-prefixcache",
+                         SERVING_PREFIXCACHE_DEADLINE_S, budget_s)
+
+
 def _child_autotune():
     """autotune stage: the Pallas block-size sweep harness
     (ops/pallas/autotune.py) — sweeps every knob that is honest on this
@@ -1292,6 +1334,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-failover":
         _child_serving_failover()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-prefixcache":
+        _child_serving_prefixcache()
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-autotune":
         _child_autotune()
         return
@@ -1376,6 +1421,7 @@ def _main_measured(errors):
                 result = _attach_serving_frontdoor(result, remaining())
                 result = _attach_serving_disagg(result, remaining())
                 result = _attach_serving_failover(result, remaining())
+                result = _attach_serving_prefixcache(result, remaining())
                 _emit_final(_attach_autotune(result, remaining()))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
@@ -1405,6 +1451,7 @@ def _main_measured(errors):
         result = _attach_serving_frontdoor(result, remaining())
         result = _attach_serving_disagg(result, remaining())
         result = _attach_serving_failover(result, remaining())
+        result = _attach_serving_prefixcache(result, remaining())
         _emit_final(_attach_autotune(result, remaining()))
         return
     # last resort: still one JSON line, rc 0, explicit marker

@@ -19,6 +19,8 @@ from .handoff import (KVHandoff, decode_handoff, encode_handoff,
                       reshard_kv_chunks)
 from .paging import (BlockManager, PagedArtifactStepBackend, PagedEngine,
                      PagedModelStepBackend)
+from .prefix_cache import (PrefixCacheDirectory, adopt_prefix,
+                           extract_prefix)
 from .quant import QuantConfig
 from .resilience import RequestFailure, ResilienceConfig
 from .scheduler import Request, ResumeState, Scheduler
@@ -34,12 +36,14 @@ __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
            "InProcessTransport", "KVHandoff",
            "PagedArtifactStepBackend", "PagedEngine",
            "PagedModelStepBackend", "PrefillDenseEngine",
-           "PrefillPagedEngine", "PrefillWorker", "QuantConfig",
+           "PrefillPagedEngine", "PrefillWorker",
+           "PrefixCacheDirectory", "QuantConfig",
            "Request", "RequestFailure", "ResilienceConfig",
            "ResumeState", "Scheduler", "Server", "SocketTransport",
            "SpecConfig", "SpecEngine", "SpecModelStepBackend",
            "SpecPagedEngine", "SpecPagedStepBackend",
            "ShardedModelStepBackend", "ShardedPagedStepBackend",
            "TPConfig", "TenantConfig", "TokenStream", "Transport",
-           "TransportError", "decode_handoff", "encode_handoff",
-           "ngram_propose", "reshard_kv_chunks", "slot_sample_logits"]
+           "TransportError", "adopt_prefix", "decode_handoff",
+           "encode_handoff", "extract_prefix", "ngram_propose",
+           "reshard_kv_chunks", "slot_sample_logits"]

@@ -60,9 +60,26 @@ splits the Server into replicas of two specialties and a router:
   pool mid-stream; ``drain_prefill_worker`` stops routing to a worker
   so it can retire cleanly.
 
+- **Fleet-wide prefix cache** (PR 16, serving/prefix_cache.py): paged
+  workers publish their registered digest chains with each heartbeat
+  into a :class:`~paddle_tpu.serving.prefix_cache.
+  PrefixCacheDirectory`; on a prefill-admission miss where the
+  directory holds a longer chain, the admitting worker FETCHES the
+  covered blocks from the owner over the same transport (a
+  ``pt-kv-fetch`` payload on the worker's ``#fetch`` side channel,
+  CRC-verified, resilience-retried, ``fleet.fetch`` fault site),
+  adopts them through the shared idempotent-adopt scatter and
+  chunk-prefills only the uncovered suffix. Any fetch failure falls
+  back to local prefill — warm remote state is a perf tier, never a
+  dependency. Fleet-global block-pressure watermarks evict LRU
+  unreferenced registered blocks so the tier stays bounded.
+
 Knobs (utils/flags helpers): ``PT_SERVING_FLEET_AFFINITY`` (default
-on), ``PT_SERVING_FLEET_SPILL_DEPTH`` (default 8) and
-``PT_SERVING_FLEET_LEASE_MISSES`` (default 3 missed heartbeats).
+on), ``PT_SERVING_FLEET_SPILL_DEPTH`` (default 8),
+``PT_SERVING_FLEET_LEASE_MISSES`` (default 3 missed heartbeats),
+``PT_SERVING_FLEET_PREFIX_CACHE`` (default on, paged fleets) and the
+eviction watermarks ``PT_SERVING_FLEET_EVICT_HIGH`` / ``_LOW``
+(default 0.85 / 0.70 of fleet-global block pressure).
 """
 from __future__ import annotations
 
@@ -78,18 +95,21 @@ import numpy as np
 from ..observability import FlightRecorder
 from ..observability import metrics as _om
 from ..utils import faults
-from ..utils.flags import env_bool, env_int
+from ..utils.flags import env_bool, env_float, env_int
 from .engine import (ContinuousBatchingEngine, _M_PREFILLS, _M_TOKENS,
                      _SlotRun)
 from .handoff import KVHandoff, decode_handoff, encode_handoff
 from .paging import PagedEngine, _sha1_chain
+from . import prefix_cache as _pc
+from .prefix_cache import (PrefixCacheDirectory, _adopt_scatter,
+                           adopt_prefix, extract_prefix)
 from .resilience import (RequestFailure, ResilienceConfig,
                          ResilienceState, request_from_meta,
                          request_to_meta)
 from .scheduler import Request, ResumeState
 from .server import Server
 from .transport import (InProcessTransport, SocketTransport, Transport,
-                        TransportError)
+                        TransportError, fetch_endpoint)
 
 __all__ = ["DecodeWorker", "Fleet", "FleetRouter", "InProcessTransport",
            "PrefillDenseEngine", "PrefillPagedEngine", "PrefillWorker",
@@ -257,6 +277,26 @@ class PrefillPagedEngine(_PrefillEngineMixin, PagedEngine):
         # prefix-index hits for shared prompts), carried key armed,
         # the chunk programs' in-graph samples discarded
         return super().try_admit(request)
+
+    #: fleet-installed hook ``fn(full_tokens, local_blocks) ->
+    #: fetched_block_ids | None``: consult the fleet prefix directory
+    #: and fetch the covered blocks a remote worker holds beyond the
+    #: local match (Fleet._fetch_prefix). None outside a fleet.
+    prefix_fetcher = None
+
+    def _match_prefix_for_admission(self, full):
+        shared = self.manager.match_prefix(full)
+        if self.prefix_fetcher is not None:
+            fetched = self.prefix_fetcher(full, shared)
+            if fetched:
+                # fetched blocks arrive allocated at refcount 1 and
+                # already registered — exactly the hold a local match
+                # would have acquired, so the admission path (and its
+                # release-on-exhaustion error path) treats them as
+                # shared blocks with zero special cases
+                shared = shared + fetched
+                self.fetched_tokens += len(fetched) * self.kv_block_size
+        return shared
 
     def _finish_prefill(self, job, tok0_dev):
         req = job.run.request
@@ -500,9 +540,14 @@ class FleetRouter:
         return _sha1_chain(b"", tuple(int(t) for t in toks))
 
     def route(self, prompt, depths: List[int],
-              eligible: List[int]) -> int:
+              eligible: List[int], warm=None) -> int:
         """Pick a prefill worker index. ``depths`` aligns with
-        ``eligible`` (the non-draining workers)."""
+        ``eligible`` (the non-draining workers). ``warm`` (optional)
+        is the set of positions within ``eligible`` whose worker the
+        fleet prefix directory lists as holding this prompt's chain
+        head: when the affinity target spills over, a warm worker
+        within tolerance beats the plain least-loaded one (the fetch
+        it saves costs more than a few queue places)."""
         if not eligible:
             raise RuntimeError("no routable prefill worker (all "
                                "draining)")
@@ -514,6 +559,13 @@ class FleetRouter:
         if depths[pick] - depths[least] > self.spill_depth:
             self.spillovers += 1
             _M_SPILL.inc()
+            if warm:
+                wl = min((i for i in warm if i != pick),
+                         key=lambda i: (depths[i], i), default=None)
+                if wl is not None \
+                        and depths[wl] - depths[least] \
+                        <= self.spill_depth:
+                    return eligible[wl]
             return eligible[least]
         self.affinity_routes += 1
         _M_AFFINITY.inc()
@@ -551,9 +603,14 @@ class PrefillWorker:
     def heartbeat(self) -> Optional[dict]:
         if self.killed:
             return None
-        return {"queue_depth": self.server.scheduler.pending(),
-                "occupancy": self.engine.occupancy(),
-                "outbox": len(self.engine._outbox)}
+        hb = {"queue_depth": self.server.scheduler.pending(),
+              "occupancy": self.engine.occupancy(),
+              "outbox": len(self.engine._outbox)}
+        if isinstance(self.engine, PagedEngine):
+            # the prefix-directory publish: heartbeat-shaped, so
+            # directory state rides the lease machinery for free
+            hb["prefixes"] = self.engine.manager.registered_chains()
+        return hb
 
     def queue_depth(self) -> int:
         return self.server.scheduler.pending()
@@ -626,13 +683,19 @@ class DecodeWorker:
             # dedup entries are dead weight
             self._adopted = {t for t in self._adopted
                              if t[0] not in self.server.results}
-        return {
+        hb = {
             "queue_depth": self.server.scheduler.pending(),
             "occupancy": self.engine.occupancy(),
             "free_slots": self.engine.free_slot_count(),
             "progress": {run.request.request_id: list(run.tokens)
                          for _slot, run in self.engine.live_runs()},
         }
+        if isinstance(self.engine, PagedEngine):
+            # decode workers publish too: adopted prompts and
+            # decode-time-shared completed sequences are fetchable
+            # warm state like any prefill worker's
+            hb["prefixes"] = self.engine.manager.registered_chains()
+        return hb
 
     # -- capacity ----------------------------------------------------------
     def free_slots(self) -> int:
@@ -762,13 +825,12 @@ class DecodeWorker:
         table_row = np.zeros((eng.max_blocks,), np.int32)
         table_row[:n_total] = blocks
         if self._adopt_jit is None:
-            def _adopt_fn(cache_flat, rows_flat, table):
-                # pad rows (beyond the shipped prefix) write zeros into
-                # the reserved trash block — the one block whose
-                # content is junk by contract
-                return tuple(c.at[table].set(r.astype(c.dtype))
-                             for c, r in zip(cache_flat, rows_flat))
-            self._adopt_jit = jax.jit(_adopt_fn, donate_argnums=(0,))
+            # the shared adopt scatter (prefix_cache._adopt_scatter):
+            # pad rows beyond the shipped prefix write zeros into the
+            # reserved trash block, so handoff adopts and prefix-fetch
+            # adopts are literally the same program
+            self._adopt_jit = jax.jit(_adopt_scatter,
+                                      donate_argnums=(0,))
         rows = []
         for i, (shape, dtype) in enumerate(eng.backend.pool_specs):
             r = np.zeros((eng.max_blocks,) + tuple(shape[1:]),
@@ -861,7 +923,10 @@ class Fleet:
                  affinity: Optional[bool] = None,
                  spill_depth: Optional[int] = None,
                  resilience: Optional[ResilienceConfig] = None,
-                 lease_misses: Optional[int] = None):
+                 lease_misses: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 evict_high: Optional[float] = None,
+                 evict_low: Optional[float] = None):
         if not prefill_workers or not decode_workers:
             raise ValueError("need at least one prefill and one decode "
                              "worker")
@@ -921,6 +986,33 @@ class Fleet:
             n: {"state": "live", "misses": 0} for n in names}
         for n in names:
             _M_WORKER_STATE.set(1, worker=n)
+        # -- fleet-wide prefix cache (PR 16) --
+        if prefix_cache is None:
+            prefix_cache = env_bool("PT_SERVING_FLEET_PREFIX_CACHE",
+                                    True)
+        if evict_high is None:
+            evict_high = env_float("PT_SERVING_FLEET_EVICT_HIGH", 0.85)
+        if evict_low is None:
+            evict_low = env_float("PT_SERVING_FLEET_EVICT_LOW", 0.70)
+        if not 0.0 < evict_low <= evict_high <= 1.0:
+            raise ValueError(
+                f"eviction watermarks need 0 < low <= high <= 1; got "
+                f"low={evict_low}, high={evict_high}")
+        self.prefix_cache_enabled = bool(prefix_cache) and paged
+        self.evict_high, self.evict_low = float(evict_high), \
+            float(evict_low)
+        self.directory = PrefixCacheDirectory()
+        self._fetch_seq = 0
+        self._fetch_endpoints: set = set()
+        self.prefix_fetches = 0
+        self.prefix_fetch_blocks = 0
+        self.prefix_fetch_kv_bytes: List[int] = []
+        self.prefix_fetch_failures: Dict[str, int] = {}
+        self.prefix_fetch_duplicates = 0
+        self.prefix_evictions = 0
+        if self.prefix_cache_enabled:
+            for w in self.prefill:
+                w.engine.prefix_fetcher = self._make_fetcher(w)
         self._handoff_seq = 0
         self.handoffs = 0
         self.handoff_wire_bytes: List[int] = []
@@ -964,13 +1056,18 @@ class Fleet:
                 "(block_size/kv_int8)")
 
     # -- submission --------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int = 20, **kw) -> int:
+    def submit(self, prompt, max_new_tokens: int = 20,
+               prefill_worker: Optional[str] = None, **kw) -> int:
         """Route and submit one request; returns the fleet-wide id
         (key into ``results``). Capacity is validated against BOTH
         pools at the door: the routed prefill worker's (inside
         ``Server.submit``) and the largest decode pool's — a request no
         decode worker could ever adopt is refused here, not deferred
-        forever mid-stream."""
+        forever mid-stream. ``prefill_worker`` pins the request to a
+        named routable worker, bypassing the router — the test/bench
+        hook that forces a warm-REMOTE prefill (affinity would
+        otherwise co-locate every same-prefix request with the warm
+        copy and the fetch path would never exercise)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         err = None
         for d in self._live_decode():
@@ -985,8 +1082,23 @@ class Fleet:
             raise ValueError(f"no decode worker can serve this "
                              f"request: {err}")
         eligible = self._routable_prefill()
-        depths = [self.prefill[i].queue_depth() for i in eligible]
-        wi = self.router.route(prompt, depths, eligible)
+        if prefill_worker is not None:
+            by_name = {self.prefill[i].name: i for i in eligible}
+            if prefill_worker not in by_name:
+                raise ValueError(
+                    f"prefill worker {prefill_worker!r} is not "
+                    f"routable (have {sorted(by_name)})")
+            wi = by_name[prefill_worker]
+        else:
+            depths = [self.prefill[i].queue_depth() for i in eligible]
+            warm = None
+            if self.prefix_cache_enabled:
+                owners = set(self.directory.owners(
+                    self.router.affinity_key(prompt)))
+                if owners:
+                    warm = {pos for pos, i in enumerate(eligible)
+                            if self.prefill[i].name in owners}
+            wi = self.router.route(prompt, depths, eligible, warm=warm)
         w = self.prefill[wi]
         rid = w.server.submit(prompt, max_new_tokens=max_new_tokens,
                               **kw)
@@ -1179,6 +1291,164 @@ class Fleet:
             q.popleft()
             self._assigned[d.name] -= 1
 
+    # -- fleet-wide prefix cache: fetch / directory / eviction -------------
+    def _make_fetcher(self, w: PrefillWorker):
+        def _fetch(full, local_blocks):
+            return self._fetch_prefix(w, full, local_blocks)
+        return _fetch
+
+    def _worker_by_name(self, name: str):
+        for w in self.prefill:
+            if w.name == name:
+                return w
+        for d in self.decode:
+            if d.name == name:
+                return d
+        return None
+
+    def _note_fetch_fail(self, reason: str):
+        self.prefix_fetch_failures[reason] = \
+            self.prefix_fetch_failures.get(reason, 0) + 1
+        _pc._M_FETCH_FAILS.inc(reason=reason)
+
+    def _drain_fetch_endpoint(self, ep: str):
+        """Discard stray frames on a fetch side channel — late
+        at-least-once retransmits of fetches that already concluded
+        (adopted, or given up on). Left queued they would hold
+        ``transport.pending()`` above zero and spin the idle loop."""
+        while self.transport.recv(ep) is not None:
+            self.prefix_fetch_duplicates += 1
+            _pc._M_FETCH_DUPS.inc()
+
+    def _fetch_prefix(self, w: PrefillWorker, full,
+                      local_blocks) -> Optional[List[int]]:
+        """One synchronous remote prefix fetch on behalf of worker
+        ``w``'s admission: directory lookup → owner-side extract →
+        transport round trip on ``w``'s ``#fetch`` side channel → CRC
+        verify → idempotent adopt → register. Returns the adopted
+        block ids, or None — and EVERY failure (dead owner, exhausted
+        retry budget, stale directory, CRC mismatch, full pool, open
+        breaker) is a None: the request prefills locally, it never
+        fails because warm remote state was advertised."""
+        eng = w.engine
+        n_local = len(local_blocks)
+        exclude = {w.name} | {n for n, h in self._health.items()
+                              if h["state"] != "live"}
+        depth, owners = self.directory.deepest_covered(
+            full, eng.kv_block_size, eng.manager.hash_fn,
+            exclude=exclude)
+        if depth <= n_local:
+            return None                  # nothing beyond the local match
+        if self._res.breaker_open:
+            self._note_fetch_fail("circuit_open")
+            return None
+        owner = self._worker_by_name(owners[0])
+        if owner is None:
+            self._note_fetch_fail("stale")
+            return None
+        self._fetch_seq += 1
+        seq = self._fetch_seq
+        ep = fetch_endpoint(w.name)
+        self._fetch_endpoints.add(ep)
+        holder: dict = {}
+
+        def _do():
+            faults.fault_point("fleet.fetch")
+            if owner.killed:
+                raise TransportError(
+                    f"prefix owner {owner.name!r} is dead")
+            if "data" not in holder and "stale" not in holder:
+                # extract + serialize ONCE; retries resend the same
+                # bytes (same discipline as _ship)
+                h = extract_prefix(owner.engine, full, depth,
+                                   skip=n_local, source=owner.name)
+                if h is None:    # owner evicted since its last beat
+                    holder["stale"] = True
+                    return
+                h.meta["request"] = {"request_id": -seq}
+                h.meta["seq"] = seq
+                h.meta["crc32"] = h.payload_crc32()
+                holder["kv"] = h.kv_bytes()
+                holder["data"] = encode_handoff(h)
+            self.transport.send(ep, holder["data"])
+
+        ok, _ = self._with_retry(_do)
+        if holder.get("stale"):
+            self._note_fetch_fail("stale")
+            return None
+        if not ok:
+            self._note_fetch_fail("circuit_open"
+                                  if self._res.breaker_open
+                                  else "transport")
+            # one attempt may still have delivered a frame whose ack
+            # was lost — clean the side channel before falling back
+            self._drain_fetch_endpoint(ep)
+            return None
+        fetched = None
+        while True:                      # drain the side channel FULLY
+            data = self.transport.recv(ep)
+            if data is None:
+                break
+            try:
+                h = decode_handoff(data)
+                if h.meta.get("seq") != seq or fetched is not None:
+                    # at-least-once retransmit: this fetch's duplicate
+                    # or a concluded earlier fetch's straggler
+                    self.prefix_fetch_duplicates += 1
+                    _pc._M_FETCH_DUPS.inc()
+                    continue
+                h.verify_crc()           # loud, pre-allocation
+            except ValueError:
+                self._note_fetch_fail("corrupt")
+                continue
+            got = adopt_prefix(eng, h, local_blocks, full)
+            if got is None:
+                self._note_fetch_fail("pool_full")
+                continue
+            fetched = got
+            self.prefix_fetches += 1
+            self.prefix_fetch_blocks += len(got)
+            self.prefix_fetch_kv_bytes.append(holder["kv"])
+            _pc._M_FETCHES.inc()
+            _pc._M_FETCH_BLOCKS.inc(len(got))
+            _pc._M_FETCH_BYTES.inc(len(holder["data"]))
+            self.flight.record("prefix_fetch", worker=w.name,
+                               owner=owner.name, blocks=len(got),
+                               clock=self._clock)
+        return fetched
+
+    def _evict_tick(self):
+        """Watermark eviction: when fleet-global block pressure (the
+        fraction of usable blocks not free, summed over every live
+        arena) exceeds ``evict_high``, evict LRU unreferenced
+        registered blocks — most-pressured arenas first — until it is
+        back at ``evict_low``. Referenced blocks are untouchable, so
+        live streams never lose state; the owners' next heartbeats
+        retract the evicted digests from the directory."""
+        mgrs = [w.engine.manager for w in self.prefill
+                if self._alive(w.name)] \
+            + [d.engine.manager for d in self.decode
+               if self._alive(d.name)]
+        usable = sum(m.usable_blocks() for m in mgrs)
+        if not usable:
+            return
+        free = sum(len(m._free) for m in mgrs)
+        if 1.0 - free / usable <= self.evict_high:
+            return
+        need = int(np.ceil((1.0 - self.evict_low) * usable)) - free
+        done = 0
+        for m in sorted(mgrs, key=lambda m: m.block_pressure(),
+                        reverse=True):
+            if need <= 0:
+                break
+            n = m.evict_cached(need)
+            need -= n
+            done += n
+        if done:
+            self.prefix_evictions += done
+            self.flight.record("prefix_evict", blocks=done,
+                               clock=self._clock)
+
     def tick(self):
         """One fleet tick: prefill advance → ship → deliver/adopt →
         decode advance → heartbeats/lease scan. Deterministic given
@@ -1202,6 +1472,10 @@ class Fleet:
             if self._alive(d.name):
                 d.tick()
         self._beat()
+        if self.prefix_cache_enabled:
+            for ep in list(self._fetch_endpoints):
+                self._drain_fetch_endpoint(ep)
+            self._evict_tick()
         if self._redrive_t0:
             self._settle_redrives()
         if self._clock % 64 == 0:
@@ -1258,6 +1532,12 @@ class Fleet:
             return
         h["misses"] = 0
         h["last"] = hb
+        if self.prefix_cache_enabled and "prefixes" in hb:
+            # the fleet.directory fault drops ONE publish: the
+            # directory serves a stale view until the next beat — the
+            # fetch path must degrade to stale-fallback, never corrupt
+            if not faults.should_fire("fleet.directory"):
+                self.directory.publish(worker.name, hb["prefixes"])
         if role == "decode":
             # progress carried by the heartbeat IS the redrive record:
             # after the worker dies, tokens generated since its last
@@ -1276,6 +1556,9 @@ class Fleet:
         self.flight.record("worker_dead", worker=worker.name,
                            role=role, clock=self._clock,
                            lease_misses=self.lease_misses)
+        # the dead worker's directory entries expire with its lease —
+        # later fetches stop considering it immediately
+        self.directory.drop_worker(worker.name)
         if role == "decode":
             self._recover_decode_streams(worker)
         else:
@@ -1318,6 +1601,9 @@ class Fleet:
         fleet's submission records under their ORIGINAL ids — nothing
         was lost but compute, so a fresh prefill on a surviving worker
         regenerates the identical stream."""
+        ep = fetch_endpoint(w.name)
+        self.transport.drop_endpoint(ep)
+        self._fetch_endpoints.discard(ep)
         lost = [rid for rid, rec in self._requests.items()
                 if rec["worker"] == w.name and rid not in self._handoffs
                 and not self._terminal(rid)]
@@ -1482,6 +1768,16 @@ class Fleet:
             "affinity_routes": self.router.affinity_routes,
             "spillovers": self.router.spillovers,
             "prefix_hit_rate": round(self.prefix_hit_rate(), 4),
+            "prefix_fetches": self.prefix_fetches,
+            "prefix_fetch_blocks": self.prefix_fetch_blocks,
+            "prefix_fetch_kv_bytes_mean": round(float(np.mean(
+                self.prefix_fetch_kv_bytes)), 1)
+            if self.prefix_fetch_kv_bytes else 0.0,
+            "prefix_fetch_failures": dict(self.prefix_fetch_failures),
+            "prefix_fetch_duplicates": self.prefix_fetch_duplicates,
+            "prefix_evictions": self.prefix_evictions,
+            "prefix_directory": self.directory.stats()
+            if self.prefix_cache_enabled else None,
             "migrations": self.migrations,
             "ticks": self._clock,
             "lease_misses": self.lease_misses,
@@ -1579,6 +1875,10 @@ class Fleet:
         self._draining.discard(idx)
         w = self.prefill.pop(idx)
         self._health.pop(w.name, None)
+        self.directory.drop_worker(w.name)
+        ep = fetch_endpoint(w.name)
+        self.transport.drop_endpoint(ep)
+        self._fetch_endpoints.discard(ep)
         self._draining = {i - 1 if i > idx else i
                           for i in self._draining}
         return w

@@ -46,11 +46,18 @@ import numpy as np
 
 from ..utils import faults
 
-__all__ = ["HANDOFF_FORMAT", "HANDOFF_VERSION", "KVHandoff",
-           "decode_handoff", "encode_handoff", "reshard_kv_chunks"]
+__all__ = ["FETCH_FORMAT", "HANDOFF_FORMAT", "HANDOFF_VERSION",
+           "KVHandoff", "decode_handoff", "encode_handoff",
+           "reshard_kv_chunks"]
 
 HANDOFF_FORMAT = "pt-kv-handoff"
+# prefix-fetch responses (serving/prefix_cache.py) ride the SAME v1
+# serializer/CRC/validation machinery under their own format stamp, so
+# a fetch payload mis-delivered to an adopt path is refused by kind,
+# never silently armed as a stream
+FETCH_FORMAT = "pt-kv-fetch"
 HANDOFF_VERSION = 1
+_KNOWN_FORMATS = frozenset({HANDOFF_FORMAT, FETCH_FORMAT})
 
 
 @dataclass
@@ -136,7 +143,7 @@ def decode_handoff(data: bytes) -> KVHandoff:
     with np.load(io.BytesIO(data), allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
         arrays = {k: z[k] for k in z.files if k != "__meta__"}
-    if meta.get("format") != HANDOFF_FORMAT:
+    if meta.get("format") not in _KNOWN_FORMATS:
         raise ValueError("payload is not a KV handoff")
     if meta.get("version") != HANDOFF_VERSION:
         raise ValueError(

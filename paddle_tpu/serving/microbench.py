@@ -33,7 +33,8 @@ import numpy as np
 
 __all__ = ["run_fleet_kill_soak", "run_serving_disagg_bench",
            "run_serving_failover_bench", "run_serving_frontdoor_bench",
-           "run_serving_megakernel_bench", "run_serving_quant_bench",
+           "run_serving_megakernel_bench",
+           "run_serving_prefixcache_bench", "run_serving_quant_bench",
            "run_serving_spec_bench", "run_serving_tp_bench"]
 
 
@@ -187,6 +188,139 @@ def run_serving_disagg_bench(requests_per_group: int = 6,
         "serving_disagg_decode_compiles": compiles[0],
         "serving_disagg_prefill_compiles": compiles[1],
     }
+
+
+def run_serving_prefixcache_bench(max_new: int = 8,
+                                  sys_len: int = 192,
+                                  tail_len: int = 7) -> dict:
+    """Fleet-wide KV prefix cache stage (serving/prefix_cache.py):
+    cold vs warm-local vs warm-remote TTFT on a shared-system-prompt
+    workload, plus the bytes-moved-vs-flops-saved accounting that IS
+    the feature's economic claim.
+
+    What the stage pins every round:
+
+    - **TTFT ladder**: the same system prompt served (a) cold — full
+      chunked prefill, (b) warm-LOCAL — the PR 4 index covers the
+      prefix on the admitting worker, (c) warm-REMOTE — another worker
+      holds the warm copy and the admitting worker fetches it over the
+      ``#fetch`` side channel, then prefills only the tail. Gate (in
+      bench.py): warm-remote strictly beats cold — a fetch must cost
+      less than the prefill it saves, or the tier is pointless;
+    - **bytes moved vs flops saved**: wire KV bytes per fetch against
+      ``~2 * n_params * covered_tokens`` of skipped prefill compute —
+      the trade the directory arbitrates;
+    - **counters from the metrics registry** (fetches / fetched blocks
+      / failures / duplicates / evictions) — the observability
+      satellite read back the way an operator would read it;
+    - the compile pin: decode and prefill compile counts stay 1 on
+      every worker — the fetch adopts through the shared scatter
+      program, never a new steady-path program.
+
+    A warm-up round on a DIFFERENT system prompt first compiles every
+    program (chunk prefill, decode block, adopt + fetch scatter), so
+    the measured TTFTs compare compute, not compilation. The default
+    system prefix is 24 blocks (192 tokens) — long enough that the
+    saved chunk dispatches dominate the fixed per-fetch cost
+    (serialize + CRC + one scatter) even on the CPU lane.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.observability import metrics as om
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    DecodeWorker, Fleet, PrefillWorker,
+                                    PrefillPagedEngine)
+    from paddle_tpu.serving import prefix_cache as pc
+
+    paddle.seed(0)
+    om.reset()
+    om.enable(True)
+    cfg = llama_tiny_config(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    rs = np.random.RandomState(0)
+    kw = dict(num_slots=2, max_len=256, decode_block=4, block_size=8,
+              prefill_chunk=8)
+    pf = [PrefillPagedEngine(model, **kw) for _ in range(2)]
+    dc = [ContinuousBatchingEngine(model, paged=True, **kw)
+          for _ in range(2)]
+    fleet = Fleet([PrefillWorker(e) for e in pf],
+                  [DecodeWorker(e) for e in dc])
+
+    def prompt(sys_p):
+        return np.concatenate(
+            [sys_p, rs.randint(0, cfg.vocab_size,
+                               (tail_len,)).astype(np.int32)])
+
+    def ttft_ms(rid):
+        for d in fleet.decode:
+            if rid in d.server.ttft:
+                return d.server.ttft[rid] * 1000.0
+        return None
+
+    def serve(p, worker):
+        rid = fleet.submit(p, max_new_tokens=max_new,
+                           prefill_worker=worker)
+        res = fleet.run_until_idle(max_ticks=2000)
+        return rid, res[rid], ttft_ms(rid)
+
+    # ---- warm-up: compile every program incl. the fetch scatter ----------
+    sys_w = rs.randint(0, cfg.vocab_size, (sys_len,)).astype(np.int32)
+    serve(prompt(sys_w), "prefill0")
+    serve(prompt(sys_w), "prefill1")        # first fetch: compiles
+    warmup_fetches = fleet.prefix_fetches
+
+    # ---- the measured ladder on a fresh system prompt --------------------
+    sys_m = rs.randint(0, cfg.vocab_size, (sys_len,)).astype(np.int32)
+    _, _, cold_ms = serve(prompt(sys_m), "prefill0")         # cold
+    _, _, local_ms = serve(prompt(sys_m), "prefill0")        # warm-local
+    p_rem = prompt(sys_m)
+    rr, out_r, remote_ms = serve(p_rem, "prefill1")          # warm-remote
+    ref = model.generate(paddle.to_tensor(p_rem[None, :]),
+                         max_new_tokens=max_new,
+                         temperature=0.0).numpy()[0]
+    identical = bool(np.array_equal(out_r, ref))
+
+    fetches = fleet.prefix_fetches - warmup_fetches
+    kv_bytes = fleet.prefix_fetch_kv_bytes[warmup_fetches:]
+    covered = sum(e.fetched_tokens for e in pf)
+    n_params = int(model.num_params())
+    flops_saved = 2 * n_params * covered
+    bytes_moved = int(np.sum(kv_bytes)) if kv_bytes else 0
+    fst = fleet.stats()
+    out = {
+        "serving_prefixcache_bit_identical": identical,
+        "serving_prefixcache_ttft_cold_ms": round(cold_ms, 2),
+        "serving_prefixcache_ttft_warm_local_ms": round(local_ms, 2),
+        "serving_prefixcache_ttft_warm_remote_ms": round(remote_ms, 2),
+        "serving_prefixcache_remote_vs_cold_speedup": round(
+            cold_ms / max(remote_ms, 1e-9), 2),
+        "serving_prefixcache_fetches": fetches,
+        "serving_prefixcache_fetch_kv_bytes_mean": round(
+            float(np.mean(kv_bytes)), 1) if kv_bytes else 0.0,
+        "serving_prefixcache_bytes_moved": bytes_moved,
+        "serving_prefixcache_covered_tokens": covered,
+        "serving_prefixcache_flops_saved": flops_saved,
+        "serving_prefixcache_flops_per_wire_byte": round(
+            flops_saved / bytes_moved, 1) if bytes_moved else None,
+        "serving_prefixcache_fetch_counter": int(
+            pc._M_FETCHES.value()),
+        "serving_prefixcache_fail_counters": {
+            k: int(v) for k, v in
+            fst["prefix_fetch_failures"].items()},
+        "serving_prefixcache_duplicates": fst[
+            "prefix_fetch_duplicates"],
+        "serving_prefixcache_evictions": fst["prefix_evictions"],
+        "serving_prefixcache_directory_entries": fst[
+            "prefix_directory"]["entries"],
+        "serving_prefixcache_decode_compiles": max(
+            e.decode_compile_count() for e in dc),
+        "serving_prefixcache_prefill_compiles": max(
+            e.prefill_compile_count() for e in pf),
+    }
+    om.reset()
+    om.enable(False)
+    return out
 
 
 def run_serving_failover_bench(requests: int = 6, max_new: int = 24,

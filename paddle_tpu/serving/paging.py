@@ -82,6 +82,14 @@ _M_PFX_HITS = _om.counter("pt_paging_prefix_hit_blocks_total",
 _M_ALLOC_FAIL = _om.counter("pt_paging_allocate_failures_total",
                             "block allocations refused (pool exhausted "
                             "or injected fault)")
+_M_BLK_EVICT = _om.counter("pt_blockmanager_evictions_total",
+                           "registered refcount-0 blocks evicted from "
+                           "the LRU prefix cache (allocate-pressure "
+                           "or fleet watermark)")
+_M_BLK_PRESSURE = _om.gauge("pt_blockmanager_block_pressure",
+                            "fraction of the usable pool NOT on the "
+                            "free list (referenced + LRU-cached) — the "
+                            "eviction tier's control signal")
 
 
 def _sha1_chain(parent_digest: bytes, tokens: Tuple[int, ...]) -> bytes:
@@ -113,9 +121,11 @@ class BlockManager:
         self._ref: Dict[int, int] = {}          # allocated -> refcount
         self._index: Dict[bytes, Tuple[int, Tuple[int, ...]]] = {}
         self._digest_of: Dict[int, bytes] = {}  # registered blocks
+        self._depth: Dict[bytes, int] = {}      # digest -> chain blocks
         self._cached: "OrderedDict[int, None]" = OrderedDict()
         self.lookups = 0
         self.hit_blocks = 0
+        self.evictions = 0
         self._note_pool()
 
     def _note_pool(self):
@@ -126,6 +136,7 @@ class BlockManager:
         _M_BLK_FREE.set(len(self._free))
         _M_BLK_REF.set(len(self._ref))
         _M_BLK_CACHED.set(len(self._cached))
+        _M_BLK_PRESSURE.set(self.block_pressure())
 
     # -- capacity ----------------------------------------------------------
     def available(self) -> int:
@@ -136,6 +147,13 @@ class BlockManager:
         admission-validation bound (a request needing more than this
         can NEVER be admitted, no matter what retires)."""
         return self.num_blocks - 1
+
+    def block_pressure(self) -> float:
+        """Fraction of the usable pool not on the free list. Referenced
+        AND LRU-cached blocks both count as pressure: cached blocks are
+        reclaimable, but only by evicting warm prefix state — exactly
+        the trade the fleet's watermark eviction arbitrates."""
+        return 1.0 - len(self._free) / self.usable_blocks()
 
     def allocate(self, n: int) -> Optional[List[int]]:
         """n fresh blocks at refcount 1, evicting LRU cached prefix
@@ -155,11 +173,35 @@ class BlockManager:
                 b = self._free.pop()
             else:                      # evict the LRU cached prefix
                 b, _ = self._cached.popitem(last=False)
-                del self._index[self._digest_of.pop(b)]
+                digest = self._digest_of.pop(b)
+                del self._index[digest]
+                self._depth.pop(digest, None)
+                self.evictions += 1
+                _M_BLK_EVICT.inc()
             self._ref[b] = 1
             out.append(b)
         self._note_pool()
         return out
+
+    def evict_cached(self, n: int) -> int:
+        """Evict up to ``n`` LRU-retained registered blocks back to the
+        free list (the fleet's watermark eviction tier drives this).
+        Referenced blocks are untouchable; returns the count actually
+        evicted. Directory consequences are the caller's: the owner's
+        next heartbeat publish simply no longer lists the digests."""
+        done = 0
+        while done < n and self._cached:
+            b, _ = self._cached.popitem(last=False)
+            digest = self._digest_of.pop(b)
+            del self._index[digest]
+            self._depth.pop(digest, None)
+            self._free.append(b)
+            done += 1
+            self.evictions += 1
+            _M_BLK_EVICT.inc()
+        if done:
+            self._note_pool()
+        return done
 
     # -- prefix sharing ----------------------------------------------------
     def _shareable_blocks(self, prompt) -> int:
@@ -199,20 +241,39 @@ class BlockManager:
             del self._cached[block_id]
         self._ref[block_id] = r + 1
 
-    def register_prefix(self, prompt, block_ids: Sequence[int]):
+    def register_prefix(self, prompt, block_ids: Sequence[int],
+                        n_blocks: Optional[int] = None):
         """Index the prompt's full prefix blocks (now filled) so later
         requests can share them. Blocks that were themselves matched
-        from the index re-derive the same digests — no-ops."""
+        from the index re-derive the same digests — no-ops.
+
+        ``n_blocks`` overrides the default shareable count — decode-time
+        block sharing passes the FULLY-WRITTEN block count of the
+        completed sequence (every position resident, including decode
+        positions), which can exceed ``_shareable_blocks`` of the prompt
+        alone."""
         bs = self.block_size
+        if n_blocks is None:
+            n_blocks = self._shareable_blocks(prompt)
         parent = b""
-        for j in range(self._shareable_blocks(prompt)):
+        for j in range(n_blocks):
             chunk = tuple(int(t) for t in prompt[j * bs:(j + 1) * bs])
             digest = self.hash_fn(parent, chunk)
             bid = block_ids[j]
             if digest not in self._index and bid not in self._digest_of:
                 self._index[digest] = (bid, chunk)
                 self._digest_of[bid] = digest
+            # depth is a pure function of the digest (it hashes the
+            # whole chain), so re-registration writes the same value
+            self._depth[digest] = j + 1
             parent = digest
+
+    def registered_chains(self) -> Dict[bytes, int]:
+        """``{digest: covered_blocks}`` for every registered block —
+        what a fleet worker publishes to the prefix-cache directory on
+        each heartbeat. A digest at chain position j covers j+1 blocks
+        of any prompt whose prefix hashes to it."""
+        return {d: self._depth.get(d, 0) for d in self._index}
 
     def release(self, block_ids: Sequence[int]):
         """Drop one reference per block. At refcount 0 a registered
@@ -270,6 +331,10 @@ class BlockManager:
         for digest, (bid, _) in self._index.items():
             assert self._digest_of.get(bid) == digest, \
                 f"index entry for block {bid} disagrees with digest map"
+        stale_depth = set(self._depth) - set(self._index)
+        assert not stale_depth, \
+            f"chain-depth entries for unregistered digests: " \
+            f"{sorted(d.hex() for d in stale_depth)}"
 
 
 class PagedModelStepBackend(ModelStepBackend):
@@ -580,6 +645,7 @@ class PagedEngine(ContinuousBatchingEngine):
         self.shared_tokens = 0         # skipped via prefix reuse
         self.prefilled_tokens = 0      # actually computed
         self.prefill_chunks = 0        # chunk programs dispatched
+        self.fetched_tokens = 0        # of shared: remote-fetched KV
 
     # -- introspection -----------------------------------------------------
     def prefix_cache_hit_rate(self) -> float:
@@ -651,7 +717,7 @@ class PagedEngine(ContinuousBatchingEngine):
                     None)
         if slot is None:
             raise RuntimeError("no free slot (scheduler bug)")
-        shared = self.manager.match_prefix(full)
+        shared = self._match_prefix_for_admission(full)
         total = self.blocks_needed(L, mnt)
         fresh = self.manager.allocate(total - len(shared))
         if fresh is None:            # pool exhausted: retry later
@@ -691,6 +757,15 @@ class PagedEngine(ContinuousBatchingEngine):
             topk=jnp.int32(request.top_k),
             topp=jnp.float32(request.top_p), resume_tok=resume_tok))
         return True
+
+    def _match_prefix_for_admission(self, full) -> List[int]:
+        """Admission-time prefix match. The base engine consults only
+        its LOCAL index; the fleet's prefill engines override this to
+        also fetch a longer chain another worker has registered
+        (serving/prefix_cache.py) — either way the returned blocks are
+        ref-acquired for the admitting request and ``done`` starts past
+        them."""
+        return self.manager.match_prefix(full)
 
     def admit(self, request) -> bool:
         if not self.try_admit(request):
@@ -785,6 +860,20 @@ class PagedEngine(ContinuousBatchingEngine):
     def _retire(self, slot, run, now):
         super()._retire(slot, run, now)
         if run.block_ids is not None:
+            if run.failure is None and run.tokens:
+                # decode-time block sharing: every position the stream
+                # WROTE is resident — prompt plus generated history
+                # minus the final sampled token (never written). Extend
+                # the digest chain over the fully-written blocks so a
+                # later request continuing this conversation shares the
+                # decode-position KV too. Failed/poisoned runs register
+                # NOTHING (a poisoned block must never be matchable).
+                seq = np.concatenate([
+                    np.asarray(run.request.prompt, np.int32).reshape(-1),
+                    np.asarray(run.tokens[:-1], np.int32)])
+                self.manager.register_prefix(
+                    seq, run.block_ids,
+                    n_blocks=len(seq) // self.kv_block_size)
             self.manager.release(run.block_ids)
             run.block_ids = None     # the no-double-free invariant
 
@@ -841,6 +930,8 @@ class PagedEngine(ContinuousBatchingEngine):
                       for d, (bid, chunk) in m._index.items()],
             "cached": [int(b) for b in m._cached],   # LRU order
             "lookups": m.lookups, "hit_blocks": m.hit_blocks,
+            "depth": [[d.hex(), int(n)] for d, n in m._depth.items()],
+            "evictions": m.evictions,
         }
         jobs_meta = []
         for j, job in enumerate(self._jobs):
@@ -858,7 +949,8 @@ class PagedEngine(ContinuousBatchingEngine):
             "prompt_tokens": self.prompt_tokens,
             "shared_tokens": self.shared_tokens,
             "prefilled_tokens": self.prefilled_tokens,
-            "prefill_chunks": self.prefill_chunks}
+            "prefill_chunks": self.prefill_chunks,
+            "fetched_tokens": self.fetched_tokens}
         return meta, arrays
 
     def restore_state(self, meta, arrays):
@@ -879,6 +971,11 @@ class PagedEngine(ContinuousBatchingEngine):
                     for d, bid, chunk in mm["index"]}
         m._cached = OrderedDict((int(b), None) for b in mm["cached"])
         m.lookups, m.hit_blocks = mm["lookups"], mm["hit_blocks"]
+        m._depth = {bytes.fromhex(d): int(n)
+                    for d, n in mm.get("depth", [])}
+        m._depth = {d: n for d, n in m._depth.items()
+                    if d in m._index}
+        m.evictions = int(mm.get("evictions", 0))
         m.assert_consistent()
         self._jobs = []
         for j, jm in enumerate(meta["jobs"]):
@@ -899,3 +996,4 @@ class PagedEngine(ContinuousBatchingEngine):
         self.shared_tokens = pc["shared_tokens"]
         self.prefilled_tokens = pc["prefilled_tokens"]
         self.prefill_chunks = pc["prefill_chunks"]
+        self.fetched_tokens = pc.get("fetched_tokens", 0)

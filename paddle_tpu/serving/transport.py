@@ -64,7 +64,18 @@ from ..observability import metrics as _om
 from ..utils import faults
 
 __all__ = ["InProcessTransport", "SocketTransport", "Transport",
-           "TransportError"]
+           "TransportError", "fetch_endpoint"]
+
+#: suffix of a worker's prefix-fetch receive queue. Fetch RESPONSES
+#: (serving/prefix_cache.py) travel the same transport as handoffs but
+#: on a per-worker side channel, so a bulk fetch payload can never
+#: interleave into — or stall behind — the worker's handoff stream.
+FETCH_ENDPOINT_SUFFIX = "#fetch"
+
+
+def fetch_endpoint(worker: str) -> str:
+    """Transport endpoint name of ``worker``'s prefix-fetch channel."""
+    return worker + FETCH_ENDPOINT_SUFFIX
 
 # transport metric families (registered at import; no-ops until
 # metrics.enable()/PT_METRICS)

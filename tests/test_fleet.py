@@ -428,7 +428,11 @@ class TestRouter:
             lambda: srv.run_until_idle(), [dc[0]])
 
         _reset(*pf, *dc)                     # same burst, no affinity
-        off = _fleet(pf, dc, affinity=False)
+        # prefix_cache=False: with the PR 16 fetch tier on, a scattered
+        # request FETCHES the warm prefix instead of paying it cold —
+        # tests/test_prefix_cache.py pins that recovery; this test pins
+        # the affinity-routing claim in isolation
+        off = _fleet(pf, dc, affinity=False, prefix_cache=False)
         _, _, off_rate = burst_rate(
             lambda p: off.submit(p, max_new_tokens=4),
             lambda: off.run_until_idle(max_ticks=300),

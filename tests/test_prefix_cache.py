@@ -1,0 +1,578 @@
+"""Fleet-wide KV prefix cache (serving/prefix_cache.py + the fleet
+directory/fetch/eviction wiring): a prompt whose prefix is warm on
+ANOTHER worker fetches the covered KV blocks over the ``pt-kv-fetch``
+side channel and streams BIT-IDENTICAL to a locally-prefilled request
+(greedy AND seeded-sampled, fp32 AND kv_int8, same-layout AND
+cross-TP-layout) with decode/prefill compile counts still 1. Plus: the
+heartbeat-shaped directory (publish/replace/drop, consecutive-from-root
+coverage), warm-aware spillover routing, the watermark eviction tier
+retracting directory entries, and the failure semantics — dead owner,
+stale directory, injected ``fleet.fetch``/``fleet.directory`` faults,
+wire faults on the real socket transport — ALWAYS degrade to local
+prefill, never to a failed or wrong stream."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import (ContinuousBatchingEngine, DecodeWorker,
+                                Fleet, FleetRouter, PrefillPagedEngine,
+                                PrefillWorker, PrefixCacheDirectory,
+                                RequestFailure, ResilienceConfig,
+                                SocketTransport, reshard_kv_chunks)
+from paddle_tpu.serving.paging import _sha1_chain
+from paddle_tpu.utils import faults
+
+# ~2% per-site wire faults on the socket-transport fetch test
+WIRE_FAULTS = ("transport.partial_write:p=0.02;"
+               "transport.corrupt:p=0.02;transport.disconnect:p=0.02")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    """One model + the paged 2-prefill/2-decode engine set and an int8
+    2-prefill/1-decode set (a remote fetch needs a second prefill
+    worker to be the cold requester). reset() frees slots/blocks,
+    never the compiled programs."""
+    paddle.seed(0)
+    cfg = llama_tiny_config(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    kw = dict(num_slots=2, max_len=64, decode_block=4, block_size=8,
+              prefill_chunk=8)
+    pf = [PrefillPagedEngine(model, **kw) for _ in range(2)]
+    dc = [ContinuousBatchingEngine(model, paged=True, **kw)
+          for _ in range(2)]
+    pf_8 = [PrefillPagedEngine(model, kv_int8=True, **kw)
+            for _ in range(2)]
+    dc_8 = ContinuousBatchingEngine(model, paged=True, kv_int8=True,
+                                    **kw)
+    return model, cfg, pf, dc, (pf_8, dc_8)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+@pytest.fixture
+def _no_compile_cache():
+    """Same environment guard as tests/test_resilience.py: tests that
+    compile a fresh paged backend in this process must bypass the
+    persistent jax compilation cache (the documented jaxlib
+    second-identical-compile heap landmine)."""
+    import jax
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", True)
+
+
+def _ref(model, prompt, max_new, **kw):
+    return model.generate(paddle.to_tensor(prompt[None, :]),
+                          max_new_tokens=max_new, **kw).numpy()[0]
+
+
+def _reset(*engines):
+    for e in engines:
+        e.reset()
+
+
+def _fleet(pf_engines, dc_engines, **kw):
+    return Fleet([PrefillWorker(e) for e in pf_engines],
+                 [DecodeWorker(e) for e in dc_engines], **kw)
+
+
+def _check_clean(fleet):
+    """Zero-leak teardown: empty slots/outboxes/queues and exact arena
+    accounting on EVERY live worker."""
+    assert not fleet.busy()
+    for w in fleet.prefill:
+        if not fleet._alive(w.name):
+            continue
+        assert not w.engine._outbox
+        assert all(s is None for s in w.engine._slots)
+        assert not w.engine.manager._ref
+        w.engine.manager.assert_consistent()
+    for d in fleet.decode:
+        if not fleet._alive(d.name):
+            continue
+        assert all(s is None for s in d.engine._slots)
+        assert not d.engine.manager._ref
+        d.engine.manager.assert_consistent()
+
+
+def _group(cfg, seed, sys_len=16, tails=(3,)):
+    """A shared-system-prompt request group: ``sys_len`` must be a
+    whole number of (8-token) blocks so the whole prefix is shareable."""
+    rs = np.random.RandomState(seed)
+    sys_p = rs.randint(0, cfg.vocab_size, (sys_len,)).astype(np.int32)
+    return [np.concatenate(
+        [sys_p, rs.randint(0, cfg.vocab_size, (t,)).astype(np.int32)])
+        for t in tails]
+
+
+def _chain(prompt, bs=8):
+    """digest -> covered blocks, the shape registered_chains() emits."""
+    out, parent = {}, b""
+    for j in range((len(prompt) - 1) // bs):
+        parent = _sha1_chain(
+            parent, tuple(int(t) for t in prompt[j * bs:(j + 1) * bs]))
+        out[parent] = j + 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the directory alone (no model, cheap)
+# ---------------------------------------------------------------------------
+
+class TestDirectory:
+    def test_publish_replaces_and_drop_expires(self):
+        d = PrefixCacheDirectory()
+        p = np.arange(25, dtype=np.int32)          # 3 shareable blocks
+        chain = _chain(p)
+        d.publish("a", chain)
+        d.publish("b", chain)
+        assert d.size() == 3
+        for digest in chain:
+            assert d.owners(digest) == ("a", "b")
+        # a publish REPLACES: "a" evicted its chain head since last beat
+        tail = dict(list(chain.items())[1:])
+        d.publish("a", tail)
+        head = next(iter(chain))
+        assert d.owners(head) == ("b",)
+        assert d.worker_entries("a") == tail
+        d.drop_worker("b")                         # lease death
+        assert d.owners(head) == ()
+        assert d.size() == 2 and d.stats()["workers"] == ["a"]
+        d.drop_worker("a")
+        assert d.size() == 0 and d.stats()["deepest_chain"] == 0
+
+    def test_deepest_covered_requires_consecutive_from_root(self):
+        d = PrefixCacheDirectory()
+        p = np.arange(25, dtype=np.int32)
+        chain = _chain(p)
+        d.publish("a", chain)
+        # "b" lists only the chain TAIL (its head was LRU-evicted):
+        # its own match_prefix walks from the root, so it cannot serve
+        d.publish("b", dict(list(chain.items())[1:]))
+        depth, owners = d.deepest_covered(p, 8, _sha1_chain)
+        assert (depth, owners) == (3, ("a",))
+        depth, owners = d.deepest_covered(p, 8, _sha1_chain,
+                                          exclude=("a",))
+        assert (depth, owners) == (0, ())
+        # a shorter full chain still serves its covered prefix
+        d.drop_worker("a")
+        d.publish("c", dict(list(chain.items())[:2]))
+        assert d.deepest_covered(p, 8, _sha1_chain) == (2, ("c",))
+        # unrelated prompt: no coverage at all
+        q = np.arange(100, 125, dtype=np.int32)
+        assert d.deepest_covered(q, 8, _sha1_chain) == (0, ())
+
+
+class TestRouterWarmSpillover:
+    def test_warm_owner_beats_least_loaded_within_tolerance(self):
+        r = FleetRouter(block_size=8, affinity=True, spill_depth=2)
+        p = np.arange(12, dtype=np.int32)
+        home = r.route(p, [0, 0, 0], [0, 1, 2])
+        depths = [0, 0, 0]
+        depths[home] = 5                 # affinity target backlogged
+        others = [i for i in range(3) if i != home]
+        warm = {others[1]}
+        # warm worker within spill tolerance wins the spillover (the
+        # fetch it saves costs more than a few queue places)...
+        assert r.route(p, depths, [0, 1, 2], warm=warm) == others[1]
+        # ...but a warm worker too deep loses to plain least-loaded
+        depths[others[1]] = 4
+        assert r.route(p, depths, [0, 1, 2], warm=warm) == others[0]
+
+
+# ---------------------------------------------------------------------------
+# the headline: remote-fetch bit-identity
+# ---------------------------------------------------------------------------
+
+class TestRemoteFetchBitIdentity:
+    def test_greedy_and_sampled_remote_fetch_bit_identical(self, setup):
+        """Warm a system prompt on prefill0, then pin same-prefix
+        requests to prefill1: the covered blocks arrive over the fetch
+        channel, only the tail chunk-prefills, and BOTH the greedy and
+        the seeded-sampled streams equal generate() exactly — with
+        zero new compiled programs on either steady path."""
+        model, cfg, pf, dc, _ = setup
+        _reset(*pf, *dc)
+        fleet = _fleet(pf, dc)
+        pa1, pa2 = _group(cfg, 21, tails=(3, 5))
+        pb1, pb2 = _group(cfg, 22, tails=(2, 6))
+        for warm in (pa1, pb1):                  # warm prefill0
+            fleet.submit(warm, max_new_tokens=4,
+                         prefill_worker="prefill0")
+            fleet.run_until_idle(max_ticks=200)
+        # the warm owners published: prefill0 AND (decode-time block
+        # sharing) the decode worker that finished the streams
+        ents = fleet.directory.worker_entries
+        assert ents("prefill0") and (ents("decode0") or ents("decode1"))
+        rg = fleet.submit(pa2, max_new_tokens=6,
+                          prefill_worker="prefill1")
+        res = fleet.run_until_idle(max_ticks=200)
+        rs_ = fleet.submit(pb2, max_new_tokens=6, temperature=0.9,
+                           top_k=40, seed=11, prefill_worker="prefill1")
+        res.update(fleet.run_until_idle(max_ticks=200))
+        np.testing.assert_array_equal(
+            res[rg], _ref(model, pa2, 6, temperature=0.0))
+        np.testing.assert_array_equal(
+            res[rs_], _ref(model, pb2, 6, do_sample=True,
+                           temperature=0.9, top_k=40, seed=11))
+        st = fleet.stats()
+        assert st["prefix_fetches"] == 2
+        assert st["prefix_fetch_blocks"] == 4    # two 2-block prefixes
+        assert st["prefix_fetch_failures"] == {}
+        assert pf[1].fetched_tokens == 32
+        # ONE decode block program total (a worker that served no
+        # stream compiles nothing; none compiles a second program)
+        assert {d.engine.decode_compile_count()
+                for d in fleet.decode} <= {0, 1}
+        assert max(d.engine.decode_compile_count()
+                   for d in fleet.decode) == 1
+        for w in fleet.prefill:
+            assert w.engine.prefill_compile_count() == 1
+        _check_clean(fleet)
+
+    def test_kv_int8_remote_fetch_bit_identical(self, setup):
+        """The quantized arena crosses the fetch channel as codes +
+        scales at storage size: the fetched-prefix stream equals the
+        locally-prefilled stream of the SAME prompt token for token."""
+        model, cfg, _, _, (pf_8, dc_8) = setup
+        _reset(*pf_8, dc_8)
+        fleet = _fleet(pf_8, [dc_8])
+        p1, p2 = _group(cfg, 23, tails=(3, 3))
+        r0 = fleet.submit(p1, max_new_tokens=6,
+                          prefill_worker="prefill0")
+        res = fleet.run_until_idle(max_ticks=200)
+        r1 = fleet.submit(p1, max_new_tokens=6,
+                          prefill_worker="prefill1")
+        r2 = fleet.submit(p2, max_new_tokens=5, temperature=1.1,
+                          top_p=0.9, seed=3, prefill_worker="prefill1")
+        res.update(fleet.run_until_idle(max_ticks=200))
+        np.testing.assert_array_equal(res[r0], res[r1])
+        np.testing.assert_array_equal(
+            res[r2], _ref(model, p2, 5, do_sample=True,
+                          temperature=1.1, top_p=0.9, seed=3))
+        assert fleet.stats()["prefix_fetches"] >= 1
+        assert dc_8.decode_compile_count() == 1
+        _check_clean(fleet)
+
+    def test_transient_fetch_fault_retried_invisibly(self, setup):
+        """One ``fleet.fetch`` fault with retry budget left: the fetch
+        lands on the retry — transient faults on the side channel are
+        semantically invisible, not even a fallback."""
+        model, cfg, pf, dc, _ = setup
+        _reset(*pf, *dc)
+        fleet = _fleet(pf, dc)
+        p1, p2 = _group(cfg, 24, tails=(3, 4))
+        fleet.submit(p1, max_new_tokens=4, prefill_worker="prefill0")
+        fleet.run_until_idle(max_ticks=200)
+        with faults.injected("fleet.fetch:at=1"):
+            rid = fleet.submit(p2, max_new_tokens=6,
+                               prefill_worker="prefill1")
+            res = fleet.run_until_idle(max_ticks=200)
+        np.testing.assert_array_equal(
+            res[rid], _ref(model, p2, 6, temperature=0.0))
+        assert fleet.stats()["prefix_fetches"] == 1
+        _check_clean(fleet)
+
+    def test_env_knob_disables_the_tier(self, setup, monkeypatch):
+        model, cfg, pf, dc, _ = setup
+        monkeypatch.setenv("PT_SERVING_FLEET_PREFIX_CACHE", "0")
+        _reset(*pf, *dc)
+        fleet = _fleet(pf, dc)
+        assert fleet.prefix_cache_enabled is False
+        assert fleet.stats()["prefix_directory"] is None
+        with pytest.raises(ValueError, match="watermark"):
+            _fleet(pf, dc, evict_high=0.3, evict_low=0.5)
+
+
+class TestScatteredBurstRecovery:
+    def test_no_affinity_scatter_recovers_hit_rate_via_fetch(
+            self, setup):
+        """The counterpart of test_fleet's affinity pin: WITHOUT
+        affinity a shared-prefix burst scatters — but with the fetch
+        tier on, scattered members pull the warm blocks instead of
+        paying the prefix cold, so the fleet-wide hit rate recovers."""
+        model, cfg, pf, dc, _ = setup
+        _reset(*pf, *dc)
+        fleet = _fleet(pf, dc, affinity=False)
+        warm = _group(cfg, 25, tails=(2,))[0]
+        fleet.submit(warm, max_new_tokens=4)
+        fleet.run_until_idle(max_ticks=200)
+        pt0 = sum(e.prompt_tokens for e in pf)
+        st0 = sum(e.shared_tokens for e in pf)
+        burst = _group(cfg, 25, tails=(3, 4, 5, 6))
+        rids = [fleet.submit(p, max_new_tokens=4) for p in burst]
+        res = fleet.run_until_idle(max_ticks=300)
+        for rid, p in zip(rids, burst):
+            np.testing.assert_array_equal(
+                res[rid], _ref(model, p, 4, temperature=0.0))
+        pt = sum(e.prompt_tokens for e in pf) - pt0
+        st = sum(e.shared_tokens for e in pf) - st0
+        assert fleet.stats()["prefix_fetches"] >= 1
+        assert st / pt > 0.5, (st, pt)
+        _check_clean(fleet)
+
+
+# ---------------------------------------------------------------------------
+# cross-TP-layout fetches
+# ---------------------------------------------------------------------------
+
+class TestCrossTPLayout:
+    def test_reshard_fetch_payload_roundtrip_1_2_4(self):
+        """The wire pin, device-free: per-shard fetch chunks re-chunk
+        to ANY degree dividing the kv heads — TP 1->2, 2->1, 2->4 —
+        for int8 codes AND the 3D fp32 scale leaves, bytes preserved
+        (axis 2 is the kv-head axis of every pool leaf)."""
+        rs = np.random.RandomState(0)
+        codes = rs.randint(-127, 127, (3, 8, 4, 16)).astype(np.int8)
+        scales = rs.randn(3, 8, 4).astype(np.float32)
+        for full in (codes, scales):
+            for src, dst in ((1, 2), (2, 1), (2, 4)):
+                parts = (np.split(full, src, axis=2) if src > 1
+                         else [full])
+                out = reshard_kv_chunks(parts, dst, axis=2)
+                assert len(out) == dst
+                for got, want in zip(out, np.split(full, dst, axis=2)):
+                    assert got.dtype == full.dtype
+                    np.testing.assert_array_equal(got, want)
+
+    def test_sharded_owner_fetch_to_unsharded_requester(
+            self, setup, _no_compile_cache):
+        """TP 2->1 over the REAL fetch path: the warm owner is the
+        mesh-sharded decode worker (decode-time sharing registered the
+        blocks there), the cold requester is a 1-chip prefill worker —
+        per-shard chunks reassemble and the stream stays
+        bit-identical. The digest chain is layout-invariant: the
+        requester registers the SAME digests the sharded owner
+        published."""
+        import jax
+        if jax.device_count() < 2:
+            pytest.skip("needs >= 2 (simulated) devices")
+        from paddle_tpu.distributed.mesh import build_device_mesh
+        from paddle_tpu.serving import TPConfig
+        paddle.seed(0)
+        cfg8 = llama_tiny_config(num_attention_heads=8,
+                                 num_key_value_heads=8)
+        model8 = LlamaForCausalLM(cfg8)
+        mesh = build_device_mesh({"mp": 2}, allow_subset=True)
+        kw = dict(num_slots=2, max_len=64, decode_block=4,
+                  block_size=8, prefill_chunk=8)
+        pf1 = [PrefillPagedEngine(model8, **kw) for _ in range(2)]
+        dc2 = ContinuousBatchingEngine(
+            model8, paged=True, tp=TPConfig(axes=("mp",), mesh=mesh),
+            **kw)
+        assert dc2.tp_degree() == 2
+        fleet = _fleet(pf1, [dc2])
+        p1, p2 = _group(cfg8, 26, tails=(3, 5))
+        fleet.submit(p1, max_new_tokens=4, prefill_worker="prefill0")
+        fleet.run_until_idle(max_ticks=200)
+        # sorted owners put decode0 first: the SHARDED arena serves
+        assert "decode0" in fleet.directory.owners(
+            next(iter(_chain(p1))))
+        rid = fleet.submit(p2, max_new_tokens=6,
+                           prefill_worker="prefill1")
+        res = fleet.run_until_idle(max_ticks=200)
+        np.testing.assert_array_equal(
+            res[rid], _ref(model8, p2, 6, temperature=0.0))
+        assert fleet.stats()["prefix_fetches"] == 1
+        assert set(_chain(p1)) <= set(
+            pf1[1].manager.registered_chains())
+        assert dc2.decode_compile_count() == 1
+        _check_clean(fleet)
+
+    def test_unsharded_owner_fetch_to_sharded_requester(
+            self, setup, _no_compile_cache):
+        """TP 1->2: the warm owner is a 1-chip prefill worker (the
+        warm request completed AT prefill, so no decode copy exists),
+        the cold requester is mesh-sharded — the logical rows re-chunk
+        to degree 2 and re-commit through the backend's commit_arrays
+        hook."""
+        import jax
+        if jax.device_count() < 2:
+            pytest.skip("needs >= 2 (simulated) devices")
+        from paddle_tpu.distributed.mesh import build_device_mesh
+        from paddle_tpu.serving import TPConfig
+        paddle.seed(0)
+        cfg8 = llama_tiny_config(num_attention_heads=8,
+                                 num_key_value_heads=8)
+        model8 = LlamaForCausalLM(cfg8)
+        mesh = build_device_mesh({"mp": 2}, allow_subset=True)
+        kw = dict(num_slots=2, max_len=64, decode_block=4,
+                  block_size=8, prefill_chunk=8)
+        tp = TPConfig(axes=("mp",), mesh=mesh)
+        pf_a = PrefillPagedEngine(model8, **kw)
+        pf_b = PrefillPagedEngine(model8, tp=tp, **kw)
+        dc2 = ContinuousBatchingEngine(model8, paged=True, tp=tp, **kw)
+        assert pf_b.tp_degree() == 2
+        fleet = _fleet([pf_a, pf_b], [dc2])
+        p1, p2 = _group(cfg8, 27, tails=(3, 5))
+        # max_new==1 completes at prefill: prefill0 is the ONLY owner
+        fleet.submit(p1, max_new_tokens=1, prefill_worker="prefill0")
+        fleet.run_until_idle(max_ticks=100)
+        assert fleet.directory.worker_entries("prefill0")
+        assert not fleet.directory.worker_entries("prefill1")
+        assert not fleet.directory.worker_entries("decode0")
+        rid = fleet.submit(p2, max_new_tokens=6,
+                           prefill_worker="prefill1")
+        res = fleet.run_until_idle(max_ticks=200)
+        np.testing.assert_array_equal(
+            res[rid], _ref(model8, p2, 6, temperature=0.0))
+        assert fleet.stats()["prefix_fetches"] == 1
+        _check_clean(fleet)
+
+
+# ---------------------------------------------------------------------------
+# the eviction tier
+# ---------------------------------------------------------------------------
+
+class TestEvictionTier:
+    def test_watermark_eviction_retracts_directory(self, setup):
+        """Distinct prompts pile registered blocks into the arenas
+        until fleet-global pressure crosses the high watermark: LRU
+        unreferenced blocks evict down to the low watermark, live
+        streams keep every referenced block, and the owners' next
+        heartbeats retract the evicted digests — the directory is
+        exactly the union of what the managers still hold."""
+        model, cfg, pf, dc, _ = setup
+        _reset(pf[0], dc[0])
+        fleet = _fleet([pf[0]], [dc[0]], evict_high=0.35,
+                       evict_low=0.15)
+        rids, prompts = [], []
+        for seed in (31, 32, 33, 34):
+            p = _group(cfg, seed, tails=(3,))[0]
+            prompts.append(p)
+            rids.append(fleet.submit(p, max_new_tokens=4))
+        res = fleet.run_until_idle(max_ticks=400)
+        for rid, p in zip(rids, prompts):
+            np.testing.assert_array_equal(
+                res[rid], _ref(model, p, 4, temperature=0.0))
+        assert fleet.prefix_evictions > 0
+        fleet.tick()          # publish the post-eviction truth
+        mgrs = [pf[0].manager, dc[0].manager]
+        pressure = 1.0 - (sum(len(m._free) for m in mgrs)
+                          / sum(m.usable_blocks() for m in mgrs))
+        assert pressure <= 0.35 + 1e-9
+        held = set().union(*(set(m.registered_chains()) for m in mgrs))
+        assert fleet.directory.size() == len(held)
+        _check_clean(fleet)
+
+
+# ---------------------------------------------------------------------------
+# failure semantics: every degradation is local prefill, never a loss
+# ---------------------------------------------------------------------------
+
+class TestFailureSemantics:
+    def test_dead_owner_falls_back_then_lease_expires_entries(
+            self, setup):
+        """The mid-fetch worker kill: the only owner dies between its
+        last publish and the fetch — the fetch fails loudly on the
+        side channel, the request prefills locally and streams
+        bit-identical; once the lease expires the directory forgets
+        the owner and later requests skip the fetch entirely."""
+        model, cfg, pf, dc, _ = setup
+        _reset(*pf, *dc)
+        fleet = _fleet(pf, dc, lease_misses=2)
+        p1, p2, p3 = _group(cfg, 41, tails=(3, 4, 5))
+        # max_new==1: completes at prefill -> prefill0 is the ONLY
+        # owner (no decode-side copy to serve the fetch instead)
+        fleet.submit(p1, max_new_tokens=1, prefill_worker="prefill0")
+        fleet.run_until_idle(max_ticks=100)
+        assert fleet.directory.worker_entries("prefill0")
+        fleet.kill_prefill_worker(0)
+        rid = fleet.submit(p2, max_new_tokens=6,
+                           prefill_worker="prefill1")
+        res = fleet.run_until_idle(max_ticks=300)
+        np.testing.assert_array_equal(
+            res[rid], _ref(model, p2, 6, temperature=0.0))
+        st = fleet.stats()
+        assert st["prefix_fetches"] == 0
+        assert st["prefix_fetch_failures"].get("transport", 0) >= 1
+        # the lease expired during the run: entries gone with it
+        assert fleet._health["prefill0"]["state"] == "dead"
+        assert fleet.directory.worker_entries("prefill0") == {}
+        fails = dict(fleet.prefix_fetch_failures)
+        rid2 = fleet.submit(p3, max_new_tokens=4,
+                            prefill_worker="prefill1")
+        res = fleet.run_until_idle(max_ticks=200)
+        np.testing.assert_array_equal(
+            res[rid2], _ref(model, p3, 4, temperature=0.0))
+        # dead owner excluded at lookup: no attempt, no new failure
+        assert dict(fleet.prefix_fetch_failures) == fails
+        _check_clean(fleet)
+
+    def test_fetch_over_socket_transport_under_wire_faults(
+            self, setup):
+        """The fetch payload crosses the REAL localhost-TCP transport
+        with ~2% wire faults armed: retransmits, CRC drops and
+        duplicate deliveries on the side channel all drain — the
+        stream is bit-identical whether the fetch adopted or fell
+        back, and nothing leaks or spins."""
+        model, cfg, pf, dc, _ = setup
+        _reset(*pf, *dc)
+        t = SocketTransport("fleet", io_timeout_s=5.0,
+                            retry_backoff_s=0.001)
+        try:
+            fleet = _fleet(pf, dc, transport=t)
+            p1, p2 = _group(cfg, 42, tails=(3, 5))
+            fleet.submit(p1, max_new_tokens=4,
+                         prefill_worker="prefill0")
+            fleet.run_until_idle(max_ticks=200)
+            with faults.injected(WIRE_FAULTS, seed=13):
+                rid = fleet.submit(p2, max_new_tokens=6,
+                                   prefill_worker="prefill1")
+                res = fleet.run_until_idle(max_ticks=300)
+            np.testing.assert_array_equal(
+                res[rid], _ref(model, p2, 6, temperature=0.0))
+            st = fleet.stats()
+            assert st["prefix_fetches"] \
+                + sum(st["prefix_fetch_failures"].values()) >= 1
+            _check_clean(fleet)
+        finally:
+            t.close()
+
+    def test_chaos_fetch_sites_hold_invariants(self, setup):
+        """A seeded schedule over the NEW sites (``fleet.fetch`` at
+        15%, ``fleet.directory`` losing publishes at 10%) plus ambient
+        serialize/transport/allocate faults, against a shared-prefix
+        burst that exercises the fetch path hard: every request
+        completes or fails explicitly, completed greedy rows are
+        bit-identical, compile counts hold, and every arena accounts
+        for every block."""
+        model, cfg, pf, dc, _ = setup
+        _reset(*pf, *dc)
+        rs = np.random.RandomState(77)
+        prompts = _group(cfg, 43, tails=tuple(1 + (i % 5)
+                                              for i in range(8)))
+        prompts += [rs.randint(0, cfg.vocab_size, (L,)).astype(
+            np.int32) for L in rs.randint(4, 15, size=4)]
+        news = [4 + (i % 3) * 4 for i in range(len(prompts))]
+        fleet = _fleet(pf, dc, resilience=ResilienceConfig(
+            retry_attempts=3, retry_backoff_s=0.001,
+            breaker_threshold=16))
+        rids = [fleet.submit(p, max_new_tokens=mn, arrival_step=i)
+                for i, (p, mn) in enumerate(zip(prompts, news))]
+        spec = ("fleet.fetch:p=0.15;fleet.directory:p=0.1;"
+                "fleet.serialize:p=0.02;fleet.transport:p=0.02;"
+                "serving.allocate:p=0.02")
+        with faults.injected(spec, seed=5):
+            res = fleet.run_until_idle(max_ticks=800)
+        for rid, p, mn in zip(rids, prompts, news):
+            assert rid in res, f"request {rid} vanished"
+            v = res[rid]
+            if isinstance(v, RequestFailure):
+                assert v.reason in ("timeout", "poisoned",
+                                    "circuit_open", "shed", "handoff")
+            else:
+                np.testing.assert_array_equal(
+                    v, _ref(model, p, mn, temperature=0.0))
+        for d in fleet.decode:
+            assert d.engine.decode_compile_count() == 1
+        for w in fleet.prefill:
+            assert w.engine.prefill_compile_count() == 1
+        _check_clean(fleet)

@@ -26,7 +26,8 @@ GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
                  "tests/test_sparse_quant.py",
                  "tests/test_megakernel.py", "tests/test_autotune.py",
                  "tests/test_frontend.py", "tests/test_fleet.py",
-                 "tests/test_fleet_failover.py"]
+                 "tests/test_fleet_failover.py",
+                 "tests/test_prefix_cache.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -210,6 +211,23 @@ REQUIRED_NODES = [
     "test_paged_kv_int8_kill_bit_identical",
     "test_fleet_failover.py::TestRedriveBitIdentity::"
     "test_no_surviving_decode_worker_fails_explicitly",
+    # PR 16 fleet-prefix-cache pins: the headline remote-fetch
+    # bit-identity matrix (greedy + sampled, with compile counts),
+    # the watermark-eviction directory retraction, the dead-owner
+    # local-prefill fallback + lease expiry, and the chaos schedule
+    # over the new fetch/directory fault sites
+    "test_prefix_cache.py::TestRemoteFetchBitIdentity::"
+    "test_greedy_and_sampled_remote_fetch_bit_identical",
+    "test_prefix_cache.py::TestRemoteFetchBitIdentity::"
+    "test_kv_int8_remote_fetch_bit_identical",
+    "test_prefix_cache.py::TestEvictionTier::"
+    "test_watermark_eviction_retracts_directory",
+    "test_prefix_cache.py::TestFailureSemantics::"
+    "test_dead_owner_falls_back_then_lease_expires_entries",
+    "test_prefix_cache.py::TestFailureSemantics::"
+    "test_chaos_fetch_sites_hold_invariants",
+    "test_serving_paged.py::TestPrefixSharing::"
+    "test_decode_time_block_sharing_extends_the_chain",
 ]
 
 

@@ -200,6 +200,37 @@ class TestPrefixSharing:
         engine.drain_finished()
         assert not engine.manager._ref
 
+    def test_decode_time_block_sharing_extends_the_chain(
+            self, paged_setup):
+        """A COMPLETED stream registers every fully-written block of
+        prompt + generated history — decode positions included — so a
+        follow-up that quotes the generated text shares blocks the
+        prompt alone never covered (the multi-turn steady state: turn
+        N+1's prompt is turn N's transcript)."""
+        model, cfg, engine = paged_setup
+        engine.reset()
+        rs = np.random.RandomState(9)
+        p0 = rs.randint(0, cfg.vocab_size, (12,)).astype(np.int32)
+        srv = Server(engine)
+        r0 = srv.submit(p0, max_new_tokens=12)
+        seq = srv.run_until_idle()[r0]
+        np.testing.assert_array_equal(
+            seq, _ref(model, p0, 12, temperature=0.0))
+        # the prompt alone covers 1 shareable block ((12-1)//8); the
+        # completed 24-token sequence registered 2 ((24-1)//8) — the
+        # 2nd block holds 4 DECODE positions (12..15)
+        assert max(engine.manager.registered_chains().values()) == 2
+        st0 = engine.shared_tokens
+        p1 = np.concatenate([seq[:20].astype(np.int32),
+                             rs.randint(0, cfg.vocab_size, (4,))
+                             .astype(np.int32)])
+        r1 = srv.submit(p1, max_new_tokens=4)
+        np.testing.assert_array_equal(
+            srv.run_until_idle()[r1],
+            _ref(model, p1, 4, temperature=0.0))
+        assert engine.shared_tokens - st0 == 16   # both blocks hit
+        assert not engine.manager._ref
+
     def test_hash_collision_falls_back_to_recompute(self, paged_setup):
         """A degenerate hash (every block collides) must never share
         mismatched blocks: the stored-token comparison rejects the hit
