@@ -56,6 +56,8 @@ SERVING_DISAGG_DEADLINE_S = env_float(
     "BENCH_SERVING_DISAGG_DEADLINE_S", 300)
 SERVING_PREFIXCACHE_DEADLINE_S = env_float(
     "BENCH_SERVING_PREFIXCACHE_DEADLINE_S", 300)
+SERVING_AUTOSCALE_DEADLINE_S = env_float(
+    "BENCH_SERVING_AUTOSCALE_DEADLINE_S", 300)
 AUTOTUNE_DEADLINE_S = env_float("BENCH_AUTOTUNE_DEADLINE_S", 300)
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
@@ -867,7 +869,8 @@ def _run_child(mode: str, deadline: float):
                 "--child-serving-spec", "--child-serving-quant",
                 "--child-serving-megakernel",
                 "--child-serving-frontdoor", "--child-serving-disagg",
-                "--child-serving-prefixcache", "--child-autotune"):
+                "--child-serving-prefixcache",
+                "--child-serving-autoscale", "--child-autotune"):
         env["JAX_PLATFORMS"] = "cpu"
     if mode in ("--child-comms", "--child-serving-tp"):
         # simulated 2x4 mesh on the CPU lane
@@ -1205,6 +1208,33 @@ def _attach_serving_prefixcache(result, budget_s=None):
                          SERVING_PREFIXCACHE_DEADLINE_S, budget_s)
 
 
+def _child_serving_autoscale():
+    """serving-autoscale stage: SLO-driven autoscaling
+    (serving/loadgen.py + autoscaler.py) — ONE seeded kill-and-burst
+    trace replayed against an autoscaled fleet vs static-peak vs
+    static-min. Pins bit-identity across scale events (completed
+    streams match static-peak token-for-token, greedy rows match
+    generate()), the decode-compile count staying 1 through scale-ins,
+    the control loop converging (scale up on the burst, repair the
+    kill, drain back to the min size), and SLO attainment vs
+    worker-ticks — the capacity autoscaling saves. All fields non-null
+    on the CPU lane; the TPU child stages the same fleet."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.serving.microbench import run_serving_autoscale_bench
+    out = run_serving_autoscale_bench(
+        seed=env_int("BENCH_SERVING_AUTOSCALE_SEED", 0),
+        horizon=env_int("BENCH_SERVING_AUTOSCALE_HORIZON", 36),
+        max_new=env_int("BENCH_SERVING_AUTOSCALE_MAX_NEW", 10))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_serving_autoscale(result, budget_s=None):
+    return _attach_stage(result, "serving-autoscale",
+                         "--child-serving-autoscale",
+                         SERVING_AUTOSCALE_DEADLINE_S, budget_s)
+
+
 def _child_autotune():
     """autotune stage: the Pallas block-size sweep harness
     (ops/pallas/autotune.py) — sweeps every knob that is honest on this
@@ -1337,6 +1367,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-prefixcache":
         _child_serving_prefixcache()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-autoscale":
+        _child_serving_autoscale()
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-autotune":
         _child_autotune()
         return
@@ -1422,6 +1455,7 @@ def _main_measured(errors):
                 result = _attach_serving_disagg(result, remaining())
                 result = _attach_serving_failover(result, remaining())
                 result = _attach_serving_prefixcache(result, remaining())
+                result = _attach_serving_autoscale(result, remaining())
                 _emit_final(_attach_autotune(result, remaining()))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
@@ -1452,6 +1486,7 @@ def _main_measured(errors):
         result = _attach_serving_disagg(result, remaining())
         result = _attach_serving_failover(result, remaining())
         result = _attach_serving_prefixcache(result, remaining())
+        result = _attach_serving_autoscale(result, remaining())
         _emit_final(_attach_autotune(result, remaining()))
         return
     # last resort: still one JSON line, rc 0, explicit marker

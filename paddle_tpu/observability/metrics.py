@@ -30,7 +30,9 @@ from __future__ import annotations
 
 import bisect
 import json
+import math
 import threading
+from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..utils.flags import env_bool
@@ -149,14 +151,25 @@ class Gauge(_Metric):
 class Histogram(_Metric):
     """Bucketed distribution: ``observe(v)`` lands in the first bucket
     with upper bound >= v (raw per-bucket counts stored; exposition
-    renders them cumulative with ``+Inf``, the Prometheus convention)."""
+    renders them cumulative with ``+Inf``, the Prometheus convention).
+
+    Alongside the cumulative buckets each sample keeps a bounded ring
+    of the most recent raw observations (``recent_cap``, default 512)
+    so a controller can read a ROLLING-window percentile — the
+    cumulative-since-start buckets can never "clear" after a long
+    breach, which is exactly wrong for a control loop. The ring only
+    exists on the enabled path (one deque append per observe); the
+    disabled fast path is untouched."""
 
     kind = "histogram"
 
     def __init__(self, name, help_="", labels=(),
-                 buckets: Sequence[float] = _DEFAULT_BUCKETS):
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS,
+                 recent_cap: int = 512):
         super().__init__(name, help_, labels)
         self.bounds: Tuple[float, ...] = tuple(sorted(buckets))
+        self.recent_cap = int(recent_cap)
+        self._recent: Dict[Tuple[str, ...], deque] = {}
 
     def _zero(self):
         # [count, sum, per-bucket counts..., overflow]
@@ -169,10 +182,48 @@ class Histogram(_Metric):
         s[0] += 1
         s[1] += value
         s[2 + bisect.bisect_left(self.bounds, value)] += 1
+        key = self._key(labels)
+        ring = self._recent.get(key)
+        if ring is None:
+            with self._lock:
+                ring = self._recent.setdefault(
+                    key, deque(maxlen=self.recent_cap))
+        ring.append(value)
 
     def count(self, **labels) -> int:
         s = self._samples.get(self._key(labels))
         return s[0] if s is not None else 0
+
+    def recent_quantile(self, q: float, window: Optional[int] = None,
+                        **labels) -> Optional[float]:
+        """Nearest-rank quantile ``q`` over the last ``window`` raw
+        observations (default: everything the ring retains, at most
+        ``recent_cap``). None when no samples exist — a controller
+        must treat "no data" differently from "0.0 seconds"."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        ring = self._recent.get(self._key(labels))
+        if not ring:
+            return None
+        vals = list(ring)
+        if window is not None:
+            if window < 1:
+                raise ValueError(f"window {window}; must be >= 1")
+            vals = vals[-window:]
+        vals.sort()
+        idx = min(len(vals) - 1,
+                  max(0, math.ceil(q * len(vals)) - 1))
+        return vals[idx]
+
+    def recent_count(self, **labels) -> int:
+        """Raw observations currently retained in the ring."""
+        ring = self._recent.get(self._key(labels))
+        return len(ring) if ring else 0
+
+    def clear(self):
+        super().clear()
+        with self._lock:
+            self._recent.clear()
 
     def _value_of(self, sample):
         cum, cum_counts = 0, []

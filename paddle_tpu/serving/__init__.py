@@ -8,6 +8,8 @@ strictly higher aggregate tokens/s on mixed-length traffic. The AOT
 path (``inference.export_decoder(engine_slots=...)`` +
 ``GenerationPredictor.serve``) serves the same engine from the
 serialized artifact alone."""
+from .autoscaler import (Autoscaler, AutoscalerConfig, DecisionKernel,
+                         Observation)
 from .engine import (ArtifactStepBackend, ContinuousBatchingEngine,
                      ModelStepBackend, slot_sample_logits)
 from .fleet import (DecodeWorker, Fleet, FleetRouter, InProcessTransport,
@@ -17,6 +19,7 @@ from .fleet import (DecodeWorker, Fleet, FleetRouter, InProcessTransport,
 from .frontend import FairScheduler, Frontend, TenantConfig, TokenStream
 from .handoff import (KVHandoff, decode_handoff, encode_handoff,
                       reshard_kv_chunks)
+from .loadgen import Trace, TraceConfig, generate_trace, replay
 from .paging import (BlockManager, PagedArtifactStepBackend, PagedEngine,
                      PagedModelStepBackend)
 from .prefix_cache import (PrefixCacheDirectory, adopt_prefix,
@@ -30,7 +33,8 @@ from .spec import (SpecConfig, SpecEngine, SpecModelStepBackend,
 from .tp import (ShardedModelStepBackend, ShardedPagedStepBackend,
                  TPConfig)
 
-__all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
+__all__ = ["Autoscaler", "AutoscalerConfig", "ContinuousBatchingEngine",
+           "DecisionKernel", "ModelStepBackend", "Observation",
            "ArtifactStepBackend", "BlockManager", "DecodeWorker",
            "FairScheduler", "Fleet", "FleetRouter", "Frontend",
            "InProcessTransport", "KVHandoff",
@@ -44,6 +48,7 @@ __all__ = ["ContinuousBatchingEngine", "ModelStepBackend",
            "SpecPagedEngine", "SpecPagedStepBackend",
            "ShardedModelStepBackend", "ShardedPagedStepBackend",
            "TPConfig", "TenantConfig", "TokenStream", "Transport",
-           "TransportError", "adopt_prefix", "decode_handoff",
-           "encode_handoff", "extract_prefix", "ngram_propose",
+           "Trace", "TraceConfig", "TransportError", "adopt_prefix",
+           "decode_handoff", "encode_handoff", "extract_prefix",
+           "generate_trace", "ngram_propose", "replay",
            "reshard_kv_chunks", "slot_sample_logits"]

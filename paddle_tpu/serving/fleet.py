@@ -970,7 +970,9 @@ class Fleet:
             d.name: deque() for d in self.decode}
         self._assigned: Dict[str, int] = {d.name: 0
                                           for d in self.decode}
-        self._draining: set = set()
+        self._draining: set = set()          # prefill indices
+        self._draining_decode: set = set()   # decode NAMES (stable
+        # across removals, unlike indices)
         # -- failure-domain records (everything redrive needs lives on
         # THIS side of the wire) --
         # rid -> {prompt, kw, worker, t_submit}: every submission
@@ -1166,16 +1168,21 @@ class Fleet:
         already assigned but not yet adopted; ties break low-index for
         determinism. A killed-but-undetected worker is still a target
         (the fleet cannot know yet — its payloads are redriven when
-        the lease expires); a detected-dead one never is. None when
+        the lease expires); a detected-dead one never is; a DRAINING
+        one only when no non-draining worker survives (correct but
+        dispreferred — the drain must eventually converge). None when
         the decode pool is gone entirely."""
         names = [d.name for d in self.decode]
         live = [i for i in range(len(self.decode))
                 if self._alive(names[i])]
         if not live:
             return None
-        return max(live, key=lambda i: (self.decode[i].free_slots()
-                                        - self._assigned[names[i]],
-                                        -i))
+        routable = [i for i in live
+                    if names[i] not in self._draining_decode]
+        return max(routable or live,
+                   key=lambda i: (self.decode[i].free_slots()
+                                  - self._assigned[names[i]],
+                                  -i))
 
     def _ship(self, w: PrefillWorker, ph: _PendingHandoff):
         rid = ph.run.request.request_id
@@ -1799,13 +1806,20 @@ class Fleet:
                 {"name": w.name, "state": self._health[w.name]["state"],
                  "queue": w.queue_depth(),
                  "tokens_emitted": w.engine.tokens_emitted,
+                 "block_pressure": round(
+                     w.engine.manager.block_pressure(), 4)
+                 if hasattr(w.engine, "manager") else 0.0,
                  "prefill_compiles": w.engine.prefill_compile_count()
                  if hasattr(w.engine, "prefill_compile_count") else 1}
                 for w in self.prefill],
             "decode_workers": [
                 {"name": d.name, "state": self._health[d.name]["state"],
                  "free_slots": d.free_slots(),
+                 "draining": d.name in self._draining_decode,
                  "tokens_emitted": d.engine.tokens_emitted,
+                 "block_pressure": round(
+                     d.engine.manager.block_pressure(), 4)
+                 if hasattr(d.engine, "manager") else 0.0,
                  "decode_compiles": d.engine.decode_compile_count()}
                 for d in self.decode],
         }
@@ -1816,7 +1830,10 @@ class Fleet:
         starts routing payloads to it on the next tick. Same
         compatibility contract as construction — an incompatible
         engine is refused here, not discovered when a payload fails to
-        adopt mid-stream."""
+        adopt mid-stream. The ``fleet.scale`` fault site fires BEFORE
+        any state mutates, so a transiently-failed scale action
+        retries cleanly under the PR 5 policy."""
+        faults.fault_point("fleet.scale")
         self._check_engine_compat(worker.engine,
                                   self.prefill[0].engine)
         worker.name = worker.name or f"decode{len(self.decode)}"
@@ -1828,6 +1845,80 @@ class Fleet:
         self._assigned[worker.name] = 0
         self._health[worker.name] = {"state": "live", "misses": 0}
         _M_WORKER_STATE.set(1, worker=worker.name)
+
+    def drain_decode_worker(self, idx: int):
+        """Stop routing new handoffs to decode worker ``idx``; its
+        in-flight streams finish in place (bit-identical — nothing
+        about their state moves), and once idle it can be removed.
+        Idempotent; refuses to drain the last routable decode
+        worker. The ``fleet.scale`` fault site covers it like every
+        scale action."""
+        faults.fault_point("fleet.scale")
+        if not 0 <= idx < len(self.decode):
+            raise ValueError(f"no decode worker at index {idx}")
+        name = self.decode[idx].name
+        if name in self._draining_decode:
+            return
+        routable = [d.name for d in self._live_decode()
+                    if d.name not in self._draining_decode
+                    and d.name != name]
+        if not routable:
+            raise ValueError("cannot drain the last routable decode "
+                             "worker")
+        self._draining_decode.add(name)
+        self.flight.record("decode_drain", worker=name,
+                           clock=self._clock)
+
+    def undrain_decode_worker(self, idx: int):
+        """Cancel a pending drain — the cheap scale-up when traffic
+        returns before the drain converged (no fresh engine, no new
+        programs; the worker simply becomes routable again)."""
+        faults.fault_point("fleet.scale")
+        if not 0 <= idx < len(self.decode):
+            raise ValueError(f"no decode worker at index {idx}")
+        name = self.decode[idx].name
+        if name in self._draining_decode:
+            self._draining_decode.discard(name)
+            self.flight.record("decode_undrain", worker=name,
+                               clock=self._clock)
+
+    def remove_decode_worker(self, idx: int) -> DecodeWorker:
+        """Scale down: remove a DRAINED decode worker. Refused while
+        the worker still owns streams (busy slots, queued adoptions,
+        or payloads assigned on the wire) — drain first and run the
+        fleet until it empties. Dead workers are not removable: their
+        tombstones keep the name reserved and the lease history
+        readable."""
+        faults.fault_point("fleet.scale")
+        if not 0 <= idx < len(self.decode):
+            raise ValueError(f"no decode worker at index {idx}")
+        d = self.decode[idx]
+        if not self._alive(d.name):
+            raise RuntimeError(
+                f"decode worker {d.name!r} is dead — its streams "
+                "were redriven and its tombstone stays")
+        if len(self._live_decode()) < 2:
+            raise ValueError("cannot remove the last live decode "
+                             "worker")
+        if (d.busy() or self._pending_adopt[d.name]
+                or self._assigned[d.name]):
+            raise RuntimeError(
+                f"decode worker {d.name!r} still owns streams — "
+                "drain and run the fleet idle first")
+        # results are fleet-durable: streams the worker completed must
+        # survive its removal (scale-down would otherwise lose them)
+        self._local_results.update(d.server.results)
+        self.decode.pop(idx)
+        self._draining_decode.discard(d.name)
+        self._pending_adopt.pop(d.name, None)
+        self._assigned.pop(d.name, None)
+        self._health.pop(d.name, None)
+        self.directory.drop_worker(d.name)
+        self.transport.drop_endpoint(d.name)
+        _M_WORKER_STATE.set(0, worker=d.name)
+        self.flight.record("decode_remove", worker=d.name,
+                           clock=self._clock)
+        return d
 
     def migrate_decode_worker(self, idx: int, engine,
                               path: str) -> DecodeWorker:

@@ -31,7 +31,8 @@ import time
 
 import numpy as np
 
-__all__ = ["run_fleet_kill_soak", "run_serving_disagg_bench",
+__all__ = ["run_fleet_kill_soak", "run_serving_autoscale_bench",
+           "run_serving_disagg_bench",
            "run_serving_failover_bench", "run_serving_frontdoor_bench",
            "run_serving_megakernel_bench",
            "run_serving_prefixcache_bench", "run_serving_quant_bench",
@@ -1107,4 +1108,211 @@ def run_serving_tp_bench(requests: int = 6, max_new: int = 16,
         "serving_tp_collective_calls_per_step": round(calls_step, 2),
         "serving_tp_int8_error_bound": float(int8_bound),
         "serving_tp_decode_compiles": tp.decode_compile_count(),
+    }
+
+
+def run_serving_autoscale_bench(seed: int = 0, horizon: int = 36,
+                                max_new: int = 10) -> dict:
+    """SLO-driven autoscaling stage (serving/loadgen.py +
+    serving/autoscaler.py): ONE seeded kill-and-burst trace — steady
+    traffic, a burst episode, a decode-worker kill inside the burst,
+    recovery — replayed against three fleets: AUTOSCALED (starts at
+    the min size, control loop armed), STATIC-PEAK (pinned at the
+    autoscaler's max), STATIC-MIN (pinned at the min, no repair).
+
+    What the stage pins every round:
+
+    - **identical traffic**: all three arms replay the same
+      materialized trace (same prompts, ticks, sampling seeds) and the
+      same kill tick — the A/B/C is about fleet sizing only;
+    - **bit-identity across scale events**: every request completed in
+      both the autoscaled and static-peak arms must match
+      token-for-token, and completed greedy rows must equal
+      ``generate()`` — scaling up mid-burst, draining after it, and
+      redriving through the kill never touch token streams;
+    - **SLO attainment vs worker-ticks**: fraction of completed
+      requests with TTFT under the target, against the capacity spent
+      (sum over ticks of live decode workers) — the autoscaled arm
+      should track static-peak's attainment at fewer worker-ticks;
+    - **the loop converging**: the autoscaled fleet scales up on the
+      burst (and repairs the kill immediately — below-min bypasses
+      hysteresis), then drains back to the min size after the burst
+      clears; peak and end sizes are reported;
+    - the compile-count pin: every decode engine — including the ones
+      scaled in mid-run — compiles its decode block exactly once.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.observability import metrics as om
+    from paddle_tpu.serving import (Autoscaler, AutoscalerConfig,
+                                    ContinuousBatchingEngine,
+                                    DecodeWorker, Fleet, PrefillWorker,
+                                    PrefillPagedEngine, RequestFailure,
+                                    TraceConfig, generate_trace, replay)
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    kw = dict(num_slots=2, max_len=64, decode_block=4, block_size=8,
+              prefill_chunk=8)
+    scfg = AutoscalerConfig(min_decode=2, max_decode=4,
+                            interval_ticks=2, queue_high=2,
+                            pressure_high=0.92, ttft_slo_s=0.5,
+                            breach_intervals=2, clear_intervals=4,
+                            up_cooldown=2, down_cooldown=3)
+
+    trace = generate_trace(TraceConfig(
+        seed=seed, horizon=horizon, base_rate=0.2, bursts=1,
+        burst_mult=6.0, burst_len=(8, 12), prompt_alpha=1.5,
+        prompt_lo=4, prompt_hi=12, output_alpha=1.2, output_lo=4,
+        output_hi=max_new, vocab_size=cfg.vocab_size,
+        shared_fraction=0.3, shared_len=8, sampled_fraction=0.25))
+    b0, b1 = trace.burst_windows[0]
+    kill_tick = (b0 + b1) // 2
+    # every arm runs the SAME total tick window (trace + recovery
+    # tail): worker-ticks then mean "capacity reserved over the
+    # window", the quantity autoscaling actually saves, and the tail
+    # gives the control loop room to drain back to the min size
+    total_ticks = horizon + 60
+
+    def drive(n_decode, autoscale):
+        fleet = Fleet(
+            [PrefillWorker(PrefillPagedEngine(model, **kw))
+             for _ in range(2)],
+            [DecodeWorker(ContinuousBatchingEngine(model, paged=True,
+                                                   **kw))
+             for _ in range(n_decode)],
+            lease_misses=2, spill_depth=100)
+        scaler = Autoscaler(
+            fleet,
+            lambda: ContinuousBatchingEngine(model, paged=True, **kw),
+            config=scfg) if autoscale else None
+        state = {"killed": False, "worker_ticks": 0,
+                 "peak": n_decode, "clock": 0}
+
+        def submit(r):
+            return fleet.submit(
+                r.prompt, max_new_tokens=r.max_new_tokens,
+                temperature=r.temperature, top_k=r.top_k, seed=r.seed,
+                arrival_step=r.arrival_step, tenant=r.tenant,
+                priority=r.priority)
+
+        def on_tick(clock):
+            state["clock"] = clock
+            if not state["killed"] and clock >= kill_tick:
+                live = [i for i, d in enumerate(fleet.decode)
+                        if not d.killed]
+                if len(live) > 1:
+                    fleet.kill_decode_worker(live[-1])
+                    state["killed"] = True
+            n_live = len(fleet._live_decode())
+            state["worker_ticks"] += n_live
+            state["peak"] = max(state["peak"], n_live)
+            if scaler is not None:
+                scaler.on_tick(clock)
+
+        t0 = time.perf_counter()
+        ids = replay(trace, submit, fleet.tick, fleet.busy,
+                     max_ticks=3000, on_tick=on_tick)
+        while state["clock"] < total_ticks:
+            fleet.tick()
+            on_tick(state["clock"] + 1)
+        dt = time.perf_counter() - t0
+        # zero block leaks on every surviving arena — including the
+        # workers the autoscaler scaled in and the ones it drained
+        for w in list(fleet.prefill) + list(fleet.decode):
+            if fleet._alive(w.name) and hasattr(w.engine, "manager"):
+                assert not w.engine.manager._ref, \
+                    f"block leak on {w.name}"
+                w.engine.manager.assert_consistent()
+        res = fleet.results
+        ttft = {}
+        for w in list(fleet.prefill) + list(fleet.decode):
+            ttft.update(w.server.ttft)
+        rows, completed_tokens = {}, 0
+        for tid, rid in ids.items():
+            v = res.get(rid)
+            if v is not None and not isinstance(v, RequestFailure):
+                rows[tid] = np.asarray(v)
+                completed_tokens += int(np.asarray(v).size)
+        attain = [1 for tid, rid in ids.items()
+                  if tid in rows and rid in ttft
+                  and ttft[rid] <= scfg.ttft_slo_s]
+        return {
+            "fleet": fleet, "scaler": scaler, "ids": ids,
+            "rows": rows, "dt": dt,
+            "completed": len(rows), "failed": len(ids) - len(rows),
+            "tokens": completed_tokens,
+            "worker_ticks": state["worker_ticks"],
+            "peak": state["peak"],
+            "end_live": len(fleet._live_decode()),
+            "attainment": len(attain) / max(len(rows), 1),
+            "ticks": fleet.stats()["ticks"],
+        }
+
+    # warm-up: compiles land here so the arms compare steady states
+    drive(scfg.min_decode, autoscale=False)
+    om.reset()
+    om.enable(True)
+    try:
+        auto = drive(scfg.min_decode, autoscale=True)
+        peak = drive(scfg.max_decode, autoscale=False)
+        mini = drive(scfg.min_decode, autoscale=False)
+    finally:
+        om.enable(False)
+
+    both = sorted(set(auto["rows"]) & set(peak["rows"]))
+    identical = all(np.array_equal(auto["rows"][t], peak["rows"][t])
+                    for t in both)
+    greedy_ok = True
+    for t in both[:8]:
+        r = trace.requests[t]
+        if r.temperature > 0.0:
+            continue
+        ref = model.generate(paddle.to_tensor(r.prompt[None, :]),
+                             max_new_tokens=r.max_new_tokens
+                             ).numpy()[0]
+        greedy_ok = greedy_ok and np.array_equal(auto["rows"][t], ref)
+    compiles = max(
+        (d.engine.decode_compile_count()
+         for d in auto["fleet"].decode), default=1)
+    sc = auto["scaler"].stats()
+    return {
+        "serving_autoscale_requests": len(trace),
+        "serving_autoscale_burst_window": [int(b0), int(b1)],
+        "serving_autoscale_kill_tick": int(kill_tick),
+        "serving_autoscale_bit_identical_vs_peak": bool(identical),
+        "serving_autoscale_greedy_matches_generate": bool(greedy_ok),
+        "serving_autoscale_decode_compiles": int(compiles),
+        "serving_autoscale_scale_ups": sc["scale_ups"],
+        "serving_autoscale_scale_downs": sc["scale_downs"],
+        "serving_autoscale_removals": sc["removals"],
+        "serving_autoscale_peak_size": auto["peak"],
+        "serving_autoscale_end_size": auto["end_live"],
+        "serving_autoscale_returned_to_min": bool(
+            auto["end_live"] == scfg.min_decode),
+        "serving_autoscale_completed": auto["completed"],
+        "serving_autoscale_failed": auto["failed"],
+        "serving_autoscale_attainment": round(auto["attainment"], 4),
+        "serving_autoscale_attainment_static_peak": round(
+            peak["attainment"], 4),
+        "serving_autoscale_attainment_static_min": round(
+            mini["attainment"], 4),
+        "serving_autoscale_worker_ticks": auto["worker_ticks"],
+        "serving_autoscale_worker_ticks_static_peak":
+            peak["worker_ticks"],
+        "serving_autoscale_worker_ticks_static_min":
+            mini["worker_ticks"],
+        "serving_autoscale_worker_tick_ratio_vs_peak": round(
+            auto["worker_ticks"] / max(peak["worker_ticks"], 1), 3),
+        "serving_autoscale_goodput_per_worker_tick": round(
+            auto["tokens"] / max(auto["worker_ticks"], 1), 3),
+        "serving_autoscale_goodput_per_worker_tick_static_peak": round(
+            peak["tokens"] / max(peak["worker_ticks"], 1), 3),
+        "serving_autoscale_goodput_per_worker_tick_static_min": round(
+            mini["tokens"] / max(mini["worker_ticks"], 1), 3),
+        "serving_autoscale_tokens_per_sec": round(
+            auto["tokens"] / auto["dt"], 1) if auto["dt"] else 0.0,
+        "serving_autoscale_leaks": 0,
     }

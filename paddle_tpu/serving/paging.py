@@ -123,6 +123,8 @@ class BlockManager:
         self._digest_of: Dict[int, bytes] = {}  # registered blocks
         self._depth: Dict[bytes, int] = {}      # digest -> chain blocks
         self._cached: "OrderedDict[int, None]" = OrderedDict()
+        # block id -> prefix-index hits observed (eviction cost signal)
+        self._hits: Dict[int, int] = {}
         self.lookups = 0
         self.hit_blocks = 0
         self.evictions = 0
@@ -155,12 +157,33 @@ class BlockManager:
         the trade the fleet's watermark eviction arbitrates."""
         return 1.0 - len(self._free) / self.usable_blocks()
 
+    def _evict_victim(self) -> int:
+        """Pick and unregister the next cached block to evict. The
+        score is COST-AWARE, not pure LRU: least observed prefix-index
+        reuse first (a 24-block system prompt shared by 100 tenants
+        outlives a cold one-off chain of the same age), ties broken by
+        LRU age. With no recorded hits anywhere this degrades to
+        exactly the old LRU-first order."""
+        best, best_score = None, None
+        for pos, b in enumerate(self._cached):
+            score = (self._hits.get(b, 0), pos)
+            if best_score is None or score < best_score:
+                best, best_score = b, score
+        del self._cached[best]
+        digest = self._digest_of.pop(best)
+        del self._index[digest]
+        self._depth.pop(digest, None)
+        self._hits.pop(best, None)
+        self.evictions += 1
+        _M_BLK_EVICT.inc()
+        return best
+
     def allocate(self, n: int) -> Optional[List[int]]:
-        """n fresh blocks at refcount 1, evicting LRU cached prefix
-        blocks if the free list runs short; None if the pool can't
-        cover the request (caller re-queues). The ``serving.allocate``
-        fault site deterministically simulates transient exhaustion
-        (returns None with the pool untouched)."""
+        """n fresh blocks at refcount 1, evicting cached prefix blocks
+        (least-reused first, then LRU) if the free list runs short;
+        None if the pool can't cover the request (caller re-queues).
+        The ``serving.allocate`` fault site deterministically simulates
+        transient exhaustion (returns None with the pool untouched)."""
         if faults.should_fire("serving.allocate"):
             _M_ALLOC_FAIL.inc()
             return None
@@ -171,34 +194,25 @@ class BlockManager:
         for _ in range(n):
             if self._free:
                 b = self._free.pop()
-            else:                      # evict the LRU cached prefix
-                b, _ = self._cached.popitem(last=False)
-                digest = self._digest_of.pop(b)
-                del self._index[digest]
-                self._depth.pop(digest, None)
-                self.evictions += 1
-                _M_BLK_EVICT.inc()
+            else:
+                b = self._evict_victim()
             self._ref[b] = 1
             out.append(b)
         self._note_pool()
         return out
 
     def evict_cached(self, n: int) -> int:
-        """Evict up to ``n`` LRU-retained registered blocks back to the
-        free list (the fleet's watermark eviction tier drives this).
-        Referenced blocks are untouchable; returns the count actually
-        evicted. Directory consequences are the caller's: the owner's
-        next heartbeat publish simply no longer lists the digests."""
+        """Evict up to ``n`` retained registered blocks back to the
+        free list (the fleet's watermark eviction tier drives this),
+        least-reused-first with LRU tiebreak (see
+        :meth:`_evict_victim`). Referenced blocks are untouchable;
+        returns the count actually evicted. Directory consequences are
+        the caller's: the owner's next heartbeat publish simply no
+        longer lists the digests."""
         done = 0
         while done < n and self._cached:
-            b, _ = self._cached.popitem(last=False)
-            digest = self._digest_of.pop(b)
-            del self._index[digest]
-            self._depth.pop(digest, None)
-            self._free.append(b)
+            self._free.append(self._evict_victim())
             done += 1
-            self.evictions += 1
-            _M_BLK_EVICT.inc()
         if done:
             self._note_pool()
         return done
@@ -229,6 +243,9 @@ class BlockManager:
             parent = digest
         for b in blocks:
             self._acquire(b)
+            # reuse tally: the eviction tier's cost signal — every
+            # observed hit makes the block costlier to evict
+            self._hits[b] = self._hits.get(b, 0) + 1
         self.hit_blocks += len(blocks)
         _M_PFX_LOOKUPS.inc()
         _M_PFX_HITS.inc(len(blocks))
@@ -335,6 +352,10 @@ class BlockManager:
         assert not stale_depth, \
             f"chain-depth entries for unregistered digests: " \
             f"{sorted(d.hex() for d in stale_depth)}"
+        stale_hits = set(self._hits) - reg
+        assert not stale_hits, \
+            f"reuse tallies for unregistered blocks: " \
+            f"{sorted(stale_hits)}"
 
 
 class PagedModelStepBackend(ModelStepBackend):
@@ -932,6 +953,7 @@ class PagedEngine(ContinuousBatchingEngine):
             "lookups": m.lookups, "hit_blocks": m.hit_blocks,
             "depth": [[d.hex(), int(n)] for d, n in m._depth.items()],
             "evictions": m.evictions,
+            "hits": [[int(b), int(h)] for b, h in m._hits.items()],
         }
         jobs_meta = []
         for j, job in enumerate(self._jobs):
@@ -976,6 +998,8 @@ class PagedEngine(ContinuousBatchingEngine):
         m._depth = {d: n for d, n in m._depth.items()
                     if d in m._index}
         m.evictions = int(mm.get("evictions", 0))
+        m._hits = {int(b): int(h) for b, h in mm.get("hits", [])
+                   if int(b) in m._digest_of}
         m.assert_consistent()
         self._jobs = []
         for j, jm in enumerate(meta["jobs"]):

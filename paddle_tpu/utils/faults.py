@@ -46,6 +46,7 @@ fleet.transport        Transport.send (both kinds)    wire refuses send
 fleet.adopt            DecodeWorker.adopt             adopt-side crash
 fleet.fetch            Fleet._fetch_prefix op         fetch-op crash
 fleet.directory        Fleet._beat_one publish        one publish lost
+fleet.scale            Fleet add/drain/remove decode  scale action fails
 transport.partial_write SocketTransport frame write   torn TCP write
 transport.corrupt      SocketTransport frame write    flipped wire byte
 transport.disconnect   SocketTransport ack wait       ack loss/conn drop

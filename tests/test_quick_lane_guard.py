@@ -27,7 +27,8 @@ GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
                  "tests/test_megakernel.py", "tests/test_autotune.py",
                  "tests/test_frontend.py", "tests/test_fleet.py",
                  "tests/test_fleet_failover.py",
-                 "tests/test_prefix_cache.py"]
+                 "tests/test_prefix_cache.py",
+                 "tests/test_autoscaler.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -228,6 +229,26 @@ REQUIRED_NODES = [
     "test_chaos_fetch_sites_hold_invariants",
     "test_serving_paged.py::TestPrefixSharing::"
     "test_decode_time_block_sharing_extends_the_chain",
+    # PR 17 autoscaling pins: the deterministic trace generator's
+    # byte-identical replay + per-component stream independence, the
+    # decision kernel's hysteresis/cooldown/below-min contracts, the
+    # cost-aware prefix eviction, and the headline kill-and-burst
+    # matrix (autoscaled streams bit-identical to the static fleet,
+    # paged + paged+kv_int8, nothing ever recompiles)
+    "test_autoscaler.py::TestLoadgen::test_byte_identical_replay",
+    "test_autoscaler.py::TestLoadgen::"
+    "test_component_stream_independence",
+    "test_autoscaler.py::TestRecentQuantile::test_window_semantics",
+    "test_autoscaler.py::TestCostAwareEviction::"
+    "test_reused_prefix_outlives_cold_chain",
+    "test_autoscaler.py::TestDecisionKernel::"
+    "test_up_cooldown_suppresses_thrash",
+    "test_autoscaler.py::TestDecisionKernel::"
+    "test_lease_death_bypasses_cooldown",
+    "test_autoscaler.py::TestAutoscalerOnFleet::"
+    "test_scale_action_retries_under_faults",
+    "test_autoscaler.py::TestAutoscaleKillBurst::test_paged",
+    "test_autoscaler.py::TestAutoscaleKillBurst::test_paged_kv_int8",
 ]
 
 

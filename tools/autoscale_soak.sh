@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Seeded kill-and-burst autoscaling soak for the serving fleet (CPU
+# lane).
+#
+# Replays ONE seeded loadgen trace (steady traffic, a burst episode, a
+# decode-worker kill inside the burst, recovery) against an autoscaled
+# paged fleet — the control loop armed with min=2/max=4 — plus the
+# static-peak and static-min reference arms, and asserts the
+# autoscaling invariants:
+#   - every request completed OR ended in an explicit RequestFailure
+#   - completed streams bit-identical to the static-peak arm, greedy
+#     rows bit-identical to generate() (scale events never touch
+#     token streams)
+#   - zero block leaks on every surviving arena, including workers the
+#     autoscaler scaled in and drained out
+#   - the fleet returns to the min size after the burst clears
+#   - decode compile counts stay 1 through every scale-in
+#
+# Usage: tools/autoscale_soak.sh [SEED] [HORIZON]
+#   SEED     trace/kill schedule seed        (default 0)
+#   HORIZON  trace submit window, in ticks   (default 36)
+#
+# The same SEED replays the identical trace+kill schedule bit-for-bit.
+# Exits non-zero on any invariant violation.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SEED="${1:-0}"
+HORIZON="${2:-36}"
+
+JAX_PLATFORMS=cpu python - "$SEED" "$HORIZON" <<'PY'
+import json
+import sys
+
+import jax
+# the documented jaxlib landmine: a stale persistent compile cache can
+# corrupt the heap when additional paged backends compile in-process
+# (ROADMAP env note); scale-ups compile fresh decode backends, so
+# stay cold
+jax.config.update("jax_enable_compilation_cache", False)
+
+from paddle_tpu.serving.microbench import run_serving_autoscale_bench
+
+seed, horizon = (int(a) for a in sys.argv[1:3])
+out = run_serving_autoscale_bench(seed=seed, horizon=horizon)
+print("AUTOSCALE_JSON " + json.dumps(out))
+assert out["serving_autoscale_completed"] \
+    + out["serving_autoscale_failed"] \
+    == out["serving_autoscale_requests"], "request vanished"
+assert out["serving_autoscale_bit_identical_vs_peak"], \
+    "streams diverged across scale events"
+assert out["serving_autoscale_greedy_matches_generate"], \
+    "greedy rows diverged from generate()"
+assert out["serving_autoscale_returned_to_min"], \
+    "fleet did not drain back to the min size"
+assert out["serving_autoscale_decode_compiles"] == 1, \
+    "a scale event recompiled the decode block"
+assert out["serving_autoscale_leaks"] == 0
+print(f"autoscale soak OK: seed={seed} "
+      f"ups={out['serving_autoscale_scale_ups']} "
+      f"downs={out['serving_autoscale_scale_downs']} "
+      f"peak={out['serving_autoscale_peak_size']} "
+      f"end={out['serving_autoscale_end_size']} "
+      f"completed={out['serving_autoscale_completed']} "
+      f"failed={out['serving_autoscale_failed']}")
+PY
