@@ -58,6 +58,8 @@ SERVING_PREFIXCACHE_DEADLINE_S = env_float(
     "BENCH_SERVING_PREFIXCACHE_DEADLINE_S", 300)
 SERVING_AUTOSCALE_DEADLINE_S = env_float(
     "BENCH_SERVING_AUTOSCALE_DEADLINE_S", 300)
+SERVING_RECOVERY_DEADLINE_S = env_float(
+    "BENCH_SERVING_RECOVERY_DEADLINE_S", 300)
 AUTOTUNE_DEADLINE_S = env_float("BENCH_AUTOTUNE_DEADLINE_S", 300)
 # cheap tunnel-health probe (tiny matmul) before committing to a heavy
 # child: a wedged tunnel then costs PROBE_DEADLINE_S, not TPU_DEADLINE_S
@@ -870,7 +872,8 @@ def _run_child(mode: str, deadline: float):
                 "--child-serving-megakernel",
                 "--child-serving-frontdoor", "--child-serving-disagg",
                 "--child-serving-prefixcache",
-                "--child-serving-autoscale", "--child-autotune"):
+                "--child-serving-autoscale",
+                "--child-serving-recovery", "--child-autotune"):
         env["JAX_PLATFORMS"] = "cpu"
     if mode in ("--child-comms", "--child-serving-tp"):
         # simulated 2x4 mesh on the CPU lane
@@ -1235,6 +1238,33 @@ def _attach_serving_autoscale(result, budget_s=None):
                          SERVING_AUTOSCALE_DEADLINE_S, budget_s)
 
 
+def _child_serving_recovery():
+    """serving-recovery stage: the durable fleet control plane
+    (serving/durability.py + fleet.py) — ONE seeded workload run
+    clean, then run again with a checkpoint mid-traffic and a
+    whole-fleet crash two ticks later, recovered via Fleet.recover.
+    Pins bit-identity through the crash (every completed row matches
+    the clean arm token-for-token, greedy AND seeded-sampled),
+    recovery wall time, journal records replayed, streams redriven,
+    decode compiles staying 1 on the recovered arenas, zero leaks.
+    All fields non-null on the CPU lane; the TPU child stages the
+    same fleet."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.serving.microbench import run_serving_recovery_bench
+    out = run_serving_recovery_bench(
+        seed=env_int("BENCH_SERVING_RECOVERY_SEED", 0),
+        requests=env_int("BENCH_SERVING_RECOVERY_REQUESTS", 6),
+        max_new=env_int("BENCH_SERVING_RECOVERY_MAX_NEW", 10))
+    print("BENCH_JSON " + json.dumps(out), flush=True)
+
+
+def _attach_serving_recovery(result, budget_s=None):
+    return _attach_stage(result, "serving-recovery",
+                         "--child-serving-recovery",
+                         SERVING_RECOVERY_DEADLINE_S, budget_s)
+
+
 def _child_autotune():
     """autotune stage: the Pallas block-size sweep harness
     (ops/pallas/autotune.py) — sweeps every knob that is honest on this
@@ -1370,6 +1400,9 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-autoscale":
         _child_serving_autoscale()
         return
+    if len(sys.argv) > 1 and sys.argv[1] == "--child-serving-recovery":
+        _child_serving_recovery()
+        return
     if len(sys.argv) > 1 and sys.argv[1] == "--child-autotune":
         _child_autotune()
         return
@@ -1456,6 +1489,7 @@ def _main_measured(errors):
                 result = _attach_serving_failover(result, remaining())
                 result = _attach_serving_prefixcache(result, remaining())
                 result = _attach_serving_autoscale(result, remaining())
+                result = _attach_serving_recovery(result, remaining())
                 _emit_final(_attach_autotune(result, remaining()))
                 return
             errors.append(f"tpu attempt {attempt + 1}: {err}")
@@ -1487,6 +1521,7 @@ def _main_measured(errors):
         result = _attach_serving_failover(result, remaining())
         result = _attach_serving_prefixcache(result, remaining())
         result = _attach_serving_autoscale(result, remaining())
+        result = _attach_serving_recovery(result, remaining())
         _emit_final(_attach_autotune(result, remaining()))
         return
     # last resort: still one JSON line, rc 0, explicit marker

@@ -35,12 +35,32 @@ __all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
            "atomic_json_dump"]
 
 
+def _fsync_dir(dirname: str):
+    """fsync a DIRECTORY so a just-renamed entry is durable — without
+    it, the rename itself can vanish on power loss even though the
+    file contents were fsynced. Filesystems that refuse directory
+    fds (some network/overlay mounts) degrade to content-only
+    durability, same as before."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def atomic_write(path: str, write_fn, mode: str = "wb"):
     """Crash-safe file write: ``write_fn(f)`` goes to a same-directory
-    temp file which is fsynced and ``os.replace``d over ``path`` — a
-    reader (or a restart) sees either the old complete file or the new
-    complete file, never a torn write. Shared by checkpoint shards,
-    metadata, and the serving engine's snapshot files."""
+    temp file which is fsynced and ``os.replace``d over ``path``, and
+    the PARENT DIRECTORY is fsynced after the rename — a reader (or a
+    restart, or a power loss) sees either the old complete file or the
+    new complete file, never a torn write and never a vanished rename.
+    Shared by checkpoint shards, metadata, the serving engine's
+    snapshot files and the fleet's checkpoint manifests."""
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
         with open(tmp, mode) as f:
@@ -48,6 +68,7 @@ def atomic_write(path: str, write_fn, mode: str = "wb"):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(path))
     except BaseException:
         if os.path.exists(tmp):
             os.remove(tmp)

@@ -10,6 +10,7 @@ path (``inference.export_decoder(engine_slots=...)`` +
 serialized artifact alone."""
 from .autoscaler import (Autoscaler, AutoscalerConfig, DecisionKernel,
                          Observation)
+from .durability import PrefixSpillStore, WriteAheadJournal
 from .engine import (ArtifactStepBackend, ContinuousBatchingEngine,
                      ModelStepBackend, slot_sample_logits)
 from .fleet import (DecodeWorker, Fleet, FleetRouter, InProcessTransport,
@@ -41,14 +42,15 @@ __all__ = ["Autoscaler", "AutoscalerConfig", "ContinuousBatchingEngine",
            "PagedArtifactStepBackend", "PagedEngine",
            "PagedModelStepBackend", "PrefillDenseEngine",
            "PrefillPagedEngine", "PrefillWorker",
-           "PrefixCacheDirectory", "QuantConfig",
+           "PrefixCacheDirectory", "PrefixSpillStore", "QuantConfig",
            "Request", "RequestFailure", "ResilienceConfig",
            "ResumeState", "Scheduler", "Server", "SocketTransport",
            "SpecConfig", "SpecEngine", "SpecModelStepBackend",
            "SpecPagedEngine", "SpecPagedStepBackend",
            "ShardedModelStepBackend", "ShardedPagedStepBackend",
            "TPConfig", "TenantConfig", "TokenStream", "Transport",
-           "Trace", "TraceConfig", "TransportError", "adopt_prefix",
+           "Trace", "TraceConfig", "TransportError",
+           "WriteAheadJournal", "adopt_prefix",
            "decode_handoff", "encode_handoff", "extract_prefix",
            "generate_trace", "ngram_propose", "replay",
            "reshard_kv_chunks", "slot_sample_logits"]

@@ -83,6 +83,7 @@ eviction watermarks ``PT_SERVING_FLEET_EVICT_HIGH`` / ``_LOW``
 """
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -96,6 +97,7 @@ from ..observability import FlightRecorder
 from ..observability import metrics as _om
 from ..utils import faults
 from ..utils.flags import env_bool, env_float, env_int
+from . import durability as _dur
 from .engine import (ContinuousBatchingEngine, _M_PREFILLS, _M_TOKENS,
                      _SlotRun)
 from .handoff import KVHandoff, decode_handoff, encode_handoff
@@ -249,11 +251,56 @@ class _PrefillEngineMixin:
         self._release_slot_resources(ph.run)
 
     def snapshot_state(self):
-        if self._outbox:
-            raise RuntimeError(
-                "prefill worker holds un-shipped handoffs — drive the "
-                "fleet until the outbox drains before snapshotting")
-        return super().snapshot_state()
+        """Un-shipped handoffs RIDE the snapshot (PR 20) instead of
+        refusing it: each live outbox entry serializes alongside the
+        engine state — its run is a live slot, so the base snapshot
+        already carries the slot/blocks; this adds the parked
+        ship-side fields. A coordinated fleet checkpoint can therefore
+        land at ANY tick boundary."""
+        meta, arrays = super().snapshot_state()
+        ob_meta = []
+        for ph in self._outbox:
+            if ph.run.failure is not None \
+                    or self._slots[ph.slot] is not ph.run:
+                continue                    # cancelled — never ships
+            k = len(ob_meta)
+            arrays[f"ob{k}_prompt"] = np.asarray(ph.prompt, np.int32)
+            arrays[f"ob{k}_key"] = np.asarray(ph.key, np.uint32)
+            if ph.row is not None:
+                for i, r in enumerate(ph.row):
+                    arrays[f"ob{k}_row{i}"] = np.asarray(r)
+            ob_meta.append({
+                "slot": int(ph.slot), "tok0": int(ph.tok0),
+                "rem0": int(ph.rem0), "pad0": int(ph.pad0),
+                "bucket": int(ph.bucket),
+                "row": ph.row is not None,
+                "tokens": None if ph.tokens is None
+                else [int(t) for t in ph.tokens],
+                "orig_len": None if ph.orig_len is None
+                else int(ph.orig_len)})
+        meta["outbox"] = ob_meta
+        return meta, arrays
+
+    def restore_state(self, meta, arrays):
+        super().restore_state(meta, arrays)
+        self._outbox = []
+        n_leaves = len(self.backend.pool_specs)
+        for k, e in enumerate(meta.get("outbox", ())):
+            run = self._slots[e["slot"]]
+            if run is None:
+                continue
+            row = None
+            if e["row"]:
+                row = tuple(np.asarray(arrays[f"ob{k}_row{i}"])
+                            for i in range(n_leaves))
+            self._outbox.append(_PendingHandoff(
+                run=run, slot=int(e["slot"]),
+                prompt=np.asarray(arrays[f"ob{k}_prompt"], np.int32),
+                tok0=int(e["tok0"]), rem0=int(e["rem0"]),
+                key=np.asarray(arrays[f"ob{k}_key"], np.uint32),
+                row=row, pad0=int(e["pad0"]), bucket=int(e["bucket"]),
+                tokens=e["tokens"],
+                orig_len=e["orig_len"]))
 
 
 class PrefillPagedEngine(_PrefillEngineMixin, PagedEngine):
@@ -583,7 +630,8 @@ class PrefillWorker:
     around prefill faults — while decode never runs here."""
 
     def __init__(self, engine, *, name: str = "",
-                 scheduler=None, resilience=None, observability=None):
+                 scheduler=None, resilience=None, observability=None,
+                 server: Optional[Server] = None):
         if not isinstance(engine, (PrefillDenseEngine,
                                    PrefillPagedEngine)):
             raise ValueError(
@@ -592,8 +640,8 @@ class PrefillWorker:
                 f"{type(engine).__name__}")
         self.engine = engine
         self.name = name
-        self.server = Server(engine, scheduler, resilience,
-                             observability)
+        self.server = server or Server(engine, scheduler, resilience,
+                                       observability)
         self.killed = False
 
     def kill(self):
@@ -926,7 +974,9 @@ class Fleet:
                  lease_misses: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  evict_high: Optional[float] = None,
-                 evict_low: Optional[float] = None):
+                 evict_low: Optional[float] = None,
+                 durability: Optional[str] = None,
+                 spill_max_bytes: Optional[int] = None):
         if not prefill_workers or not decode_workers:
             raise ValueError("need at least one prefill and one decode "
                              "worker")
@@ -1026,6 +1076,59 @@ class Fleet:
         # rid -> (detection wall time) for redriven streams still open
         self._redrive_t0: Dict[int, float] = {}
         self._clock = 0
+        # -- durable control plane (PR 20) --
+        self.durability_dir: Optional[str] = None
+        self._dur_epoch = 0
+        self._journal: Optional[_dur.WriteAheadJournal] = None
+        self._spill: Optional[_dur.PrefixSpillStore] = None
+        # rid -> journaled token high-water mark / terminal written
+        self._journaled_progress: Dict[int, int] = {}
+        self._journaled_terminals: set = set()
+        self.recoveries = 0
+        self.last_recovery: Optional[dict] = None
+        if spill_max_bytes is None:
+            spill_max_bytes = env_int("PT_SERVING_SPILL_MAX_BYTES",
+                                      1 << 28)
+        self._spill_max_bytes = int(spill_max_bytes)
+        if durability is not None:
+            self._attach_durability(durability, epoch=0)
+            if self._journal.empty():
+                self._jrec({"k": "genesis",
+                            "prefill": [w.name for w in self.prefill],
+                            "decode": [d.name for d in self.decode]})
+
+    def _attach_durability(self, dirname: str, epoch: int):
+        """Open (or reopen, in recovery) the journal segment for
+        ``epoch`` and the spill tier under ``dirname``."""
+        os.makedirs(dirname, exist_ok=True)
+        self.durability_dir = dirname
+        self._dur_epoch = int(epoch)
+        self._journal = _dur.WriteAheadJournal(
+            _dur.journal_path(dirname, epoch))
+        if self.prefix_cache_enabled:
+            self._spill = _dur.PrefixSpillStore(
+                os.path.join(dirname, "spill"),
+                max_bytes=self._spill_max_bytes)
+
+    def _jrec(self, rec: dict):
+        """Append one control-plane record, retrying transient
+        failures with the fleet's seeded backoff. Durability is a HARD
+        contract: a permanently failing journal is a crashed fleet,
+        not a silently forgetful one."""
+        if self._journal is None:
+            return
+        last = None
+        for attempt in range(self.resilience.retry_attempts + 1):
+            try:
+                self._journal.append(rec)
+                return
+            except (faults.InjectedFault, OSError) as e:
+                last = e
+                if attempt < self.resilience.retry_attempts:
+                    time.sleep(self._res.backoff_s(attempt))
+        raise RuntimeError(
+            f"write-ahead journal append failed past the retry "
+            f"budget: {type(last).__name__}: {last}")
 
     def _check_compat(self):
         """Every engine in the fleet must share the KV layout — a
@@ -1111,6 +1214,12 @@ class Fleet:
             "prompt": prompt.copy(), "worker": w.name,
             "t_submit": time.perf_counter(),
             "kw": dict(kw, max_new_tokens=max_new_tokens)}
+        if self._journal is not None:
+            self._jrec({"k": "submit", "rid": int(rid),
+                        "prompt": [int(t) for t in prompt],
+                        "worker": w.name,
+                        "kw": {k: v for k, v in
+                               self._requests[rid]["kw"].items()}})
         return rid
 
     # -- liveness views ----------------------------------------------------
@@ -1235,6 +1344,17 @@ class Fleet:
                 "base_len": len(toks), "tokens0": list(toks),
                 "t_admit": float(h.meta["t_admit"])}
             self._progress[rid] = toks
+            if self._journal is not None:
+                self._jrec({
+                    "k": "ship", "rid": int(rid), "dst": dst,
+                    "seq": int(seq),
+                    "key0": [int(x) for x in
+                             np.asarray(h.arrays["key"],
+                                        np.uint32).reshape(-1)],
+                    "base_len": len(toks),
+                    "tokens0": [int(t) for t in toks],
+                    "t_admit": float(h.meta["t_admit"])})
+                self._journaled_progress[rid] = len(toks)
         else:
             reason = "circuit_open" if self._res.breaker_open \
                 else "handoff"
@@ -1280,6 +1400,11 @@ class Fleet:
             if ok and status == DecodeWorker.ADOPTED:
                 q.popleft()
                 self._assigned[d.name] -= 1
+                if self._journal is not None:
+                    self._jrec({"k": "adopt",
+                                "rid": int(h.request_id),
+                                "worker": d.name,
+                                "seq": int(h.meta.get("seq", 0))})
                 continue
             if ok and status == DecodeWorker.DUPLICATE:
                 # an ack-lost retransmit: the first copy already
@@ -1344,6 +1469,13 @@ class Fleet:
         depth, owners = self.directory.deepest_covered(
             full, eng.kv_block_size, eng.manager.hash_fn,
             exclude=exclude)
+        if self._spill is not None:
+            # the disk tier competes with live owners: strictly deeper
+            # spilled coverage wins (tie → live owner, it is fresher);
+            # ANY spill failure falls through to the remote path below
+            got = self._spill_fetch(w, full, local_blocks, depth)
+            if got is not None:
+                return got
         if depth <= n_local:
             return None                  # nothing beyond the local match
         if self._res.breaker_open:
@@ -1424,6 +1556,54 @@ class Fleet:
                                clock=self._clock)
         return fetched
 
+    def _spill_fetch(self, w: PrefillWorker, full, local_blocks,
+                     dir_depth: int) -> Optional[List[int]]:
+        """Serve a prefix fetch from the disk spill tier: deepest
+        spilled chain on the prompt's digest path, CRC-verified,
+        token-compared, re-skipped past the local match and adopted
+        through the SAME scatter as a live fetch — bit-identical state
+        either way. Every failure (armed ``spill.read``, unreadable
+        file, CRC/collision mismatch, full pool) counts a miss and
+        returns None: the caller falls back to a live owner or local
+        prefill."""
+        eng = w.engine
+        n_local = len(local_blocks)
+        sdepth, digest = self._spill.lookup(
+            full, eng.kv_block_size, eng.manager.hash_fn)
+        if digest is None or sdepth <= max(dir_depth, n_local):
+            return None
+        try:
+            h = self._spill.read(digest)
+        except (faults.InjectedFault, OSError, ValueError):
+            self._spill.note_miss()
+            self._note_fetch_fail("spill")
+            return None
+        bs = eng.kv_block_size
+        stored = [int(t) for t in h.arrays["tokens"][:sdepth * bs]]
+        if stored != [int(t) for t in full[:sdepth * bs]] \
+                or int(h.meta.get("n_blocks", 0)) != sdepth:
+            self._spill.note_miss()      # hash collision / stale file
+            self._note_fetch_fail("spill")
+            return None
+        try:
+            got = adopt_prefix(eng, _dur.slice_prefix_payload(
+                h, n_local), local_blocks, full)
+        except ValueError:
+            self._spill.note_miss()      # incompatible payload
+            self._note_fetch_fail("spill")
+            return None
+        if got is None:
+            self._spill.note_miss()
+            self._note_fetch_fail("pool_full")
+            return None
+        self._spill.note_hit()
+        self.prefix_fetches += 1
+        self.prefix_fetch_blocks += len(got)
+        self.flight.record("prefix_spill_hit", worker=w.name,
+                           blocks=len(got), depth=sdepth,
+                           clock=self._clock)
+        return got
+
     def _evict_tick(self):
         """Watermark eviction: when fleet-global block pressure (the
         fraction of usable blocks not free, summed over every live
@@ -1432,29 +1612,65 @@ class Fleet:
         back at ``evict_low``. Referenced blocks are untouchable, so
         live streams never lose state; the owners' next heartbeats
         retract the evicted digests from the directory."""
-        mgrs = [w.engine.manager for w in self.prefill
+        pool = [(w.engine, w.name) for w in self.prefill
                 if self._alive(w.name)] \
-            + [d.engine.manager for d in self.decode
+            + [(d.engine, d.name) for d in self.decode
                if self._alive(d.name)]
-        usable = sum(m.usable_blocks() for m in mgrs)
+        usable = sum(e.manager.usable_blocks() for e, _ in pool)
         if not usable:
             return
-        free = sum(len(m._free) for m in mgrs)
+        free = sum(len(e.manager._free) for e, _ in pool)
         if 1.0 - free / usable <= self.evict_high:
             return
         need = int(np.ceil((1.0 - self.evict_low) * usable)) - free
         done = 0
-        for m in sorted(mgrs, key=lambda m: m.block_pressure(),
-                        reverse=True):
+        for e, name in sorted(pool,
+                              key=lambda p: p[0].manager
+                              .block_pressure(), reverse=True):
             if need <= 0:
                 break
-            n = m.evict_cached(need)
+            if self._spill is not None:
+                self._spill_victims(e, name, need)
+            n = e.manager.evict_cached(need)
             need -= n
             done += n
         if done:
             self.prefix_evictions += done
             self.flight.record("prefix_evict", blocks=done,
                                clock=self._clock)
+
+    def _spill_victims(self, engine, name: str, n: int):
+        """Copy the chains about to be watermark-evicted from
+        ``engine``'s arena into the disk spill tier — BEFORE
+        ``evict_cached`` frees them, via the side-effect-free preview
+        + extraction (the spill must not perturb which blocks the
+        eviction then picks). Deepest chains only, deduped by prefix
+        containment; a failed spill write is a lost optimization,
+        never a failed eviction."""
+        m = engine.manager
+        victims = set(m.eviction_victims(n))
+        if not victims:
+            return
+        tok_map = m.chain_tokens_map()
+        cands = []
+        for b in victims:
+            d = m._digest_of.get(b)
+            t = tok_map.get(d) if d is not None else None
+            if t is not None:
+                cands.append((m._depth.get(d, 0), d, t))
+        cands.sort(key=lambda c: (-c[0], c[1]))
+        kept = []
+        for depth, d, t in cands:
+            if any(kt[:len(t)] == t for _, _, kt in kept):
+                continue            # covered by a deeper kept chain
+            kept.append((depth, d, t))
+        for depth, d, t in kept:
+            try:
+                h = _dur.extract_chain(engine, t, depth, source=name)
+                if h is not None:
+                    self._spill.put(d, h)
+            except (faults.InjectedFault, OSError, ValueError):
+                continue            # spill is best-effort by contract
 
     def tick(self):
         """One fleet tick: prefill advance → ship → deliver/adopt →
@@ -1485,6 +1701,10 @@ class Fleet:
             self._evict_tick()
         if self._redrive_t0:
             self._settle_redrives()
+        if self._journal is not None:
+            # terminals journal BEFORE the gc can drop their records —
+            # a crash after gc must still know the stream concluded
+            self._journal_terminals()
         if self._clock % 64 == 0:
             self._gc_records()
         if _om.enabled():
@@ -1553,6 +1773,18 @@ class Fleet:
             for rid, toks in hb["progress"].items():
                 if rid in self._handoffs:
                     self._progress[rid] = list(toks)
+                    if self._journal is not None:
+                        n0 = self._journaled_progress.get(rid, 0)
+                        if len(toks) > n0:
+                            # high-water marks journal as DELTAS; the
+                            # only-extend replay guard makes them
+                            # idempotent over a newer manifest
+                            self._jrec({
+                                "k": "progress", "rid": int(rid),
+                                "base": int(n0),
+                                "ext": [int(t)
+                                        for t in toks[n0:]]})
+                            self._journaled_progress[rid] = len(toks)
 
     def _declare_dead(self, worker, role: str):
         h = self._health[worker.name]
@@ -1566,6 +1798,12 @@ class Fleet:
         # the dead worker's directory entries expire with its lease —
         # later fetches stop considering it immediately
         self.directory.drop_worker(worker.name)
+        if self._journal is not None:
+            # recovery must NOT restore a worker that died post-
+            # checkpoint: its streams redrive below, producing fresh
+            # ship records the restored corpse would conflict with
+            self._jrec({"k": "scale", "action": "dead",
+                        "worker": worker.name, "role": role})
         if role == "decode":
             self._recover_decode_streams(worker)
         else:
@@ -1585,6 +1823,49 @@ class Fleet:
         return (rid in self._failures or rid in self._local_results
                 or any(rid in w.server.results for w in self.prefill)
                 or any(rid in d.server.results for d in self.decode))
+
+    def _terminal_value(self, rid: int):
+        """The terminal row/failure for ``rid``, or None while the
+        stream is still open."""
+        if rid in self._failures:
+            return self._failures[rid]
+        if rid in self._local_results:
+            return self._local_results[rid]
+        for w in self.prefill:
+            v = w.server.results.get(rid)
+            if v is not None:
+                return v
+        for d in self.decode:
+            v = d.server.results.get(rid)
+            if v is not None:
+                return v
+        return None
+
+    def _journal_terminals(self):
+        """Journal every terminal not yet written: completed ROWS ride
+        the journal (first-write-wins), so finished results survive a
+        whole-process crash without re-decoding — the worker results
+        ledgers live in hub memory otherwise."""
+        for rid in list(self._requests):
+            if rid in self._journaled_terminals:
+                continue
+            v = self._terminal_value(rid)
+            if v is None:
+                continue
+            if isinstance(v, RequestFailure):
+                self._jrec({"k": "terminal", "rid": int(rid),
+                            "failure": {
+                                "reason": v.reason,
+                                "message": v.message,
+                                "tokens_emitted":
+                                    int(v.tokens_emitted)}})
+            else:
+                self._jrec({"k": "terminal", "rid": int(rid),
+                            "tokens": [int(t)
+                                       for t in np.asarray(v)
+                                       .reshape(-1)]})
+            self._journaled_terminals.add(rid)
+            self._journaled_progress.pop(rid, None)
 
     def _recover_decode_streams(self, d: DecodeWorker):
         """Every stream the dead decode worker owned — adopted,
@@ -1724,6 +2005,8 @@ class Fleet:
             self._requests.pop(rid, None)
             self._handoffs.pop(rid, None)
             self._progress.pop(rid, None)
+            self._journaled_progress.pop(rid, None)
+        self._journaled_terminals &= set(self._requests)
 
     def _settle_redrives(self):
         """Close the redrive-latency clock for redriven streams that
@@ -1802,6 +2085,16 @@ class Fleet:
                               for n, h in sorted(self._health.items())},
             "transport": self.transport.stats()
             if hasattr(self.transport, "stats") else None,
+            "durability": None if self.durability_dir is None else {
+                "dir": self.durability_dir,
+                "epoch": self._dur_epoch,
+                "journal_seq": self._journal.seq,
+                "journal_appends": self._journal.appends,
+                "journal_bytes": self._journal.bytes_written,
+                "recoveries": self.recoveries,
+                "last_recovery": self.last_recovery,
+                "spill": self._spill.stats()
+                if self._spill is not None else None},
             "prefill_workers": [
                 {"name": w.name, "state": self._health[w.name]["state"],
                  "queue": w.queue_depth(),
@@ -1824,6 +2117,370 @@ class Fleet:
                 for d in self.decode],
         }
 
+    # -- durable control plane: checkpoint / recover (PR 20) ---------------
+    def checkpoint(self) -> str:
+        """Coordinated fleet checkpoint at a tick boundary: snapshot
+        every live worker's Server (the PR 5 npz path — un-shipped
+        outboxes now ride it), then commit fleet registries + topology
+        + the flight ring ATOMICALLY by renaming the epoch manifest
+        into place. The rename is THE commit: only after it does the
+        journal rotate to a fresh segment (the old one is fully
+        absorbed) and stale epochs get pruned. A crash anywhere in
+        between recovers from the previous epoch's manifest+journal —
+        every window is covered. Returns the manifest path."""
+        if self.durability_dir is None:
+            raise RuntimeError(
+                "fleet has no durability directory — construct with "
+                "durability=<dir> to enable checkpoints")
+        d = self.durability_dir
+        epoch = self._dur_epoch + 1
+        # the checkpoint event goes into the ring BEFORE capture so
+        # the recovered fleet's history includes it (PR 6 contract)
+        self.flight.record("checkpoint", epoch=epoch,
+                           clock=self._clock)
+        workers = []
+        for i, w in enumerate(self.prefill):
+            if w.killed or not self._alive(w.name):
+                continue        # a corpse's state is unreadable by
+            snap = os.path.basename(    # contract; its streams redrive
+                _dur.snapshot_path(d, epoch, w.name))
+            w.server.snapshot(os.path.join(d, snap))
+            workers.append({"name": w.name, "role": "prefill",
+                            "snapshot": snap,
+                            "draining": i in self._draining})
+        for dw in self.decode:
+            if dw.killed or not self._alive(dw.name):
+                continue
+            snap = os.path.basename(
+                _dur.snapshot_path(d, epoch, dw.name))
+            dw.server.snapshot(os.path.join(d, snap))
+            workers.append({"name": dw.name, "role": "decode",
+                            "snapshot": snap,
+                            "draining":
+                                dw.name in self._draining_decode})
+        manifest = {
+            "clock": self._clock,
+            "workers": workers,
+            "requests": {str(rid): {
+                "prompt": [int(t) for t in rec["prompt"]],
+                "worker": rec["worker"], "kw": dict(rec["kw"])}
+                for rid, rec in self._requests.items()},
+            "handoffs": {str(rid): {
+                "dst": h["dst"],
+                "key0": [int(x) for x in
+                         np.asarray(h["key0"]).reshape(-1)],
+                "base_len": int(h["base_len"]),
+                "tokens0": [int(t) for t in h["tokens0"]],
+                "t_admit": float(h["t_admit"])}
+                for rid, h in self._handoffs.items()},
+            "progress": {str(rid): [int(t) for t in toks]
+                         for rid, toks in self._progress.items()},
+            "failures": {str(rid): {
+                "reason": f.reason, "message": f.message,
+                "tokens_emitted": int(f.tokens_emitted)}
+                for rid, f in self._failures.items()},
+            "local_results": {str(rid): [int(t) for t in
+                                         np.asarray(v).reshape(-1)]
+                              for rid, v in
+                              self._local_results.items()},
+            "router": {"affinity_routes": self.router.affinity_routes,
+                       "spillovers": self.router.spillovers},
+            "handoff_seq": self._handoff_seq,
+            "fetch_seq": self._fetch_seq,
+            "counters": {
+                "handoffs": self.handoffs,
+                "migrations": self.migrations,
+                "redrives": self.redrives,
+                "workers_lost": self.workers_lost,
+                "prefix_evictions": self.prefix_evictions,
+                "prefix_fetches": self.prefix_fetches,
+                "prefix_fetch_blocks": self.prefix_fetch_blocks,
+                "recoveries": self.recoveries},
+            "flight": self.flight.to_meta(),
+        }
+        path = _dur.write_manifest(d, epoch, manifest)
+        # the commit landed: rotate to the fresh segment and remember
+        # what the manifest already absorbed so nothing re-journals
+        self._journal.close()
+        self._attach_durability(d, epoch)
+        self._journaled_terminals = {
+            rid for rid in self._requests if self._terminal(rid)}
+        self._journaled_progress = {
+            rid: len(toks) for rid, toks in self._progress.items()}
+        self._prune_durability(epoch)
+        return path
+
+    def _prune_durability(self, keep_epoch: int):
+        """Delete manifests/journals/snapshots of epochs older than
+        ``keep_epoch`` — including orphans of checkpoints that crashed
+        before their commit."""
+        d = self.durability_dir
+        for name in os.listdir(d):
+            for pfx in ("manifest-", "journal-", "ckpt-"):
+                if not name.startswith(pfx):
+                    continue
+                stem = name[len(pfx):].split("-", 1)[0] \
+                    .split(".", 1)[0]
+                if stem.isdigit() and int(stem) < keep_epoch:
+                    try:
+                        os.remove(os.path.join(d, name))
+                    except OSError:
+                        pass
+
+    @classmethod
+    def recover(cls, dirname: str, *, engine_factory,
+                transport: Optional[Transport] = None,
+                **fleet_kw) -> "Fleet":
+        """Cold-start recovery of a whole killed fleet: load the
+        newest VALID manifest (torn ones discarded loudly), replay the
+        journal tail (torn tail truncated loudly), rebuild every
+        worker via ``engine_factory(role, name)`` + ``Server.restore``,
+        purge streams the journal knows concluded, and REDRIVE every
+        stream that was in flight — queued, mid-prefill, shipped-in-
+        transit, adopted — with the PR 15 host-replayed key machinery,
+        so completed rows are BIT-IDENTICAL to an uncrashed run. The
+        recovered fleet continues journaling into the same epoch
+        segment."""
+        epoch, manifest = _dur.load_latest_manifest(dirname)
+        if manifest is None:
+            epochs = _dur.list_epochs(dirname, "journal")
+            if not epochs:
+                raise FileNotFoundError(
+                    f"no checkpoint manifest or journal under "
+                    f"{dirname!r} — nothing to recover")
+            epoch = epochs[-1]
+        records, torn = _dur.WriteAheadJournal.replay(
+            _dur.journal_path(dirname, epoch))
+        # -- topology: manifest workers (or journal genesis), then the
+        # journal's scale/death records applied in order --
+        if manifest is not None:
+            spec = [dict(e) for e in manifest["workers"]]
+        else:
+            gen = next((r for r in records
+                        if r.get("k") == "genesis"), None)
+            if gen is None:
+                raise RuntimeError(
+                    f"journal epoch {epoch} has no genesis record and "
+                    "no manifest — cannot derive the fleet topology")
+            spec = [{"name": n, "role": "prefill", "snapshot": None,
+                     "draining": False} for n in gen["prefill"]] \
+                + [{"name": n, "role": "decode", "snapshot": None,
+                    "draining": False} for n in gen["decode"]]
+        for r in records:
+            if r.get("k") != "scale":
+                continue
+            a, n = r["action"], r["worker"]
+            if a == "add_decode":
+                spec.append({"name": n, "role": "decode",
+                             "snapshot": None, "draining": False})
+            elif a in ("remove_decode", "remove_prefill", "dead"):
+                spec = [e for e in spec if e["name"] != n]
+            elif a in ("drain_decode", "drain_prefill"):
+                for e in spec:
+                    if e["name"] == n:
+                        e["draining"] = True
+            elif a == "undrain_decode":
+                for e in spec:
+                    if e["name"] == n:
+                        e["draining"] = False
+        pws: List[PrefillWorker] = []
+        dws: List[DecodeWorker] = []
+        for e in spec:
+            eng = engine_factory(e["role"], e["name"])
+            srv = None
+            if e.get("snapshot"):
+                srv = Server.restore(
+                    os.path.join(dirname, e["snapshot"]), eng)
+            if e["role"] == "prefill":
+                pws.append(PrefillWorker(eng, name=e["name"],
+                                         server=srv))
+            else:
+                dws.append(DecodeWorker(eng, name=e["name"],
+                                        server=srv))
+        fleet = cls(pws, dws, transport=transport, **fleet_kw)
+        # -- registries: manifest base, then the journal overlay
+        # applied sequentially (idempotent: progress only extends,
+        # terminals first-write-wins) --
+        if manifest is not None:
+            fleet._clock = int(manifest.get("clock", 0))
+            now = time.perf_counter()
+            for rid_s, m in manifest["requests"].items():
+                fleet._requests[int(rid_s)] = {
+                    "prompt": np.asarray(m["prompt"], np.int32),
+                    "worker": m["worker"], "t_submit": now,
+                    "kw": dict(m["kw"])}
+            for rid_s, m in manifest["handoffs"].items():
+                fleet._handoffs[int(rid_s)] = {
+                    "dst": m["dst"],
+                    "key0": np.asarray(m["key0"], np.uint32),
+                    "base_len": int(m["base_len"]),
+                    "tokens0": list(m["tokens0"]),
+                    "t_admit": float(m["t_admit"])}
+            fleet._progress = {int(r): list(t) for r, t in
+                               manifest["progress"].items()}
+            for rid_s, m in manifest["failures"].items():
+                rid = int(rid_s)
+                fleet._failures[rid] = RequestFailure(
+                    request_id=rid, reason=m["reason"],
+                    message=m["message"],
+                    tokens_emitted=int(m["tokens_emitted"]))
+            fleet._local_results = {
+                int(r): np.asarray(t, np.int32)
+                for r, t in manifest["local_results"].items()}
+            fleet.router.affinity_routes = \
+                int(manifest["router"]["affinity_routes"])
+            fleet.router.spillovers = \
+                int(manifest["router"]["spillovers"])
+            fleet._handoff_seq = int(manifest["handoff_seq"])
+            fleet._fetch_seq = int(manifest["fetch_seq"])
+            c = manifest.get("counters", {})
+            fleet.handoffs = int(c.get("handoffs", 0))
+            fleet.migrations = int(c.get("migrations", 0))
+            fleet.redrives = int(c.get("redrives", 0))
+            fleet.workers_lost = int(c.get("workers_lost", 0))
+            fleet.prefix_evictions = int(c.get("prefix_evictions", 0))
+            fleet.prefix_fetches = int(c.get("prefix_fetches", 0))
+            fleet.prefix_fetch_blocks = \
+                int(c.get("prefix_fetch_blocks", 0))
+            fleet.recoveries = int(c.get("recoveries", 0))
+            # the fleet flight ring survives the crash with continuing
+            # seqs — the same contract PR 6 pinned for Server
+            fleet.flight.restore_meta(manifest["flight"])
+        name_to_pi = {w.name: i for i, w in enumerate(fleet.prefill)}
+        for e in spec:
+            if e.get("draining"):
+                if e["role"] == "prefill":
+                    fleet._draining.add(name_to_pi[e["name"]])
+                else:
+                    fleet._draining_decode.add(e["name"])
+        now = time.perf_counter()
+        for r in records:
+            k = r.get("k")
+            if k == "submit":
+                fleet._requests[int(r["rid"])] = {
+                    "prompt": np.asarray(r["prompt"], np.int32),
+                    "worker": r["worker"], "t_submit": now,
+                    "kw": dict(r["kw"])}
+            elif k == "ship":
+                rid = int(r["rid"])
+                fleet._handoffs[rid] = {
+                    "dst": r["dst"],
+                    "key0": np.asarray(r["key0"], np.uint32),
+                    "base_len": int(r["base_len"]),
+                    "tokens0": list(r["tokens0"]),
+                    "t_admit": float(r["t_admit"])}
+                fleet._progress[rid] = list(r["tokens0"])
+                fleet._handoff_seq = max(fleet._handoff_seq,
+                                         int(r["seq"]))
+            elif k == "progress":
+                rid = int(r["rid"])
+                cur = fleet._progress.get(rid)
+                base = int(r["base"])
+                if cur is None or base > len(cur):
+                    continue        # its ship record fell in a torn
+                cand = cur[:base] + list(r["ext"])      # tail — the
+                if len(cand) > len(cur):    # redrive uses what stands
+                    fleet._progress[rid] = cand
+            elif k == "terminal":
+                rid = int(r["rid"])
+                if fleet._terminal(rid):
+                    continue                # first write wins
+                if "failure" in r:
+                    f = r["failure"]
+                    fleet._failures[rid] = RequestFailure(
+                        request_id=rid, reason=f["reason"],
+                        message=f["message"],
+                        tokens_emitted=int(f["tokens_emitted"]))
+                else:
+                    fleet._local_results[rid] = np.asarray(
+                        r["tokens"], np.int32)
+        # fresh submissions must never reuse a pre-crash rid: bump
+        # every prefill server's allocator past the ids its range is
+        # known to have issued (snapshots cover their own, but rids
+        # issued AFTER the checkpoint only exist in the journal)
+        known = set(fleet._requests) | set(fleet._failures) \
+            | set(fleet._local_results)
+        for i, w in enumerate(fleet.prefill):
+            base, hi = (i + 1) * 1_000_000, (i + 2) * 1_000_000
+            mx = max((rid for rid in known if base <= rid < hi),
+                     default=None)
+            if mx is not None and w.server._next_id <= mx:
+                w.server._next_id = mx + 1
+        fleet._attach_durability(dirname, epoch)
+        fleet._journaled_progress = {
+            rid: len(toks) for rid, toks in fleet._progress.items()}
+        fleet._journaled_terminals = {
+            rid for rid in fleet._requests if fleet._terminal(rid)}
+        # -- purge: streams the control plane knows concluded must not
+        # decode again on a restored worker (exactly ONE terminal per
+        # request across pre- and post-crash traces) --
+        fleet._purge_terminal_streams()
+        # -- redrive: everything in flight that no restored worker
+        # owns reconstructs from the records, exactly as if the owner
+        # alone had died (PR 15) --
+        owned = fleet._owned_rids()
+        redriven = 0
+        for rid in sorted(fleet._requests):
+            if rid in owned or fleet._terminal(rid):
+                continue
+            redriven += 1
+            if rid in fleet._handoffs:
+                fleet._redrive(rid)
+            else:
+                fleet._reinject(rid, None)
+        fleet.recoveries += 1
+        fleet.last_recovery = {
+            "epoch": int(epoch), "replayed": len(records),
+            "torn_tail": bool(torn), "redriven": redriven,
+            "workers": len(spec)}
+        _dur._M_J_REPLAYS.inc(len(records))
+        _dur._M_CKPT_RECOVERIES.inc()
+        fleet.flight.record("recovered", epoch=int(epoch),
+                            clock=fleet._clock,
+                            replayed=len(records), redriven=redriven)
+        return fleet
+
+    def _owned_rids(self) -> set:
+        """Every rid a restored worker holds live — queued, mid-
+        prefill, parked in an outbox (an outbox run occupies its
+        slot), or decoding. Owned streams finish on their own,
+        bit-identically: the decode block is a pure function of the
+        restored state."""
+        owned = set()
+        for worker in list(self.prefill) + list(self.decode):
+            for r in worker.server.scheduler._queue:
+                owned.add(r.request_id)
+            for _slot, run in worker.engine.live_runs():
+                owned.add(run.request.request_id)
+        return owned
+
+    def _purge_terminal_streams(self):
+        done = [rid for rid in self._requests if self._terminal(rid)]
+        for rid in done:
+            for w in self.prefill:
+                self._purge_from_worker(w, rid, prefill=True)
+            for d in self.decode:
+                self._purge_from_worker(d, rid, prefill=False)
+
+    def _purge_from_worker(self, worker, rid: int, prefill: bool):
+        """Remove every live trace of a concluded stream from a
+        restored worker: queue entry, outbox hold, occupied slot —
+        and the cancel artifact itself, so the server never harvests
+        a SECOND terminal for the rid."""
+        eng = worker.engine
+        worker.server.scheduler.drop_where(
+            lambda r: r.request_id == rid)
+        if prefill:
+            for ph in list(eng._outbox):
+                if ph.run.request.request_id == rid:
+                    eng._outbox.remove(ph)
+                    eng.release_handoff(ph)
+        for slot, run in eng.live_runs():
+            if run.request.request_id == rid:
+                eng.cancel_slot(slot, "recovered_terminal")
+        eng._finished = [r for r in eng._finished
+                         if r.request.request_id != rid]
+
     # -- scale / migration -------------------------------------------------
     def add_decode_worker(self, worker: DecodeWorker):
         """Scale up the decode pool mid-stream; the least-loaded pick
@@ -1845,6 +2502,9 @@ class Fleet:
         self._assigned[worker.name] = 0
         self._health[worker.name] = {"state": "live", "misses": 0}
         _M_WORKER_STATE.set(1, worker=worker.name)
+        if self._journal is not None:
+            self._jrec({"k": "scale", "action": "add_decode",
+                        "worker": worker.name})
 
     def drain_decode_worker(self, idx: int):
         """Stop routing new handoffs to decode worker ``idx``; its
@@ -1868,6 +2528,9 @@ class Fleet:
         self._draining_decode.add(name)
         self.flight.record("decode_drain", worker=name,
                            clock=self._clock)
+        if self._journal is not None:
+            self._jrec({"k": "scale", "action": "drain_decode",
+                        "worker": name})
 
     def undrain_decode_worker(self, idx: int):
         """Cancel a pending drain — the cheap scale-up when traffic
@@ -1881,6 +2544,9 @@ class Fleet:
             self._draining_decode.discard(name)
             self.flight.record("decode_undrain", worker=name,
                                clock=self._clock)
+            if self._journal is not None:
+                self._jrec({"k": "scale", "action": "undrain_decode",
+                            "worker": name})
 
     def remove_decode_worker(self, idx: int) -> DecodeWorker:
         """Scale down: remove a DRAINED decode worker. Refused while
@@ -1918,6 +2584,13 @@ class Fleet:
         _M_WORKER_STATE.set(0, worker=d.name)
         self.flight.record("decode_remove", worker=d.name,
                            clock=self._clock)
+        if self._journal is not None:
+            # completed results move into _local_results above; the
+            # terminal scan journals any not yet written, so removal
+            # never loses a result across a crash
+            self._journal_terminals()
+            self._jrec({"k": "scale", "action": "remove_decode",
+                        "worker": d.name})
         return d
 
     def migrate_decode_worker(self, idx: int, engine,
@@ -1958,6 +2631,9 @@ class Fleet:
             raise ValueError("cannot drain the last routable prefill "
                              "worker")
         self._draining.add(idx)
+        if self._journal is not None:
+            self._jrec({"k": "scale", "action": "drain_prefill",
+                        "worker": self.prefill[idx].name})
 
     def remove_prefill_worker(self, idx: int):
         if self.prefill[idx].busy():
@@ -1972,4 +2648,7 @@ class Fleet:
         self._fetch_endpoints.discard(ep)
         self._draining = {i - 1 if i > idx else i
                           for i in self._draining}
+        if self._journal is not None:
+            self._jrec({"k": "scale", "action": "remove_prefill",
+                        "worker": w.name})
         return w

@@ -36,7 +36,8 @@ __all__ = ["run_fleet_kill_soak", "run_serving_autoscale_bench",
            "run_serving_failover_bench", "run_serving_frontdoor_bench",
            "run_serving_megakernel_bench",
            "run_serving_prefixcache_bench", "run_serving_quant_bench",
-           "run_serving_spec_bench", "run_serving_tp_bench"]
+           "run_serving_recovery_bench", "run_serving_spec_bench",
+           "run_serving_tp_bench"]
 
 
 def run_serving_disagg_bench(requests_per_group: int = 6,
@@ -1315,4 +1316,156 @@ def run_serving_autoscale_bench(seed: int = 0, horizon: int = 36,
         "serving_autoscale_tokens_per_sec": round(
             auto["tokens"] / auto["dt"], 1) if auto["dt"] else 0.0,
         "serving_autoscale_leaks": 0,
+    }
+
+
+def run_serving_recovery_bench(seed: int = 0, requests: int = 6,
+                               max_new: int = 10) -> dict:
+    """Durable-control-plane stage (serving/durability.py +
+    fleet.py): ONE seeded workload run twice — a CLEAN arm straight
+    to idle, and a CRASHED arm that checkpoints mid-traffic, submits
+    more, is killed two ticks later with streams in every state, and
+    comes back via ``Fleet.recover``.
+
+    What the stage pins every round:
+
+    - **bit-identity through the crash**: every row the crashed arm
+      completes must equal the clean arm's token-for-token (greedy
+      AND seeded-sampled) — the whole point of journaled rng keys +
+      redrive;
+    - **recovery cost**: wall time of ``Fleet.recover`` itself
+      (manifest load + journal replay + worker restore + redrive
+      dispatch), the journal records replayed, and the streams
+      redriven;
+    - the compile-count pin: recovery reuses the restored arenas —
+      decode compiles stay 1 per engine, no new programs on the
+      steady path;
+    - zero block leaks on every recovered arena.
+    """
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.serving import (ContinuousBatchingEngine,
+                                    DecodeWorker, Fleet,
+                                    PrefillPagedEngine, PrefillWorker,
+                                    RequestFailure)
+
+    paddle.seed(0)
+    cfg = llama_tiny_config(tensor_parallel=False)
+    model = LlamaForCausalLM(cfg)
+    kw = dict(num_slots=2, max_len=64, decode_block=4, block_size=8,
+              prefill_chunk=8)
+    rs = np.random.RandomState(seed)
+    lens = rs.randint(5, 18, size=requests)
+    prompts = [rs.randint(0, cfg.vocab_size, (int(L),)).astype(np.int32)
+               for L in lens]
+    sample_kw = [{} if i % 3 else
+                 {"temperature": 0.9, "top_k": 40, "seed": 11 + i}
+                 for i in range(requests)]
+
+    pf = [PrefillPagedEngine(model, **kw) for _ in range(2)]
+    dc = [ContinuousBatchingEngine(model, paged=True, **kw)
+          for _ in range(2)]
+    by_name = {f"prefill{i}": e for i, e in enumerate(pf)}
+    by_name.update({f"decode{i}": e for i, e in enumerate(dc)})
+
+    def submit_all(fleet):
+        """First half before the mid-run boundary, second half after —
+        the caller decides what the boundary is (checkpoint or just
+        ticks). Returns {rid: prompt index}."""
+        rid_of = {}
+        for i in range(requests // 2):
+            rid_of[fleet.submit(prompts[i], max_new_tokens=max_new,
+                                **sample_kw[i])] = i
+        return rid_of
+
+    def submit_rest(fleet, rid_of):
+        for i in range(requests // 2, requests):
+            rid_of[fleet.submit(prompts[i], max_new_tokens=max_new,
+                                **sample_kw[i])] = i
+        return rid_of
+
+    def rows_of(fleet, rid_of):
+        res = fleet.results
+        out = {}
+        for rid, i in rid_of.items():
+            v = res.get(rid)
+            if v is not None and not isinstance(v, RequestFailure):
+                out[i] = np.asarray(v)
+        return out
+
+    # -- clean arm (also the warm-up: compiles land here) --
+    for e in list(by_name.values()):
+        e.reset()
+    clean_fleet = Fleet([PrefillWorker(e) for e in pf],
+                        [DecodeWorker(e) for e in dc])
+    rid_of = submit_all(clean_fleet)
+    for _ in range(4):
+        clean_fleet.tick()
+    submit_rest(clean_fleet, rid_of)
+    t0 = time.perf_counter()
+    clean_fleet.run_until_idle(max_ticks=600)
+    clean_dt = time.perf_counter() - t0
+    clean_rows = rows_of(clean_fleet, rid_of)
+    del clean_fleet
+
+    # -- crashed arm --
+    d = tempfile.mkdtemp(prefix="pt-recovery-bench-")
+    try:
+        for e in list(by_name.values()):
+            e.reset()
+        fleet = Fleet([PrefillWorker(e) for e in pf],
+                      [DecodeWorker(e) for e in dc], durability=d)
+        rid_of2 = submit_all(fleet)
+        for _ in range(4):
+            fleet.tick()
+        t0 = time.perf_counter()
+        fleet.checkpoint()
+        ckpt_dt = time.perf_counter() - t0
+        submit_rest(fleet, rid_of2)
+        for _ in range(2):
+            fleet.tick()
+        journal_appends = fleet._journal.appends
+        del fleet                       # CRASH: only the dir survives
+        for e in list(by_name.values()):
+            e.reset()
+        t0 = time.perf_counter()
+        fleet2 = Fleet.recover(
+            d, engine_factory=lambda role, name: by_name[name])
+        recover_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fleet2.run_until_idle(max_ticks=600)
+        drain_dt = time.perf_counter() - t0
+        crashed_rows = rows_of(fleet2, rid_of2)
+        lr = dict(fleet2.last_recovery)
+        leaks = 0
+        for w in list(fleet2.prefill) + list(fleet2.decode):
+            if hasattr(w.engine, "manager"):
+                leaks += len(w.engine.manager._ref)
+        compiles = max((dw.engine.decode_compile_count()
+                        for dw in fleet2.decode), default=1)
+        del fleet2
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    identical = (sorted(clean_rows) == sorted(crashed_rows)
+                 and all(np.array_equal(clean_rows[i], crashed_rows[i])
+                         for i in clean_rows))
+    return {
+        "serving_recovery_requests": int(requests),
+        "serving_recovery_bit_identical": bool(identical),
+        "serving_recovery_completed": len(crashed_rows),
+        "serving_recovery_journal_appends": int(journal_appends),
+        "serving_recovery_journal_replayed": int(lr["replayed"]),
+        "serving_recovery_redriven": int(lr["redriven"]),
+        "serving_recovery_torn_tail": bool(lr["torn_tail"]),
+        "serving_recovery_checkpoint_wall_s": round(ckpt_dt, 4),
+        "serving_recovery_recover_wall_s": round(recover_dt, 4),
+        "serving_recovery_drain_wall_s": round(drain_dt, 4),
+        "serving_recovery_clean_wall_s": round(clean_dt, 4),
+        "serving_recovery_decode_compiles": int(compiles),
+        "serving_recovery_leaks": int(leaks),
     }

@@ -201,6 +201,43 @@ class BlockManager:
         self._note_pool()
         return out
 
+    def eviction_victims(self, n: int) -> List[int]:
+        """Non-mutating preview of the next ``n`` blocks
+        :meth:`evict_cached` would pick, in eviction order — the spill
+        tier reads this to copy exactly the chains about to die,
+        WITHOUT perturbing hit counts or LRU order (a perturbed
+        preview would desynchronize from the real eviction)."""
+        scored = sorted(((self._hits.get(b, 0), pos, b)
+                         for pos, b in enumerate(self._cached)))
+        return [b for _, _, b in scored[:n]]
+
+    def chain_tokens_map(self) -> Dict[bytes, Tuple[int, ...]]:
+        """Reconstruct full chain tokens for every registered digest
+        that is reachable from the root: ``{digest: tokens of the
+        whole chain ending at it}``. The index stores only per-block
+        chunks; this stitches them depth-by-depth by re-deriving each
+        digest from its candidate parent — stateless, so the snapshot
+        format never changes. A chain whose head was evicted is
+        unreachable and simply omitted (it could not be re-matched or
+        spilled anyway)."""
+        by_depth: Dict[int, List[Tuple[bytes, Tuple[int, ...]]]] = {}
+        for d, (_bid, chunk) in self._index.items():
+            by_depth.setdefault(self._depth.get(d, 0), []).append(
+                (d, chunk))
+        toks: Dict[bytes, Tuple[int, ...]] = {}
+        for d, chunk in by_depth.get(1, ()):
+            if self.hash_fn(b"", chunk) == d:
+                toks[d] = tuple(chunk)
+        for k in sorted(x for x in by_depth if x > 1):
+            prev = [(pd, pt) for pd, pt in toks.items()
+                    if self._depth.get(pd) == k - 1]
+            for d, chunk in by_depth[k]:
+                for pd, pt in prev:
+                    if self.hash_fn(pd, chunk) == d:
+                        toks[d] = pt + tuple(chunk)
+                        break
+        return toks
+
     def evict_cached(self, n: int) -> int:
         """Evict up to ``n`` retained registered blocks back to the
         free list (the fleet's watermark eviction tier drives this),

@@ -50,6 +50,10 @@ fleet.scale            Fleet add/drain/remove decode  scale action fails
 transport.partial_write SocketTransport frame write   torn TCP write
 transport.corrupt      SocketTransport frame write    flipped wire byte
 transport.disconnect   SocketTransport ack wait       ack loss/conn drop
+journal.write          WriteAheadJournal.append       journal IO error
+journal.torn_tail      WriteAheadJournal.append       crash mid-append
+checkpoint.commit      durability.write_manifest      die before commit
+spill.read             PrefixSpillStore.read          spill file unread
 ====================== ============================== ==================
 """
 from __future__ import annotations

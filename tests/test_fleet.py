@@ -583,7 +583,12 @@ class TestMigrationAndScale:
         with pytest.raises(ValueError, match="last routable"):
             fleet.drain_prefill_worker(0)
 
-    def test_prefill_snapshot_refused_with_pending_outbox(self, setup):
+    def test_prefill_snapshot_serializes_pending_outbox(self, setup):
+        """PR 20 LIFTED the un-shipped-handoff refusal: a snapshot
+        taken with a parked outbox serializes every pending handoff —
+        rng key, in-hand token, prompt — so a coordinated fleet
+        checkpoint can land at ANY tick boundary (the whole-fleet
+        crash-recovery suite pins the full round trip)."""
         model, cfg, pf, dc, *_ = setup
         _reset(pf[0], dc[0])
         w = PrefillWorker(pf[0])
@@ -592,9 +597,13 @@ class TestMigrationAndScale:
         for _ in range(4):
             w.tick()
         assert pf[0]._outbox
-        with pytest.raises(RuntimeError, match="un-shipped"):
-            pf[0].snapshot_state()
+        meta, arrays = pf[0].snapshot_state()   # no longer refused
         (ph,) = pf[0].take_handoffs()
+        (e,) = meta["outbox"]
+        assert e["tok0"] == ph.tok0
+        assert np.array_equal(arrays["ob0_key"], np.asarray(ph.key))
+        assert np.array_equal(arrays["ob0_prompt"],
+                              np.asarray(ph.prompt, np.int32))
         pf[0].release_handoff(ph)
         pf[0].manager.assert_consistent()
 

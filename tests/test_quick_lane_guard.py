@@ -28,7 +28,8 @@ GUARDED_FILES = ["tests/test_serving_paged.py", "tests/test_serving.py",
                  "tests/test_frontend.py", "tests/test_fleet.py",
                  "tests/test_fleet_failover.py",
                  "tests/test_prefix_cache.py",
-                 "tests/test_autoscaler.py"]
+                 "tests/test_autoscaler.py",
+                 "tests/test_durability.py"]
 
 REQUIRED_NODES = [
     "test_serving_paged.py::TestPagedBitExactness::"
@@ -249,6 +250,16 @@ REQUIRED_NODES = [
     "test_scale_action_retries_under_faults",
     "test_autoscaler.py::TestAutoscaleKillBurst::test_paged",
     "test_autoscaler.py::TestAutoscaleKillBurst::test_paged_kv_int8",
+    "test_durability.py::TestJournal::"
+    "test_torn_tail_truncated_loudly",
+    "test_durability.py::TestWholeFleetRecovery::"
+    "test_paged_recover_bit_identical_greedy_and_sampled",
+    "test_durability.py::TestWholeFleetRecovery::"
+    "test_kv_int8_recover_bit_identical",
+    "test_durability.py::TestWholeFleetRecovery::"
+    "test_torn_tail_recovery_is_loud_and_bit_identical",
+    "test_durability.py::TestSpillTier::"
+    "test_watermark_eviction_spills_then_spill_hit",
 ]
 
 

@@ -44,3 +44,155 @@ print(f"chaos soak OK: seed={seed} kills={out['soak_kills']} "
       f"completed={out['soak_completed']} failed={out['soak_failed']} "
       f"redrives={out['soak_redrives']} leaks={out['soak_leaks']}")
 PY
+
+# ---------------------------------------------------------------------------
+# Whole-fleet crash soak: a REAL SIGKILL mid-burst, then recovery in a
+# fresh process from the durability directory alone.
+#
+# Phase 1 drives a durable paged fleet (write-ahead journal armed, one
+# coordinated checkpoint mid-traffic) and SIGKILLs ITSELF at a seeded
+# tick with streams queued, mid-chunked-prefill, shipped-in-transit and
+# adopted-and-decoding. Phase 2 is a fresh interpreter: Fleet.recover
+# from the surviving directory, run to idle, and assert
+#   - every journaled request completed OR ended in an explicit
+#     RequestFailure (none vanished in the crash)
+#   - every completed greedy row bit-identical to generate(), every
+#     seeded-sampled row bit-identical to generate(do_sample=True,...)
+#     — the prompts/kw come from the durable records themselves
+#   - exactly one terminal per request across pre/post-crash state
+#   - zero block leaks, decode compile counts still 1
+# ---------------------------------------------------------------------------
+
+DUR_DIR="$(mktemp -d /tmp/pt-chaos-recover.XXXXXX)"
+trap 'rm -rf "$DUR_DIR"' EXIT
+
+echo "whole-fleet crash soak: durability dir $DUR_DIR"
+set +e
+JAX_PLATFORMS=cpu PT_CHAOS_DUR_DIR="$DUR_DIR" \
+    python - "$SEED" "$REQUESTS" <<'PY'
+import os
+import signal
+import sys
+
+import numpy as np
+import jax
+jax.config.update("jax_enable_compilation_cache", False)
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import (ContinuousBatchingEngine, DecodeWorker,
+                                Fleet, PrefillPagedEngine,
+                                PrefillWorker)
+
+seed, requests = (int(a) for a in sys.argv[1:3])
+paddle.seed(0)
+cfg = llama_tiny_config(tensor_parallel=False)
+model = LlamaForCausalLM(cfg)
+kw = dict(num_slots=2, max_len=64, decode_block=4, block_size=8,
+          prefill_chunk=8)
+fleet = Fleet(
+    [PrefillWorker(PrefillPagedEngine(model, **kw)) for _ in range(2)],
+    [DecodeWorker(ContinuousBatchingEngine(model, paged=True, **kw))
+     for _ in range(2)],
+    durability=os.environ["PT_CHAOS_DUR_DIR"])
+
+rs = np.random.RandomState(seed)
+lens = rs.randint(5, 18, size=requests)
+prompts = [rs.randint(0, cfg.vocab_size, (int(L),)).astype(np.int32)
+           for L in lens]
+kill_tick = int(rs.randint(4, 8))
+for i, p in enumerate(prompts[: requests // 2]):
+    skw = {} if i % 3 else {"temperature": 0.9, "top_k": 40,
+                            "seed": 11 + i}
+    fleet.submit(p, max_new_tokens=10, **skw)
+for _ in range(3):
+    fleet.tick()
+fleet.checkpoint()
+for i, p in enumerate(prompts[requests // 2:], start=requests // 2):
+    skw = {} if i % 3 else {"temperature": 0.9, "top_k": 40,
+                            "seed": 11 + i}
+    fleet.submit(p, max_new_tokens=10, **skw)
+for t in range(kill_tick):
+    fleet.tick()
+print(f"phase 1: SIGKILL at tick {fleet._clock} "
+      f"(kill_tick={kill_tick})", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)      # the crash is REAL
+raise SystemExit("unreachable")
+PY
+rc=$?
+set -e
+if [ "$rc" -eq 0 ]; then
+    echo "phase 1 exited cleanly — the SIGKILL never fired" >&2
+    exit 1
+fi
+echo "phase 1 died rc=$rc (expected); recovering in a fresh process"
+
+JAX_PLATFORMS=cpu PT_CHAOS_DUR_DIR="$DUR_DIR" python - <<'PY'
+import os
+
+import numpy as np
+import jax
+jax.config.update("jax_enable_compilation_cache", False)
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.serving import (ContinuousBatchingEngine, DecodeWorker,
+                                Fleet, PrefillPagedEngine,
+                                PrefillWorker, RequestFailure)
+
+paddle.seed(0)
+cfg = llama_tiny_config(tensor_parallel=False)
+model = LlamaForCausalLM(cfg)
+kw = dict(num_slots=2, max_len=64, decode_block=4, block_size=8,
+          prefill_chunk=8)
+
+
+def make(role, name):
+    if role == "prefill":
+        return PrefillPagedEngine(model, **kw)
+    return ContinuousBatchingEngine(model, paged=True, **kw)
+
+
+fleet = Fleet.recover(os.environ["PT_CHAOS_DUR_DIR"],
+                      engine_factory=make)
+print(f"recovered: {fleet.last_recovery}")
+fleet.run_until_idle(max_ticks=600)
+res = fleet.results
+assert fleet._requests, "no journaled requests survived the crash"
+completed = failed = 0
+for rid, rec in sorted(fleet._requests.items()):
+    v = res.get(rid)
+    assert v is not None, f"rid {rid} vanished in the crash"
+    if isinstance(v, RequestFailure):
+        failed += 1
+        continue
+    completed += 1
+    rkw = dict(rec["kw"])
+    mn = rkw.pop("max_new_tokens")
+    if rkw.get("temperature", 0.0) > 0.0:
+        ref = model.generate(paddle.to_tensor(
+            np.asarray(rec["prompt"], np.int32)[None, :]),
+            max_new_tokens=mn, do_sample=True, **rkw).numpy()[0]
+    else:
+        ref = model.generate(paddle.to_tensor(
+            np.asarray(rec["prompt"], np.int32)[None, :]),
+            max_new_tokens=mn).numpy()[0]
+    assert np.array_equal(np.asarray(v), ref), \
+        f"rid {rid} diverged through the crash"
+    owners = sum(1 for w in fleet.prefill + fleet.decode
+                 if rid in w.server.results) \
+        + int(rid in fleet._local_results) + int(rid in fleet._failures)
+    assert owners == 1, f"rid {rid}: {owners} terminals"
+for w in fleet.prefill + fleet.decode:
+    assert all(s is None for s in w.engine._slots), w.name
+    if hasattr(w.engine, "manager"):
+        assert not w.engine.manager._ref, f"block leak on {w.name}"
+        w.engine.manager.assert_consistent()
+for d in fleet.decode:
+    assert d.engine.decode_compile_count() == 1, \
+        "recovery recompiled the decode block"
+print(f"whole-fleet crash soak OK: replayed="
+      f"{fleet.last_recovery['replayed']} "
+      f"redriven={fleet.last_recovery['redriven']} "
+      f"completed={completed} failed={failed}")
+PY
